@@ -1,0 +1,14 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so every
+sharding/parallelism test runs without TPU hardware (the tony-mini idea from
+the reference test strategy — SURVEY.md §4 — applied to devices)."""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,98 @@
+"""Config-system tests, including the key⟷defaults-file parity test — the
+analogue of the reference's TestTonyConfigurationFields.java:11-62 which
+forces TonyConfigurationKeys and tony-default.xml to stay in sync in both
+directions, including default values."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import TonyConfiguration, keys, load_job_config
+
+DEFAULTS_FILE = (
+    Path(__file__).resolve().parents[1]
+    / "tony_tpu" / "conf" / constants.TONY_DEFAULT_CONF
+)
+
+
+def _expected_defaults() -> dict:
+    d = dict(keys.DEFAULTS)
+    for job in ("worker", "ps"):
+        d[keys.instances_key(job)] = keys.default_instances(job)
+        d[keys.memory_key(job)] = keys.DEFAULT_MEMORY
+        d[keys.vcores_key(job)] = keys.DEFAULT_VCORES
+        d[keys.gpus_key(job)] = keys.DEFAULT_GPUS
+        d[keys.tpus_key(job)] = keys.DEFAULT_TPUS
+    return d
+
+
+def test_config_parity():
+    shipped = json.loads(DEFAULTS_FILE.read_text())
+    expected = _expected_defaults()
+    missing = set(expected) - set(shipped)
+    extra = set(shipped) - set(expected)
+    assert not missing, f"keys declared in keys.py but absent from defaults file: {missing}"
+    assert not extra, f"keys in defaults file not declared in keys.py: {extra}"
+    for k, v in expected.items():
+        assert shipped[k] == v, f"default mismatch for {k}: {shipped[k]!r} != {v!r}"
+
+
+def test_every_key_constant_has_a_default():
+    key_consts = {
+        v for n, v in vars(keys).items()
+        if n.startswith("K_") and isinstance(v, str)
+    }
+    assert key_consts == set(keys.DEFAULTS), (
+        "every K_* constant must have an entry in keys.DEFAULTS"
+    )
+
+
+def test_layering_order(tmp_path):
+    job = tmp_path / "tony.json"
+    job.write_text(json.dumps({keys.K_FRAMEWORK: "pytorch", "tony.worker.instances": 4}))
+    conf = load_job_config(conf_file=str(job), overrides=["tony.worker.instances=8"])
+    # default ⟵ job file ⟵ CLI override
+    assert conf.get_str(keys.K_FRAMEWORK) == "pytorch"
+    assert conf.get_int(keys.instances_key("worker")) == 8
+    assert conf.get_str(keys.K_AM_MEMORY) == "2g"  # untouched default
+
+
+def test_site_config_layer(tmp_path, monkeypatch):
+    site_dir = tmp_path / "confdir"
+    site_dir.mkdir()
+    (site_dir / constants.TONY_SITE_CONF).write_text(
+        json.dumps({keys.K_HISTORY_LOCATION: "/srv/hist"})
+    )
+    monkeypatch.setenv(constants.TONY_CONF_DIR_ENV, str(site_dir))
+    conf = TonyConfiguration()
+    assert conf.get_str(keys.K_HISTORY_LOCATION) == "/srv/hist"
+
+
+def test_freeze_thaw(tmp_path):
+    conf = TonyConfiguration()
+    conf.set("tony.evaluator.instances", 2)
+    final = tmp_path / constants.TONY_FINAL_CONF
+    conf.write_final(final)
+    thawed = TonyConfiguration.from_final(final)
+    assert thawed.to_dict() == conf.to_dict()
+
+
+def test_job_type_discovery():
+    conf = TonyConfiguration()
+    conf.set("tony.evaluator.instances", 1)
+    conf.set("tony.chief2.instances", 1)
+    assert set(conf.job_types()) >= {"worker", "ps", "evaluator", "chief2"}
+
+
+def test_bool_parsing():
+    conf = TonyConfiguration(load_defaults=False)
+    conf.set("a", "true")
+    conf.set("b", "0")
+    conf.set("c", "junk")
+    assert conf.get_bool("a") is True
+    assert conf.get_bool("b") is False
+    assert conf.get_bool("missing", True) is True
+    with pytest.raises(ValueError):
+        conf.get_bool("c")

@@ -1,0 +1,113 @@
+"""Utils tests — mirrors the reference's pure-unit tier
+(tony-core/src/test/.../TestUtils.java:26-131): memory parse, polling, zip,
+container-request parsing, TF_CONFIG construction, pytorch spec parse."""
+
+import json
+import zipfile
+
+import pytest
+
+from tony_tpu import utils
+from tony_tpu.conf import TonyConfiguration, keys
+
+
+def test_parse_memory_string_mb():
+    assert utils.parse_memory_string_mb("2g") == 2048
+    assert utils.parse_memory_string_mb("512m") == 512
+    assert utils.parse_memory_string_mb("1024") == 1024
+    assert utils.parse_memory_string_mb(256) == 256
+    assert utils.parse_memory_string_mb("1.5g") == 1536
+    with pytest.raises(ValueError):
+        utils.parse_memory_string_mb("")
+
+
+def test_poll_success_and_timeout():
+    calls = []
+
+    def eventually():
+        calls.append(1)
+        return len(calls) >= 3
+
+    assert utils.poll(eventually, interval_s=0.01, timeout_s=5) is True
+    assert utils.poll(lambda: False, interval_s=0.01, timeout_s=0.05) is False
+
+
+def test_poll_till_non_null():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return "spec" if len(calls) >= 2 else None
+
+    assert utils.poll_till_non_null(fn, interval_s=0.01, timeout_s=5) == "spec"
+
+
+def test_zip_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.py").write_text("print('a')")
+    (src / "sub" / "b.txt").write_text("b")
+    z = tmp_path / "tony.zip"
+    utils.zip_dir(src, z)
+    assert sorted(zipfile.ZipFile(z).namelist()) == ["a.py", "sub/b.txt"]
+    out = tmp_path / "out"
+    utils.unzip(z, out)
+    assert (out / "sub" / "b.txt").read_text() == "b"
+
+
+def test_parse_container_requests():
+    """Analogue of TestUtils.testParseContainerRequests (reference :55-78):
+    arbitrary job types via the instances regex, with resources."""
+    conf = TonyConfiguration()
+    conf.set(keys.instances_key("worker"), 3)
+    conf.set(keys.tpus_key("worker"), 8)
+    conf.set(keys.memory_key("worker"), "4g")
+    conf.set(keys.instances_key("evaluator"), 1)
+    conf.set(keys.resources_key("evaluator"), "disk=10g,fpga=1")
+    conf.set(keys.instances_key("ps"), 0)  # explicit zero → dropped
+    reqs = utils.parse_container_requests(conf)
+    assert set(reqs) == {"worker", "evaluator"}
+    w = reqs["worker"]
+    assert (w.num_instances, w.memory_mb, w.tpus) == (3, 4096, 8)
+    assert reqs["evaluator"].extra_resources == {"disk": "10g", "fpga": "1"}
+    # one distinct priority per job type (YARN-7631 workaround kept)
+    assert len({r.priority for r in reqs.values()}) == len(reqs)
+
+
+def test_construct_tf_config():
+    spec = {"worker": ["h1:1", "h2:2"], "ps": ["h3:3"]}
+    cfg = json.loads(utils.construct_tf_config(spec, "worker", 1))
+    assert cfg["cluster"]["ps"] == ["h3:3"]
+    assert cfg["task"] == {"type": "worker", "index": 1}
+
+
+def test_parse_cluster_spec_for_pytorch():
+    spec = {"worker": ["h1:29500", "h2:2"]}
+    assert utils.parse_cluster_spec_for_pytorch(spec) == "tcp://h1:29500"
+    with pytest.raises(ValueError):
+        utils.parse_cluster_spec_for_pytorch({"ps": ["h:1"]})
+
+
+def test_flatten_cluster_spec_chief_is_process_zero():
+    # process 0 must be the chief job's task 0, because jax.distributed
+    # starts the coordinator on process 0 and we advertise the chief's
+    # address as coordinator_address — even when the chief job type sorts
+    # after others alphabetically (e.g. ps < worker).
+    spec = {"ps": ["p0"], "worker": ["w0", "w1"]}
+    flat = utils.flatten_cluster_spec(spec, chief_name="worker")
+    assert flat[0] == ("worker", 0, "w0")
+    assert utils.coordinator_address_from_spec(spec, "worker") == "w0"
+    assert flat == [("worker", 0, "w0"), ("worker", 1, "w1"), ("ps", 0, "p0")]
+
+
+def test_execute_shell_env_and_timeout(tmp_path):
+    marker = tmp_path / "env.txt"
+    rc = utils.execute_shell(f'echo -n "$MY_VAR" > {marker}', extra_env={"MY_VAR": "x1"})
+    assert rc == 0 and marker.read_text() == "x1"
+    assert utils.execute_shell("exit 3") == 3
+    assert utils.execute_shell("sleep 5", timeout_ms=200) == 124
+
+
+def test_parse_key_values():
+    assert utils.parse_key_values("a=1, b=2,,c=") == {"a": "1", "b": "2", "c": ""}
+    assert utils.parse_key_values("") == {}

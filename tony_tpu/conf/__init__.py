@@ -1,0 +1,4 @@
+from tony_tpu.conf.configuration import TonyConfiguration, load_job_config
+from tony_tpu.conf import keys
+
+__all__ = ["TonyConfiguration", "load_job_config", "keys"]

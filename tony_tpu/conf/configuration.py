@@ -1,0 +1,155 @@
+"""Layered configuration — the analogue of Hadoop ``Configuration`` as used
+by the reference (TonyClient.initTonyConf, TonyClient.java:347-363).
+
+Layering order (later layers win), matching the reference:
+
+    tony-default.json  (shipped resource)
+  ⟵ $TONY_CONF_DIR/tony-site.json   (cluster admin)
+  ⟵ tony.json / --conf_file         (per-job file)
+  ⟵ --conf k=v CLI overrides
+
+The fully-resolved config is frozen to ``tony-final.json`` and shipped to
+every process (coordinator + executors), which re-read it instead of
+re-layering (TonyApplicationMaster.java:200, TaskExecutor.java:164).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any, Iterator, Mapping
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+
+_RESOURCE_DIR = Path(__file__).resolve().parent
+
+_TRUE_STRINGS = frozenset({"true", "1", "yes", "on"})
+_FALSE_STRINGS = frozenset({"false", "0", "no", "off"})
+
+
+class TonyConfiguration:
+    """A string-keyed config map with typed accessors and JSON layering."""
+
+    def __init__(self, load_defaults: bool = True) -> None:
+        self._props: dict[str, Any] = {}
+        if load_defaults:
+            self.add_resource(_RESOURCE_DIR / constants.TONY_DEFAULT_CONF)
+            site_dir = os.environ.get(constants.TONY_CONF_DIR_ENV)
+            if site_dir:
+                site = Path(site_dir) / constants.TONY_SITE_CONF
+                if site.is_file():
+                    self.add_resource(site)
+
+    # -- layering ----------------------------------------------------------
+    def add_resource(self, path: str | os.PathLike[str]) -> "TonyConfiguration":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"config resource {path} must be a JSON object")
+        self._props.update(data)
+        return self
+
+    def set_all(self, overrides: Mapping[str, Any]) -> "TonyConfiguration":
+        self._props.update(overrides)
+        return self
+
+    def set_kv_list(self, kvs: list[str]) -> "TonyConfiguration":
+        """Apply ``--conf k=v`` style overrides."""
+        for kv in kvs:
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"--conf expects key=value, got {kv!r}")
+            self._props[k.strip()] = v.strip()
+        return self
+
+    # -- accessors ---------------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._props.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._props
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._props)
+
+    def items(self):
+        return self._props.items()
+
+    def set(self, key: str, value: Any) -> None:
+        self._props[key] = value
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._props.get(key)
+        if v is None or v == "":
+            return default
+        return int(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._props.get(key)
+        if v is None or v == "":
+            return default
+        if isinstance(v, bool):
+            return v
+        s = str(v).strip().lower()
+        if s in _TRUE_STRINGS:
+            return True
+        if s in _FALSE_STRINGS:
+            return False
+        raise ValueError(f"not a boolean: {key}={v!r}")
+
+    def get_str(self, key: str, default: str = "") -> str:
+        v = self._props.get(key)
+        return default if v is None else str(v)
+
+    # -- job-type families -------------------------------------------------
+    def job_types(self) -> list[str]:
+        """Discover configured job types via the instances regex
+        (TonyConfigurationKeys.java:119; Utils.parseContainerRequests:288-314)."""
+        pat = re.compile(keys.INSTANCES_REGEX)
+        names = []
+        for k in self._props:
+            m = pat.fullmatch(k)
+            if m:
+                names.append(m.group(1))
+        return sorted(names)
+
+    # -- freeze / thaw -----------------------------------------------------
+    def write_final(self, path: str | os.PathLike[str]) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._props, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, p)
+
+    @classmethod
+    def from_final(cls, path: str | os.PathLike[str]) -> "TonyConfiguration":
+        conf = cls(load_defaults=False)
+        conf.add_resource(path)
+        return conf
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._props)
+
+
+def load_job_config(
+    conf_file: str | None = None,
+    overrides: list[str] | None = None,
+    cwd: str | os.PathLike[str] | None = None,
+) -> TonyConfiguration:
+    """Full client-side layering (TonyClient.initTonyConf:347-363)."""
+    conf = TonyConfiguration()
+    job_file = conf_file
+    if job_file is None:
+        candidate = Path(cwd or os.getcwd()) / constants.TONY_JOB_CONF
+        if candidate.is_file():
+            job_file = str(candidate)
+    if job_file:
+        conf.add_resource(job_file)
+    if overrides:
+        conf.set_kv_list(overrides)
+    return conf

@@ -1,0 +1,173 @@
+"""All ``tony.*`` configuration keys and their defaults.
+
+TPU-native analogue of the reference's ``TonyConfigurationKeys.java``
+(tony-core/src/main/java/com/linkedin/tony/TonyConfigurationKeys.java:1-179).
+Differences from the reference, by design:
+
+* resources are TPU-first: every job type gets a ``tony.<job>.tpus`` family
+  beside memory/vcores (the reference only had ``gpus``); the scheduler maps
+  ``instances × tpus`` onto legal slice topologies (``tony.tpu.topology``).
+* storage keys are generic URIs (local dir or ``gs://``) instead of HDFS.
+* the framework switch gains a ``jax`` value (reference: tensorflow|pytorch,
+  TonyConfigurationKeys.java:74-75).
+
+Every ``K_*`` constant here must appear in ``tony-default.json`` with the
+matching default, and vice versa — enforced both directions by
+``tests/test_conf.py::test_config_parity`` (the analogue of the reference's
+``TestTonyConfigurationFields.java:11-62``).
+"""
+
+from __future__ import annotations
+
+TONY_PREFIX = "tony."
+
+# --- application ----------------------------------------------------------
+APPLICATION_PREFIX = TONY_PREFIX + "application."
+K_APPLICATION_NAME = APPLICATION_PREFIX + "name"
+K_FRAMEWORK = APPLICATION_PREFIX + "framework"           # jax | tensorflow | pytorch
+K_IS_SINGLE_NODE = APPLICATION_PREFIX + "single-node"
+K_ENABLE_PREPROCESS = APPLICATION_PREFIX + "enable-preprocess"
+K_APPLICATION_TIMEOUT = APPLICATION_PREFIX + "timeout"   # ms, 0 = none
+K_CLIENT_CONNECT_RETRIES = APPLICATION_PREFIX + "num-client-coordinator-connect-retries"
+K_SECURITY_ENABLED = APPLICATION_PREFIX + "security.enabled"
+K_NODE_LABEL = APPLICATION_PREFIX + "node-label"
+K_DOCKER_ENABLED = APPLICATION_PREFIX + "docker.enabled"
+K_DOCKER_IMAGE = APPLICATION_PREFIX + "docker.image"
+
+# --- task (executor) ------------------------------------------------------
+TASK_PREFIX = TONY_PREFIX + "task."
+K_TASK_HEARTBEAT_INTERVAL_MS = TASK_PREFIX + "heartbeat-interval"
+K_TASK_MAX_MISSED_HEARTBEATS = TASK_PREFIX + "max-missed-heartbeats"
+K_TASK_REGISTRATION_TIMEOUT_MS = TASK_PREFIX + "registration-timeout"
+K_TASK_REGISTRATION_RETRY_MS = TASK_PREFIX + "registration-retry-interval"
+
+# --- coordinator (AM analogue) --------------------------------------------
+AM_PREFIX = TONY_PREFIX + "am."
+K_AM_RETRY_COUNT = AM_PREFIX + "retry-count"
+K_AM_MEMORY = AM_PREFIX + "memory"
+K_AM_VCORES = AM_PREFIX + "vcores"
+K_AM_GPUS = AM_PREFIX + "gpus"
+K_AM_MONITOR_INTERVAL_MS = AM_PREFIX + "monitor-interval"
+K_AM_RPC_PORT_RANGE = AM_PREFIX + "rpc-port-range"       # "10000-15000"
+K_AM_STOP_GRACE_MS = AM_PREFIX + "stop-grace"            # wait for client finish signal
+
+# --- chief semantics (TonyConfigurationKeys.java:159-163) ------------------
+CHIEF_PREFIX = TONY_PREFIX + "chief."
+K_CHIEF_NAME = CHIEF_PREFIX + "name"
+K_CHIEF_INDEX = CHIEF_PREFIX + "index"
+
+# --- worker ---------------------------------------------------------------
+WORKER_PREFIX = TONY_PREFIX + "worker."
+K_WORKER_TIMEOUT = WORKER_PREFIX + "timeout"
+
+# --- TPU resource model (new) ---------------------------------------------
+TPU_PREFIX = TONY_PREFIX + "tpu."
+K_TPU_TOPOLOGY = TPU_PREFIX + "topology"                 # e.g. "v5e-8", "" = auto
+K_TPU_ACCELERATOR_TYPE = TPU_PREFIX + "accelerator-type" # e.g. "v5litepod-8"
+K_TPU_SLICE_STRICT = TPU_PREFIX + "strict-slice-shapes"  # reject illegal topologies
+
+# --- storage / staging -----------------------------------------------------
+K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
+K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
+K_OTHER_NAMENODES = TONY_PREFIX + "other.namenodes"      # extra filesystems to token
+
+# --- history server --------------------------------------------------------
+K_HTTP_PORT = TONY_PREFIX + "http.port"                  # "disabled" or int
+K_HTTPS_PORT = TONY_PREFIX + "https.port"
+K_SECRET_KEY = TONY_PREFIX + "secret.key"
+
+# --- client ---------------------------------------------------------------
+K_YARN_QUEUE = TONY_PREFIX + "yarn.queue"                # kept for conf parity
+K_CLIENT_MONITOR_INTERVAL_MS = TONY_PREFIX + "client.monitor-interval"
+
+# --- profiler / tensorboard seam ------------------------------------------
+K_PROFILER_ENABLED = TONY_PREFIX + "profiler.enabled"
+K_TENSORBOARD_ENABLED = TONY_PREFIX + "tensorboard.enabled"
+
+# --- version info (gradle/version-info.gradle analogue) --------------------
+VERSION_INFO_PREFIX = TONY_PREFIX + "version-info."
+K_VERSION_INFO_VERSION = VERSION_INFO_PREFIX + "version"
+
+DEFAULTS: dict[str, object] = {
+    K_APPLICATION_NAME: "TonyTpuApplication",
+    K_FRAMEWORK: "jax",
+    K_IS_SINGLE_NODE: False,
+    K_ENABLE_PREPROCESS: False,
+    K_APPLICATION_TIMEOUT: 0,
+    K_CLIENT_CONNECT_RETRIES: 3,
+    K_SECURITY_ENABLED: False,
+    K_NODE_LABEL: "",
+    K_DOCKER_ENABLED: False,
+    K_DOCKER_IMAGE: "",
+    K_TASK_HEARTBEAT_INTERVAL_MS: 1000,
+    K_TASK_MAX_MISSED_HEARTBEATS: 25,
+    K_TASK_REGISTRATION_TIMEOUT_MS: 0,
+    K_TASK_REGISTRATION_RETRY_MS: 500,
+    K_AM_RETRY_COUNT: 0,
+    K_AM_MEMORY: "2g",
+    K_AM_VCORES: 1,
+    K_AM_GPUS: 0,
+    K_AM_MONITOR_INTERVAL_MS: 200,
+    K_AM_RPC_PORT_RANGE: "10000-15000",
+    K_AM_STOP_GRACE_MS: 30000,
+    K_CHIEF_NAME: "worker",
+    K_CHIEF_INDEX: "0",
+    K_WORKER_TIMEOUT: 0,
+    K_TPU_TOPOLOGY: "",
+    K_TPU_ACCELERATOR_TYPE: "",
+    K_TPU_SLICE_STRICT: False,
+    K_STAGING_LOCATION: "",
+    K_HISTORY_LOCATION: "",
+    K_OTHER_NAMENODES: "",
+    K_HTTP_PORT: "disabled",
+    K_HTTPS_PORT: 19886,
+    K_SECRET_KEY: "dev",
+    K_YARN_QUEUE: "default",
+    K_CLIENT_MONITOR_INTERVAL_MS: 1000,
+    K_PROFILER_ENABLED: False,
+    K_TENSORBOARD_ENABLED: True,
+    K_VERSION_INFO_VERSION: "",
+}
+
+# --- dynamic per-job-type key families -------------------------------------
+# Analogue of TonyConfigurationKeys.getInstancesKey/... (:124-151) and the
+# discovery regex ``tony\.([a-z]+)\.instances`` (:119).
+INSTANCES_REGEX = r"tony\.([a-z][a-z0-9_]*)\.instances$"
+DEFAULT_MEMORY = "2g"
+DEFAULT_VCORES = 1
+DEFAULT_GPUS = 0
+DEFAULT_TPUS = 0
+
+
+def instances_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.instances"
+
+
+def memory_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.memory"
+
+
+def vcores_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.vcores"
+
+
+def gpus_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.gpus"
+
+
+def tpus_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.tpus"
+
+
+def resources_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.resources"
+
+
+def env_key(job_name: str) -> str:
+    return f"{TONY_PREFIX}{job_name}.env"
+
+
+def default_instances(job_name: str) -> int:
+    """ps/worker default to 1 instance, everything else 0
+    (TonyConfigurationKeys.getDefaultInstances:128-136)."""
+    return 1 if job_name in ("ps", "worker") else 0

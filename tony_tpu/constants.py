@@ -1,0 +1,85 @@
+"""Well-known names: environment variables, file names, job/task names.
+
+TPU-native analogue of the reference's ``Constants.java``
+(tony-core/src/main/java/com/linkedin/tony/Constants.java:1-92).  The
+TF/PyTorch env names are kept byte-identical so that unmodified reference
+training scripts keep working; the JAX block is new (the reference has no
+JAX runtime).
+"""
+
+# ---------------------------------------------------------------------------
+# Framework env contract: TensorFlow (Constants.java TF block)
+# ---------------------------------------------------------------------------
+TF_CONFIG = "TF_CONFIG"
+CLUSTER_SPEC = "CLUSTER_SPEC"
+
+# ---------------------------------------------------------------------------
+# Framework env contract: PyTorch (Constants.java:25-28)
+# ---------------------------------------------------------------------------
+RANK = "RANK"
+WORLD = "WORLD"
+WORLD_SIZE = "WORLD_SIZE"
+INIT_METHOD = "INIT_METHOD"
+MASTER_ADDR = "MASTER_ADDR"
+MASTER_PORT = "MASTER_PORT"
+
+# ---------------------------------------------------------------------------
+# Framework env contract: JAX (new — the TPU-native runtime).
+# JAX_COORDINATOR_ADDRESS is read natively by jax.distributed.initialize()
+# (jax/_src/distributed.py:77); process id/count have no native env fallback,
+# so we export TONY_* names and provide tony_tpu.runtime.initialize().
+# ---------------------------------------------------------------------------
+JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+TONY_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
+TONY_NUM_PROCESSES = "TONY_NUM_PROCESSES"
+TONY_PROCESS_ID = "TONY_PROCESS_ID"
+JAX_LOCAL_DEVICE_IDS = "JAX_LOCAL_DEVICE_IDS"
+TONY_SLICE_TOPOLOGY = "TONY_SLICE_TOPOLOGY"
+TONY_MESH_SHAPE = "TONY_MESH_SHAPE"
+
+# ---------------------------------------------------------------------------
+# Task identity env (Constants.java JOB_NAME/TASK_INDEX/TASK_NUM/SESSION_ID)
+# ---------------------------------------------------------------------------
+JOB_NAME = "JOB_NAME"
+TASK_INDEX = "TASK_INDEX"
+TASK_NUM = "TASK_NUM"
+SESSION_ID = "SESSION_ID"
+TB_PORT = "TB_PORT"
+PROFILER_PORT = "PROFILER_PORT"
+
+# Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
+TONY_AM_ADDRESS = "TONY_AM_ADDRESS"
+TONY_TASK_COMMAND = "TONY_TASK_COMMAND"
+TONY_CONF_PATH = "TONY_CONF_PATH"
+
+# ---------------------------------------------------------------------------
+# File names (Constants.java tony.zip / tony-final.xml)
+# ---------------------------------------------------------------------------
+TONY_ARCHIVE = "tony.zip"
+TONY_FINAL_CONF = "tony-final.json"
+TONY_DEFAULT_CONF = "tony-default.json"
+TONY_SITE_CONF = "tony-site.json"
+TONY_JOB_CONF = "tony.json"
+TONY_STAGING_DIR = ".tony"
+TONY_CONF_DIR_ENV = "TONY_CONF_DIR"
+
+# ---------------------------------------------------------------------------
+# Job / task names
+# ---------------------------------------------------------------------------
+WORKER_JOB_NAME = "worker"
+PS_JOB_NAME = "ps"
+CHIEF_JOB_NAME = "chief"
+EVALUATOR_JOB_NAME = "evaluator"
+NOTEBOOK_JOB_NAME = "notebook"
+DRIVER_JOB_NAME = "driver"
+AM_NAME = "am"
+
+# ---------------------------------------------------------------------------
+# Test / fault-injection env flags (Constants.java:69-74).  Each one is read
+# at a single well-defined point; see tests/test_fault_injection.py.
+# ---------------------------------------------------------------------------
+TEST_AM_CRASH = "TEST_AM_CRASH"                          # coordinator exits on purpose
+TEST_WORKER_TERMINATION = "TEST_WORKER_TERMINATION"      # coordinator kills workers when chief registers
+TEST_TASK_EXECUTOR_HANG = "TEST_TASK_EXECUTOR_HANG"      # executor sleeps then dies
+TEST_TASK_EXECUTOR_NUM_HB_MISS = "TEST_TASK_EXECUTOR_NUM_HB_MISS"  # heartbeater skips N pings
+TEST_TASK_EXECUTOR_SKEW = "TEST_TASK_EXECUTOR_SKEW"      # "job#idx#ms" straggler simulation

@@ -1,0 +1,247 @@
+"""Shared helpers — the analogue of the reference's ``util/Utils.java``
+(tony-core/src/main/java/com/linkedin/tony/util/Utils.java:1-454):
+polling, memory-string parsing, zip/unzip, shell execution with injected env,
+conf→container-request parsing, and the per-framework cluster-spec builders.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import socket
+import subprocess
+import time
+import zipfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Mapping, Sequence, TypeVar
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# Polling (Utils.poll/pollTillNonNull:67-121)
+# ---------------------------------------------------------------------------
+def poll(
+    fn: Callable[[], bool], interval_s: float = 0.1, timeout_s: float | None = None
+) -> bool:
+    """Poll ``fn`` until it returns True or timeout expires. ``timeout_s=None``
+    polls forever (the reference's pollTillNonNull with 0 timeout)."""
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        if fn():
+            return True
+        if deadline is not None and time.monotonic() >= deadline:
+            return False
+        time.sleep(interval_s)
+
+
+def poll_till_non_null(
+    fn: Callable[[], T | None], interval_s: float = 0.1, timeout_s: float | None = None
+) -> T | None:
+    result: list[T | None] = [None]
+
+    def check() -> bool:
+        result[0] = fn()
+        return result[0] is not None
+
+    poll(check, interval_s, timeout_s)
+    return result[0]
+
+
+# ---------------------------------------------------------------------------
+# Memory strings (Utils.parseMemoryString:123-134)
+# ---------------------------------------------------------------------------
+def parse_memory_string_mb(mem: str | int) -> int:
+    """``"2g"``→2048, ``"512m"``→512, ``"1024"``→1024 (MB)."""
+    if isinstance(mem, int):
+        return mem
+    s = str(mem).strip().lower()
+    if not s:
+        raise ValueError("empty memory string")
+    if s.endswith("g"):
+        return int(float(s[:-1]) * 1024)
+    if s.endswith("m"):
+        return int(float(s[:-1]))
+    return int(s)
+
+
+# ---------------------------------------------------------------------------
+# Archives (Utils.zipArchive/unzipArchive — zip4j in the reference)
+# ---------------------------------------------------------------------------
+def zip_dir(src_dir: str | os.PathLike[str], dst_zip: str | os.PathLike[str]) -> None:
+    src = Path(src_dir)
+    with zipfile.ZipFile(dst_zip, "w", zipfile.ZIP_DEFLATED) as zf:
+        for p in sorted(src.rglob("*")):
+            if p.is_file():
+                zf.write(p, p.relative_to(src))
+
+
+def unzip(src_zip: str | os.PathLike[str], dst_dir: str | os.PathLike[str]) -> None:
+    with zipfile.ZipFile(src_zip) as zf:
+        zf.extractall(dst_dir)
+
+
+# ---------------------------------------------------------------------------
+# Ports
+# ---------------------------------------------------------------------------
+def reserve_port(host: str = "127.0.0.1") -> int:
+    """Pick a free port via a throwaway socket (TaskExecutor.java:70-82).
+    The port is released immediately, so there is a small race window — the
+    same window the reference accepts."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def local_host() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+# ---------------------------------------------------------------------------
+# Shell execution (Utils.executeShell:237-263)
+# ---------------------------------------------------------------------------
+def execute_shell(
+    command: str,
+    timeout_ms: int = 0,
+    extra_env: Mapping[str, str] | None = None,
+    cwd: str | None = None,
+) -> int:
+    """Run ``bash -c <command>`` inheriting stdio, with injected env and an
+    optional kill-after timeout. Returns the exit code (124 on timeout, like
+    coreutils ``timeout``)."""
+    env = dict(os.environ)
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    # start_new_session so a timeout kill reaps the whole process group, not
+    # just bash — timed-out user jobs must not leave orphans holding the TPU.
+    proc = subprocess.Popen(
+        ["bash", "-c", command], env=env, cwd=cwd, start_new_session=True
+    )
+    try:
+        return proc.wait(timeout=timeout_ms / 1000.0 if timeout_ms else None)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return 124
+
+
+# ---------------------------------------------------------------------------
+# Container requests (Utils.parseContainerRequests:288-314)
+# ---------------------------------------------------------------------------
+@dataclass
+class ContainerRequest:
+    """Resource ask for one job type — the reference's
+    ``TensorFlowContainerRequest.java`` with a TPU count added."""
+
+    job_name: str
+    num_instances: int
+    memory_mb: int
+    vcores: int
+    gpus: int = 0
+    tpus: int = 0
+    priority: int = 0
+    extra_resources: dict[str, str] = field(default_factory=dict)
+
+
+def parse_container_requests(conf: TonyConfiguration) -> dict[str, ContainerRequest]:
+    """Scan ``tony.<job>.instances`` families into ContainerRequests. One
+    priority per job type (YARN-7631 workaround in the reference,
+    Utils.java:304-311 — kept because it also gives us a stable job ordering)."""
+    requests: dict[str, ContainerRequest] = {}
+    for prio, job in enumerate(conf.job_types()):
+        n = conf.get_int(keys.instances_key(job), keys.default_instances(job))
+        if n <= 0:
+            continue
+        requests[job] = ContainerRequest(
+            job_name=job,
+            num_instances=n,
+            memory_mb=parse_memory_string_mb(
+                conf.get(keys.memory_key(job), keys.DEFAULT_MEMORY)
+            ),
+            vcores=conf.get_int(keys.vcores_key(job), keys.DEFAULT_VCORES),
+            gpus=conf.get_int(keys.gpus_key(job), keys.DEFAULT_GPUS),
+            tpus=conf.get_int(keys.tpus_key(job), keys.DEFAULT_TPUS),
+            priority=prio,
+            extra_resources=parse_key_values(conf.get_str(keys.resources_key(job))),
+        )
+    return requests
+
+
+def parse_key_values(spec: str) -> dict[str, str]:
+    """``"a=1,b=2"`` → dict (Utils.parseKeyValue)."""
+    out: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, v = part.partition("=")
+        out[k.strip()] = v.strip() if sep else ""
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cluster-spec builders (Utils.constructTFConfig:357-367,
+# Utils.parseClusterSpecForPytorch:424-435)
+# ---------------------------------------------------------------------------
+def construct_tf_config(
+    cluster_spec: Mapping[str, Sequence[str]], job_name: str, task_index: int
+) -> str:
+    """Build the TF_CONFIG JSON for one task from the full cluster spec."""
+    return json.dumps(
+        {
+            "cluster": {k: list(v) for k, v in cluster_spec.items()},
+            "task": {"type": job_name, "index": task_index},
+        }
+    )
+
+
+def parse_cluster_spec_for_pytorch(
+    cluster_spec: Mapping[str, Sequence[str]], chief_name: str = "worker"
+) -> str:
+    """Return ``tcp://<chief host:port>`` — PyTorch's INIT_METHOD rendezvous
+    address (worker 0 by convention)."""
+    chief = cluster_spec.get(chief_name)
+    if not chief:
+        raise ValueError(f"no {chief_name!r} tasks in cluster spec")
+    return f"tcp://{chief[0]}"
+
+
+def coordinator_address_from_spec(
+    cluster_spec: Mapping[str, Sequence[str]], chief_name: str = "worker"
+) -> str:
+    """JAX analogue: the jax.distributed coordinator is process 0 of the
+    chief job type."""
+    chief = cluster_spec.get(chief_name)
+    if not chief:
+        raise ValueError(f"no {chief_name!r} tasks in cluster spec")
+    return chief[0]
+
+
+def flatten_cluster_spec(
+    cluster_spec: Mapping[str, Sequence[str]], chief_name: str = "worker"
+) -> list[tuple[str, int, str]]:
+    """Deterministic global ordering of (job, index, host:port) — defines
+    jax.distributed process ids. The chief job type sorts first so that
+    process 0 is always chief:0 — jax.distributed starts the coordinator on
+    process 0, which must match coordinator_address_from_spec. Remaining job
+    types sort alphabetically; indices are already dense per job."""
+    out: list[tuple[str, int, str]] = []
+    ordered = sorted(cluster_spec, key=lambda j: (j != chief_name, j))
+    for job in ordered:
+        for idx, addr in enumerate(cluster_spec[job]):
+            out.append((job, idx, addr))
+    return out
+
+
+def shlex_join(parts: Sequence[str]) -> str:
+    return " ".join(shlex.quote(p) for p in parts)
