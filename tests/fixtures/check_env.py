@@ -1,0 +1,15 @@
+"""Asserts the generic env contract + shell-env propagation (reference:
+exit_0_check_env.py). Exits nonzero on any missing/bad variable."""
+import os
+import sys
+
+for var in ("JOB_NAME", "TASK_INDEX", "TASK_NUM", "SESSION_ID"):
+    if var not in os.environ:
+        print(f"missing {var}", file=sys.stderr)
+        sys.exit(2)
+
+if os.environ.get("USER_SHELL_VAR") != "propagated":
+    print("shell-env not propagated", file=sys.stderr)
+    sys.exit(3)
+
+sys.exit(0)

@@ -1,0 +1,34 @@
+"""Asserts the JAX runtime env contract: coordinator address + process
+identity, with chief:0 as process 0, and a parseable CLUSTER_SPEC."""
+import json
+import os
+import sys
+
+for var in (
+    "JAX_COORDINATOR_ADDRESS",
+    "TONY_COORDINATOR_ADDRESS",
+    "TONY_NUM_PROCESSES",
+    "TONY_PROCESS_ID",
+    "CLUSTER_SPEC",
+):
+    if var not in os.environ:
+        print(f"missing {var}", file=sys.stderr)
+        sys.exit(2)
+
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+n = sum(len(v) for v in spec.values())
+pid = int(os.environ["TONY_PROCESS_ID"])
+if int(os.environ["TONY_NUM_PROCESSES"]) != n or not 0 <= pid < n:
+    print("inconsistent process identity", file=sys.stderr)
+    sys.exit(3)
+
+# chief (worker:0 by default) must be process 0 and own the coordinator port
+if os.environ["JOB_NAME"] == "worker" and os.environ["TASK_INDEX"] == "0":
+    if pid != 0:
+        print(f"chief has process_id {pid}, want 0", file=sys.stderr)
+        sys.exit(4)
+    if os.environ["JAX_COORDINATOR_ADDRESS"] not in spec["worker"][0]:
+        print("coordinator address is not chief's", file=sys.stderr)
+        sys.exit(5)
+
+sys.exit(0)

@@ -1,0 +1,20 @@
+"""Asserts the PyTorch runtime env contract (reference:
+exit_0_check_pytorchenv.py): RANK / WORLD / INIT_METHOD present and sane."""
+import os
+import sys
+
+for var in ("RANK", "WORLD", "WORLD_SIZE", "INIT_METHOD", "MASTER_ADDR", "MASTER_PORT"):
+    if var not in os.environ:
+        print(f"missing {var}", file=sys.stderr)
+        sys.exit(2)
+
+if not os.environ["INIT_METHOD"].startswith("tcp://"):
+    print(f"bad INIT_METHOD {os.environ['INIT_METHOD']}", file=sys.stderr)
+    sys.exit(3)
+
+rank, world = int(os.environ["RANK"]), int(os.environ["WORLD"])
+if not 0 <= rank < world:
+    print(f"bad rank {rank} of {world}", file=sys.stderr)
+    sys.exit(4)
+
+sys.exit(0)

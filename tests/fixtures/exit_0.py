@@ -1,0 +1,4 @@
+"""Trivial success fixture (reference: tony-core/src/test/resources/exit_0.py)."""
+import sys
+
+sys.exit(0)

@@ -1,0 +1,4 @@
+"""Trivial failure fixture (reference: tony-core/src/test/resources/exit_1.py)."""
+import sys
+
+sys.exit(1)

@@ -1,0 +1,99 @@
+"""End-to-end tests on the mini cluster — the analogue of the reference's
+``TestTonyE2E.java`` (11 scenarios on a 3-NM MiniYARNCluster): a real
+coordinator with a real RPC server launching real executor subprocesses that
+run Python fixture scripts asserting the env contract."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.history.writer import JobMetadata
+from tony_tpu.mini import MiniTonyCluster
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return MiniTonyCluster(tmp_path)
+
+
+def _job(cluster, fixture, workers=1, ps=0, framework="jax", **extra):
+    conf = cluster.base_conf()
+    conf.set(keys.K_FRAMEWORK, framework)
+    conf.set(keys.K_EXECUTES, str(FIXTURES / fixture))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), workers)
+    conf.set(keys.instances_key("ps"), ps)
+    for k, v in extra.items():
+        conf.set(k, v)
+    return conf
+
+
+def test_single_worker_succeeds(cluster):
+    status, _ = cluster.run_job(_job(cluster, "exit_0.py"))
+    assert status is SessionStatus.SUCCEEDED
+
+
+def test_failing_worker_fails_job(cluster):
+    status, coord = cluster.run_job(_job(cluster, "exit_1.py"))
+    assert status is SessionStatus.FAILED
+    assert "worker:0" in coord.session.diagnostics
+
+
+def test_env_contract_and_shell_env(cluster):
+    conf = _job(cluster, "check_env.py", workers=2)
+    conf.set(keys.K_SHELL_ENV, "USER_SHELL_VAR=propagated")
+    status, _ = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED
+
+
+def test_jax_runtime_env(cluster):
+    status, _ = cluster.run_job(_job(cluster, "check_jax_env.py", workers=2, ps=1))
+    assert status is SessionStatus.SUCCEEDED
+
+
+def test_pytorch_runtime_env(cluster):
+    status, _ = cluster.run_job(
+        _job(cluster, "check_pytorch_env.py", workers=2, framework="pytorch")
+    )
+    assert status is SessionStatus.SUCCEEDED
+
+
+def test_gang_barrier_with_ps(cluster):
+    # ps + 2 workers: everyone must pass the barrier; chief success ends the
+    # job while ps (running exit_0 too, but untracked) cannot block it.
+    status, coord = cluster.run_job(_job(cluster, "exit_0.py", workers=2, ps=1))
+    assert status is SessionStatus.SUCCEEDED
+    spec = coord.session.cluster_spec()
+    assert spec is not None and len(spec["worker"]) == 2 and len(spec["ps"]) == 1
+
+
+def test_history_written(cluster):
+    status, coord = cluster.run_job(_job(cluster, "exit_0.py"))
+    assert status is SessionStatus.SUCCEEDED
+    jhists = list(cluster.history_dir.rglob("*.jhist"))
+    assert len(jhists) == 1
+    meta = JobMetadata.parse_jhist_name(jhists[0].name)
+    assert meta.status == "SUCCEEDED" and meta.app_id == coord.app_id
+    assert (jhists[0].parent / "config.json").is_file()
+
+
+def test_task_urls_point_at_logs(cluster):
+    status, coord = cluster.run_job(_job(cluster, "exit_0.py", workers=2))
+    urls = coord.session.task_urls()
+    assert [u.index for u in urls] == [0, 1]
+    assert all(u.url.startswith("file://") for u in urls)
+
+
+def test_application_timeout(cluster):
+    conf = _job(cluster, "exit_0.py")
+    # make the worker hang forever via a sleep command instead of the fixture
+    conf.set(keys.K_EXECUTES, "-c 'import time; time.sleep(600)'")
+    conf.set(keys.K_APPLICATION_TIMEOUT, 2000)
+    status, coord = cluster.run_job(conf, timeout_s=60)
+    assert status is SessionStatus.FAILED
+    assert "timed out" in coord.session.diagnostics

@@ -1,0 +1,94 @@
+"""Fault-injection e2e matrix — the analogue of the reference's env-flag
+fault tests (TestTonyE2E.java:86-117, 201-238): deterministic failures
+injected via env vars read at well-defined points (SURVEY §4)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.mini import MiniTonyCluster
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return MiniTonyCluster(tmp_path)
+
+
+def _job(cluster, fixture, workers=1, **conf_extra):
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / fixture))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), workers)
+    for k, v in conf_extra.items():
+        conf.set(k, v)
+    return conf
+
+
+def test_missed_heartbeats_fail_job(cluster, monkeypatch):
+    # Executor skips 200 pings; expiry = interval × max-missed = 0.6s while
+    # the user script sleeps — the liveness monitor must declare it dead
+    # (TestTonyE2E.java:86-100).
+    monkeypatch.setenv("TEST_TASK_EXECUTOR_NUM_HB_MISS", "200")
+    conf = _job(cluster, "exit_0.py")
+    conf.set(keys.K_EXECUTES, "-c 'import time; time.sleep(30)'")
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 100)
+    conf.set(keys.K_TASK_MAX_MISSED_HEARTBEATS, 6)
+    status, coord = cluster.run_job(conf, timeout_s=60)
+    assert status is SessionStatus.FAILED
+    assert "missed too many heartbeats" in coord.session.diagnostics
+
+
+def test_skewed_straggler_still_passes(cluster, monkeypatch):
+    # worker:1 sleeps 1.5s before even registering; the gang barrier must
+    # hold for it and the job still succeeds (TestTonyE2E.java:102-117).
+    monkeypatch.setenv("TEST_TASK_EXECUTOR_SKEW", "worker#1#1500")
+    status, _ = cluster.run_job(_job(cluster, "check_jax_env.py", workers=2))
+    assert status is SessionStatus.SUCCEEDED
+
+
+def test_worker_termination_fails_job(cluster, monkeypatch):
+    # As soon as the chief registers, the coordinator SIGKILLs a non-chief
+    # worker (preemption simulation); its nonzero exit must fail the session
+    # (TestTonyE2E.java:226-238 via TonyApplicationMaster.java:1108-1119).
+    monkeypatch.setenv("TEST_WORKER_TERMINATION", "1")
+    conf = _job(cluster, "exit_0.py", workers=2)
+    # keep tasks alive long enough for the kill to land mid-flight
+    conf.set(keys.K_EXECUTES, "-c 'import time; time.sleep(10)'")
+    status, coord = cluster.run_job(conf, timeout_s=60)
+    assert status is SessionStatus.FAILED
+
+
+def test_session_retry_recovers(cluster, tmp_path):
+    # First attempt fails (marker file absent → fixture exits 1 and creates
+    # it); with am.retry-count=1 the coordinator resets the session, bumps
+    # the session id, and the rerun succeeds — the whole-session retry path
+    # (TonyApplicationMaster.reset:526-542).
+    marker = tmp_path / "attempt.marker"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if m.exists():\n"
+        "    sys.exit(0)\n"
+        "m.touch()\n"
+        "sys.exit(1)\n"
+    )
+    conf = _job(cluster, "exit_0.py")
+    conf.set(keys.K_EXECUTES, str(script))
+    conf.set(keys.K_AM_RETRY_COUNT, 1)
+    status, coord = cluster.run_job(conf, timeout_s=90)
+    assert status is SessionStatus.SUCCEEDED
+    assert coord.session.session_id == 2  # second attempt won
+
+
+def test_retries_exhausted_still_fails(cluster):
+    conf = _job(cluster, "exit_1.py")
+    conf.set(keys.K_AM_RETRY_COUNT, 1)
+    status, coord = cluster.run_job(conf, timeout_s=90)
+    assert status is SessionStatus.FAILED
+    assert coord.session.session_id == 2

@@ -1,0 +1,165 @@
+"""RPC layer tests: the 7-call protocol over real sockets, the rendezvous
+barrier semantics, auth, error framing, and client reconnects."""
+
+import threading
+import time
+
+import pytest
+
+from tony_tpu.rpc import ApplicationRpc, ApplicationRpcClient, ApplicationRpcServer, RpcError, TaskUrl
+
+
+class FakeApp(ApplicationRpc):
+    """Minimal coordinator-side impl with a 2-task rendezvous barrier."""
+
+    def __init__(self, expected=2):
+        self.expected = expected
+        self.registered = {}
+        self.heartbeats = []
+        self.results = []
+        self.finished = threading.Event()
+        self.tb_url = None
+
+    def get_task_urls(self):
+        return [TaskUrl("worker", 0, "http://logs/0"), TaskUrl("worker", 1, "http://logs/1")]
+
+    def get_cluster_spec(self):
+        if len(self.registered) < self.expected:
+            return None
+        return self._spec()
+
+    def _spec(self):
+        spec = {}
+        for worker, addr in sorted(self.registered.items()):
+            job = worker.split(":")[0]
+            spec.setdefault(job, []).append(addr)
+        return spec
+
+    def register_worker_spec(self, worker, spec):
+        self.registered[worker] = spec
+        if len(self.registered) < self.expected:
+            return None
+        return self._spec()
+
+    def register_tensorboard_url(self, spec, url):
+        self.tb_url = (spec, url)
+        return None
+
+    def register_execution_result(self, exit_code, job_name, job_index, session_id):
+        self.results.append((exit_code, job_name, job_index, session_id))
+        return None
+
+    def finish_application(self):
+        self.finished.set()
+
+    def task_executor_heartbeat(self, task_id):
+        self.heartbeats.append(task_id)
+
+    def get_application_status(self):
+        return {"state": "RUNNING", "diagnostics": ""}
+
+
+@pytest.fixture()
+def served():
+    app = FakeApp()
+    server = ApplicationRpcServer(app, host="127.0.0.1", port_range=(20000, 25000))
+    server.start()
+    yield app, server
+    server.stop()
+
+
+def _client(server, **kw):
+    return ApplicationRpcClient("127.0.0.1", server.port, **kw)
+
+
+def test_rendezvous_barrier(served):
+    app, server = served
+    c0 = _client(server)
+    c1 = _client(server)
+    assert c0.get_cluster_spec() is None
+    assert c0.register_worker_spec("worker:0", "h0:1000") is None  # barrier holds
+    spec = c1.register_worker_spec("worker:1", "h1:1001")
+    assert spec == {"worker": ["h0:1000", "h1:1001"]}
+    assert c0.get_cluster_spec() == spec  # late poll sees the released spec
+
+
+def test_all_seven_calls(served):
+    app, server = served
+    c = _client(server)
+    urls = c.get_task_urls()
+    assert urls[0] == TaskUrl("worker", 0, "http://logs/0")
+    c.register_worker_spec("worker:0", "h0:1")
+    c.register_worker_spec("worker:1", "h1:2")
+    c.register_tensorboard_url("worker:0", "http://tb:6006")
+    assert app.tb_url == ("worker:0", "http://tb:6006")
+    c.register_execution_result(0, "worker", "0", "s0")
+    assert app.results == [(0, "worker", "0", "s0")]
+    c.task_executor_heartbeat("worker:0")
+    assert app.heartbeats == ["worker:0"]
+    c.finish_application()
+    assert app.finished.is_set()
+
+
+def test_auth_rejected():
+    app = FakeApp()
+    server = ApplicationRpcServer(
+        app, host="127.0.0.1", port_range=(20000, 25000), secret="s3cr3t"
+    )
+    server.start()
+    try:
+        bad = ApplicationRpcClient("127.0.0.1", server.port, secret="wrong")
+        with pytest.raises(RpcError, match="authentication"):
+            bad.get_cluster_spec()
+        good = ApplicationRpcClient("127.0.0.1", server.port, secret="s3cr3t")
+        assert good.get_cluster_spec() is None
+    finally:
+        server.stop()
+
+
+def test_remote_error_travels_framed(served):
+    _, server = served
+
+    class Exploding(FakeApp):
+        def get_task_urls(self):
+            raise RuntimeError("boom")
+
+    server._impl = Exploding()
+    c = _client(server)
+    with pytest.raises(RpcError, match="RuntimeError: boom"):
+        c.get_task_urls()
+    # connection still usable after a remote error
+    assert c.get_cluster_spec() is None
+
+
+def test_unknown_method_and_bad_args(served):
+    _, server = served
+    assert server.dispatch({"method": "nope"})["ok"] is False
+    r = server.dispatch({"method": "task_executor_heartbeat", "args": {"bad": 1}})
+    assert r["ok"] is False and "expects args" in r["error"]
+    assert server.dispatch("junk")["ok"] is False
+
+
+def test_client_reconnects_after_drop(served):
+    app, server = served
+    c = _client(server, retry_interval_s=0.05)
+    c.task_executor_heartbeat("worker:0")
+    # simulate a dropped connection under the client
+    c._sock.close()
+    c.task_executor_heartbeat("worker:0")  # must transparently reconnect
+    assert app.heartbeats == ["worker:0", "worker:0"]
+
+
+def test_concurrent_heartbeaters(served):
+    app, server = served
+
+    def beat(i):
+        c = _client(server)
+        for _ in range(10):
+            c.task_executor_heartbeat(f"w:{i}")
+
+    threads = [threading.Thread(target=beat, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(app.heartbeats) == 40

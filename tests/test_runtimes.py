@@ -1,0 +1,49 @@
+"""Unit tests for the framework runtime env builders (the TaskExecutor
+switch analogue, TaskExecutor.java:128-151)."""
+
+import json
+
+import pytest
+
+from tony_tpu.conf import TonyConfiguration
+from tony_tpu.executor.runtimes import get_runtime
+
+SPEC = {"worker": ["h0:5000", "h1:5001"], "ps": ["h2:5002"]}
+
+
+def _conf():
+    return TonyConfiguration()
+
+
+def test_tensorflow_env():
+    env = get_runtime("tensorflow").build_env(SPEC, "worker", 1, _conf())
+    tf = json.loads(env["TF_CONFIG"])
+    assert tf["cluster"] == SPEC
+    assert tf["task"] == {"type": "worker", "index": 1}
+    assert json.loads(env["CLUSTER_SPEC"]) == SPEC
+
+
+def test_pytorch_env():
+    env = get_runtime("pytorch").build_env(SPEC, "ps", 0, _conf())
+    assert env["INIT_METHOD"] == "tcp://h0:5000"
+    assert env["MASTER_ADDR"] == "h0"
+    assert env["MASTER_PORT"] == "5000"
+    assert env["WORLD"] == env["WORLD_SIZE"] == "3"
+    # flat order: worker (chief job) first, then ps → ps:0 has rank 2
+    assert env["RANK"] == "2"
+
+
+def test_jax_env_chief_is_process_zero():
+    rt = get_runtime("jax")
+    chief_env = rt.build_env(SPEC, "worker", 0, _conf())
+    assert chief_env["TONY_PROCESS_ID"] == "0"
+    assert chief_env["JAX_COORDINATOR_ADDRESS"] == "h0:5000"
+    assert chief_env["TONY_NUM_PROCESSES"] == "3"
+    ps_env = rt.build_env(SPEC, "ps", 0, _conf())
+    assert ps_env["TONY_PROCESS_ID"] == "2"
+    assert ps_env["JAX_COORDINATOR_ADDRESS"] == "h0:5000"
+
+
+def test_unknown_framework():
+    with pytest.raises(ValueError, match="unknown framework"):
+        get_runtime("mxnet")

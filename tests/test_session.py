@@ -1,0 +1,95 @@
+"""Unit tests for the session state machine: task tables, barrier assembly,
+chief semantics, completion accounting (TonySession analogue)."""
+
+import pytest
+
+from tony_tpu.conf import TonyConfiguration, keys
+from tony_tpu.coordinator.session import SessionStatus, TonySession
+
+
+def _conf(**jobs):
+    conf = TonyConfiguration()
+    conf.set(keys.instances_key("worker"), 0)  # clear shipped default
+    conf.set(keys.instances_key("ps"), 0)
+    for job, n in jobs.items():
+        conf.set(keys.instances_key(job), n)
+    return conf
+
+
+def test_task_tables():
+    s = TonySession(_conf(worker=3, ps=2), session_id=1)
+    assert {j: len(t) for j, t in s.tasks.items()} == {"worker": 3, "ps": 2}
+    assert s.num_expected_registrations() == 5
+    assert all(t.session_id == 1 for t in s.all_tasks())
+
+
+def test_barrier_releases_only_when_all_registered():
+    s = TonySession(_conf(worker=2, ps=1))
+    assert s.cluster_spec() is None
+    s.register_task("worker:0", "h0:1")
+    s.register_task("ps:0", "h2:3")
+    assert s.cluster_spec() is None  # worker:1 still missing
+    s.register_task("worker:1", "h1:2")
+    assert s.cluster_spec() == {"worker": ["h0:1", "h1:2"], "ps": ["h2:3"]}
+
+
+def test_unknown_registration_ignored():
+    s = TonySession(_conf(worker=1))
+    assert s.register_task("worker:5", "h:1") is False
+    assert s.register_task("junk", "h:1") is False
+
+
+def test_chief_success_short_circuits_ps():
+    # chief (worker:0) finishing cleanly ends the job even though ps never
+    # exits (TonySession.updateSessionStatus:307-310: ps is untracked).
+    s = TonySession(_conf(worker=1, ps=1))
+    s.on_task_completed("worker", 0, 0)
+    assert s.status is SessionStatus.SUCCEEDED
+
+
+def test_non_chief_failure_fails_job():
+    s = TonySession(_conf(worker=2))
+    s.on_task_completed("worker", 1, 9)
+    assert s.status is SessionStatus.FAILED
+    assert "worker:1" in s.diagnostics
+
+
+def test_chief_failure_fails_job_even_after_others_succeed():
+    s = TonySession(_conf(worker=2))
+    s.on_task_completed("worker", 1, 0)
+    assert s.status is SessionStatus.NEW  # chief still out
+    s.on_task_completed("worker", 0, 1)
+    assert s.status is SessionStatus.FAILED
+
+
+def test_all_workers_done_succeeds_without_chief_semantics():
+    conf = _conf(worker=2, evaluator=1)
+    conf.set(keys.K_CHIEF_NAME, "chief")  # no chief job configured
+    s = TonySession(conf)
+    s.on_task_completed("worker", 0, 0)
+    s.on_task_completed("worker", 1, 0)
+    assert s.status is SessionStatus.NEW  # evaluator still running
+    s.on_task_completed("evaluator", 0, 0)
+    assert s.status is SessionStatus.SUCCEEDED
+
+
+def test_configurable_chief_identity():
+    conf = _conf(master=1, worker=1)
+    conf.set(keys.K_CHIEF_NAME, "master")
+    s = TonySession(conf)
+    assert s.is_chief("master", 0)
+    assert not s.is_chief("worker", 0)
+
+
+def test_failure_sticks_over_late_success():
+    s = TonySession(_conf(worker=2))
+    s.on_task_completed("worker", 1, 1)
+    s.on_task_completed("worker", 0, 0)  # chief ok, but session already failed
+    assert s.status is SessionStatus.FAILED
+
+
+def test_kill():
+    s = TonySession(_conf(worker=1))
+    s.kill("user abort")
+    assert s.status is SessionStatus.KILLED
+    assert s.training_finished()

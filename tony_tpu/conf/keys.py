@@ -33,6 +33,15 @@ K_SECURITY_ENABLED = APPLICATION_PREFIX + "security.enabled"
 K_NODE_LABEL = APPLICATION_PREFIX + "node-label"
 K_DOCKER_ENABLED = APPLICATION_PREFIX + "docker.enabled"
 K_DOCKER_IMAGE = APPLICATION_PREFIX + "docker.image"
+# Job payload (the reference passes these as TonyClient CLI args --executes/
+# --src_dir/--python_venv/--task_params/--shell_env and threads them through
+# tony-final.xml; here they are first-class conf keys).
+K_EXECUTES = APPLICATION_PREFIX + "executes"
+K_SRC_DIR = APPLICATION_PREFIX + "src-dir"
+K_PYTHON_VENV = APPLICATION_PREFIX + "python-venv"
+K_PYTHON_BINARY = APPLICATION_PREFIX + "python-binary-path"
+K_TASK_PARAMS = APPLICATION_PREFIX + "task-params"
+K_SHELL_ENV = APPLICATION_PREFIX + "shell-env"
 
 # --- task (executor) ------------------------------------------------------
 TASK_PREFIX = TONY_PREFIX + "task."
@@ -99,6 +108,12 @@ DEFAULTS: dict[str, object] = {
     K_NODE_LABEL: "",
     K_DOCKER_ENABLED: False,
     K_DOCKER_IMAGE: "",
+    K_EXECUTES: "",
+    K_SRC_DIR: "",
+    K_PYTHON_VENV: "",
+    K_PYTHON_BINARY: "python",
+    K_TASK_PARAMS: "",
+    K_SHELL_ENV: "",
     K_TASK_HEARTBEAT_INTERVAL_MS: 1000,
     K_TASK_MAX_MISSED_HEARTBEATS: 25,
     K_TASK_REGISTRATION_TIMEOUT_MS: 0,

@@ -1,0 +1,10 @@
+from tony_tpu.coordinator.session import SessionStatus, TaskStatus, TonySession, TonyTask
+from tony_tpu.coordinator.app_master import TonyCoordinator
+
+__all__ = [
+    "TonySession",
+    "TonyTask",
+    "SessionStatus",
+    "TaskStatus",
+    "TonyCoordinator",
+]

@@ -1,0 +1,309 @@
+"""The control-plane coordinator — the analogue of
+``TonyApplicationMaster.java`` (tony-core/.../TonyApplicationMaster.java:1-1122):
+runs the RPC server, schedules one executor per requested task instance
+through a container backend, arms the rendezvous barrier, heartbeat-monitors
+tasks, fails fast on chief death, retries the whole session with a bumped
+session id, and writes job history on exit.
+
+Runs either as its own process (``python -m tony_tpu.coordinator.app_master``,
+launched by the submission client the way YARN launched the AM container) or
+embedded in-process for mini-cluster tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from tony_tpu import constants, utils
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.backend import ContainerBackend, LocalProcessBackend
+from tony_tpu.coordinator.liveness import LivenessMonitor
+from tony_tpu.coordinator.session import SessionStatus, TonySession, TonyTask
+from tony_tpu.history import JobMetadata, setup_job_dir
+from tony_tpu.history.writer import create_history_file, write_config_file
+from tony_tpu.rpc.protocol import ApplicationRpc, TaskUrl
+from tony_tpu.rpc.server import ApplicationRpcServer
+
+log = logging.getLogger(__name__)
+
+
+class _RpcForClient(ApplicationRpc):
+    """RPC surface served to the client and executors
+    (TonyApplicationMaster.RpcForClient:721-837)."""
+
+    def __init__(self, coordinator: "TonyCoordinator") -> None:
+        self._c = coordinator
+
+    def get_task_urls(self) -> list[TaskUrl]:
+        return self._c.session.task_urls() if self._c.session else []
+
+    def get_cluster_spec(self) -> dict[str, list[str]] | None:
+        return self._c.session.cluster_spec() if self._c.session else None
+
+    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+        return self._c.on_register_worker_spec(worker, spec)
+
+    def register_tensorboard_url(self, spec: str, url: str) -> str | None:
+        self._c.tensorboard_url = url
+        log.info("TensorBoard for %s at %s", spec, url)
+        return None
+
+    def register_execution_result(
+        self, exit_code: int, job_name: str, job_index: str, session_id: str
+    ) -> str | None:
+        # Advisory only: the container exit status observed by the backend is
+        # the source of truth (TonyApplicationMaster.java:808-824 explains
+        # why the RPC-reported code was demoted).
+        log.info("task %s:%s (session %s) reported exit %d",
+                 job_name, job_index, session_id, exit_code)
+        return None
+
+    def finish_application(self) -> None:
+        self._c.client_signal_to_finish.set()
+
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        self._c.liveness.receive_ping(task_id)
+
+    def get_application_status(self) -> dict[str, Any]:
+        return self._c.application_status()
+
+
+class TonyCoordinator:
+    def __init__(
+        self,
+        conf: TonyConfiguration,
+        app_dir: str | os.PathLike[str],
+        app_id: str | None = None,
+        backend: ContainerBackend | None = None,
+    ) -> None:
+        self.conf = conf
+        self.app_dir = Path(app_dir)
+        self.app_dir.mkdir(parents=True, exist_ok=True)
+        self.app_id = app_id or f"application_{int(time.time() * 1000)}_{os.getpid()}"
+        self.backend = backend or LocalProcessBackend(self.app_dir / "logs")
+        self.session: TonySession | None = None
+        self.tensorboard_url: str | None = None
+        self.client_signal_to_finish = threading.Event()
+        self._wake = threading.Event()  # interrupts the monitor poll
+        self._killed = threading.Event()
+        self.started_ms = int(time.time() * 1000)
+        self._session_seq = 0
+        self._hb_missed: set[str] = set()
+
+        secret = None
+        if conf.get_bool(keys.K_SECURITY_ENABLED):
+            secret = conf.get_str(keys.K_SECRET_KEY)
+        lo, hi = (int(x) for x in conf.get_str(keys.K_AM_RPC_PORT_RANGE, "10000-15000").split("-"))
+        self.rpc_server = ApplicationRpcServer(
+            _RpcForClient(self), host="0.0.0.0", port_range=(lo, hi), secret=secret
+        )
+        self.liveness = LivenessMonitor(
+            heartbeat_interval_ms=conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000),
+            max_missed_heartbeats=conf.get_int(keys.K_TASK_MAX_MISSED_HEARTBEATS, 25),
+            on_expired=self._on_task_deemed_dead,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def prepare(self) -> None:
+        """prepare (TonyApplicationMaster.java:379-428): start RPC + liveness,
+        advertise the RPC address for the client, write history config."""
+        self.rpc_server.start()
+        self.liveness.start()
+        (self.app_dir / "coordinator.addr").write_text(
+            f"127.0.0.1:{self.rpc_server.port}\n"
+        )
+        hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
+        if hist:
+            job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
+            write_config_file(job_dir, self.conf)
+
+    def run(self) -> SessionStatus:
+        """Retry loop (TonyApplicationMaster.java:340-365): run sessions until
+        one succeeds or retries are exhausted."""
+        self.prepare()
+        retries_left = self.conf.get_int(keys.K_AM_RETRY_COUNT, 0)
+        try:
+            while True:
+                status = self._run_one_session()
+                if status is SessionStatus.SUCCEEDED or self._killed.is_set():
+                    break
+                if retries_left <= 0:
+                    break
+                retries_left -= 1
+                log.warning("session failed; retrying (%d retries left)", retries_left)
+                self._reset()
+            return self.stop(status)
+        finally:
+            self.backend.stop_all()
+            self.liveness.stop()
+            self.rpc_server.stop()
+
+    def _run_one_session(self) -> SessionStatus:
+        if os.environ.get(constants.TEST_AM_CRASH):
+            # Fault injection: AM dies on purpose (reference :341-346).
+            log.error("TEST_AM_CRASH set — coordinator crashing")
+            os._exit(1)
+        self._session_seq += 1
+        self.session = TonySession(self.conf, session_id=self._session_seq)
+        self.session.status = SessionStatus.RUNNING
+        self._schedule_tasks()
+        return self._monitor()
+
+    def _schedule_tasks(self) -> None:
+        """scheduleTasks (TonyApplicationMaster.java:507-524) + the
+        ContainerLauncher env contract (:1017-1092)."""
+        assert self.session is not None
+        for task in self.session.all_tasks():
+            env = self._task_env(task)
+            task.handle = self.backend.launch(task, env)
+            if isinstance(self.backend, LocalProcessBackend):
+                task.url = self.backend.task_url(task)
+
+    def _task_env(self, task: TonyTask) -> dict[str, str]:
+        assert self.session is not None
+        n = len(self.session.tasks[task.job_name])
+        return {
+            constants.JOB_NAME: task.job_name,
+            constants.TASK_INDEX: str(task.index),
+            constants.TASK_NUM: str(n),
+            constants.SESSION_ID: str(self.session.session_id),
+            constants.TONY_AM_ADDRESS: f"127.0.0.1:{self.rpc_server.port}",
+            constants.TONY_CONF_PATH: str(self.app_dir / constants.TONY_FINAL_CONF),
+        }
+
+    # -- rendezvous + fault injection hooks --------------------------------
+    def on_register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+        session = self.session
+        if session is None:
+            return None
+        if session.register_task(worker, spec):
+            self.liveness.register(worker)
+            log.info("registered %s at %s", worker, spec)
+        task = session.get_task_by_id(worker)
+        if (
+            task is not None
+            and session.is_chief(task.job_name, task.index)
+            and os.environ.get(constants.TEST_WORKER_TERMINATION)
+        ):
+            # Fault injection: kill a non-chief worker as soon as the chief
+            # registers (reference :1108-1119) — simulates preemption.
+            self._kill_one_non_chief()
+        return session.cluster_spec()
+
+    def _kill_one_non_chief(self) -> None:
+        assert self.session is not None
+        for t in self.session.all_tasks():
+            if not self.session.is_chief(t.job_name, t.index) and t.handle is not None:
+                log.warning("TEST_WORKER_TERMINATION: killing %s", t.id)
+                self.backend.kill(t.handle)
+                return
+
+    def _on_task_deemed_dead(self, task_id: str) -> None:
+        """onTaskDeemedDead (TonyApplicationMaster.java:1094-1104). On a TPU
+        slice a hung host wedges everyone's collectives, so the whole session
+        fails (and retries slice-wide) rather than killing one task."""
+        self._hb_missed.add(task_id)
+        if self.session is not None:
+            self.session._fail(f"task {task_id} missed too many heartbeats")
+        self._wake.set()
+
+    # -- monitor loop (TonyApplicationMaster.monitor:548-610) ---------------
+    def _monitor(self) -> SessionStatus:
+        assert self.session is not None
+        session = self.session
+        interval_s = self.conf.get_int(keys.K_AM_MONITOR_INTERVAL_MS, 200) / 1000.0
+        timeout_ms = self.conf.get_int(keys.K_APPLICATION_TIMEOUT, 0)
+        deadline = time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
+        while not session.training_finished():
+            if self._killed.is_set():
+                session.kill("killed by client")
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                session._fail(f"application timed out after {timeout_ms}ms")
+                break
+            for task in session.all_tasks():
+                if task.handle is None or task.completed():
+                    continue
+                code = self.backend.poll(task.handle)
+                if code is not None:
+                    self.liveness.unregister(task.id)
+                    session.on_task_completed(task.job_name, task.index, code)
+            self._wake.wait(interval_s)
+            self._wake.clear()
+        # Stop whatever is still running (failed/killed sessions leave
+        # stragglers; succeeded chief leaves ps tasks by design).
+        for task in session.all_tasks():
+            if task.handle is not None and not task.completed():
+                self.backend.kill(task.handle)
+        return session.status
+
+    def _reset(self) -> None:
+        """reset (TonyApplicationMaster.java:526-542): stop all containers,
+        drop liveness state; the next _run_one_session builds a fresh session
+        with a bumped id (stale events are fenced by task.session_id)."""
+        self.backend.stop_all()
+        self.liveness.reset()
+        self._hb_missed.clear()
+        self.client_signal_to_finish.clear()
+
+    def stop(self, status: SessionStatus) -> SessionStatus:
+        """stop (TonyApplicationMaster.java:621-637): write history, then wait
+        (bounded) for the client's finishApplication signal."""
+        hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
+        if hist:
+            job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
+            create_history_file(
+                job_dir, JobMetadata.new(self.app_id, self.started_ms, status.value)
+            )
+        (self.app_dir / "final-status.json").write_text(
+            json.dumps(self.application_status()) + "\n"
+        )
+        grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0
+        self.client_signal_to_finish.wait(timeout=grace_s)
+        return status
+
+    def kill(self) -> None:
+        self._killed.set()
+        self._wake.set()
+
+    def application_status(self) -> dict[str, Any]:
+        if self.session is None:
+            return {"state": "NEW", "diagnostics": ""}
+        state = self.session.status.value
+        if state == "NEW":
+            state = "RUNNING"
+        return {
+            "state": state,
+            "diagnostics": self.session.diagnostics,
+            "session_id": self.session.session_id,
+            "tensorboard_url": self.tensorboard_url,
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s coordinator %(name)s: %(message)s",
+    )
+    parser = argparse.ArgumentParser(description="tony_tpu coordinator (AM analogue)")
+    parser.add_argument("--app-dir", required=True)
+    parser.add_argument("--app-id", default=None)
+    args = parser.parse_args(argv)
+    conf = TonyConfiguration.from_final(
+        Path(args.app_dir) / constants.TONY_FINAL_CONF
+    )
+    coordinator = TonyCoordinator(conf, args.app_dir, app_id=args.app_id)
+    status = coordinator.run()
+    return 0 if status is SessionStatus.SUCCEEDED else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

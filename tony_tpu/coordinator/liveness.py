@@ -1,0 +1,81 @@
+"""Heartbeat liveness monitor — the analogue of the reference's
+``AbstractLivelinessMonitor`` subclass in the AM
+(TonyApplicationMaster.java:174-186): tasks register at rendezvous, ping at a
+configured interval, and expire after ``max_missed × interval`` of silence,
+triggering a session-level failure callback (onTaskDeemedDead:1094-1104).
+
+On TPU pods this matters more than on YARN: a hung host stalls ICI
+collectives for the whole slice, so expiry triggers slice-wide restart via
+the coordinator's retry path, never a single-task kill (SURVEY §7 hard
+part b).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+
+class LivenessMonitor:
+    def __init__(
+        self,
+        heartbeat_interval_ms: int,
+        max_missed_heartbeats: int,
+        on_expired: Callable[[str], None],
+    ) -> None:
+        self._expiry_s = heartbeat_interval_ms * max_missed_heartbeats / 1000.0
+        self._check_interval_s = max(heartbeat_interval_ms / 1000.0, 0.05)
+        self._on_expired = on_expired
+        self._last_seen: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="liveness-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def register(self, task_id: str) -> None:
+        with self._lock:
+            self._last_seen[task_id] = time.monotonic()
+
+    def unregister(self, task_id: str) -> None:
+        with self._lock:
+            self._last_seen.pop(task_id, None)
+
+    def receive_ping(self, task_id: str) -> None:
+        with self._lock:
+            # Only tasks that registered are monitored; a ping from an
+            # unknown task re-registers it (covers coordinator restart).
+            self._last_seen[task_id] = time.monotonic()
+
+    def reset(self) -> None:
+        """Drop all monitored tasks (session retry re-registers everyone)."""
+        with self._lock:
+            self._last_seen.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._check_interval_s):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    tid for tid, seen in self._last_seen.items()
+                    if now - seen > self._expiry_s
+                ]
+                for tid in expired:
+                    del self._last_seen[tid]
+            for tid in expired:
+                log.error("task %s missed heartbeats for %.1fs — deemed dead",
+                          tid, self._expiry_s)
+                self._on_expired(tid)

@@ -1,0 +1,190 @@
+"""Job-session state machine — the analogue of ``TonySession.java``
+(tony-core/.../tensorflow/TonySession.java:1-562): per-job-type task tables,
+cluster-spec assembly, completion accounting with chief semantics, and final
+status. One session per attempt; the coordinator builds a fresh session (with
+a bumped session id) on retry, and stale completion events are fenced by the
+session id (TonyApplicationMaster.java:957-960).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+from dataclasses import dataclass, field
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.rpc.protocol import TaskUrl
+from tony_tpu.utils import ContainerRequest, parse_container_requests
+
+log = logging.getLogger(__name__)
+
+# Job types excluded from completion accounting: parameter servers run
+# forever by design, so "all workers done" ends the job
+# (TonySession.updateSessionStatus:307-310). Notebook follows ps semantics.
+UNTRACKED_JOB_TYPES = frozenset({constants.PS_JOB_NAME})
+
+
+class TaskStatus(enum.Enum):
+    NEW = "NEW"
+    SCHEDULED = "SCHEDULED"
+    REGISTERED = "REGISTERED"
+    COMPLETED = "COMPLETED"
+
+
+class SessionStatus(enum.Enum):
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+@dataclass
+class TonyTask:
+    """One task instance (TonySession.TonyTask:442-552)."""
+
+    job_name: str
+    index: int
+    session_id: int
+    status: TaskStatus = TaskStatus.NEW
+    host_port: str | None = None  # registered by the executor at rendezvous
+    exit_code: int | None = None
+    url: str | None = None
+    handle: object = None  # backend-specific container handle
+
+    @property
+    def id(self) -> str:
+        return f"{self.job_name}:{self.index}"
+
+    def completed(self) -> bool:
+        return self.status is TaskStatus.COMPLETED
+
+
+class TonySession:
+    def __init__(self, conf: TonyConfiguration, session_id: int = 0) -> None:
+        self.conf = conf
+        self.session_id = session_id
+        self.status = SessionStatus.NEW
+        self.diagnostics = ""
+        self._lock = threading.RLock()
+        self.requests: dict[str, ContainerRequest] = parse_container_requests(conf)
+        self.tasks: dict[str, list[TonyTask]] = {
+            job: [TonyTask(job, i, session_id) for i in range(req.num_instances)]
+            for job, req in self.requests.items()
+        }
+        self.chief_name = conf.get_str(keys.K_CHIEF_NAME, "worker")
+        self.chief_index = int(conf.get_str(keys.K_CHIEF_INDEX, "0"))
+
+    # -- lookups -----------------------------------------------------------
+    def all_tasks(self) -> list[TonyTask]:
+        return [t for tasks in self.tasks.values() for t in tasks]
+
+    def get_task(self, job_name: str, index: int) -> TonyTask | None:
+        tasks = self.tasks.get(job_name)
+        if tasks is None or not 0 <= index < len(tasks):
+            return None
+        return tasks[index]
+
+    def get_task_by_id(self, task_id: str) -> TonyTask | None:
+        job, sep, idx = task_id.partition(":")
+        if not sep or not idx.isdigit():
+            return None
+        return self.get_task(job, int(idx))
+
+    def is_chief(self, job_name: str, index: int) -> bool:
+        """Chief identity is configurable (tony.chief.name/index,
+        TonyConfigurationKeys.java:159-163; TonySession.isChief:382-386)."""
+        return job_name == self.chief_name and index == self.chief_index
+
+    def num_expected_registrations(self) -> int:
+        return len(self.all_tasks())
+
+    # -- rendezvous --------------------------------------------------------
+    def register_task(self, task_id: str, host_port: str) -> bool:
+        """Record an executor's host:port. Returns True if newly registered."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                log.warning("registration from unknown task %s", task_id)
+                return False
+            fresh = task.status is not TaskStatus.REGISTERED
+            task.host_port = host_port
+            if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
+                task.status = TaskStatus.REGISTERED
+            return fresh
+
+    def cluster_spec(self) -> dict[str, list[str]] | None:
+        """The gang barrier (TonyApplicationMaster.java:771-806): None until
+        every task has registered, then {job: [host:port by index]}."""
+        with self._lock:
+            spec: dict[str, list[str]] = {}
+            for job, tasks in self.tasks.items():
+                addrs = []
+                for t in tasks:
+                    if t.host_port is None:
+                        return None
+                    addrs.append(t.host_port)
+                spec[job] = addrs
+            return spec
+
+    # -- completion accounting (TonySession.onTaskCompleted:269-293,
+    #    updateSessionStatus:298-342) -------------------------------------
+    def on_task_completed(self, job_name: str, index: int, exit_code: int) -> None:
+        with self._lock:
+            task = self.get_task(job_name, index)
+            if task is None:
+                log.warning("completion for unknown task %s:%s", job_name, index)
+                return
+            task.exit_code = exit_code
+            task.status = TaskStatus.COMPLETED
+            if exit_code != 0:
+                # Any tracked-task failure fails the job; chief failure does
+                # so even if everything else succeeded (chief short-circuit,
+                # TonySession.java:276-292). PS crash also fails the job in
+                # the reference (exit code nonzero on an allocated container).
+                self._fail(f"task {task.id} exited with {exit_code}")
+            elif self.is_chief(job_name, index):
+                # Chief finishing cleanly ends training (TF semantics).
+                self._maybe_succeed(chief_done=True)
+            else:
+                self._maybe_succeed(chief_done=False)
+
+    def _fail(self, why: str) -> None:
+        if self.status not in (SessionStatus.SUCCEEDED, SessionStatus.KILLED):
+            self.status = SessionStatus.FAILED
+            self.diagnostics = self.diagnostics or why
+            log.error("session %d failed: %s", self.session_id, why)
+
+    def _maybe_succeed(self, chief_done: bool) -> None:
+        if self.status is SessionStatus.FAILED:
+            return
+        tracked = [
+            t for job, tasks in self.tasks.items() if job not in UNTRACKED_JOB_TYPES
+            for t in tasks
+        ]
+        if chief_done or all(t.completed() for t in tracked):
+            self.status = SessionStatus.SUCCEEDED
+
+    def training_finished(self) -> bool:
+        return self.status in (
+            SessionStatus.SUCCEEDED,
+            SessionStatus.FAILED,
+            SessionStatus.KILLED,
+        )
+
+    def kill(self, why: str = "killed") -> None:
+        with self._lock:
+            if not self.training_finished():
+                self.status = SessionStatus.KILLED
+                self.diagnostics = why
+
+    # -- observability -----------------------------------------------------
+    def task_urls(self) -> list[TaskUrl]:
+        return sorted(
+            TaskUrl(t.job_name, t.index, t.url)
+            for t in self.all_tasks()
+            if t.url is not None
+        )

@@ -1,0 +1,15 @@
+from tony_tpu.executor.runtimes import (
+    JAXRuntime,
+    PyTorchRuntime,
+    Runtime,
+    TensorFlowRuntime,
+    get_runtime,
+)
+
+__all__ = [
+    "Runtime",
+    "JAXRuntime",
+    "TensorFlowRuntime",
+    "PyTorchRuntime",
+    "get_runtime",
+]

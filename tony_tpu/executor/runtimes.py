@@ -1,0 +1,126 @@
+"""Framework runtimes — the seam the whole build pivots on.
+
+The reference switches on ``tony.application.framework`` inside the task
+executor and injects either TF_CONFIG or PyTorch RANK/WORLD/INIT_METHOD env
+(TaskExecutor.java:128-151, Utils.java:357-367 and :424-435). This build
+keeps both of those runtimes byte-compatible and adds the TPU-native
+``JAXRuntime``: it injects the jax.distributed coordinator address, process
+id, and process count derived from the same rendezvous cluster spec, so the
+user script just calls ``tony_tpu.runtime.initialize()`` (or reads
+JAX_COORDINATOR_ADDRESS natively) and XLA collectives ride ICI/DCN — no
+TF_CONFIG, no NCCL (SURVEY §2.3, §5.8).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+from typing import Mapping, Sequence
+
+from tony_tpu import constants, utils
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+
+ClusterSpec = Mapping[str, Sequence[str]]
+
+
+class Runtime(abc.ABC):
+    """Builds the framework-specific env for one task, given the rendezvous
+    cluster spec."""
+
+    name: str
+
+    @abc.abstractmethod
+    def build_env(
+        self,
+        cluster_spec: ClusterSpec,
+        job_name: str,
+        task_index: int,
+        conf: TonyConfiguration,
+    ) -> dict[str, str]:
+        ...
+
+
+class TensorFlowRuntime(Runtime):
+    """TF_CONFIG + CLUSTER_SPEC (TaskExecutor.java:129-137)."""
+
+    name = "tensorflow"
+
+    def build_env(self, cluster_spec, job_name, task_index, conf):
+        return {
+            constants.TF_CONFIG: utils.construct_tf_config(
+                cluster_spec, job_name, task_index
+            ),
+            constants.CLUSTER_SPEC: json.dumps(
+                {k: list(v) for k, v in cluster_spec.items()}
+            ),
+        }
+
+
+class PyTorchRuntime(Runtime):
+    """RANK / WORLD / INIT_METHOD (TaskExecutor.java:139-150), plus the
+    modern MASTER_ADDR / MASTER_PORT / WORLD_SIZE equivalents so current
+    torch.distributed scripts work unmodified."""
+
+    name = "pytorch"
+
+    def build_env(self, cluster_spec, job_name, task_index, conf):
+        chief_name = conf.get_str(keys.K_CHIEF_NAME, "worker")
+        init_method = utils.parse_cluster_spec_for_pytorch(cluster_spec, chief_name)
+        master = init_method[len("tcp://"):]
+        host, _, port = master.rpartition(":")
+        world = sum(len(v) for v in cluster_spec.values())
+        flat = utils.flatten_cluster_spec(cluster_spec, chief_name)
+        rank = flat.index(
+            (job_name, task_index, cluster_spec[job_name][task_index])
+        )
+        return {
+            constants.INIT_METHOD: init_method,
+            constants.RANK: str(rank),
+            constants.WORLD: str(world),
+            constants.WORLD_SIZE: str(world),
+            constants.MASTER_ADDR: host,
+            constants.MASTER_PORT: port,
+            constants.CLUSTER_SPEC: json.dumps(
+                {k: list(v) for k, v in cluster_spec.items()}
+            ),
+        }
+
+
+class JAXRuntime(Runtime):
+    """The TPU-native runtime. Process 0 is chief:0 (it hosts the
+    jax.distributed coordinator service on its registered port — the port
+    the executor reserved and advertised at rendezvous)."""
+
+    name = "jax"
+
+    def build_env(self, cluster_spec, job_name, task_index, conf):
+        chief_name = conf.get_str(keys.K_CHIEF_NAME, "worker")
+        flat = utils.flatten_cluster_spec(cluster_spec, chief_name)
+        coordinator = utils.coordinator_address_from_spec(cluster_spec, chief_name)
+        process_id = flat.index(
+            (job_name, task_index, cluster_spec[job_name][task_index])
+        )
+        return {
+            constants.JAX_COORDINATOR_ADDRESS: coordinator,
+            constants.TONY_COORDINATOR_ADDRESS: coordinator,
+            constants.TONY_NUM_PROCESSES: str(len(flat)),
+            constants.TONY_PROCESS_ID: str(process_id),
+            constants.CLUSTER_SPEC: json.dumps(
+                {k: list(v) for k, v in cluster_spec.items()}
+            ),
+        }
+
+
+_RUNTIMES: dict[str, type[Runtime]] = {
+    r.name: r for r in (TensorFlowRuntime, PyTorchRuntime, JAXRuntime)
+}
+
+
+def get_runtime(framework: str) -> Runtime:
+    try:
+        return _RUNTIMES[framework.lower()]()
+    except KeyError:
+        raise ValueError(
+            f"unknown framework {framework!r}; expected one of {sorted(_RUNTIMES)}"
+        ) from None

@@ -1,0 +1,234 @@
+"""Per-task executor agent — the analogue of ``TaskExecutor.java``
+(tony-core/.../TaskExecutor.java:1-343): reserves its rendezvous port,
+registers with the coordinator and blocks at the gang barrier, heartbeats,
+injects the framework runtime env, execs the user command, and reports the
+exit code. Launched by the coordinator's container backend with the identity
+env contract (JOB_NAME / TASK_INDEX / TASK_NUM / SESSION_ID / TONY_AM_ADDRESS
+/ TONY_CONF_PATH).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+from tony_tpu import constants, utils
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.rpc.client import ApplicationRpcClient
+
+log = logging.getLogger(__name__)
+
+MAX_CONSECUTIVE_HB_FAILURES = 5  # TaskExecutor.Heartbeater:234-273
+
+
+class Heartbeater(threading.Thread):
+    """1 Hz pings to the coordinator; the executor dies hard after 5
+    consecutive send failures (a dead coordinator means the session is being
+    torn down or retried — lingering would leave a zombie holding the TPU).
+    TEST_TASK_EXECUTOR_NUM_HB_MISS skips the first N pings (fault injection,
+    TaskExecutor.java:238-248)."""
+
+    def __init__(self, client: ApplicationRpcClient, task_id: str, interval_ms: int):
+        super().__init__(name="heartbeater", daemon=True)
+        self._client = client
+        self._task_id = task_id
+        self._interval_s = interval_ms / 1000.0
+        self._skip = int(os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        failures = 0
+        while not self._stop.wait(self._interval_s):
+            if self._skip > 0:
+                self._skip -= 1
+                continue
+            try:
+                self._client.task_executor_heartbeat(self._task_id)
+                failures = 0
+            except Exception:
+                failures += 1
+                log.warning("heartbeat failed (%d consecutive)", failures)
+                if failures >= MAX_CONSECUTIVE_HB_FAILURES:
+                    log.error("lost the coordinator — exiting")
+                    os._exit(1)
+
+
+class TaskExecutor:
+    def __init__(self) -> None:
+        env = os.environ
+        self.job_name = env[constants.JOB_NAME]
+        self.task_index = int(env[constants.TASK_INDEX])
+        self.task_num = int(env[constants.TASK_NUM])
+        self.session_id = env.get(constants.SESSION_ID, "0")
+        self.am_host, _, am_port = env[constants.TONY_AM_ADDRESS].rpartition(":")
+        self.am_port = int(am_port)
+        self.conf = TonyConfiguration.from_final(env[constants.TONY_CONF_PATH])
+        secret = None
+        if self.conf.get_bool(keys.K_SECURITY_ENABLED):
+            secret = self.conf.get_str(keys.K_SECRET_KEY)
+        self.client = ApplicationRpcClient(self.am_host, self.am_port, secret=secret)
+        # The rendezvous port: what this task advertises as host:port. Under
+        # the JAX runtime, chief:0's port becomes the jax.distributed
+        # coordinator service port (TaskExecutor.java:70-82 reserves the
+        # framework server port the same way).
+        self.port = utils.reserve_port()
+        self.host = "127.0.0.1" if self._local_mode() else utils.local_host()
+        self.tb_port: int | None = None
+        self.heartbeater: Heartbeater | None = None
+
+    def _local_mode(self) -> bool:
+        return self.am_host in ("127.0.0.1", "localhost")
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_name}:{self.task_index}"
+
+    # -- rendezvous (TaskExecutor.registerAndGetClusterSpec:196-213) --------
+    def register_and_get_cluster_spec(self) -> dict[str, list[str]]:
+        self.heartbeater = Heartbeater(
+            ApplicationRpcClient(self.am_host, self.am_port,
+                                 secret=self.client._secret),
+            self.task_id,
+            self.conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000),
+        )
+        self.heartbeater.start()
+        retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
+        timeout_ms = self.conf.get_int(keys.K_TASK_REGISTRATION_TIMEOUT_MS, 0)
+        spec = utils.poll_till_non_null(
+            lambda: self.client.register_worker_spec(
+                self.task_id, f"{self.host}:{self.port}"
+            ),
+            interval_s=retry_s,
+            timeout_s=timeout_ms / 1000.0 if timeout_ms else None,
+        )
+        if spec is None:
+            raise TimeoutError("timed out waiting for the gang barrier")
+        return spec
+
+    # -- env assembly -------------------------------------------------------
+    def build_task_env(self, cluster_spec: dict[str, list[str]]) -> dict[str, str]:
+        from tony_tpu.executor.runtimes import get_runtime
+
+        framework = self.conf.get_str(keys.K_FRAMEWORK, "jax")
+        env = get_runtime(framework).build_env(
+            cluster_spec, self.job_name, self.task_index, self.conf
+        )
+        env.update(
+            {
+                constants.JOB_NAME: self.job_name,
+                constants.TASK_INDEX: str(self.task_index),
+                constants.TASK_NUM: str(self.task_num),
+                constants.SESSION_ID: self.session_id,
+            }
+        )
+        if self.tb_port is not None:
+            env[constants.TB_PORT] = str(self.tb_port)
+        # user-supplied extra env (--shell_env analogue)
+        env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
+        return env
+
+    def build_task_command(self) -> str:
+        """Interpreter + script + params (TonySession.getTaskCommand:74-94),
+        preferring an unpacked venv's interpreter when one is shipped."""
+        executes = self.conf.get_str(keys.K_EXECUTES)
+        if not executes:
+            raise ValueError(f"{keys.K_EXECUTES} is required")
+        python = self.conf.get_str(keys.K_PYTHON_BINARY, "python") or "python"
+        venv_zip = self.conf.get_str(keys.K_PYTHON_VENV)
+        if venv_zip:
+            # Per-task extraction dir: executors sharing a cwd (the local
+            # backend case) must not race on one ./venv, and a stale venv
+            # from a previous job must never be silently reused.
+            venv_dir = Path(f"venv-{self.job_name}-{self.task_index}-{os.getpid()}")
+            utils.unzip(venv_zip, venv_dir)
+            candidate = venv_dir / "bin" / "python"
+            if candidate.exists():
+                candidate.chmod(0o755)
+                python = str(candidate)
+            else:
+                log.warning("venv %s has no bin/python; using %r", venv_zip, python)
+        params = self.conf.get_str(keys.K_TASK_PARAMS)
+        return f"{python} {executes} {params}".strip()
+
+    def _maybe_sleep_for_skew(self) -> None:
+        """TEST_TASK_EXECUTOR_SKEW="job#idx#ms" straggler simulation
+        (TaskExecutor.java:320-340)."""
+        spec = os.environ.get(constants.TEST_TASK_EXECUTOR_SKEW)
+        if not spec:
+            return
+        try:
+            job, idx, ms = spec.split("#")
+        except ValueError:
+            log.warning("bad %s spec %r", constants.TEST_TASK_EXECUTOR_SKEW, spec)
+            return
+        if job == self.job_name and int(idx) == self.task_index:
+            log.info("skew injection: sleeping %sms", ms)
+            time.sleep(int(ms) / 1000.0)
+
+    def is_chief(self) -> bool:
+        return (
+            self.job_name == self.conf.get_str(keys.K_CHIEF_NAME, "worker")
+            and self.task_index == int(self.conf.get_str(keys.K_CHIEF_INDEX, "0"))
+        )
+
+    # -- main ---------------------------------------------------------------
+    def run(self) -> int:
+        if os.environ.get(constants.TEST_TASK_EXECUTOR_HANG):
+            # Fault injection: hang before ever registering, then die
+            # (TaskExecutor.java:301-318).
+            log.error("TEST_TASK_EXECUTOR_HANG set — hanging")
+            time.sleep(20)
+            return 1
+        self._maybe_sleep_for_skew()
+        cluster_spec = self.register_and_get_cluster_spec()
+        log.info("barrier released; cluster spec: %s", cluster_spec)
+        if self.is_chief() and self.conf.get_bool(keys.K_TENSORBOARD_ENABLED, True):
+            self.tb_port = utils.reserve_port()
+            try:
+                self.client.register_tensorboard_url(
+                    self.task_id, f"http://{self.host}:{self.tb_port}"
+                )
+            except Exception:
+                log.warning("could not register TensorBoard URL", exc_info=True)
+        env = self.build_task_env(cluster_spec)
+        command = self.build_task_command()
+        timeout_ms = (
+            self.conf.get_int(keys.K_WORKER_TIMEOUT, 0)
+            if self.job_name == constants.WORKER_JOB_NAME
+            else 0
+        )
+        log.info("executing: %s", command)
+        rc = utils.execute_shell(command, timeout_ms=timeout_ms, extra_env=env)
+        log.info("user process exited with %d", rc)
+        try:
+            self.client.register_execution_result(
+                rc, self.job_name, str(self.task_index), self.session_id
+            )
+        except Exception:
+            # Advisory call: the backend sees our real exit code either way.
+            log.warning("could not report execution result", exc_info=True)
+        if self.heartbeater is not None:
+            self.heartbeater.stop()
+        self.client.close()
+        return rc
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s executor %(name)s: %(message)s",
+    )
+    executor = TaskExecutor()
+    return executor.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from tony_tpu.history.writer import JobMetadata, create_history_file, setup_job_dir
+
+__all__ = ["JobMetadata", "create_history_file", "setup_job_dir"]

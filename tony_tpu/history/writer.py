@@ -1,0 +1,75 @@
+"""Job-history write path — the analogue of the reference's
+``HistoryFileUtils.java:18-40`` + ``TonyJobMetadata.java:33-43`` +
+``TonyApplicationMaster.setupJobDir:436-454`` / ``writeConfigFile:462-469``:
+
+    <history>/<year>/<month>/<day>/<app_id>/
+        config.json                                  (frozen job config)
+        <app_id>-<started>-<completed>-<user>-<STATUS>.jhist   (metadata file)
+
+The reference encodes all metadata in the `.jhist` *filename* (the file is
+empty) so the history server can list jobs without opening files; we keep
+that trick but also write a JSON body with the same fields for richer UIs.
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import time
+from dataclasses import asdict, dataclass
+from pathlib import Path
+
+from tony_tpu.conf.configuration import TonyConfiguration
+
+
+@dataclass(frozen=True)
+class JobMetadata:
+    app_id: str
+    started_ms: int
+    completed_ms: int
+    user: str
+    status: str  # SUCCEEDED | FAILED | KILLED | RUNNING
+
+    def jhist_name(self) -> str:
+        return (
+            f"{self.app_id}-{self.started_ms}-{self.completed_ms}"
+            f"-{self.user}-{self.status}.jhist"
+        )
+
+    @staticmethod
+    def parse_jhist_name(name: str) -> "JobMetadata":
+        if not name.endswith(".jhist"):
+            raise ValueError(f"not a jhist file: {name}")
+        stem = name[: -len(".jhist")]
+        parts = stem.rsplit("-", 4)
+        if len(parts) != 5:
+            raise ValueError(f"malformed jhist name: {name}")
+        app_id, started, completed, user, status = parts
+        return JobMetadata(app_id, int(started), int(completed), user, status)
+
+    @staticmethod
+    def new(app_id: str, started_ms: int, status: str, user: str | None = None) -> "JobMetadata":
+        return JobMetadata(
+            app_id=app_id,
+            started_ms=started_ms,
+            completed_ms=int(time.time() * 1000),
+            user=user or getpass.getuser(),
+            status=status,
+        )
+
+
+def setup_job_dir(history_location: str, app_id: str, started_ms: int) -> Path:
+    t = time.localtime(started_ms / 1000)
+    job_dir = Path(history_location) / f"{t.tm_year:04d}" / f"{t.tm_mon:02d}" / f"{t.tm_mday:02d}" / app_id
+    job_dir.mkdir(parents=True, exist_ok=True)
+    return job_dir
+
+
+def write_config_file(job_dir: Path, conf: TonyConfiguration) -> None:
+    conf.write_final(job_dir / "config.json")
+
+
+def create_history_file(job_dir: Path, metadata: JobMetadata) -> Path:
+    p = job_dir / metadata.jhist_name()
+    p.write_text(json.dumps(asdict(metadata), indent=2) + "\n")
+    return p

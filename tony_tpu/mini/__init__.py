@@ -1,0 +1,3 @@
+from tony_tpu.mini.mini_cluster import MiniTonyCluster
+
+__all__ = ["MiniTonyCluster"]

@@ -1,0 +1,64 @@
+"""In-process mini cluster — the analogue of ``tony-mini``
+(tony-mini/.../MiniCluster.java:38-64), which spins up MiniYARNCluster +
+MiniDFSCluster for e2e tests without real infrastructure.
+
+Here the substrate is a temp directory (staging + history + logs) and the
+coordinator runs in-process with a ``LocalProcessBackend``, so a full
+client → coordinator → executors → user-script job runs on one machine.
+Every e2e test and ``LocalSubmitter`` builds on this (SURVEY §4: "one
+in-process fake cluster" is the reference's key transferable test idea).
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.app_master import TonyCoordinator
+from tony_tpu.coordinator.backend import LocalProcessBackend
+from tony_tpu.coordinator.session import SessionStatus
+
+
+class MiniTonyCluster:
+    def __init__(self, base_dir: str | Path) -> None:
+        self.base_dir = Path(base_dir)
+        self.staging_dir = self.base_dir / "staging"
+        self.history_dir = self.base_dir / "history"
+        for d in (self.staging_dir, self.history_dir):
+            d.mkdir(parents=True, exist_ok=True)
+        self._app_seq = 0
+
+    def base_conf(self) -> TonyConfiguration:
+        conf = TonyConfiguration()
+        conf.set(keys.K_STAGING_LOCATION, str(self.staging_dir))
+        conf.set(keys.K_HISTORY_LOCATION, str(self.history_dir))
+        conf.set(keys.K_AM_STOP_GRACE_MS, 0)  # no client finish-signal to wait for
+        return conf
+
+    def run_job(
+        self, conf: TonyConfiguration, timeout_s: float = 120.0
+    ) -> tuple[SessionStatus, TonyCoordinator]:
+        """Run one job to completion with an in-process coordinator. The
+        RPC server + executor subprocesses are real; only the "RM" container
+        allocation is replaced by local process spawning."""
+        self._app_seq += 1
+        app_id = f"application_mini_{self._app_seq}"
+        app_dir = self.staging_dir / app_id
+        app_dir.mkdir(parents=True, exist_ok=True)
+        conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+        coordinator = TonyCoordinator(
+            conf, app_dir, app_id=app_id,
+            backend=LocalProcessBackend(app_dir / "logs"),
+        )
+        result: list[SessionStatus] = []
+        t = threading.Thread(target=lambda: result.append(coordinator.run()))
+        t.start()
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            coordinator.kill()
+            t.join(timeout=10)
+            raise TimeoutError(f"job {app_id} did not finish within {timeout_s}s")
+        return result[0], coordinator

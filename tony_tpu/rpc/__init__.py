@@ -1,0 +1,11 @@
+from tony_tpu.rpc.protocol import ApplicationRpc, RpcError, TaskUrl
+from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.rpc.client import ApplicationRpcClient
+
+__all__ = [
+    "ApplicationRpc",
+    "ApplicationRpcServer",
+    "ApplicationRpcClient",
+    "RpcError",
+    "TaskUrl",
+]

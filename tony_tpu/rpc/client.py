@@ -1,0 +1,118 @@
+"""Retrying RPC client — the analogue of ``ApplicationRpcClient.java``
+(tony-core/.../rpc/impl/ApplicationRpcClient.java:41-162): used by both the
+submission client's monitor loop and every task executor. Keeps one
+persistent connection, transparently reconnecting with bounded retries (the
+reference wraps its proxy in a Hadoop RetryPolicy; same idea)."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Any
+
+from tony_tpu.rpc import wire
+from tony_tpu.rpc.protocol import ApplicationRpc, RpcError, TaskUrl
+
+log = logging.getLogger(__name__)
+
+
+class ApplicationRpcClient(ApplicationRpc):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        secret: str | None = None,
+        connect_timeout_s: float = 5.0,
+        call_retries: int = 3,
+        retry_interval_s: float = 0.5,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self._secret = secret
+        self._connect_timeout_s = connect_timeout_s
+        self._call_retries = call_retries
+        self._retry_interval_s = retry_interval_s
+        self._sock: socket.socket | None = None
+        # One in-flight call at a time per client (executor threads share it).
+        self._lock = threading.Lock()
+
+    # -- transport ---------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(
+                (self.host, self.port), timeout=self._connect_timeout_s
+            )
+            s.settimeout(60.0)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _call(self, method: str, **args: Any) -> Any:
+        req = {"method": method, "args": args}
+        if self._secret is not None:
+            req["auth"] = self._secret
+        last_err: Exception | None = None
+        with self._lock:
+            for attempt in range(self._call_retries + 1):
+                try:
+                    sock = self._connect()
+                    wire.send_msg(sock, req)
+                    resp = wire.recv_msg(sock)
+                    if not isinstance(resp, dict):
+                        raise RpcError("malformed response")
+                    if not resp.get("ok"):
+                        raise RpcError(resp.get("error", "unknown remote error"))
+                    return resp.get("result")
+                except RpcError:
+                    raise  # application-level failure: do not retry blindly
+                except (OSError, wire.WireError) as e:
+                    last_err = e
+                    self._sock = None  # force reconnect
+                    if attempt < self._call_retries:
+                        time.sleep(self._retry_interval_s)
+        raise ConnectionError(
+            f"RPC {method} to {self.host}:{self.port} failed after "
+            f"{self._call_retries + 1} attempts: {last_err}"
+        )
+
+    # -- typed API ---------------------------------------------------------
+    def get_task_urls(self) -> list[TaskUrl]:
+        return [TaskUrl.from_json(d) for d in self._call("get_task_urls")]
+
+    def get_cluster_spec(self) -> dict[str, list[str]] | None:
+        return self._call("get_cluster_spec")
+
+    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+        return self._call("register_worker_spec", worker=worker, spec=spec)
+
+    def register_tensorboard_url(self, spec: str, url: str) -> str | None:
+        return self._call("register_tensorboard_url", spec=spec, url=url)
+
+    def register_execution_result(
+        self, exit_code: int, job_name: str, job_index: str, session_id: str
+    ) -> str | None:
+        return self._call(
+            "register_execution_result",
+            exit_code=exit_code,
+            job_name=job_name,
+            job_index=job_index,
+            session_id=session_id,
+        )
+
+    def finish_application(self) -> None:
+        return self._call("finish_application")
+
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        return self._call("task_executor_heartbeat", task_id=task_id)
+
+    def get_application_status(self) -> dict[str, Any]:
+        return self._call("get_application_status")

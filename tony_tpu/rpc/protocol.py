@@ -1,0 +1,100 @@
+"""The control-plane RPC protocol — the analogue of the reference's
+``ApplicationRpc`` interface and its 7 calls
+(tony-core/src/main/proto/tensorflow_cluster_service_protos.proto:11-19,
+tony-core/.../rpc/ApplicationRpc.java).
+
+The reference used Hadoop ProtobufRpcEngine with ~1300 LoC of hand-written
+PB adapters; here the wire format is length-framed JSON over TCP (wire.py) —
+the control plane moves tiny messages at human rates (1 Hz heartbeats,
+one-shot registration), so the framing is chosen for debuggability, and the
+hot data path never touches this channel (it rides ICI/DCN via XLA).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+
+class RpcError(Exception):
+    """Remote call failed application-side (the error travels back framed)."""
+
+
+@dataclass(frozen=True, order=True)
+class TaskUrl:
+    """Per-task log URL (rpc/TaskUrl.java:11-41) — comparable so CLI output
+    is stably sorted."""
+
+    name: str
+    index: int
+    url: str
+
+    def to_json(self) -> dict[str, Any]:
+        return {"name": self.name, "index": self.index, "url": self.url}
+
+    @staticmethod
+    def from_json(d: Mapping[str, Any]) -> "TaskUrl":
+        return TaskUrl(str(d["name"]), int(d["index"]), str(d["url"]))
+
+
+class ApplicationRpc(abc.ABC):
+    """The 7-call protocol served by the coordinator. Implemented by the
+    coordinator's ``RpcForClient`` analogue; called by the submission client
+    and by every task executor."""
+
+    @abc.abstractmethod
+    def get_task_urls(self) -> list[TaskUrl]:
+        ...
+
+    @abc.abstractmethod
+    def get_cluster_spec(self) -> dict[str, list[str]] | None:
+        ...
+
+    @abc.abstractmethod
+    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+        """Rendezvous barrier: returns None until every requested task has
+        registered, then the full cluster spec
+        (TonyApplicationMaster.java:771-806)."""
+
+    @abc.abstractmethod
+    def register_tensorboard_url(self, spec: str, url: str) -> str | None:
+        ...
+
+    @abc.abstractmethod
+    def register_execution_result(
+        self, exit_code: int, job_name: str, job_index: str, session_id: str
+    ) -> str | None:
+        """Advisory only — container exit status is the source of truth
+        (TonyApplicationMaster.java:808-824)."""
+
+    @abc.abstractmethod
+    def finish_application(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_application_status(self) -> dict[str, Any]:
+        """{"state": RUNNING|SUCCEEDED|FAILED|KILLED, "diagnostics": str}.
+
+        Not one of the reference's 7 calls: there the client polls the YARN
+        ResourceManager's ApplicationReport (TonyClient.monitorApplication:
+        631-672). This build has no external RM, so the coordinator serves
+        its own status."""
+
+
+# Method name → (argument names) — the wire-level registry. Adding a call
+# means adding it here, on ApplicationRpc, and in client.py's typed wrappers.
+RPC_METHODS: dict[str, tuple[str, ...]] = {
+    "get_task_urls": (),
+    "get_cluster_spec": (),
+    "register_worker_spec": ("worker", "spec"),
+    "register_tensorboard_url": ("spec", "url"),
+    "register_execution_result": ("exit_code", "job_name", "job_index", "session_id"),
+    "finish_application": (),
+    "task_executor_heartbeat": ("task_id",),
+    "get_application_status": (),
+}

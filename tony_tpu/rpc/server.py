@@ -1,0 +1,117 @@
+"""Threaded RPC server hosted inside the coordinator — the analogue of
+``ApplicationRpcServer.java`` (tony-core/.../rpc/ApplicationRpcServer.java:24-154):
+binds a port from a configured range (default 10000-15000, matching
+ApplicationRpcServer.java:36), dispatches the 7-call protocol to an
+``ApplicationRpc`` implementation, and optionally enforces a shared-secret
+token (the ClientToAM-token analogue, TonyApplicationMaster.java:401-411).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import socketserver
+import threading
+from typing import Any
+
+from tony_tpu.rpc import wire
+from tony_tpu.rpc.protocol import RPC_METHODS, ApplicationRpc, TaskUrl
+
+log = logging.getLogger(__name__)
+
+
+def _encode(result: Any) -> Any:
+    if isinstance(result, list) and result and isinstance(result[0], TaskUrl):
+        return [t.to_json() for t in result]
+    if isinstance(result, TaskUrl):
+        return result.to_json()
+    return result
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "ApplicationRpcServer" = self.server.rpc_server  # type: ignore[attr-defined]
+        try:
+            while True:
+                try:
+                    req = wire.recv_msg(self.request)
+                except wire.WireError:
+                    return  # client hung up
+                wire.send_msg(self.request, server.dispatch(req))
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ApplicationRpcServer:
+    """Serve an ``ApplicationRpc`` impl over framed JSON. Connections are
+    persistent (the heartbeater keeps one open); each connection gets a
+    thread, which is fine at control-plane scale (1 client + N executors)."""
+
+    def __init__(
+        self,
+        impl: ApplicationRpc,
+        host: str = "0.0.0.0",
+        port_range: tuple[int, int] = (10000, 15000),
+        secret: str | None = None,
+    ) -> None:
+        self._impl = impl
+        self._secret = secret
+        self.host = host
+        self.port = self._bind(host, port_range)
+        self._thread: threading.Thread | None = None
+
+    def _bind(self, host: str, port_range: tuple[int, int]) -> int:
+        lo, hi = port_range
+        # Random start then linear probe — same spirit as the reference's
+        # random port in 10000-15000 (ApplicationRpcServer.java:36).
+        start = random.randint(lo, hi)
+        for off in range(hi - lo + 1):
+            port = lo + (start - lo + off) % (hi - lo + 1)
+            try:
+                self._server = _TcpServer((host, port), _Handler, bind_and_activate=True)
+                self._server.rpc_server = self  # type: ignore[attr-defined]
+                return port
+            except OSError:
+                continue
+        raise OSError(f"no free port in {lo}-{hi}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+        log.info("RPC server listening on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, req: Any) -> dict[str, Any]:
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be an object"}
+        if self._secret is not None and req.get("auth") != self._secret:
+            return {"ok": False, "error": "authentication failed"}
+        method = req.get("method")
+        if method not in RPC_METHODS:
+            return {"ok": False, "error": f"unknown method {method!r}"}
+        wanted = RPC_METHODS[method]
+        args = req.get("args") or {}
+        if set(args) != set(wanted):
+            return {
+                "ok": False,
+                "error": f"{method} expects args {sorted(wanted)}, got {sorted(args)}",
+            }
+        try:
+            result = getattr(self._impl, method)(**args)
+            return {"ok": True, "result": _encode(result)}
+        except Exception as e:  # noqa: BLE001 — errors must travel back framed
+            log.exception("RPC %s failed", method)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}

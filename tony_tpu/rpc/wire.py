@@ -1,0 +1,50 @@
+"""Length-framed JSON wire format shared by RPC client and server.
+
+Frame = 4-byte big-endian payload length + UTF-8 JSON payload.
+Request:  {"method": str, "args": {...}, "auth": str|absent}
+Response: {"ok": true, "result": ...} | {"ok": false, "error": str}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any
+
+MAX_FRAME = 64 * 1024 * 1024  # control-plane messages are tiny; this is a DoS guard
+
+_LEN = struct.Struct(">I")
+
+
+class WireError(Exception):
+    """Malformed frame or closed connection mid-frame."""
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(payload)}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise WireError(f"frame too large: {length}")
+    payload = _recv_exact(sock, length)
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"bad payload: {e}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,76 @@
+"""User-facing runtime helpers for training scripts launched by tony_tpu.
+
+The executor injects the env contract; a JAX training script needs exactly
+one call before touching devices::
+
+    import tony_tpu.runtime as rt
+    rt.initialize()          # no-op when launched standalone / single-process
+
+This is the TPU-native replacement for the reference's convention of user
+scripts hand-parsing TF_CONFIG or RANK/INIT_METHOD (e.g.
+tony-examples/mnist-tensorflow/mnist_distributed.py:188-220 and
+mnist-pytorch/mnist_distributed.py:185-214).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from tony_tpu import constants
+
+
+@dataclass(frozen=True)
+class TaskContext:
+    job_name: str
+    task_index: int
+    task_num: int
+    session_id: str
+    process_id: int
+    num_processes: int
+    coordinator_address: str | None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.coordinator_address is not None and self.num_processes > 1
+
+
+def task_context() -> TaskContext:
+    env = os.environ
+    return TaskContext(
+        job_name=env.get(constants.JOB_NAME, "worker"),
+        task_index=int(env.get(constants.TASK_INDEX, "0")),
+        task_num=int(env.get(constants.TASK_NUM, "1")),
+        session_id=env.get(constants.SESSION_ID, "0"),
+        process_id=int(env.get(constants.TONY_PROCESS_ID, "0")),
+        num_processes=int(env.get(constants.TONY_NUM_PROCESSES, "1")),
+        coordinator_address=env.get(constants.TONY_COORDINATOR_ADDRESS),
+    )
+
+
+def cluster_spec() -> dict[str, list[str]] | None:
+    raw = os.environ.get(constants.CLUSTER_SPEC)
+    return json.loads(raw) if raw else None
+
+
+def initialize(**kwargs) -> TaskContext:
+    """Initialize jax.distributed from the injected env. Outside a tony_tpu
+    job (or in a single-process job) this is a no-op, so scripts run
+    unchanged locally."""
+    ctx = task_context()
+    if ctx.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+            **kwargs,
+        )
+    return ctx
+
+
+def tensorboard_port() -> int | None:
+    raw = os.environ.get(constants.TB_PORT)
+    return int(raw) if raw else None

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import json
 import os
-import shlex
 import socket
 import subprocess
 import time
@@ -77,7 +76,8 @@ def zip_dir(src_dir: str | os.PathLike[str], dst_zip: str | os.PathLike[str]) ->
     src = Path(src_dir)
     with zipfile.ZipFile(dst_zip, "w", zipfile.ZIP_DEFLATED) as zf:
         for p in sorted(src.rglob("*")):
-            if p.is_file():
+            if p.is_file() or (p.is_dir() and not any(p.iterdir())):
+                # empty dirs get explicit entries so unzip restores them
                 zf.write(p, p.relative_to(src))
 
 
@@ -99,7 +99,25 @@ def reserve_port(host: str = "127.0.0.1") -> int:
 
 
 def local_host() -> str:
-    return socket.gethostbyname(socket.gethostname())
+    """Best-effort externally-reachable address of this host. The UDP
+    connect never sends a packet; it just asks the kernel which interface
+    would route outward — avoiding the 127.0.1.1 /etc/hosts trap that
+    hostname resolution falls into on stock Debian images."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            addr = s.getsockname()[0]
+            if not addr.startswith("127."):
+                return addr
+    except OSError:
+        pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"
 
 
 # ---------------------------------------------------------------------------
@@ -234,14 +252,14 @@ def flatten_cluster_spec(
     jax.distributed process ids. The chief job type sorts first so that
     process 0 is always chief:0 — jax.distributed starts the coordinator on
     process 0, which must match coordinator_address_from_spec. Remaining job
-    types sort alphabetically; indices are already dense per job."""
+    types sort alphabetically; indices are already dense per job. Raises if
+    the chief job type is absent (a silent fallback would assign process 0
+    to a non-coordinator and deadlock initialization with no diagnostic)."""
+    if chief_name not in cluster_spec:
+        raise ValueError(f"no {chief_name!r} tasks in cluster spec")
     out: list[tuple[str, int, str]] = []
     ordered = sorted(cluster_spec, key=lambda j: (j != chief_name, j))
     for job in ordered:
         for idx, addr in enumerate(cluster_spec[job]):
             out.append((job, idx, addr))
     return out
-
-
-def shlex_join(parts: Sequence[str]) -> str:
-    return " ".join(shlex.quote(p) for p in parts)
