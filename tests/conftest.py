@@ -4,11 +4,20 @@ the reference test strategy — SURVEY.md §4 — applied to devices)."""
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Forced (not setdefault): the ambient environment pins JAX_PLATFORMS to the
+# real TPU and a sitecustomize imports jax at interpreter startup, so both
+# the env var AND the already-imported jax config must be overridden before
+# any backend initializes. Tests always run on the virtual CPU mesh;
+# bench.py is the only entry point that targets the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,232 @@
+"""Parallel-layer tests on the virtual 8-device CPU mesh (conftest.py sets
+--xla_force_host_platform_device_count=8 — the mini-cluster idea applied to
+devices, per SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu.parallel import (
+    MeshSpec,
+    all_gather_tp,
+    all_to_all_ep,
+    build_mesh,
+    logical_sharding,
+    logical_spec,
+    pipeline_apply,
+    pmean_gradients,
+    reduce_scatter_tp,
+    ring_attention,
+    ring_halo_exchange,
+)
+from tony_tpu.parallel.mesh import round_up_to_slice
+
+
+def reference_attention(q, k, v, causal=True):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = np.tril(np.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class TestMeshSpec:
+    def test_auto_factors_all_devices(self):
+        spec = MeshSpec.auto(8)
+        assert spec.num_devices == 8
+
+    def test_auto_respects_fixed_axes(self):
+        spec = MeshSpec.auto(8, tp=4)
+        assert spec.tp == 4 and spec.num_devices == 8
+
+    def test_auto_with_fixed_dp_absorbs_leftover(self):
+        # Leftover factor must land on an unset axis, not be dropped.
+        spec = MeshSpec.auto(16, dp=1)
+        assert spec.dp == 1 and spec.num_devices == 16
+        spec = MeshSpec.auto(16, dp=2)
+        assert spec.dp == 2 and spec.num_devices == 16
+
+    def test_auto_all_axes_fixed_wrong_product(self):
+        with pytest.raises(ValueError):
+            MeshSpec.auto(16, dp=1, pp=1, ep=1, sp=2, tp=2)
+
+    def test_auto_rejects_non_dividing(self):
+        with pytest.raises(ValueError):
+            MeshSpec.auto(8, tp=3)
+
+    def test_validate_rejects_wrong_count(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=4).validate(8)
+
+    def test_build_mesh_has_five_axes(self):
+        mesh = build_mesh()
+        assert set(mesh.axis_names) == {"dp", "pp", "ep", "sp", "tp"}
+        assert mesh.devices.size == 8
+
+    def test_round_up_to_slice(self):
+        assert round_up_to_slice(3) == 4
+        assert round_up_to_slice(8) == 8
+        assert round_up_to_slice(9) == 16
+        with pytest.raises(ValueError):
+            round_up_to_slice(10_000)
+
+
+class TestLogicalSharding:
+    def test_spec_mapping(self):
+        assert logical_spec("batch", "seq", "embed") == P(("dp", "ep"), "sp", None)
+
+    def test_unknown_role_raises(self):
+        with pytest.raises(KeyError):
+            logical_spec("batch", "head")  # typo for "heads"
+
+    def test_sharding_places_array(self):
+        mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        x = jnp.zeros((8, 16, 4))
+        sh = logical_sharding(mesh, "batch", "seq", None)
+        y = jax.device_put(x, sh)
+        assert y.sharding.spec == P(("dp", "ep"), "sp", None)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = build_mesh(MeshSpec(sp=4, tp=2))
+        rng = np.random.default_rng(0)
+        b, t, h, d = 2, 32, 4, 8
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype=jnp.float32)
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_grad_flows(self):
+        mesh = build_mesh(MeshSpec(sp=2, dp=2, tp=2))
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), dtype=jnp.float32)
+
+        def loss(q):
+            return ring_attention(q, q, q, mesh).sum()
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestCollectives:
+    def _run(self, mesh, fn, in_specs, out_specs, *args):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )(*args)
+
+    def test_pmean_gradients(self):
+        mesh = build_mesh(MeshSpec(dp=4, ep=2))
+        x = jnp.arange(8.0).reshape(8, 1)
+
+        def body(g):
+            return pmean_gradients({"g": g})["g"]
+
+        out = self._run(mesh, body, (P(("dp", "ep")),), P(("dp", "ep")), x)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
+
+    def test_all_gather_then_reduce_scatter_roundtrip(self):
+        mesh = build_mesh(MeshSpec(tp=8))
+        x = jnp.arange(16.0).reshape(16, 1)
+
+        def body(x):
+            g = all_gather_tp(x, axis=0)          # [16,1] per shard
+            return reduce_scatter_tp(g, axis=0)   # back to [2,1], ×8
+
+        out = self._run(mesh, body, (P("tp"),), P("tp"), x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 8)
+
+    def test_all_to_all_ep(self):
+        mesh = build_mesh(MeshSpec(ep=4, dp=2))
+        # [tokens=4, experts=4]: shard tokens, transpose to shard experts.
+        x = jnp.arange(16.0).reshape(4, 4)
+
+        def body(x):
+            return all_to_all_ep(x, split_axis=1, concat_axis=0)
+
+        out = self._run(mesh, body, (P("ep"),), P(None, "ep"), x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_ring_halo_exchange(self):
+        mesh = build_mesh(MeshSpec(sp=4, dp=2))
+        x = jnp.arange(16.0).reshape(16, 1)
+
+        def body(x):
+            prev, nxt = ring_halo_exchange(x, "sp", halo=1)
+            return jnp.concatenate([prev, nxt], axis=0)
+
+        out = self._run(mesh, body, (P("sp"),), P("sp"), x)
+        out = np.asarray(out).reshape(4, 2)
+        # shard i holds rows [4i..4i+3]; prev-halo = last row of shard i-1,
+        # next-halo = first row of shard i+1 (ring wrap).
+        for i in range(4):
+            assert out[i, 0] == (4 * ((i - 1) % 4) + 3)
+            assert out[i, 1] == (4 * ((i + 1) % 4))
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        n_stages = 4
+        mesh = build_mesh(MeshSpec(pp=n_stages, dp=2))
+        rng = np.random.default_rng(2)
+        dim = 8
+        w = jnp.asarray(rng.normal(size=(n_stages, dim, dim)) * 0.3)
+        b = jnp.asarray(rng.normal(size=(n_stages, dim)) * 0.1)
+        params = {"w": w, "b": b}
+        x = jnp.asarray(rng.normal(size=(16, dim)))
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        out = pipeline_apply(
+            stage_fn, params, x, mesh=mesh, num_microbatches=4
+        )
+        expected = x
+        for i in range(n_stages):
+            expected = jnp.tanh(expected @ w[i] + b[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+    def test_grad_through_pipeline(self):
+        n_stages = 2
+        mesh = build_mesh(MeshSpec(pp=n_stages, dp=2, tp=2))
+        rng = np.random.default_rng(3)
+        dim = 4
+        params = {"w": jnp.asarray(rng.normal(size=(n_stages, dim, dim)) * 0.3)}
+        x = jnp.asarray(rng.normal(size=(8, dim)))
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def loss(params):
+            return pipeline_apply(
+                stage_fn, params, x, mesh=mesh, num_microbatches=2
+            ).sum()
+
+        g = jax.grad(loss)(params)
+
+        def ref_loss(params):
+            h = x
+            for i in range(n_stages):
+                h = jnp.tanh(h @ params["w"][i])
+            return h.sum()
+
+        g_ref = jax.grad(ref_loss)(params)
+        np.testing.assert_allclose(
+            np.asarray(g["w"]), np.asarray(g_ref["w"]), atol=1e-5
+        )
+
+    def test_rejects_bad_microbatch(self):
+        mesh = build_mesh(MeshSpec(pp=2, dp=4))
+        with pytest.raises(ValueError):
+            pipeline_apply(
+                lambda p, x: x, {"w": jnp.zeros((2, 1))},
+                jnp.zeros((7, 4)), mesh=mesh, num_microbatches=2,
+            )

@@ -1,0 +1,40 @@
+"""Parallelism layer: device meshes, sharding rules, and the collective
+programs (ring attention, pipeline schedule) that the reference delegated to
+TF/PyTorch runtimes (SURVEY.md §2.3). TPU-native: everything here is
+``jax.sharding.Mesh`` + ``pjit``/``shard_map`` over ICI, not NCCL/MPI.
+"""
+
+from tony_tpu.parallel.collectives import (
+    all_gather_tp,
+    all_to_all_ep,
+    pmean_gradients,
+    reduce_scatter_tp,
+    ring_halo_exchange,
+)
+from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+from tony_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    with_logical_constraint,
+)
+from tony_tpu.parallel.ring import ring_attention
+from tony_tpu.parallel.pipeline import pipeline_apply
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "all_gather_tp",
+    "all_to_all_ep",
+    "pmean_gradients",
+    "reduce_scatter_tp",
+    "ring_halo_exchange",
+    "LOGICAL_RULES",
+    "logical_sharding",
+    "logical_spec",
+    "shard_pytree",
+    "with_logical_constraint",
+    "ring_attention",
+    "pipeline_apply",
+]

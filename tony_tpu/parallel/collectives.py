@@ -1,0 +1,54 @@
+"""Named-axis collective helpers used by the model layer.
+
+These are the ICI-native replacements for the backend traffic the reference
+delegated to TF gRPC / gloo / NCCL (SURVEY.md §2.3 "Communication backend"):
+gradient reduction = psum over dp, tensor-parallel activation assembly =
+all_gather over tp, MoE token routing = all_to_all over ep. XLA lowers each
+to the right ICI/DCN collective for the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def pmean_gradients(grads, axis_names=("dp", "ep")):
+    """Average gradients over the data-parallel axes (inside shard_map)."""
+    for ax in axis_names:
+        grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
+    return grads
+
+
+def all_gather_tp(x: jax.Array, axis: int, axis_name: str = "tp") -> jax.Array:
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter_tp(x: jax.Array, axis: int, axis_name: str = "tp") -> jax.Array:
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all_ep(
+    x: jax.Array, split_axis: int, concat_axis: int, axis_name: str = "ep"
+) -> jax.Array:
+    """Token shuffle for expert parallelism: split the expert dimension
+    across ep devices, concatenate the token dimension back."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def ring_halo_exchange(
+    x: jax.Array, axis_name: str, halo: int, axis: int = 0
+) -> tuple[jax.Array, jax.Array]:
+    """Exchange ``halo``-wide boundary slabs with both ring neighbours
+    (used by conv-style ops under spatial partitioning). Returns
+    (from_prev, from_next)."""
+    n = lax.axis_size(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    lo = lax.slice_in_dim(x, 0, halo, axis=axis)
+    hi = lax.slice_in_dim(x, x.shape[axis] - halo, x.shape[axis], axis=axis)
+    from_prev = lax.ppermute(hi, axis_name, fwd)
+    from_next = lax.ppermute(lo, axis_name, bwd)
+    return from_prev, from_next

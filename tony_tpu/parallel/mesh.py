@@ -1,0 +1,148 @@
+"""Device-mesh construction for the five first-class parallelism axes.
+
+The reference's only scaling axis is instance-count per job type
+(tony-core/.../util/Utils.java:288-314 parses ``tony.<job>.instances``); the
+TPU rebuild scales inside the slice instead, over a named
+``jax.sharding.Mesh`` with axes:
+
+  dp  — data parallel (batch split, gradients psum'd)
+  pp  — pipeline parallel (layer stages, activations ppermute'd)
+  sp  — sequence/context parallel (ring attention over the sequence axis)
+  tp  — tensor parallel (heads / mlp-hidden split, activations all-gathered)
+  ep  — expert parallel (MoE experts, tokens all_to_all'd)
+
+Axis order puts tp innermost so the highest-traffic collective rides the
+shortest ICI hops (scaling-book recipe: innermost mesh axis = adjacent
+devices on the torus).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (DCN-friendly) to innermost (ICI-hot).
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A validated mesh shape over the five parallelism axes."""
+
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.ep, self.sp, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def validate(self, num_devices: int | None = None) -> "MeshSpec":
+        for name, size in zip(AXES, self.shape):
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} must be >= 1, got {size}")
+        if num_devices is not None and self.num_devices != num_devices:
+            raise ValueError(
+                f"mesh spec {self.shape} needs {self.num_devices} devices, "
+                f"have {num_devices}"
+            )
+        return self
+
+    @staticmethod
+    def auto(
+        num_devices: int,
+        *,
+        dp: int | None = None,
+        pp: int | None = None,
+        ep: int | None = None,
+        sp: int | None = None,
+        tp: int | None = None,
+    ) -> "MeshSpec":
+        """Fill unset axes by factoring ``num_devices``, preferring (in order)
+        tp, sp, pp, dp — the axes whose collectives benefit most from short
+        ICI hops get sized first; dp absorbs the remainder (its gradient
+        psum is the most latency-tolerant collective).
+        """
+        fixed = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
+        sized = math.prod(v for v in fixed.values() if v is not None)
+        if num_devices % max(sized, 1) != 0:
+            raise ValueError(
+                f"fixed axes {fixed} do not divide device count {num_devices}"
+            )
+        rest = num_devices // max(sized, 1)
+        out = dict(fixed)
+        for axis in ("tp", "sp", "pp"):
+            if out[axis] is None:
+                f = _largest_factor_at_most(rest, 2)
+                out[axis] = f
+                rest //= f
+        for axis in ("ep",):
+            if out[axis] is None:
+                out[axis] = 1
+        # The leftover factor goes to the first unset axis that can take it
+        # (dp by preference — its gradient psum tolerates long hops best).
+        if fixed["dp"] is None:
+            out["dp"] = rest
+        else:
+            for axis in ("pp", "sp", "tp", "ep"):
+                if fixed[axis] is None and rest > 1:
+                    out[axis] *= rest
+                    rest = 1
+                    break
+            if rest > 1:
+                raise ValueError(
+                    f"all axes fixed as {fixed} but {rest}x devices left over "
+                    f"for {num_devices} devices"
+                )
+        spec = MeshSpec(**{k: int(v) for k, v in out.items()})
+        return spec.validate(num_devices)
+
+
+def _largest_factor_at_most(n: int, cap: int) -> int:
+    for f in range(min(cap, n), 0, -1):
+        if n % f == 0:
+            return f
+    return 1
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a 5-axis Mesh. With no spec, auto-factor over all local devices.
+
+    On a real TPU slice `jax.devices()` is already ordered so that adjacent
+    ids are ICI neighbours; reshaping in C-order therefore keeps the
+    innermost axes (sp, tp) on the shortest hops.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if spec is None:
+        spec = MeshSpec.auto(len(devices))
+    spec.validate(len(devices))
+    dev_array = np.asarray(devices).reshape(spec.shape)
+    return Mesh(dev_array, AXES)
+
+
+def round_up_to_slice(chips: int, generation: str = "v5e") -> int:
+    """Smallest legal slice size that fits ``chips`` chips. The quantization
+    table lives with the scheduler (coordinator/backend.py SLICE_SHAPES) —
+    single source of truth for what a generation offers."""
+    from tony_tpu.coordinator.backend import SLICE_SHAPES
+
+    sizes = sorted(SLICE_SHAPES[generation])
+    for n in sizes:
+        if n >= chips:
+            return n
+    raise ValueError(
+        f"no legal {generation} slice holds {chips} chips (max {sizes[-1]})"
+    )

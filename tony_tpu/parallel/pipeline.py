@@ -1,0 +1,102 @@
+"""Pipeline parallelism as a collective program: layer stages live on the
+``pp`` mesh axis, activations flow stage-to-stage with ``ppermute`` under a
+GPipe microbatch schedule expressed as one ``lax.scan`` — so the whole
+schedule is a single XLA computation (traced once, no host control flow),
+and ``jax.grad`` differentiates straight through it (backward pipeline for
+free, reverse ppermutes inserted by AD).
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 table: PP = No);
+this is new TPU-first capability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
+    """Runs inside shard_map over ``axis_name``.
+
+    params: this stage's params, leading stage axis of local size 1.
+    x_mb:   [num_micro, mb, ...] microbatched input (replicated over pp).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    num_micro = x_mb.shape[0]
+    my_params = jax.tree.map(lambda p: p[0], params)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    ticks = num_micro + n_stages - 1
+
+    def tick(carry, t):
+        state, out = carry
+        # Stage 0 injects microbatch t (clamped; garbage ticks are never read
+        # back because their outputs fall outside the valid output window).
+        mb = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage_idx == 0, mb, state)
+        y = stage_fn(my_params, x_in)
+        # Last stage emits microbatch t-(n_stages-1); earlier ticks write to
+        # a clamped slot that later valid writes overwrite in order.
+        out_t = t - (n_stages - 1)
+        out = lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(out_t, 0, num_micro - 1), axis=0
+        )
+        state_next = lax.ppermute(y, axis_name, fwd_perm)
+        return (state_next, out), None
+
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+    # Only the last stage holds real outputs; masked psum broadcasts them so
+    # every stage returns the same array (loss is computed replicated).
+    mask = (stage_idx == n_stages - 1).astype(out.dtype)
+    return lax.psum(out * mask, axis_name)
+
+
+def pipeline_apply(
+    stage_fn,
+    stage_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    data_spec: P | None = None,
+):
+    """Apply ``stage_fn`` (params, x) -> y through ``pp`` pipeline stages.
+
+    stage_params: pytree whose leaves have a leading axis of size pp,
+    sharded over ``axis_name`` (one stage per pp-device). ``stage_fn`` must
+    map microbatch -> microbatch of identical shape (the classic GPipe
+    constraint — embed/unembed live outside the pipelined trunk).
+
+    x: [batch, ...]; batch must divide by num_microbatches. ``data_spec`` is
+    the PartitionSpec of the *microbatched* [num_micro, mb, ...] array: its
+    leading (microbatch) entry must not use ``axis_name``; later entries may
+    shard over dp/sp/tp as usual. Default: replicated.
+    """
+    if x.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {num_microbatches} microbatches"
+        )
+    mb = x.shape[0] // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    in_spec = data_spec if data_spec is not None else P()
+
+    def body(params, xm):
+        return _pipeline_local(params, xm, stage_fn=stage_fn, axis_name=axis_name)
+
+    out_mb = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, in_spec),
+        out_specs=in_spec,
+        check_vma=False,
+    )(stage_params, x_mb)
+    return out_mb.reshape((num_microbatches * mb,) + out_mb.shape[2:])
