@@ -1,0 +1,229 @@
+"""Ops-layer tests: the Pallas kernels run in interpret mode on CPU so
+kernel math is validated without TPU hardware; the blockwise-JAX paths are
+checked against naive references and through grad."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    rms_norm,
+    rope_frequencies,
+    softmax_cross_entropy,
+)
+from tony_tpu.ops.attention import _blockwise_attention_jax, _flash_attention_pallas
+
+
+def naive_attention(q, k, v, causal=True):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = np.arange(tq)[:, None] >= np.arange(tk)[None, :]
+        s = jnp.where(jnp.asarray(mask)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 64, 2, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), dtype=jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_jax_path_matches_naive(self, qkv, causal):
+        q, k, v = qkv
+        out = flash_attention(q, k, v, causal=causal, block_k=16, force_jax=True)
+        ref = naive_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_pallas_kernel_interpret_matches_naive(self, qkv, causal):
+        q, k, v = qkv
+        b, t, h, d = q.shape
+        qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        out = _flash_attention_pallas(
+            qf, kf, vf, causal=causal, scale=d**-0.5,
+            block_q=16, block_k=16, interpret=True,
+        )
+        out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        ref = naive_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_uneven_block_sizes(self, qkv):
+        q, k, v = qkv
+        out = flash_attention(q, k, v, block_q=48, block_k=48, force_jax=True)
+        ref = naive_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_uneven_blocks_pallas_interpret(self, qkv):
+        q, k, v = qkv
+        b, t, h, d = q.shape
+        qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        out = _flash_attention_pallas(
+            qf, kf, vf, causal=True, scale=d**-0.5,
+            block_q=48, block_k=48, interpret=True,
+        )
+        out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        ref = naive_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_cross_attention_lengths(self):
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 8, 2, 8)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal=False, block_k=8, force_jax=True)
+        ref = naive_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def naive_decode_attention(self, q, k, v):
+        """Causal with the query block at the END of the key range."""
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = (tk - tq) + np.arange(tq)
+        mask = q_pos[:, None] >= np.arange(tk)[None, :]
+        s = jnp.where(jnp.asarray(mask)[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def test_causal_decode_attends_full_prefix(self):
+        """t_q=1 against a t_k=8 cache must attend to ALL 8 keys (decode
+        convention), not just key 0."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 8, 2, 8)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 8, 2, 8)), dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal=True, block_k=4, force_jax=True)
+        ref = self.naive_decode_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_causal_decode_pallas_interpret(self):
+        rng = np.random.default_rng(8)
+        tq, tk, d = 4, 32, 8
+        q = jnp.asarray(rng.normal(size=(2, tq, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, tk, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, tk, d)), dtype=jnp.float32)
+        out = _flash_attention_pallas(
+            q, k, v, causal=True, scale=d**-0.5,
+            block_q=4, block_k=8, interpret=True,
+        )
+        ref = self.naive_decode_attention(
+            q.reshape(2, tq, 1, d),
+            k.reshape(2, tk, 1, d),
+            v.reshape(2, tk, 1, d),
+        ).reshape(2, tq, d)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_grad_matches_naive(self, qkv):
+        q, k, v = qkv
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, block_k=16, force_jax=True).sum()
+
+        def loss_naive(q, k, v):
+            return naive_attention(q, k, v).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_bf16_runs(self, qkv):
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+        out = flash_attention(q, k, v, force_jax=True)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestRmsNorm:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(4, 32)), dtype=jnp.float32)
+        w = jnp.asarray(rng.normal(size=(32,)), dtype=jnp.float32)
+        out = rms_norm(x, w, force_jax=True)
+        ref = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_pallas_kernel_interpret_matches_jax(self):
+        from tony_tpu.ops.norms import _rms_norm_jax, _rms_norm_pallas
+
+        rng = np.random.default_rng(6)
+        x = jnp.asarray(rng.normal(size=(300, 32)), dtype=jnp.float32)
+        w = jnp.asarray(rng.normal(size=(32,)), dtype=jnp.float32)
+        out = _rms_norm_pallas(x, w, 1e-6, block_rows=128, interpret=True)
+        ref = _rms_norm_jax(x, w, 1e-6)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_grad_finite(self):
+        x = jnp.ones((2, 8))
+        w = jnp.ones((8,))
+        g = jax.grad(lambda x: rms_norm(x, w, force_jax=True).sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), dtype=jnp.float32)
+        cos, sin = rope_frequencies(8, 32)
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1),
+            atol=1e-4,
+        )
+
+    def test_position_offset_matches_slicing(self):
+        """Sharded application with explicit positions == slicing the full
+        result (the sequence-parallel contract)."""
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), dtype=jnp.float32)
+        cos, sin = rope_frequencies(8, 32)
+        full = apply_rope(x, cos, sin)
+        half = apply_rope(x[:, 8:], cos, sin, positions=jnp.arange(8, 16))
+        np.testing.assert_allclose(
+            np.asarray(full[:, 8:]), np.asarray(half), atol=1e-6
+        )
+
+    def test_position_zero_is_identity(self):
+        x = jnp.ones((1, 1, 1, 8))
+        cos, sin = rope_frequencies(8, 4)
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+
+class TestCrossEntropy:
+    def test_matches_naive(self):
+        rng = np.random.default_rng(5)
+        logits = jnp.asarray(rng.normal(size=(4, 10)), dtype=jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 10, size=(4,)))
+        out = softmax_cross_entropy(logits, labels)
+        p = jax.nn.log_softmax(logits)
+        ref = -p[jnp.arange(4), labels].mean()
+        np.testing.assert_allclose(float(out), float(ref), atol=1e-6)
+
+    def test_mask_excludes_entries(self):
+        logits = jnp.zeros((4, 10))
+        labels = jnp.zeros((4,), dtype=jnp.int32)
+        where = jnp.asarray([True, True, False, False])
+        out = softmax_cross_entropy(logits, labels, where=where)
+        full = softmax_cross_entropy(logits[:2], labels[:2])
+        np.testing.assert_allclose(float(out), float(full), atol=1e-6)
+
+    def test_extreme_logits_stable(self):
+        logits = jnp.asarray([[1e4, -1e4, 0.0]])
+        labels = jnp.asarray([0])
+        out = softmax_cross_entropy(logits, labels)
+        assert np.isfinite(float(out))

@@ -1,0 +1,22 @@
+"""Hot-path ops: Pallas TPU kernels with pure-JAX fallbacks.
+
+The reference has no compute ops at all (SURVEY.md: "no kernels, no autograd,
+no tensors") — this layer is the TPU-native capability the rebuild adds so
+the framework's models keep the MXU busy: flash attention, fused RMSNorm,
+RoPE, stable cross-entropy. Every op dispatches to a Pallas kernel on TPU
+and a numerically identical blockwise-JAX path elsewhere (which is also the
+recompute used for the backward pass).
+"""
+
+from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.norms import rms_norm
+from tony_tpu.ops.rope import apply_rope, rope_frequencies
+from tony_tpu.ops.losses import softmax_cross_entropy
+
+__all__ = [
+    "flash_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,261 @@
+"""Flash attention: online-softmax blockwise attention that never
+materializes the [T, T] score matrix.
+
+Forward on TPU is a Pallas kernel (grid over (batch x heads, q-blocks); K/V
+blocks stream through VMEM; MXU does the two matmuls per block in fp32
+accumulation). Everywhere else — and for the backward pass — a blockwise
+``lax.scan`` computes the same math, so results match to fp tolerance and
+memory stays O(T · block) in both directions.
+
+Public layout is [batch, seq, heads, head_dim], the same as
+``tony_tpu.parallel.ring_attention``. Ring attention carries its own
+per-block accumulation (it must merge partial (o, m, l) statistics across
+ring steps, which this op's public API does not expose) — its bias-based
+masking makes the two paths intentionally independent implementations,
+cross-checked against each other in tests.
+
+Causal masking follows the decode convention: when t_q != t_k the query
+block sits at the END of the key range (query row i has global position
+t_k - t_q + i), so KV-cache decode attends to the full prefix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    return platform in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, t_k, t_q
+):
+    """One program = one (batch*head, q-block). Refs:
+    q_ref [1, block_q, d], k_ref/v_ref [1, t_k_padded, d],
+    o_ref [1, block_q, d]. ``t_k``/``t_q`` are real (pre-padding) lengths.
+    """
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    # Decode convention: the query block sits at the END of the key range,
+    # so global query position = t_k - t_q + row (self-attention reduces to
+    # position == row).
+    q_off = t_k - t_q
+    num_k_blocks = pl.cdiv(t_k, block_k)
+    if causal:
+        # q block rows end at global position q_off + (qi+1)*block_q - 1:
+        # kv blocks past that are fully masked — skip them entirely (halves
+        # the FLOPs for self-attention).
+        num_k_blocks = lax.min(
+            num_k_blocks, pl.cdiv(q_off + (qi + 1) * block_q, block_k)
+        )
+
+    q_pos = (
+        q_off + qi * block_q
+        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    )
+
+    def body(ki, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if t_k % block_k:
+            # Final block reads past t_k (pallas pads); mask the tail keys.
+            s = jnp.where(k_pos < t_k, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # Fully-masked rows keep m_new at NEG_INF; shift to 0 so exp is safe.
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    o, _, l = lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(
+    q, k, v, *, causal, scale, block_q, block_k, interpret=False
+):
+    """q,k,v: [BH, T, D] (batch and heads pre-flattened)."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    # Pad keys to a block multiple: the kernel's pl.ds would clamp an
+    # out-of-bounds read of the final partial block (double-counting rows).
+    pad_k = (-t_k) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    grid = (bh, pl.cdiv(t_q, block_q))
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        t_k=t_k, t_q=t_q,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_k + pad_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_k + pad_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise JAX path (fallback forward + recompute backward)
+# ---------------------------------------------------------------------------
+
+def _blockwise_attention_jax(q, k, v, *, causal, scale, block_k):
+    """Same online-softmax math as the kernel, as a lax.scan over kv blocks.
+    q,k,v: [BH, T, D]."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_k = min(block_k, t_k)
+    n_blocks = -(-t_k // block_k)
+    pad = n_blocks * block_k - t_k
+    if pad:
+        # dynamic_slice clamps out-of-range starts (double-counting rows),
+        # so pad to a block multiple and mask the tail keys instead.
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    qf = q.astype(jnp.float32) * scale
+    # Decode convention (see kernel): query block sits at the end of keys.
+    q_pos = (t_k - t_q) + jnp.arange(t_q)
+
+    def step(carry, ki):
+        o, m, l = carry
+        k_blk = lax.dynamic_slice_in_dim(k, ki * block_k, block_k, axis=1)
+        v_blk = lax.dynamic_slice_in_dim(v, ki * block_k, block_k, axis=1)
+        s = jnp.einsum("btd,bsd->bts", qf, k_blk.astype(jnp.float32))
+        k_pos = ki * block_k + jnp.arange(block_k)
+        if pad:
+            s = jnp.where(k_pos[None, None, :] < t_k, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+        l_new = l * alpha + p.sum(-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bts,bsd->btd", p, v_blk.astype(jnp.float32)
+        )
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    m0 = jnp.full((bh, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, t_q), jnp.float32)
+    (o, _, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(n_blocks))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, force_jax):
+    if _on_tpu() and not force_jax:
+        return _flash_attention_pallas(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+    return _blockwise_attention_jax(
+        q, k, v, causal=causal, scale=scale, block_k=block_k
+    )
+
+
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, force_jax):
+    out = _flash_core(q, k, v, causal, scale, block_q, block_k, force_jax)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(causal, scale, block_q, block_k, force_jax, res, g):
+    q, k, v = res
+    # Recompute-based backward through the blockwise scan: O(T·block)
+    # memory, identical math to the forward kernel.
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_attention_jax(
+            q, k, v, causal=causal, scale=scale, block_k=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    force_jax: bool = False,
+) -> jax.Array:
+    """Memory-efficient exact attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
+
+    K/V may have a different sequence length than Q (cross-attention /
+    decode). ``force_jax=True`` pins the blockwise-JAX path (used by tests
+    and by shard_map'd callers on CPU meshes).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    out = _flash_core(qf, kf, vf, causal, scale, block_q, block_k, force_jax)
+    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,24 @@
+"""Stable losses. Cross-entropy takes logits un-normalized and never
+materializes a full softmax in fp32 beyond one [B, V] row block."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits: jax.Array, labels: jax.Array, *, where: jax.Array | None = None
+) -> jax.Array:
+    """Mean cross-entropy. logits: [..., V], labels: int [...], where:
+    optional bool mask [...] (False entries excluded from the mean)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    nll = lse - picked
+    if where is not None:
+        w = where.astype(jnp.float32)
+        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return nll.mean()

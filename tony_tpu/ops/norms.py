@@ -1,0 +1,78 @@
+"""Fused RMSNorm: one VMEM pass per row-block on TPU (Pallas), einsum-free
+JAX fallback elsewhere. Backward is XLA autodiff of the fallback (the op is
+cheap enough that a hand bwd kernel buys nothing — HBM traffic dominates and
+recompute fuses into the surrounding matmul)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tony_tpu.ops.attention import _on_tpu
+
+
+def _rms_norm_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)).astype(
+        o_ref.dtype
+    )
+
+
+def _rms_norm_jax(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_norm_pallas(x, w, eps, block_rows, interpret=False):
+    rows, d = x.shape
+    block = min(block_rows, rows)
+    return pl.pallas_call(
+        functools.partial(_rms_norm_kernel, eps=eps),
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rms_core(x, w, eps, block_rows, force_jax):
+    if not (_on_tpu() and not force_jax):
+        return _rms_norm_jax(x, w, eps)
+    return _rms_norm_pallas(x, w, eps, block_rows)
+
+
+def _rms_fwd(x, w, eps, block_rows, force_jax):
+    return _rms_core(x, w, eps, block_rows, force_jax), (x, w)
+
+
+def _rms_bwd(eps, block_rows, force_jax, res, g):
+    x, w = res
+    _, vjp = jax.vjp(lambda x, w: _rms_norm_jax(x, w, eps), x, w)
+    return vjp(g)
+
+
+_rms_core.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(
+    x: jax.Array,
+    w: jax.Array,
+    *,
+    eps: float = 1e-6,
+    block_rows: int = 256,
+    force_jax: bool = False,
+) -> jax.Array:
+    """RMSNorm over the last axis. x: [..., d], w: [d]."""
+    shape = x.shape
+    out = _rms_core(x.reshape(-1, shape[-1]), w, eps, block_rows, force_jax)
+    return out.reshape(shape)

@@ -1,0 +1,48 @@
+"""Rotary position embeddings. Pure JAX: XLA fuses the elementwise rotation
+into the surrounding projections, so a kernel would only add a launch."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq: int, *, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables: [max_seq, head_dim // 2], fp32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    *,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """Rotate pairs of features. x: [B, T, H, D]; cos/sin: [max_seq, D/2].
+
+    ``positions`` ([B, T] or [T]) selects rows of the tables — required under
+    sequence parallelism where a shard's local index 0 is global index
+    shard*T_local (the ring layer passes the offset positions).
+    """
+    b, t, h, d = x.shape
+    if positions is None:
+        positions = jnp.arange(t)
+    c = cos[positions]  # [T, D/2] or [B, T, D/2]
+    s = sin[positions]
+    if c.ndim == 2:
+        c = c[None]
+        s = s[None]
+    c = c[:, :, None, :].astype(jnp.float32)
+    s = s[:, :, None, :].astype(jnp.float32)
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * c - x2 * s
+    r2 = x1 * s + x2 * c
+    out = jnp.stack([r1, r2], axis=-1).reshape(b, t, h, d)
+    return out.astype(x.dtype)
