@@ -1,0 +1,40 @@
+"""Model zoo for the TPU-native rebuild.
+
+The reference ships no models at all — its examples exec user-provided
+TF/PyTorch MNIST scripts (tony-examples/mnist-tensorflow/mnist_distributed.py,
+mnist-pytorch/mnist_distributed.py). The rebuild makes models first-class so
+the framework can be benchmarked end-to-end on TPU without external scripts:
+
+  - ``mnist``       — MLP + CNN matching the reference examples' task
+                      (the north-star metric in BASELINE.json is
+                      mnist_distributed steps/sec/chip).
+  - ``transformer`` — flagship decoder-only LM exercising every
+                      parallelism axis (dp/fsdp, tp, sp ring attention,
+                      pp pipeline, ep MoE) and every hot op (flash
+                      attention, fused RMSNorm, RoPE).
+  - ``train``       — sharded train-step builder over the 5-axis mesh.
+"""
+
+from tony_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    forward_pipeline,
+    param_roles,
+)
+from tony_tpu.models.mnist import MnistConfig, mnist_init, mnist_apply
+from tony_tpu.models.train import TrainState, make_train_step, lm_loss
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "forward_pipeline",
+    "param_roles",
+    "MnistConfig",
+    "mnist_init",
+    "mnist_apply",
+    "TrainState",
+    "make_train_step",
+    "lm_loss",
+]

@@ -1,0 +1,78 @@
+"""MNIST models matching the reference examples' task.
+
+The reference's examples train MNIST through user scripts
+(tony-examples/mnist-tensorflow/mnist_distributed.py:188-220 builds a
+PS-strategy graph; mnist-pytorch/mnist_distributed.py:114-122 averages
+gradients by hand). Here the models are in-framework, pure JAX, and data
+parallel over the mesh's dp axis — BASELINE.json's north-star metric
+(mnist_distributed steps/sec/chip) runs against these.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    arch: str = "cnn"           # "mlp" | "cnn"
+    hidden: int = 128
+    n_classes: int = 10
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def mnist_init(key: jax.Array, cfg: MnistConfig) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def norm(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
+
+    if cfg.arch == "mlp":
+        return {
+            "w1": norm(k1, (784, cfg.hidden), 784),
+            "b1": jnp.zeros((cfg.hidden,), jnp.float32),
+            "w2": norm(k2, (cfg.hidden, cfg.n_classes), cfg.hidden),
+            "b2": jnp.zeros((cfg.n_classes,), jnp.float32),
+        }
+    # CNN: two 3x3 convs (stride 2) + dense head. Conv lowers to MXU via
+    # XLA's conv-as-matmul on TPU; channels stay multiples of 8.
+    return {
+        "c1": norm(k1, (3, 3, 1, 32), 9),
+        "c2": norm(k2, (3, 3, 32, 64), 9 * 32),
+        "w1": norm(k3, (7 * 7 * 64, cfg.hidden), 7 * 7 * 64),
+        "b1": jnp.zeros((cfg.hidden,), jnp.float32),
+        "w2": norm(k4, (cfg.hidden, cfg.n_classes), cfg.hidden),
+        "b2": jnp.zeros((cfg.n_classes,), jnp.float32),
+    }
+
+
+def mnist_apply(params: dict, images: jax.Array, cfg: MnistConfig) -> jax.Array:
+    """images: [B, 28, 28, 1] (cnn) or [B, 784] (mlp) -> logits [B, 10]."""
+    dt = cfg.compute_dtype
+    x = images.astype(dt)
+    if cfg.arch == "mlp":
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["w1"].astype(dt) + params["b1"].astype(dt))
+        return (x @ params["w2"].astype(dt) + params["b2"].astype(dt)).astype(
+            jnp.float32
+        )
+    if x.ndim == 2:
+        x = x.reshape(-1, 28, 28, 1)
+    for w in (params["c1"], params["c2"]):
+        x = jax.lax.conv_general_dilated(
+            x, w.astype(dt), window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["w1"].astype(dt) + params["b1"].astype(dt))
+    return (x @ params["w2"].astype(dt) + params["b2"].astype(dt)).astype(
+        jnp.float32
+    )

@@ -1,0 +1,197 @@
+"""Sharded train-step builders over the 5-axis mesh.
+
+The reference delegates all training to the user script and only injects the
+distributed env (TaskExecutor.java:126-153); here training is in-framework:
+one jitted step — forward, loss, grad, adamw update — with every array's
+placement derived from the logical-role tables, so XLA SPMD emits the dp
+gradient psum, tp all-gathers and ep all-to-alls without any hand-written
+communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.models.mnist import MnistConfig, mnist_apply, mnist_init
+from tony_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    forward_pipeline,
+    init_params,
+    param_roles,
+)
+from tony_tpu.ops import softmax_cross_entropy
+from tony_tpu.parallel.sharding import logical_sharding
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _sharding_for_tree(abstract_tree, roles: dict, mesh: Mesh):
+    """NamedShardings for any pytree whose dict-keyed subtrees mirror the
+    params tree (TrainState.params itself, optax mu/nu copies). A leaf's
+    dict-key path is looked up in the nested ``roles`` table; leaves with no
+    matching role path (optimizer scalars like adam's count) replicate.
+    """
+
+    def leaf_sharding(path, _leaf):
+        node = roles
+        for entry in path:
+            if isinstance(entry, jax.tree_util.DictKey):
+                if isinstance(node, dict) and entry.key in node:
+                    node = node[entry.key]
+                else:
+                    return NamedSharding(mesh, P())
+        if isinstance(node, tuple):
+            return logical_sharding(mesh, *node)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, abstract_tree)
+
+
+def lm_loss(
+    params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh | None = None,
+    *,
+    pipeline_microbatches: int | None = None,
+) -> jax.Array:
+    """Next-token cross-entropy. tokens: [B, T+1] int32."""
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    if pipeline_microbatches is not None:
+        logits = forward_pipeline(
+            params, inputs, cfg, mesh, num_microbatches=pipeline_microbatches
+        )
+    else:
+        logits = forward(params, inputs, cfg, mesh)
+    return softmax_cross_entropy(logits, labels)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    *,
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    grad_clip: float = 1.0,
+    pipeline_microbatches: int | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+):
+    """Returns (init_fn, step_fn), both jitted over ``mesh``.
+
+    init_fn(key) -> TrainState, every leaf placed by its logical roles.
+    step_fn(state, tokens[B, T+1]) -> (state', {"loss": f32}); donates the
+    old state so params update in place in HBM.
+    """
+    opt = optimizer or optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate, weight_decay=weight_decay),
+    )
+    roles = param_roles(cfg)
+
+    def init_fn(key):
+        params = init_params(key, cfg)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt.init(params),
+        )
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    state_sh = _sharding_for_tree(abstract, roles, mesh)
+    # Tokens shard over batch only: [B, T+1] has the odd "+1" length that the
+    # sp axis can't divide; the shift inside lm_loss re-shards activations
+    # onto sp via the constraints in forward().
+    batch_sh = logical_sharding(mesh, "batch", None)
+    repl = NamedSharding(mesh, P())
+
+    jit_init = jax.jit(init_fn, out_shardings=state_sh)
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(lm_loss)(
+            state.params, tokens, cfg, mesh,
+            pipeline_microbatches=pipeline_microbatches,
+        )
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, params, opt_state)
+        return new_state, {"loss": loss}
+
+    jit_step = jax.jit(
+        step_fn,
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, {"loss": repl}),
+        donate_argnums=(0,),
+    )
+
+    def step(state, tokens):
+        # Re-shard the host batch explicitly: jit rejects (rather than
+        # reshards) committed args whose sharding differs from in_shardings.
+        return jit_step(state, jax.device_put(tokens, batch_sh))
+
+    return jit_init, step
+
+
+def make_classifier_step(
+    cfg: MnistConfig,
+    mesh: Mesh,
+    *,
+    learning_rate: float = 1e-3,
+):
+    """Data-parallel supervised step for the MNIST models: batch split over
+    (dp, ep); params replicated (they're KB-scale — fsdp would be pure
+    overhead). Returns (init_fn, step_fn)."""
+    opt = optax.adam(learning_rate)
+
+    def init_fn(key):
+        params = mnist_init(key, cfg)
+        return TrainState(jnp.zeros((), jnp.int32), params, opt.init(params))
+
+    repl = NamedSharding(mesh, P())
+    state_sh = jax.tree.map(
+        lambda _: repl, jax.eval_shape(init_fn, jax.random.key(0))
+    )
+    batch_sh = NamedSharding(mesh, P(("dp", "ep")))
+
+    def loss_fn(params, images, labels):
+        logits = mnist_apply(params, images, cfg)
+        loss = softmax_cross_entropy(logits, labels)
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, acc
+
+    def step_fn(state, images, labels):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, images, labels
+        )
+        updates, opt_state = opt.update(grads, state.opt_state)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(state.step + 1, params, opt_state),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    jit_init = jax.jit(init_fn, out_shardings=state_sh)
+    jit_step = jax.jit(
+        step_fn,
+        in_shardings=(state_sh, batch_sh, batch_sh),
+        out_shardings=(state_sh, {"loss": repl, "accuracy": repl}),
+        donate_argnums=(0,),
+    )
+
+    def step(state, images, labels):
+        return jit_step(
+            state,
+            jax.device_put(images, batch_sh),
+            jax.device_put(labels, batch_sh),
+        )
+
+    return jit_init, step

@@ -1,0 +1,366 @@
+"""Flagship decoder-only transformer LM, built TPU-first.
+
+The reference framework contains no model code (SURVEY.md: "no kernels, no
+autograd, no tensors"); this model is the compute payload the rebuild adds so
+every parallelism axis of the 5-axis mesh is exercised by a real workload:
+
+  dp/fsdp — batch split + weight sharding via logical rules (sharding.py)
+  tp      — megatron split: heads / mlp-hidden / vocab columns
+  sp      — ring attention over the sequence axis (parallel/ring.py)
+  pp      — GPipe microbatch pipeline over stacked layers (parallel/pipeline.py)
+  ep      — MoE experts with capacity-based dispatch/combine einsums
+
+Two trunk modes, one layer implementation:
+
+  * GSPMD mode (``forward``): everything under ``jit`` with sharding
+    constraints; XLA SPMD inserts the collectives (all-gather for tp,
+    psum for dp grads, all-to-all for ep dispatch). Use when pp == 1.
+  * Manual mode (``forward_pipeline``): the trunk runs inside
+    ``pipeline_apply``'s shard_map, so tp reductions are explicit
+    ``lax.psum`` and sequence parallelism is the in-shard_map ring
+    (``_ring_attention_local``). Use when pp > 1. MoE is GSPMD-only.
+
+Weights are fp32 (optimizer precision), compute is bfloat16 on the MXU with
+fp32 accumulation inside the attention/norm kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    rms_norm,
+    rope_frequencies,
+)
+from tony_tpu.parallel.pipeline import pipeline_apply
+from tony_tpu.parallel.ring import _ring_attention_local, ring_attention
+from tony_tpu.parallel.sharding import logical_spec, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10_000.0
+    # MoE: 0 experts = dense SwiGLU mlp. When > 0, every layer is an MoE
+    # layer with top-k routing and capacity_factor token capacity.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: str = "bfloat16"
+    remat: bool = True
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Params as a plain pytree; per-layer weights stacked on a leading
+    ``layers`` axis so the trunk is one ``lax.scan`` (or, reshaped, one
+    pipeline stage stack). fp32 master weights."""
+    d, h, dh, f, l = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
+    )
+    keys = jax.random.split(key, 10)
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    layer = {
+        "ln1": jnp.ones((l, d), jnp.float32),
+        "wq": norm(keys[1], (l, d, h, dh), d ** -0.5),
+        "wk": norm(keys[2], (l, d, h, dh), d ** -0.5),
+        "wv": norm(keys[3], (l, d, h, dh), d ** -0.5),
+        "wo": norm(keys[4], (l, h, dh, d), (h * dh) ** -0.5),
+        "ln2": jnp.ones((l, d), jnp.float32),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        layer["router"] = norm(keys[5], (l, d, e), d ** -0.5)
+        layer["w_gate"] = norm(keys[6], (l, e, d, f), d ** -0.5)
+        layer["w_up"] = norm(keys[7], (l, e, d, f), d ** -0.5)
+        layer["w_down"] = norm(keys[8], (l, e, f, d), f ** -0.5)
+    else:
+        layer["w_gate"] = norm(keys[6], (l, d, f), d ** -0.5)
+        layer["w_up"] = norm(keys[7], (l, d, f), d ** -0.5)
+        layer["w_down"] = norm(keys[8], (l, f, d), f ** -0.5)
+
+    return {
+        "embed": norm(keys[0], (cfg.vocab_size, d), 1.0),
+        "layers": layer,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "unembed": norm(keys[9], (d, cfg.vocab_size), d ** -0.5),
+    }
+
+
+def param_roles(cfg: TransformerConfig) -> dict:
+    """Logical-axis roles per leaf (sharding.py LOGICAL_RULES maps roles to
+    mesh axes): tp splits heads/mlp/vocab, fsdp splits the embed dim, pp
+    stages the stacked layers axis, ep splits experts."""
+    layer = {
+        "ln1": ("layers", None),
+        "wq": ("layers", "embed_fsdp", "heads", None),
+        "wk": ("layers", "embed_fsdp", "heads", None),
+        "wv": ("layers", "embed_fsdp", "heads", None),
+        "wo": ("layers", "heads", None, "embed_fsdp"),
+        "ln2": ("layers", None),
+    }
+    if cfg.n_experts:
+        layer["router"] = ("layers", None, "expert")
+        layer["w_gate"] = ("layers", "expert", "embed_fsdp", "mlp")
+        layer["w_up"] = ("layers", "expert", "embed_fsdp", "mlp")
+        layer["w_down"] = ("layers", "expert", "mlp", "embed_fsdp")
+    else:
+        layer["w_gate"] = ("layers", "embed_fsdp", "mlp")
+        layer["w_up"] = ("layers", "embed_fsdp", "mlp")
+        layer["w_down"] = ("layers", "mlp", "embed_fsdp")
+    return {
+        "embed": ("vocab", None),
+        "layers": layer,
+        "final_norm": (None,),
+        "unembed": ("embed_fsdp", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Blocks (shared by both trunk modes)
+# ---------------------------------------------------------------------------
+
+def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
+    """Pre-norm attention block. x: [b, t, d] (local shard in manual mode).
+
+    GSPMD: heads constrained onto tp, seq onto sp; ring attention when the
+    mesh has sp > 1 (exact attention over the sharded sequence), else flash.
+    Manual: params arrive pre-sliced over tp by shard_map in_specs; output
+    projection psums over tp; sp > 1 runs the in-shard_map ring body with
+    RoPE positions offset by the shard's global start.
+    """
+    dt = cfg.compute_dtype
+    h = rms_norm(x, lp["ln1"]).astype(dt)
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
+
+    if manual:
+        sp = lax.axis_size("sp")
+        t_local = x.shape[1]
+        positions = lax.axis_index("sp") * t_local + jnp.arange(t_local)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        if sp > 1:
+            o = _ring_attention_local(
+                q, k, v, axis_name="sp", causal=True,
+                scale=cfg.head_dim ** -0.5,
+            )
+        else:
+            o = flash_attention(q, k, v, causal=True)
+        out = jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"].astype(dt))
+        return lax.psum(out, "tp")
+
+    q = with_logical_constraint(q, "batch", "seq", "heads", None)
+    k = with_logical_constraint(k, "batch", "seq", "heads", None)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        o = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        o = flash_attention(q, k, v, causal=True)
+    out = jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"].astype(dt))
+    return with_logical_constraint(out, "batch", "seq", "embed")
+
+
+def _dense_mlp(x, lp, cfg, *, manual: bool):
+    """SwiGLU. tp splits d_ff columns; manual mode psums the row-parallel
+    down-projection (megatron pattern), GSPMD lets SPMD insert it."""
+    dt = cfg.compute_dtype
+    h = rms_norm(x, lp["ln2"]).astype(dt)
+    g = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dt))
+    u = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dt))
+    act = jax.nn.silu(g.astype(jnp.float32)).astype(dt) * u
+    out = jnp.einsum("btf,fd->btd", act, lp["w_down"].astype(dt))
+    if manual:
+        return lax.psum(out, "tp")
+    return with_logical_constraint(out, "batch", "seq", "embed")
+
+
+def _moe_mlp(x, lp, cfg):
+    """Capacity-based top-k MoE (Switch/Mesh-TF dispatch-combine einsums —
+    fully static shapes, so XLA inserts the ep all-to-alls from the expert
+    sharding constraint; no data-dependent control flow). GSPMD mode only.
+
+    Tokens beyond an expert's capacity are dropped (residual passes them
+    through unchanged) — the standard capacity_factor trade.
+    """
+    dt = cfg.compute_dtype
+    b, t, d = x.shape
+    e, kk = cfg.n_experts, cfg.expert_top_k
+    cap = max(1, int(cfg.capacity_factor * b * t * kk / e))
+
+    hn = rms_norm(x, lp["ln2"])
+    gate_logits = jnp.einsum(
+        "btd,de->bte", hn.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(gate_logits, axis=-1)        # [b,t,E]
+    gvals, gidx = lax.top_k(probs, kk)                  # [b,t,k]
+    gvals = gvals / jnp.maximum(gvals.sum(-1, keepdims=True), 1e-9)
+    onehot_e = jax.nn.one_hot(gidx, e, dtype=jnp.float32)  # [b,t,k,E]
+
+    # Position of each (token, choice) within its expert: flatten in
+    # (k-priority, token) order — all first choices queue before any second
+    # choice — and cumsum per expert.
+    # int32 cumsum: fp32 would lose exactness past 2^24 routed entries per
+    # expert, colliding capacity slots silently at large batch*seq.
+    flat = onehot_e.transpose(2, 0, 1, 3).reshape(kk * b * t, e).astype(jnp.int32)
+    pos = jnp.cumsum(flat, axis=0) - flat
+    pos_e = (pos * flat).sum(-1).reshape(kk, b, t).transpose(1, 2, 0)  # [b,t,k]
+    keep = (pos_e < cap).astype(jnp.float32)
+    onehot_c = jax.nn.one_hot(pos_e, cap, dtype=jnp.float32)
+    onehot_c = onehot_c * keep[..., None]               # [b,t,k,C]
+
+    dispatch = jnp.einsum("btke,btkc->btec", onehot_e, onehot_c)
+    combine = jnp.einsum("btke,btkc->btec", onehot_e * gvals[..., None], onehot_c)
+
+    xin = jnp.einsum("btd,btec->ecd", hn.astype(dt), dispatch.astype(dt))
+    xin = with_logical_constraint(xin, "expert", None, None)
+    g = jnp.einsum("ecd,edf->ecf", xin, lp["w_gate"].astype(dt))
+    u = jnp.einsum("ecd,edf->ecf", xin, lp["w_up"].astype(dt))
+    act = jax.nn.silu(g.astype(jnp.float32)).astype(dt) * u
+    out_e = jnp.einsum("ecf,efd->ecd", act, lp["w_down"].astype(dt))
+    out_e = with_logical_constraint(out_e, "expert", None, None)
+    out = jnp.einsum("ecd,btec->btd", out_e, combine.astype(dt))
+    return with_logical_constraint(out, "batch", "seq", "embed")
+
+
+def _decoder_layer(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
+    x = x + _attention(x, lp, cfg, cos, sin, manual=manual, mesh=mesh)
+    if cfg.n_experts and not manual:
+        x = x + _moe_mlp(x, lp, cfg)
+    else:
+        x = x + _dense_mlp(x, lp, cfg, manual=manual)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# GSPMD trunk (pp == 1)
+# ---------------------------------------------------------------------------
+
+def forward(
+    params: dict, tokens: jax.Array, cfg: TransformerConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, V] (compute dtype). Everything
+    under jit + sharding constraints; call inside ``jax.jit``."""
+    dt = cfg.compute_dtype
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
+    x = params["embed"][tokens].astype(dt)
+    x = with_logical_constraint(x, "batch", "seq", "embed")
+
+    layer_fn = functools.partial(
+        _decoder_layer, cfg=cfg, cos=cos, sin=sin, manual=False, mesh=mesh
+    )
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(carry, lp):
+        return layer_fn(carry, lp), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"]).astype(dt)
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
+    return with_logical_constraint(logits, "batch", "seq", "vocab")
+
+
+# ---------------------------------------------------------------------------
+# Pipeline trunk (pp > 1): manual-collective layers inside shard_map
+# ---------------------------------------------------------------------------
+
+def _stage_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs for pipeline-stage params: leading pp axis, tp on the
+    megatron dims (so each shard_map body holds only its head/mlp slice)."""
+    layer = {
+        "ln1": P("pp", None, None),
+        "wq": P("pp", None, None, "tp", None),
+        "wk": P("pp", None, None, "tp", None),
+        "wv": P("pp", None, None, "tp", None),
+        "wo": P("pp", None, "tp", None, None),
+        "ln2": P("pp", None, None),
+        "w_gate": P("pp", None, None, "tp"),
+        "w_up": P("pp", None, None, "tp"),
+        "w_down": P("pp", None, "tp", None),
+    }
+    return layer
+
+
+def forward_pipeline(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+) -> jax.Array:
+    """GPipe trunk: embed/unembed stay GSPMD (outside the pipeline — the
+    classic constraint that stages map microbatch -> same-shape microbatch),
+    the layer stack runs as pp stages with manual tp psums and the
+    in-shard_map sp ring. Dense mlp only (MoE is GSPMD-mode)."""
+    if cfg.n_experts:
+        raise ValueError("MoE layers require the GSPMD trunk (pp=1)")
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp {pp}")
+    dt = cfg.compute_dtype
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
+
+    x = params["embed"][tokens].astype(dt)
+    x = with_logical_constraint(x, "batch", "seq", "embed")
+
+    # [L, ...] -> [pp, L/pp, ...]
+    stage_params = jax.tree.map(
+        lambda p: p.reshape((pp, cfg.n_layers // pp) + p.shape[1:]),
+        params["layers"],
+    )
+
+    def stage_fn(sp_params, xm):
+        layer_fn = functools.partial(
+            _decoder_layer, cfg=cfg, cos=cos, sin=sin, manual=True, mesh=None
+        )
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
+        def body(carry, lp):
+            return layer_fn(carry, lp), None
+
+        out, _ = lax.scan(body, xm, sp_params)
+        return out
+
+    x = pipeline_apply(
+        stage_fn,
+        stage_params,
+        x,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        data_spec=P(None, ("dp", "ep"), "sp", None),
+        param_specs=_stage_param_specs(cfg),
+    )
+    x = with_logical_constraint(x, "batch", "seq", "embed")
+    x = rms_norm(x, params["final_norm"]).astype(dt)
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
+    return with_logical_constraint(logits, "batch", "seq", "vocab")

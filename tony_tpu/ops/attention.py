@@ -43,52 +43,70 @@ def _on_tpu() -> bool:
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
+_LANES = 128  # TPU vreg lane count; m/l scratch rows broadcast across lanes
+
+
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, t_k, t_q
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, t_k, t_q,
 ):
-    """One program = one (batch*head, q-block). Refs:
-    q_ref [1, block_q, d], k_ref/v_ref [1, t_k_padded, d],
-    o_ref [1, block_q, d]. ``t_k``/``t_q`` are real (pre-padding) lengths.
+    """One program = one (batch*head, q-block, kv-block). The kv axis is the
+    innermost (sequential) grid dimension, so only one [block_k, d] K/V tile
+    is resident in VMEM at a time — context length is bounded by HBM, not
+    VMEM. Running (o, m, l) statistics persist across kv steps in scratch;
+    the output block is written once on the final kv step.
+
+    Refs: q_ref [1, block_q, d], k_ref/v_ref [1, block_k, d],
+    o_ref [1, block_q, d]; scratch acc [block_q, d] f32, m/l
+    [block_q, LANES] f32 (value broadcast across lanes — vreg-friendly).
+    ``t_k``/``t_q`` are real (pre-padding) lengths.
     """
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
     block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale
+    block_k = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
     # Decode convention: the query block sits at the END of the key range,
     # so global query position = t_k - t_q + row (self-attention reduces to
     # position == row).
     q_off = t_k - t_q
-    num_k_blocks = pl.cdiv(t_k, block_k)
-    if causal:
-        # q block rows end at global position q_off + (qi+1)*block_q - 1:
-        # kv blocks past that are fully masked — skip them entirely (halves
-        # the FLOPs for self-attention).
-        num_k_blocks = lax.min(
-            num_k_blocks, pl.cdiv(q_off + (qi + 1) * block_q, block_k)
-        )
+    k_start = ki * block_k
+    # Causal skip: this kv block is fully masked when its first key comes
+    # after the q block's last row — skip the matmuls (half the FLOPs for
+    # self-attention; the tile copy still streams, hidden by the pipeline).
+    live = k_start <= q_off + (qi + 1) * block_q - 1 if causal else True
 
-    q_pos = (
-        q_off + qi * block_q
-        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    )
-
-    def body(ki, carry):
-        o, m, l = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        k_pos = ki * block_k + lax.broadcasted_iota(
+        k_pos = k_start + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         if t_k % block_k:
-            # Final block reads past t_k (pallas pads); mask the tail keys.
+            # Final block is padding past t_k; mask the tail keys.
             s = jnp.where(k_pos < t_k, s, NEG_INF)
         if causal:
+            q_pos = (
+                q_off + qi * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # Lanes of m/l hold identical values; a lane-max reads them back.
+        m = jnp.max(m_ref[...], axis=1)
+        l = jnp.max(l_ref[...], axis=1)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         # Fully-masked rows keep m_new at NEG_INF; shift to 0 so exp is safe.
@@ -98,49 +116,57 @@ def _flash_fwd_kernel(
         alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
         alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[:, None] + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return o_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    o0 = jnp.zeros((block_q, d), dtype=jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
-    o, _, l = lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(jnp.max(l_ref[...], axis=1), 1e-30)
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_attention_pallas(
     q, k, v, *, causal, scale, block_q, block_k, interpret=False
 ):
     """q,k,v: [BH, T, D] (batch and heads pre-flattened)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
-    # Pad keys to a block multiple: the kernel's pl.ds would clamp an
-    # out-of-bounds read of the final partial block (double-counting rows).
+    # Pad keys to a block multiple: the final partial tile would otherwise
+    # alias real rows when the BlockSpec clamps its window.
     pad_k = (-t_k) % block_k
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
-    grid = (bh, pl.cdiv(t_q, block_q))
+    grid = (bh, pl.cdiv(t_q, block_q), (t_k + pad_k) // block_k)
     kernel = functools.partial(
-        _flash_fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        t_k=t_k, t_q=t_q,
+        _flash_fwd_kernel, scale=scale, causal=causal, t_k=t_k, t_q=t_q,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_k + pad_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_k + pad_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
 

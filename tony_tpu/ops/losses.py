@@ -1,5 +1,7 @@
-"""Stable losses. Cross-entropy takes logits un-normalized and never
-materializes a full softmax in fp32 beyond one [B, V] row block."""
+"""Stable losses. Cross-entropy takes un-normalized logits and avoids the
+softmax round-trip (logsumexp minus the picked logit); the full logits array
+is upcast to fp32 once — XLA fuses the upcast into the logsumexp reduction,
+so peak memory is the logits themselves plus the [B, T] reductions."""
 
 from __future__ import annotations
 

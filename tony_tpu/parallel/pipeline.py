@@ -66,6 +66,7 @@ def pipeline_apply(
     num_microbatches: int,
     axis_name: str = "pp",
     data_spec: P | None = None,
+    param_specs=None,
 ):
     """Apply ``stage_fn`` (params, x) -> y through ``pp`` pipeline stages.
 
@@ -78,6 +79,12 @@ def pipeline_apply(
     the PartitionSpec of the *microbatched* [num_micro, mb, ...] array: its
     leading (microbatch) entry must not use ``axis_name``; later entries may
     shard over dp/sp/tp as usual. Default: replicated.
+
+    ``param_specs``: optional pytree of PartitionSpecs (same structure as
+    ``stage_params``) whose leading entry must be ``axis_name``; lets the
+    caller additionally shard within-stage weight dims (e.g. megatron tp
+    slices) so ``stage_fn`` sees only its local slice and reduces with
+    explicit psums. Default: sharded over ``axis_name`` only.
     """
     if x.shape[0] % num_microbatches:
         raise ValueError(
@@ -86,7 +93,19 @@ def pipeline_apply(
     mb = x.shape[0] // num_microbatches
     x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
 
-    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    else:
+        for spec in jax.tree.leaves(
+            param_specs, is_leaf=lambda s: isinstance(s, P)
+        ):
+            if not spec or spec[0] != axis_name:
+                # Without the leading stage axis, every device would get the
+                # full stack and _pipeline_local's p[0] would silently run
+                # stage 0's weights everywhere.
+                raise ValueError(
+                    f"param_specs leaf {spec} must lead with {axis_name!r}"
+                )
     in_spec = data_spec if data_spec is not None else P()
 
     def body(params, xm):
