@@ -1,0 +1,68 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+North-star metric (BASELINE.json): mnist_distributed steps/sec/chip. The
+reference publishes no numbers (SURVEY.md §6), so the baseline constant
+below is the 4xV100 proxy recorded in BASELINE.md: a synchronous DDP MNIST
+step on a 2018 YARN/GPU stack is host/dispatch-bound around 100 steps/sec
+per accelerator — the wall-clock target the north star names.
+
+Runs the same in-framework MNIST CNN + adam train step the mini-cluster
+examples use, on whatever backend is present (the driver runs it on one
+real TPU chip; CPU works for smoke). Steady-state measurement: donated
+state, on-device loop, host sync only at the timer edges.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_STEPS_PER_SEC_PER_CHIP = 100.0  # see BASELINE.md proxy table
+BATCH = 512
+WARMUP = 20
+MEASURE = 200
+
+
+def main() -> None:
+    from tony_tpu.models import MnistConfig
+    from tony_tpu.models.train import make_classifier_step
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh(MeshSpec.auto(n_chips), devices=jax.devices())
+    cfg = MnistConfig(arch="cnn", dtype="bfloat16")
+    init_fn, step_fn = make_classifier_step(cfg, mesh, learning_rate=1e-3)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(BATCH, 28, 28, 1)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, (BATCH,)), jnp.int32)
+
+    with jax.sharding.set_mesh(mesh):
+        state = init_fn(jax.random.key(0))
+        for _ in range(WARMUP):
+            state, metrics = step_fn(state, images, labels)
+        jax.block_until_ready(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            state, metrics = step_fn(state, images, labels)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    steps_per_sec_per_chip = MEASURE / dt / n_chips
+    print(json.dumps({
+        "metric": "mnist_train_steps_per_sec_per_chip",
+        "value": round(steps_per_sec_per_chip, 2),
+        "unit": f"steps/sec/chip (batch={BATCH}, cnn, adam)",
+        "vs_baseline": round(
+            steps_per_sec_per_chip / BASELINE_STEPS_PER_SEC_PER_CHIP, 3
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

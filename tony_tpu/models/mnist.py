@@ -59,19 +59,16 @@ def mnist_apply(params: dict, images: jax.Array, cfg: MnistConfig) -> jax.Array:
     x = images.astype(dt)
     if cfg.arch == "mlp":
         x = x.reshape(x.shape[0], -1)
-        x = jax.nn.relu(x @ params["w1"].astype(dt) + params["b1"].astype(dt))
-        return (x @ params["w2"].astype(dt) + params["b2"].astype(dt)).astype(
-            jnp.float32
-        )
-    if x.ndim == 2:
-        x = x.reshape(-1, 28, 28, 1)
-    for w in (params["c1"], params["c2"]):
-        x = jax.lax.conv_general_dilated(
-            x, w.astype(dt), window_strides=(2, 2), padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-        x = jax.nn.relu(x)
-    x = x.reshape(x.shape[0], -1)
+    else:
+        if x.ndim == 2:
+            x = x.reshape(-1, 28, 28, 1)
+        for w in (params["c1"], params["c2"]):
+            x = jax.lax.conv_general_dilated(
+                x, w.astype(dt), window_strides=(2, 2), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = jax.nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
     x = jax.nn.relu(x @ params["w1"].astype(dt) + params["b1"].astype(dt))
     return (x @ params["w2"].astype(dt) + params["b2"].astype(dt)).astype(
         jnp.float32

@@ -18,7 +18,7 @@ Two trunk modes, one layer implementation:
   * Manual mode (``forward_pipeline``): the trunk runs inside
     ``pipeline_apply``'s shard_map, so tp reductions are explicit
     ``lax.psum`` and sequence parallelism is the in-shard_map ring
-    (``_ring_attention_local``). Use when pp > 1. MoE is GSPMD-only.
+    (``ring_attention_local``). Use when pp > 1. MoE is GSPMD-only.
 
 Weights are fp32 (optimizer precision), compute is bfloat16 on the MXU with
 fp32 accumulation inside the attention/norm kernels.
@@ -41,7 +41,7 @@ from tony_tpu.ops import (
     rope_frequencies,
 )
 from tony_tpu.parallel.pipeline import pipeline_apply
-from tony_tpu.parallel.ring import _ring_attention_local, ring_attention
+from tony_tpu.parallel.ring import ring_attention, ring_attention_local
 from tony_tpu.parallel.sharding import logical_spec, with_logical_constraint
 
 
@@ -166,7 +166,7 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
         if sp > 1:
-            o = _ring_attention_local(
+            o = ring_attention_local(
                 q, k, v, axis_name="sp", causal=True,
                 scale=cfg.head_dim ** -0.5,
             )
@@ -175,8 +175,8 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
         out = jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"].astype(dt))
         return lax.psum(out, "tp")
 
-    q = with_logical_constraint(q, "batch", "seq", "heads", None)
-    k = with_logical_constraint(k, "batch", "seq", "heads", None)
+    q = with_logical_constraint(q, "batch", "seq", "heads", None, mesh=mesh)
+    k = with_logical_constraint(k, "batch", "seq", "heads", None, mesh=mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
@@ -184,10 +184,10 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
     else:
         o = flash_attention(q, k, v, causal=True)
     out = jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"].astype(dt))
-    return with_logical_constraint(out, "batch", "seq", "embed")
+    return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
-def _dense_mlp(x, lp, cfg, *, manual: bool):
+def _dense_mlp(x, lp, cfg, *, manual: bool, mesh: Mesh | None = None):
     """SwiGLU. tp splits d_ff columns; manual mode psums the row-parallel
     down-projection (megatron pattern), GSPMD lets SPMD insert it."""
     dt = cfg.compute_dtype
@@ -198,10 +198,10 @@ def _dense_mlp(x, lp, cfg, *, manual: bool):
     out = jnp.einsum("btf,fd->btd", act, lp["w_down"].astype(dt))
     if manual:
         return lax.psum(out, "tp")
-    return with_logical_constraint(out, "batch", "seq", "embed")
+    return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
-def _moe_mlp(x, lp, cfg):
+def _moe_mlp(x, lp, cfg, mesh: Mesh):
     """Capacity-based top-k MoE (Switch/Mesh-TF dispatch-combine einsums —
     fully static shapes, so XLA inserts the ep all-to-alls from the expert
     sharding constraint; no data-dependent control flow). GSPMD mode only.
@@ -239,22 +239,22 @@ def _moe_mlp(x, lp, cfg):
     combine = jnp.einsum("btke,btkc->btec", onehot_e * gvals[..., None], onehot_c)
 
     xin = jnp.einsum("btd,btec->ecd", hn.astype(dt), dispatch.astype(dt))
-    xin = with_logical_constraint(xin, "expert", None, None)
+    xin = with_logical_constraint(xin, "expert", None, None, mesh=mesh)
     g = jnp.einsum("ecd,edf->ecf", xin, lp["w_gate"].astype(dt))
     u = jnp.einsum("ecd,edf->ecf", xin, lp["w_up"].astype(dt))
     act = jax.nn.silu(g.astype(jnp.float32)).astype(dt) * u
     out_e = jnp.einsum("ecf,efd->ecd", act, lp["w_down"].astype(dt))
-    out_e = with_logical_constraint(out_e, "expert", None, None)
+    out_e = with_logical_constraint(out_e, "expert", None, None, mesh=mesh)
     out = jnp.einsum("ecd,btec->btd", out_e, combine.astype(dt))
-    return with_logical_constraint(out, "batch", "seq", "embed")
+    return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
 def _decoder_layer(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
     x = x + _attention(x, lp, cfg, cos, sin, manual=manual, mesh=mesh)
     if cfg.n_experts and not manual:
-        x = x + _moe_mlp(x, lp, cfg)
+        x = x + _moe_mlp(x, lp, cfg, mesh)
     else:
-        x = x + _dense_mlp(x, lp, cfg, manual=manual)
+        x = x + _dense_mlp(x, lp, cfg, manual=manual, mesh=mesh)
     return x
 
 
@@ -271,7 +271,7 @@ def forward(
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
     x = params["embed"][tokens].astype(dt)
-    x = with_logical_constraint(x, "batch", "seq", "embed")
+    x = with_logical_constraint(x, "batch", "seq", "embed", mesh=mesh)
 
     layer_fn = functools.partial(
         _decoder_layer, cfg=cfg, cos=cos, sin=sin, manual=False, mesh=mesh
@@ -285,7 +285,7 @@ def forward(
     x, _ = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"]).astype(dt)
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
-    return with_logical_constraint(logits, "batch", "seq", "vocab")
+    return with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -330,7 +330,7 @@ def forward_pipeline(
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
 
     x = params["embed"][tokens].astype(dt)
-    x = with_logical_constraint(x, "batch", "seq", "embed")
+    x = with_logical_constraint(x, "batch", "seq", "embed", mesh=mesh)
 
     # [L, ...] -> [pp, L/pp, ...]
     stage_params = jax.tree.map(
@@ -360,7 +360,7 @@ def forward_pipeline(
         data_spec=P(None, ("dp", "ep"), "sp", None),
         param_specs=_stage_param_specs(cfg),
     )
-    x = with_logical_constraint(x, "batch", "seq", "embed")
+    x = with_logical_constraint(x, "batch", "seq", "embed", mesh=mesh)
     x = rms_norm(x, params["final_norm"]).astype(dt)
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
-    return with_logical_constraint(logits, "batch", "seq", "vocab")
+    return with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)

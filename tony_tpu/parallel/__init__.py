@@ -19,7 +19,7 @@ from tony_tpu.parallel.sharding import (
     shard_pytree,
     with_logical_constraint,
 )
-from tony_tpu.parallel.ring import ring_attention
+from tony_tpu.parallel.ring import ring_attention, ring_attention_local
 from tony_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = [
@@ -36,5 +36,6 @@ __all__ = [
     "shard_pytree",
     "with_logical_constraint",
     "ring_attention",
+    "ring_attention_local",
     "pipeline_apply",
 ]

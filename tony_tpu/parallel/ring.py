@@ -57,7 +57,7 @@ def _merge(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     """Per-shard body (runs inside shard_map). q,k,v: [B, Tlocal, H, D]."""
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -118,7 +118,7 @@ def ring_attention(
         scale = q.shape[-1] ** -0.5
     spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
     return jax.shard_map(
         body,
