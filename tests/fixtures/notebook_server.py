@@ -1,0 +1,23 @@
+"""Notebook stand-in: serve one HTTP request on TB_PORT, then exit 0 (the
+executor reserves the port and registers http://host:port as the tracking
+URL; a real deployment runs jupyter --port=$TB_PORT here)."""
+
+import os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b"notebook-alive"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+server = HTTPServer(("0.0.0.0", int(os.environ["TB_PORT"])), Handler)
+server.timeout = 60
+server.handle_request()

@@ -1,0 +1,156 @@
+"""Client/CLI e2e: the full submission path — stage, spawn coordinator
+subprocess, RPC monitor, finish signal — against fixture scripts, mirroring
+the reference's client-driven e2e tier (TestTonyE2E.java runs TonyClient
+against the mini-cluster, not the AM directly)."""
+
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.client.cli import local_submit
+from tony_tpu.conf import keys
+from tony_tpu.client.client import TonyClient
+from tony_tpu.proxy import ProxyServer
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _base_argv(tmp_path, fixture, extra=()):
+    return [
+        "--executes", str(FIXTURES / fixture),
+        "--framework", "jax",
+        "--conf", f"{keys.K_STAGING_LOCATION}={tmp_path}/staging",
+        "--conf", f"{keys.K_HISTORY_LOCATION}={tmp_path}/history",
+        "--conf", "tony.application.python-binary-path=" + sys.executable,
+        "--conf", "tony.am.stop-grace=0",
+        *extra,
+    ]
+
+
+class TestClientE2E:
+    def test_submit_succeeds_exit_0(self, tmp_path):
+        rc = TonyClient().init(_base_argv(tmp_path, "exit_0.py")).run()
+        assert rc == 0
+        # History written through the client path too.
+        hist = list((tmp_path / "history").rglob("*.jhist"))
+        assert hist and "SUCCEEDED" in hist[0].name
+
+    def test_submit_fails_exit_1(self, tmp_path):
+        rc = TonyClient().init(_base_argv(tmp_path, "exit_1.py")).run()
+        assert rc == 1
+
+    def test_src_dir_packaging_relative_executes(self, tmp_path):
+        # Job sources are zipped, shipped, unpacked by the coordinator, and
+        # a *relative* entry point resolves in the unpacked workdir.
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "main.py").write_text("import helper; helper.go()\n")
+        (src / "helper.py").write_text(
+            "def go():\n    print('packaged module ran')\n"
+        )
+        argv = [
+            "--executes", "main.py",
+            "--src_dir", str(src),
+            "--conf", f"{keys.K_STAGING_LOCATION}={tmp_path}/staging",
+            "--conf", "tony.application.python-binary-path=" + sys.executable,
+            "--conf", "tony.am.stop-grace=0",
+        ]
+        rc = TonyClient().init(argv).run()
+        assert rc == 0
+
+    def test_multi_worker_via_cli_local(self, tmp_path):
+        rc = local_submit(
+            _base_argv(tmp_path, "check_jax_env.py",
+                       extra=["--conf", "tony.worker.instances=2"])
+        )
+        assert rc == 0
+
+    def test_client_timeout_kills_job(self, tmp_path):
+        argv = [
+            "--executes", "-c 'import time; time.sleep(600)'",
+            "--conf", f"{keys.K_STAGING_LOCATION}={tmp_path}/staging",
+            "--conf", "tony.application.python-binary-path=" + sys.executable,
+            "--conf", "tony.application.timeout=3000",
+            "--conf", "tony.am.stop-grace=0",
+        ]
+        rc = TonyClient().init(argv).run()
+        assert rc == 1
+
+
+class TestProxy:
+    def test_bidirectional_tunnel(self):
+        # Echo server as the "notebook".
+        server = socket.create_server(("127.0.0.1", 0))
+        port = server.getsockname()[1]
+
+        def echo_once():
+            conn, _ = server.accept()
+            data = conn.recv(1024)
+            conn.sendall(b"echo:" + data)
+            conn.close()
+
+        t = threading.Thread(target=echo_once, daemon=True)
+        t.start()
+
+        proxy = ProxyServer("127.0.0.1", port, 0)
+        lport = proxy.start()
+        try:
+            with socket.create_connection(("127.0.0.1", lport), timeout=5) as c:
+                c.sendall(b"ping")
+                assert c.recv(1024) == b"echo:ping"
+        finally:
+            proxy.stop()
+            server.close()
+
+
+class TestNotebookFlow:
+    def test_notebook_tunnel_end_to_end(self, tmp_path):
+        """Full notebook flow: submit -> executor reserves TB_PORT ->
+        notebook fixture serves on it -> registered URL -> client proxy
+        tunnel -> HTTP through the tunnel."""
+        import logging
+        import re as _re
+        import urllib.request
+
+        from tony_tpu.client import cli as cli_mod
+
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture()
+        old_level = cli_mod.log.level
+        cli_mod.log.setLevel(logging.INFO)  # default effective level is
+        cli_mod.log.addHandler(handler)     # WARNING under pytest
+        results = []
+        argv = _base_argv(tmp_path, "notebook_server.py",
+                          extra=["--conf", "tony.application.timeout=90000"])
+        t = threading.Thread(
+            target=lambda: results.append(cli_mod.notebook_submit(argv))
+        )
+        t.start()
+        try:
+            deadline = time.time() + 60
+            port = None
+            while time.time() < deadline and port is None:
+                for msg in records:
+                    m = _re.search(r"notebook tunnel: http://localhost:(\d+)", msg)
+                    if m:
+                        port = int(m.group(1))
+                time.sleep(0.2)
+            assert port is not None, f"tunnel never appeared; logs: {records}"
+            body = urllib.request.urlopen(
+                f"http://localhost:{port}/", timeout=10
+            ).read()
+            assert body == b"notebook-alive"
+            t.join(timeout=60)
+            assert results == [0]
+        finally:
+            cli_mod.log.removeHandler(handler)
+            cli_mod.log.setLevel(old_level)

@@ -1,0 +1,93 @@
+"""History read path + web server tests — the analogue of the reference's
+history-server tier (TestParserUtils/TestHdfsUtils fixture-folder scans and
+the WithBrowser smoke test, tony-history-server/test/**)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.history import JobMetadata, setup_job_dir
+from tony_tpu.history.reader import TtlCache, job_config, list_jobs
+from tony_tpu.history.server import HistoryServer
+from tony_tpu.history.writer import create_history_file, write_config_file
+
+
+def _make_job(hist, app_id, started_ms, status="SUCCEEDED"):
+    job_dir = setup_job_dir(str(hist), app_id, started_ms)
+    conf = TonyConfiguration()
+    conf.set("tony.application.name", f"name-of-{app_id}")
+    write_config_file(job_dir, conf)
+    create_history_file(job_dir, JobMetadata.new(app_id, started_ms, status))
+    return job_dir
+
+
+class TestReadPath:
+    def test_list_jobs_newest_first_and_malformed_skipped(self, tmp_path):
+        now = int(time.time() * 1000)
+        _make_job(tmp_path, "application_1_0001", now - 60_000)
+        _make_job(tmp_path, "application_1_0002", now, status="FAILED")
+        # Malformed entries must be skipped, not crash the listing.
+        bad = tmp_path / "2020" / "01" / "01" / "application_bad_x"
+        bad.mkdir(parents=True)
+        (bad / "nonsense.jhist").write_text("")
+        (tmp_path / "2020" / "01" / "01" / "not-an-app").mkdir()
+
+        jobs = list_jobs(tmp_path)
+        assert [j.app_id for j in jobs] == [
+            "application_1_0002", "application_1_0001",
+        ]
+        assert jobs[0].status == "FAILED"
+
+    def test_job_config_roundtrip(self, tmp_path):
+        now = int(time.time() * 1000)
+        _make_job(tmp_path, "application_1_0003", now)
+        cfg = job_config(tmp_path, "application_1_0003")
+        assert cfg["tony.application.name"] == "name-of-application_1_0003"
+        assert job_config(tmp_path, "application_9_9999") is None
+
+    def test_ttl_cache(self):
+        clock = [0.0]
+        cache = TtlCache(ttl_s=10.0, clock=lambda: clock[0])
+        calls = []
+        load = lambda: calls.append(1) or len(calls)
+        assert cache.get_or_load("k", load) == 1
+        assert cache.get_or_load("k", load) == 1  # cached
+        clock[0] = 11.0
+        assert cache.get_or_load("k", load) == 2  # expired
+
+
+class TestHistoryServer:
+    def test_pages_and_api(self, tmp_path):
+        now = int(time.time() * 1000)
+        _make_job(tmp_path, "application_2_0001", now)
+        server = HistoryServer(str(tmp_path), port=0)
+        port = server.serve_background()
+        try:
+            base = f"http://localhost:{port}"
+            index = urllib.request.urlopen(f"{base}/").read().decode()
+            assert "application_2_0001" in index and "SUCCEEDED" in index
+
+            page = urllib.request.urlopen(
+                f"{base}/config/application_2_0001"
+            ).read().decode()
+            assert "name-of-application_2_0001" in page
+
+            jobs = json.loads(
+                urllib.request.urlopen(f"{base}/api/jobs").read()
+            )
+            assert jobs[0]["app_id"] == "application_2_0001"
+
+            cfg = json.loads(urllib.request.urlopen(
+                f"{base}/api/config/application_2_0001"
+            ).read())
+            assert cfg["tony.application.name"] == "name-of-application_2_0001"
+
+            try:
+                urllib.request.urlopen(f"{base}/config/application_9_9")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()

@@ -1,0 +1,7 @@
+"""Submission client + CLI — the analogue of ``TonyClient.java`` and the
+``tony-cli`` module (ClusterSubmitter / LocalSubmitter / NotebookSubmitter).
+"""
+
+from tony_tpu.client.client import TonyClient
+
+__all__ = ["TonyClient"]

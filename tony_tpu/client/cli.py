@@ -1,0 +1,152 @@
+"""CLI submitters — the analogue of ``tony-cli``:
+
+  cluster  — ClusterSubmitter (ClusterSubmitter.java:48-82): stage the
+             framework next to the job so executors can import it, then
+             delegate to TonyClient.
+  local    — LocalSubmitter (LocalSubmitter.java:36-70): run the same real
+             client flow against a throwaway mini-cluster directory.
+  notebook — NotebookSubmitter (NotebookSubmitter.java:55-117): single
+             notebook task, 24h default timeout, local TCP proxy to it.
+
+Usage: ``python -m tony_tpu.client.cli <cluster|local|notebook> [options]``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import tony_tpu
+from tony_tpu import constants
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf import keys
+from tony_tpu.proxy import ProxyServer
+
+log = logging.getLogger(__name__)
+
+
+def cluster_submit(argv: list[str]) -> int:
+    """Stage a copy of the tony_tpu package into the staging area (the
+    analogue of copying the fat jar to ``.tony/<uuid>`` with
+    ``--hdfs_classpath``) so remote executors resolve the same framework
+    version the client submitted with."""
+    client = TonyClient().init(argv)
+    staging_root = Path(
+        client.conf.get_str(keys.K_STAGING_LOCATION)
+        or Path.cwd() / constants.TONY_STAGING_DIR
+    )
+    libdir = staging_root / "lib"
+    libdir.mkdir(parents=True, exist_ok=True)
+    pkg_src = Path(tony_tpu.__file__).parent
+    pkg_dst = libdir / "tony_tpu"
+    if not pkg_dst.exists():
+        shutil.copytree(
+            pkg_src, pkg_dst,
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+        )
+    client.conf.set(keys.K_LIB_PATH, str(libdir))
+    try:
+        return client.run()
+    finally:
+        # ClusterSubmitter cleans its .tony/<uuid> jar dir on exit (:74-80).
+        shutil.rmtree(pkg_dst, ignore_errors=True)
+
+
+def local_submit(argv: list[str]) -> int:
+    """Real client flow against a temp mini-cluster dir (staging + history
+    under one throwaway root, like MiniCluster's temp YARN/HDFS confs)."""
+    with tempfile.TemporaryDirectory(prefix="tony-mini-") as root:
+        client = TonyClient().init(argv)
+        client.conf.set(keys.K_STAGING_LOCATION, f"{root}/staging")
+        client.conf.set(keys.K_HISTORY_LOCATION, f"{root}/history")
+        status = client.run()
+        log.info("local run finished with exit %d (history in %s)", status, root)
+        return status
+
+
+def notebook_submit(argv: list[str]) -> int:
+    """Single-node notebook with a local proxy tunnel (the reference polls
+    ``getTaskUrls`` for the ``notebook`` task, then proxies to it, :95-117).
+
+    Wiring: the notebook task is made chief, so the executor reserves a
+    port, exports it as ``TB_PORT`` (the notebook server must listen there,
+    e.g. ``jupyter --port=$TB_PORT``), and registers
+    ``http://host:port`` with the coordinator; the client polls that
+    registered URL from the application status and tunnels to it."""
+    client = TonyClient().init(argv)
+    conf = client.conf
+    # Single-node app: the notebook is the only task (reference submits with
+    # one container); zero every other configured job type (the defaults
+    # file ships worker=1, ps=1).
+    for job in conf.job_types():
+        if job != constants.NOTEBOOK_JOB_NAME:
+            conf.set(keys.instances_key(job), 0)
+    conf.set(f"tony.{constants.NOTEBOOK_JOB_NAME}.instances", 1)
+    conf.set(keys.K_CHIEF_NAME, constants.NOTEBOOK_JOB_NAME)
+    if not conf.get_int(keys.K_APPLICATION_TIMEOUT, 0):
+        conf.set(keys.K_APPLICATION_TIMEOUT, 24 * 3600 * 1000)  # 24h (:63-66)
+
+    proxy_holder: list[ProxyServer] = []
+    job_done = threading.Event()
+
+    def tunnel_when_up() -> None:
+        while not job_done.is_set():
+            if client.rpc is None:
+                time.sleep(0.5)
+                continue
+            try:
+                status = client.rpc.get_application_status()
+            except Exception:
+                time.sleep(1)  # transient: monitor loop owns giving up
+                continue
+            url = status.get("tensorboard_url")
+            if url:
+                m = re.match(r"(?:https?://)?([^:/]+):(\d+)", url)
+                if m:
+                    proxy = ProxyServer(m.group(1), int(m.group(2)), 0)
+                    port = proxy.start()
+                    proxy_holder.append(proxy)
+                    log.info("notebook tunnel: http://localhost:%d", port)
+                return
+            time.sleep(1)
+
+    t = threading.Thread(target=tunnel_when_up, daemon=True)
+    t.start()
+    try:
+        return client.run()
+    finally:
+        job_done.set()
+        for p in proxy_holder:
+            p.stop()
+
+
+SUBMITTERS = {
+    "cluster": cluster_submit,
+    "local": local_submit,
+    "notebook": notebook_submit,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s cli: %(message)s"
+    )
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in SUBMITTERS:
+        print(
+            f"usage: python -m tony_tpu.client.cli "
+            f"<{'|'.join(SUBMITTERS)}> [options]",
+            file=sys.stderr,
+        )
+        return 2
+    return SUBMITTERS[argv[0]](argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,225 @@
+"""Submission client: package, stage, launch the coordinator, monitor.
+
+The analogue of ``TonyClient`` (tony-core/.../TonyClient.java): ``init``
+mirrors arg parsing + conf layering (:251-340), ``run`` mirrors the
+submit-and-monitor flow (:146-208, :631-672). Differences are substrate,
+not shape: the "cluster" is a staging directory (local path or mounted
+GCS), and the "AM container" is a coordinator subprocess — on a real
+deployment the same command line runs on a TPU-VM instead
+(coordinator/backend.py TpuVmBackend plans the slice).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+from pathlib import Path
+
+from tony_tpu import constants, utils
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration, load_job_config
+from tony_tpu.rpc.client import ApplicationRpcClient
+
+log = logging.getLogger(__name__)
+
+TERMINAL_STATES = {"SUCCEEDED", "FAILED", "KILLED"}
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """Common options (Utils.getCommonOptions:208-226)."""
+    p = argparse.ArgumentParser(prog="tony-tpu", add_help=True)
+    p.add_argument("--executes", help="entry point of the training job")
+    p.add_argument("--src_dir", help="directory with job sources to package")
+    p.add_argument("--python_venv", help="venv/conda archive to ship")
+    p.add_argument("--python_binary_path", help="python inside the venv")
+    p.add_argument("--task_params", help="args passed to the entry point")
+    p.add_argument("--shell_env", action="append", default=[],
+                   help="NAME=VALUE env for the training job (repeatable)")
+    p.add_argument("--conf_file", help="job config file (tony.json analogue)")
+    p.add_argument("--conf", action="append", default=[],
+                   help="key=value override (repeatable)")
+    p.add_argument("--app_name", help="application name")
+    p.add_argument("--framework", help="jax | tensorflow | pytorch")
+    return p
+
+
+class TonyClient:
+    def __init__(self) -> None:
+        self.conf = TonyConfiguration()
+        self.app_id: str | None = None
+        self.app_dir: Path | None = None
+        self.coordinator_proc: subprocess.Popen | None = None
+        self.rpc: ApplicationRpcClient | None = None
+        self._urls_printed = False
+
+    # -- init (TonyClient.init:251-340) ------------------------------------
+    def init(self, argv: list[str]) -> "TonyClient":
+        args, _ = build_arg_parser().parse_known_args(argv)
+        self.conf = load_job_config(conf_file=args.conf_file, overrides=args.conf)
+        cli_map = {
+            keys.K_EXECUTES: args.executes,
+            keys.K_SRC_DIR: args.src_dir,
+            keys.K_PYTHON_VENV: args.python_venv,
+            keys.K_PYTHON_BINARY: args.python_binary_path,
+            keys.K_TASK_PARAMS: args.task_params,
+            keys.K_APPLICATION_NAME: args.app_name,
+            keys.K_FRAMEWORK: args.framework,
+        }
+        for key, val in cli_map.items():
+            if val:
+                self.conf.set(key, val)
+        if args.shell_env:
+            self.conf.set(keys.K_SHELL_ENV, ",".join(args.shell_env))
+        return self
+
+    # -- staging (zipArchive + createAMContainerSpec:369-424, 468-491) ------
+    def _stage(self) -> Path:
+        staging_root = Path(
+            self.conf.get_str(keys.K_STAGING_LOCATION)
+            or Path.cwd() / constants.TONY_STAGING_DIR
+        )
+        self.app_id = f"application_{int(time.time() * 1000)}_{uuid.uuid4().hex[:8]}"
+        app_dir = staging_root / self.app_id
+        app_dir.mkdir(parents=True, exist_ok=True)
+
+        src_dir = self.conf.get_str(keys.K_SRC_DIR)
+        if src_dir:
+            utils.zip_dir(src_dir, app_dir / constants.TONY_ARCHIVE)
+        venv = self.conf.get_str(keys.K_PYTHON_VENV)
+        if venv:
+            staged = app_dir / Path(venv).name
+            shutil.copy2(venv, staged)
+            # Executors must unzip the *staged* copy: on a remote deployment
+            # only the staging location is shared, not the client's home dir.
+            self.conf.set(keys.K_PYTHON_VENV, str(staged))
+        self.conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+        return app_dir
+
+    # -- submit + monitor (TonyClient.run:146-208) --------------------------
+    def run(self) -> int:
+        self.app_dir = self._stage()
+        log.info("staged application %s at %s", self.app_id, self.app_dir)
+
+        cmd = [
+            sys.executable, "-m", "tony_tpu.coordinator.app_master",
+            "--app-dir", str(self.app_dir), "--app-id", str(self.app_id),
+        ]
+        # The coordinator inherits stdio like the AM inherits the YARN log
+        # dir (TonyClient.buildCommand:460-461 redirects to stdout/stderr).
+        self.coordinator_proc = subprocess.Popen(cmd)
+        try:
+            return self._monitor()
+        finally:
+            self._shutdown()
+
+    def _connect_rpc(self) -> ApplicationRpcClient | None:
+        addr_file = self.app_dir / "coordinator.addr"
+        retries = self.conf.get_int(keys.K_CLIENT_CONNECT_RETRIES, 30)
+
+        def read_addr():
+            if self.coordinator_proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator exited with {self.coordinator_proc.returncode} "
+                    f"before advertising its RPC address"
+                )
+            if addr_file.is_file():
+                return addr_file.read_text().strip()
+            return None
+
+        addr = utils.poll_till_non_null(read_addr, interval_s=0.2,
+                                        timeout_s=retries)
+        if addr is None:
+            return None
+        host, port = addr.rsplit(":", 1)
+        secret = None
+        if self.conf.get_bool(keys.K_SECURITY_ENABLED):
+            secret = self.conf.get_str(keys.K_SECRET_KEY)
+        return ApplicationRpcClient(host, int(port), secret=secret)
+
+    def _print_task_urls_once(self) -> None:
+        if self._urls_printed or self.rpc is None:
+            return
+        urls = self.rpc.get_task_urls()
+        if urls:
+            for u in sorted(urls, key=lambda u: u.name):
+                log.info("task %s logs: %s", u.name, u.url)  # printTaskUrl:172-174
+            self._urls_printed = True
+
+    def _monitor(self) -> int:
+        """monitorApplication (TonyClient.java:631-672): poll status, print
+        log URLs once, honor the client-side timeout."""
+        interval_s = self.conf.get_int(keys.K_CLIENT_MONITOR_INTERVAL_MS, 1000) / 1000
+        timeout_ms = self.conf.get_int(keys.K_APPLICATION_TIMEOUT, 0)
+        deadline = time.monotonic() + timeout_ms / 1000 if timeout_ms else None
+        self.rpc = self._connect_rpc()
+        if self.rpc is None:
+            log.error("could not reach coordinator RPC")
+            return 1
+        while True:
+            if self.coordinator_proc.poll() is not None:
+                # Coordinator death is terminal even without a final status
+                # (the AM-crash path in the reference e2e matrix).
+                code = self.coordinator_proc.returncode
+                log.info("coordinator exited with %s", code)
+                return 0 if code == 0 else 1
+            try:
+                status = self.rpc.get_application_status()
+                self._print_task_urls_once()
+            except Exception as exc:  # connection refused during teardown
+                log.debug("status poll failed: %s", exc)
+                time.sleep(interval_s)
+                continue
+            state = status.get("state", "RUNNING")
+            if status.get("tensorboard_url"):
+                self._print_tb_once(status["tensorboard_url"])
+            if state in TERMINAL_STATES:
+                log.info("application finished: %s %s", state,
+                         status.get("diagnostics", ""))
+                return 0 if state == "SUCCEEDED" else 1
+            if deadline is not None and time.monotonic() > deadline:
+                log.error("client-side timeout; killing application")
+                self.coordinator_proc.kill()
+                return 1
+            time.sleep(interval_s)
+
+    _tb_printed = False
+
+    def _print_tb_once(self, url: str) -> None:
+        if not self._tb_printed:
+            log.info("tensorboard/profiler: %s", url)
+            self._tb_printed = True
+
+    def _shutdown(self) -> None:
+        """finishApplication + cleanup (TonyClient.main:748-757)."""
+        if self.rpc is not None:
+            try:
+                self.rpc.finish_application()
+            except Exception:
+                pass
+            self.rpc.close()
+        if self.coordinator_proc is not None:
+            try:
+                self.coordinator_proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.coordinator_proc.kill()
+
+    def task_urls(self):
+        return self.rpc.get_task_urls() if self.rpc else []
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s client: %(message)s"
+    )
+    client = TonyClient().init(argv if argv is not None else sys.argv[1:])
+    return client.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

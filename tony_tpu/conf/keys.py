@@ -77,6 +77,7 @@ K_TPU_SLICE_STRICT = TPU_PREFIX + "strict-slice-shapes"  # reject illegal topolo
 
 # --- storage / staging -----------------------------------------------------
 K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
+K_LIB_PATH = TONY_PREFIX + "lib.path"                    # staged framework copy for executors
 K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
 K_OTHER_NAMENODES = TONY_PREFIX + "other.namenodes"      # extra filesystems to token
 
@@ -132,6 +133,7 @@ DEFAULTS: dict[str, object] = {
     K_TPU_ACCELERATOR_TYPE: "",
     K_TPU_SLICE_STRICT: False,
     K_STAGING_LOCATION: "",
+    K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",
     K_OTHER_NAMENODES: "",
     K_HTTP_PORT: "disabled",

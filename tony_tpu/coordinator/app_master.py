@@ -300,7 +300,26 @@ def main(argv: list[str] | None = None) -> int:
     conf = TonyConfiguration.from_final(
         Path(args.app_dir) / constants.TONY_FINAL_CONF
     )
-    coordinator = TonyCoordinator(conf, args.app_dir, app_id=args.app_id)
+    # AM-side unpack of the client's job archive (init:193-269 unzips
+    # tony.zip); executors then run with the unpacked sources as cwd, so a
+    # relative ``tony.application.executes`` resolves like a localized
+    # YARN resource would.
+    backend = None
+    archive = Path(args.app_dir) / constants.TONY_ARCHIVE
+    lib_path = conf.get_str(keys.K_LIB_PATH) or None
+    if archive.is_file() or lib_path:
+        workdir = None
+        if archive.is_file():
+            workdir = Path(args.app_dir) / "workdir"
+            utils.unzip(archive, workdir)
+        backend = LocalProcessBackend(
+            Path(args.app_dir) / "logs",
+            cwd=str(workdir) if workdir else None,
+            lib_path=lib_path,
+        )
+    coordinator = TonyCoordinator(
+        conf, args.app_dir, app_id=args.app_id, backend=backend
+    )
     status = coordinator.run()
     return 0 if status is SessionStatus.SUCCEEDED else 1
 

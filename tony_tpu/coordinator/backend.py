@@ -56,15 +56,40 @@ class LocalProcessBackend:
     ``log_dir`` (the YARN container-log-dir analogue; these paths are what
     task URLs point at)."""
 
-    def __init__(self, log_dir: str | os.PathLike[str], cwd: str | None = None) -> None:
-        self.log_dir = Path(log_dir)
+    def __init__(
+        self,
+        log_dir: str | os.PathLike[str],
+        cwd: str | None = None,
+        lib_path: str | None = None,
+    ) -> None:
+        # Absolute: task_url() builds file:// URIs, and executors launched
+        # with a different cwd must still find their log files.
+        self.log_dir = Path(log_dir).resolve()
         self.log_dir.mkdir(parents=True, exist_ok=True)
         self._cwd = cwd
+        self._lib_path = lib_path
         self._handles: list[_ProcHandle] = []
 
     def launch(self, task: TonyTask, env: Mapping[str, str]) -> _ProcHandle:
         full_env = dict(os.environ)
         full_env.update({k: str(v) for k, v in env.items()})
+        # The executor must import tony_tpu regardless of its cwd (which is
+        # the unpacked job archive for client submissions) — the analogue of
+        # ClusterSubmitter staging the framework jar on the container
+        # classpath (ClusterSubmitter.java:59-63). A staged copy
+        # (tony.lib.path, set by the cluster submitter) wins over the
+        # coordinator's own install so executors run the submitted version.
+        if self._lib_path:
+            pkg_root = self._lib_path
+        else:
+            import tony_tpu
+
+            pkg_root = str(Path(tony_tpu.__file__).parent.parent)
+        existing = full_env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            full_env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else "")
+            )
         logfile = self.log_dir / f"{task.job_name}-{task.index}.log"
         out = open(logfile, "ab")
         proc = subprocess.Popen(

@@ -82,6 +82,7 @@ class TaskExecutor:
         self.port = utils.reserve_port()
         self.host = "127.0.0.1" if self._local_mode() else utils.local_host()
         self.tb_port: int | None = None
+        self.profiler_port: int | None = None
         self.heartbeater: Heartbeater | None = None
 
     def _local_mode(self) -> bool:
@@ -131,6 +132,8 @@ class TaskExecutor:
         )
         if self.tb_port is not None:
             env[constants.TB_PORT] = str(self.tb_port)
+        if self.profiler_port is not None:
+            env[constants.PROFILER_PORT] = str(self.profiler_port)
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         return env
@@ -198,6 +201,11 @@ class TaskExecutor:
                 )
             except Exception:
                 log.warning("could not register TensorBoard URL", exc_info=True)
+        if self.conf.get_bool(keys.K_PROFILER_ENABLED, False):
+            # The profiler seam SURVEY.md §5.1 reserves: each task gets a
+            # port for jax.profiler.start_server; the user script opts in
+            # via tony_tpu.profiling.maybe_start_profiler_server().
+            self.profiler_port = utils.reserve_port()
         env = self.build_task_env(cluster_spec)
         command = self.build_task_command()
         timeout_ms = (

@@ -1,0 +1,70 @@
+"""Job-history read path — the analogue of the history server's HDFS scan
+(tony-history-server/.../JobsMetadataPageController.java:27-66,
+HdfsUtils.getJobFolders:93-113, ParserUtils.parseConfig:105-152): walk the
+``<hist>/<year>/<month>/<day>/<app_id>`` layout, parse ``.jhist`` filenames
+into metadata, and load a job's frozen ``config.json``."""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from pathlib import Path
+
+from tony_tpu.history.writer import JobMetadata
+
+_APP_ID_RE = re.compile(r"^application_[\w.]+_[\w.]+$")
+
+
+def find_job_dirs(history_location: str | Path) -> list[Path]:
+    """Recursive scan for job folders whose name looks like an app id
+    (the reference matches ``^application_\\d+_\\d+$``; ours allows the
+    mini/uuid id forms too)."""
+    root = Path(history_location)
+    if not root.is_dir():
+        return []
+    return sorted(
+        p for p in root.glob("*/*/*/*") if p.is_dir() and _APP_ID_RE.match(p.name)
+    )
+
+
+def list_jobs(history_location: str | Path) -> list[JobMetadata]:
+    """Newest-first job metadata, parsed from .jhist filenames (malformed
+    entries are skipped, as the reference's parser does)."""
+    jobs = []
+    for job_dir in find_job_dirs(history_location):
+        for f in job_dir.glob("*.jhist"):
+            try:
+                jobs.append(JobMetadata.parse_jhist_name(f.name))
+            except ValueError:
+                continue
+    return sorted(jobs, key=lambda j: j.started_ms, reverse=True)
+
+
+def job_config(history_location: str | Path, app_id: str) -> dict | None:
+    """The frozen config of one job (JobConfigPageController.java:25-59)."""
+    for job_dir in find_job_dirs(history_location):
+        if job_dir.name == app_id:
+            cfg = job_dir / "config.json"
+            if cfg.is_file():
+                return json.loads(cfg.read_text())
+    return None
+
+
+class TtlCache:
+    """Tiny TTL cache (CacheWrapper.java:11-40 uses Guava caches so repeat
+    page loads don't rescan HDFS; same idea for directory walks)."""
+
+    def __init__(self, ttl_s: float = 30.0, clock=time.monotonic) -> None:
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._store: dict = {}
+
+    def get_or_load(self, key, loader):
+        now = self._clock()
+        hit = self._store.get(key)
+        if hit is not None and now - hit[0] < self.ttl_s:
+            return hit[1]
+        value = loader()
+        self._store[key] = (now, value)
+        return value

@@ -1,0 +1,159 @@
+"""History web server — the analogue of ``tony-history-server`` (a Play
+app with two routes, conf/routes:1-3: ``GET /`` lists jobs, ``GET
+/config/:jobId`` shows a job's frozen config). Stdlib http.server instead
+of Play: no template engine, no servlet container, same two pages plus
+JSON twins for tooling.
+
+Run: ``python -m tony_tpu.history.server --history-location DIR [--port N]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import logging
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.history.reader import TtlCache, job_config, list_jobs
+
+log = logging.getLogger(__name__)
+
+_PAGE = """<!doctype html><html><head><title>tony-tpu history</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+ th {{ background: #eee; }}
+ .SUCCEEDED {{ color: #070; }} .FAILED {{ color: #a00; }} .KILLED {{ color: #850; }}
+</style></head><body><h2>{title}</h2>{body}</body></html>"""
+
+
+def _fmt_ms(ms: int) -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ms / 1000))
+
+
+class HistoryHandler(BaseHTTPRequestHandler):
+    history_location: str = "."
+    cache: TtlCache = TtlCache(ttl_s=30.0)
+
+    # -- routes -------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            if self.path in ("/", "/index.html"):
+                self._send_html(self._jobs_page())
+            elif self.path == "/api/jobs":
+                self._send_json([j.__dict__ for j in self._jobs()])
+            elif self.path.startswith("/config/"):
+                self._config_page(self.path[len("/config/"):])
+            elif self.path.startswith("/api/config/"):
+                cfg = self._config(self.path[len("/api/config/"):])
+                if cfg is None:
+                    self._send_json({"error": "not found"}, status=404)
+                else:
+                    self._send_json(cfg)
+            else:
+                self.send_error(404)
+        except Exception as exc:  # pragma: no cover - defensive
+            log.exception("history request failed")
+            self.send_error(500, str(exc))
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("http: " + fmt, *args)
+
+    # -- data (cached scans) -------------------------------------------------
+    def _jobs(self):
+        return self.cache.get_or_load(
+            "jobs", lambda: list_jobs(self.history_location)
+        )
+
+    def _config(self, app_id: str):
+        return self.cache.get_or_load(
+            ("config", app_id), lambda: job_config(self.history_location, app_id)
+        )
+
+    # -- pages ---------------------------------------------------------------
+    def _jobs_page(self) -> str:
+        rows = "".join(
+            f"<tr><td><a href='/config/{j.app_id}'>{html.escape(j.app_id)}</a></td>"
+            f"<td>{_fmt_ms(j.started_ms)}</td><td>{_fmt_ms(j.completed_ms)}</td>"
+            f"<td>{html.escape(j.user)}</td>"
+            f"<td class='{html.escape(j.status)}'>{html.escape(j.status)}</td></tr>"
+            for j in self._jobs()
+        )
+        body = (
+            "<table><tr><th>job</th><th>started</th><th>completed</th>"
+            f"<th>user</th><th>status</th></tr>{rows}</table>"
+        )
+        return _PAGE.format(title="Jobs", body=body)
+
+    def _config_page(self, app_id: str) -> None:
+        cfg = self._config(app_id)
+        if cfg is None:
+            self.send_error(404, f"no history for {app_id}")
+            return
+        rows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td><td>{html.escape(str(v))}</td></tr>"
+            for k, v in sorted(cfg.items())
+        )
+        body = f"<table><tr><th>key</th><th>value</th></tr>{rows}</table>"
+        self._send_html(_PAGE.format(title=html.escape(app_id), body=body))
+
+    # -- plumbing ------------------------------------------------------------
+    def _send_html(self, text: str, status: int = 200) -> None:
+        data = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        data = json.dumps(obj, indent=2).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class HistoryServer:
+    def __init__(self, history_location: str, port: int = 0) -> None:
+        handler = type(
+            "BoundHandler", (HistoryHandler,),
+            {"history_location": history_location, "cache": TtlCache(30.0)},
+        )
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self.httpd.server_address[1]
+
+    def serve_background(self) -> int:
+        import threading
+
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        log.info("history server on http://localhost:%d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="tony_tpu history server")
+    p.add_argument("--history-location", required=True)
+    p.add_argument("--port", type=int, default=19886)
+    args = p.parse_args(argv)
+    server = HistoryServer(args.history_location, args.port)
+    print(f"history server on http://localhost:{server.port}")
+    try:
+        server.httpd.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,10 +41,14 @@ class JobMetadata:
         if not name.endswith(".jhist"):
             raise ValueError(f"not a jhist file: {name}")
         stem = name[: -len(".jhist")]
-        parts = stem.rsplit("-", 4)
-        if len(parts) != 5:
+        # Usernames may contain hyphens; app ids (application_x_y) and the
+        # int timestamps cannot, so anchor on both ends and join the middle.
+        parts = stem.split("-")
+        if len(parts) < 5:
             raise ValueError(f"malformed jhist name: {name}")
-        app_id, started, completed, user, status = parts
+        app_id, started, completed = parts[0], parts[1], parts[2]
+        status = parts[-1]
+        user = "-".join(parts[3:-1])
         return JobMetadata(app_id, int(started), int(completed), user, status)
 
     @staticmethod

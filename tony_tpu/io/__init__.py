@@ -1,0 +1,23 @@
+"""Data plane: sharded record readers feeding JAX input pipelines.
+
+The analogue of the reference's HDFS Avro data plane
+(tony-core/.../io/HdfsAvroFileSplitReader.java): N files are concatenated
+into one byte range, split contiguously across M readers (:285-297), each
+reader prefetches on a background thread into a bounded buffer with an
+optional shuffle pool (:160-282), and consumers pull batches. Differences
+are deliberate TPU-first choices: no py4j bridge (reader and training loop
+share the process), numpy token records instead of Avro rows (the MXU wants
+dense int arrays, not generic records), and a device-placement step that
+shards each batch over the mesh's (dp, ep) axes.
+"""
+
+from tony_tpu.io.splits import compute_read_split, create_read_info, FileSegment
+from tony_tpu.io.reader import ShardedRecordReader, sharded_batches
+
+__all__ = [
+    "compute_read_split",
+    "create_read_info",
+    "FileSegment",
+    "ShardedRecordReader",
+    "sharded_batches",
+]

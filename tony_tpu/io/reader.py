@@ -1,0 +1,213 @@
+"""Sharded record reader with background prefetch.
+
+Record semantics per format:
+
+  - ``jsonl``: newline-delimited records. A reader whose byte range starts
+    mid-record skips forward to the next newline; the reader owning the
+    record's first byte reads it to completion even past its range end —
+    the classic split-brain rule (the reference does the same with Avro
+    sync markers, HdfsAvroFileSplitReader.java:190-240), so every record is
+    read exactly once across readers.
+  - ``tokens``: fixed-size binary records of ``record_len`` values of
+    ``dtype`` (the LM-training format: pre-tokenized sequences). Ranges are
+    aligned down/up to record boundaries, which keeps every record whole.
+
+The fetcher thread decodes records into a bounded queue
+(DataFetcher:176-282's bounded buffer); an optional shuffle pool trades
+memory for sample decorrelation exactly like the reference's shuffle
+buffer (:160-174).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import random
+import threading
+from typing import Any, Iterator
+
+import numpy as np
+
+from tony_tpu.io.splits import FileSegment, create_read_info
+
+_SENTINEL = object()
+
+
+class ShardedRecordReader:
+    def __init__(
+        self,
+        paths: list[str],
+        task_index: int = 0,
+        num_tasks: int = 1,
+        *,
+        fmt: str = "jsonl",
+        dtype: Any = np.uint16,
+        record_len: int | None = None,
+        batch_size: int = 32,
+        shuffle: bool = False,
+        shuffle_pool: int = 1024,
+        buffer_records: int = 4096,
+        seed: int = 0,
+    ) -> None:
+        if fmt not in ("jsonl", "tokens"):
+            raise ValueError(f"unknown format {fmt!r}")
+        if fmt == "tokens" and not record_len:
+            raise ValueError("tokens format needs record_len")
+        self.fmt = fmt
+        self.dtype = np.dtype(dtype)
+        self.record_len = record_len
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.shuffle_pool = shuffle_pool
+        self._rng = random.Random(seed + task_index)
+
+        files = [(p, os.path.getsize(p)) for p in sorted(paths)]
+        self.segments = create_read_info(files, task_index, num_tasks)
+        if fmt == "tokens":
+            self.segments = [self._align_tokens(s) for s in self.segments]
+            self.segments = [s for s in self.segments if s.length > 0]
+
+        self._queue: queue.Queue = queue.Queue(maxsize=max(buffer_records, 1))
+        self._stop = threading.Event()
+        self._fetcher = threading.Thread(target=self._fetch_loop, daemon=True)
+        self._fetcher.start()
+
+    # -- range alignment ----------------------------------------------------
+    def _record_bytes(self) -> int:
+        return self.record_len * self.dtype.itemsize
+
+    def _align_tokens(self, seg: FileSegment) -> FileSegment:
+        rb = self._record_bytes()
+        # Owner-of-first-byte rule, record-granular: round the start UP to
+        # the next boundary (a partial head belongs to the previous reader,
+        # which rounds its own end up past it) and the end UP as well.
+        start = -(-seg.offset // rb) * rb
+        end = -(-(seg.offset + seg.length) // rb) * rb
+        file_size = os.path.getsize(seg.path)
+        end = min(end, file_size - file_size % rb)
+        return FileSegment(seg.path, start, max(0, end - start))
+
+    # -- fetcher thread ------------------------------------------------------
+    def _fetch_loop(self) -> None:
+        pool: list[Any] = []
+        try:
+            for rec in self._iter_records():
+                if self._stop.is_set():
+                    return
+                if self.shuffle:
+                    if len(pool) < self.shuffle_pool:
+                        pool.append(rec)
+                        continue
+                    j = self._rng.randrange(len(pool))
+                    pool[j], rec = rec, pool[j]
+                self._put(rec)
+            if self.shuffle:
+                self._rng.shuffle(pool)
+                for rec in pool:
+                    if self._stop.is_set():
+                        return
+                    self._put(rec)
+        finally:
+            self._put(_SENTINEL)
+
+    def _put(self, item: Any) -> None:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def _iter_records(self) -> Iterator[Any]:
+        for seg in self.segments:
+            if self.fmt == "tokens":
+                yield from self._iter_tokens(seg)
+            else:
+                yield from self._iter_jsonl(seg)
+
+    def _iter_tokens(self, seg: FileSegment) -> Iterator[np.ndarray]:
+        rb = self._record_bytes()
+        with open(seg.path, "rb") as f:
+            f.seek(seg.offset)
+            remaining = seg.length
+            while remaining >= rb:
+                buf = f.read(rb)
+                if len(buf) < rb:
+                    return
+                remaining -= rb
+                yield np.frombuffer(buf, dtype=self.dtype)
+
+    def _iter_jsonl(self, seg: FileSegment) -> Iterator[Any]:
+        with open(seg.path, "rb") as f:
+            if seg.offset == 0:
+                f.seek(0)
+            else:
+                # Seek one byte back before skipping: if offset sits exactly
+                # on a record start, the preceding byte is the newline, so
+                # readline() consumes only it and the record stays ours
+                # (Hadoop LineRecordReader's boundary rule).
+                f.seek(seg.offset - 1)
+                f.readline()
+            end = seg.offset + seg.length
+            while f.tell() < end:  # owner reads its last record past `end`
+                line = f.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+    # -- consumer API (nextBatch*, :503-542) --------------------------------
+    def next_batch(self) -> list[Any] | np.ndarray | None:
+        """One batch, or None at end of shard (batches may be short at the
+        tail). Token format returns [batch, record_len] arrays."""
+        out: list[Any] = []
+        while len(out) < self.batch_size:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                self._queue.put(_SENTINEL)  # keep the stream terminated
+                break
+            out.append(item)
+        if not out:
+            return None
+        if self.fmt == "tokens":
+            return np.stack(out)
+        return out
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            batch = self.next_batch()
+            if batch is None:
+                return
+            yield batch
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._fetcher.join(timeout=5)
+
+    def __enter__(self) -> "ShardedRecordReader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def sharded_batches(reader: ShardedRecordReader, mesh, axes=("dp", "ep")):
+    """Wrap a tokens-format reader into an iterator of device arrays whose
+    batch dim is sharded over ``axes`` — the step input the train-step
+    builders expect. Short tail batches are dropped (static shapes keep XLA
+    from recompiling)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axes))
+    for batch in reader:
+        if batch.shape[0] != reader.batch_size:
+            continue
+        yield jax.device_put(batch, sharding)

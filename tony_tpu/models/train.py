@@ -42,7 +42,14 @@ def _sharding_for_tree(abstract_tree, roles: dict, mesh: Mesh):
     matching role path (optimizer scalars like adam's count) replicate.
     """
 
-    def leaf_sharding(path, _leaf):
+    def axis_size(entry) -> int:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        return size
+
+    def leaf_sharding(path, leaf):
         node = roles
         for entry in path:
             if isinstance(entry, jax.tree_util.DictKey):
@@ -51,7 +58,15 @@ def _sharding_for_tree(abstract_tree, roles: dict, mesh: Mesh):
                 else:
                     return NamedSharding(mesh, P())
         if isinstance(node, tuple):
-            return logical_sharding(mesh, *node)
+            spec = logical_sharding(mesh, *node).spec
+            # A dim whose size the mesh axes don't divide replicates instead
+            # of erroring (e.g. d_model=64 with dp=3 fsdp): sharding is a
+            # placement optimization, never a correctness requirement.
+            fixed = [
+                e if e is None or dim % axis_size(e) == 0 else None
+                for e, dim in zip(spec, leaf.shape)
+            ]
+            return NamedSharding(mesh, P(*fixed))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, abstract_tree)

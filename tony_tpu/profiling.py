@@ -1,0 +1,50 @@
+"""Profiler integration — fills the seam the reference reserved for
+TensorBoard-style observability (TaskExecutor.java:121-124 reserves a port
+and registers its URL through the AM; SURVEY.md §5.1 maps that seam to
+``jax.profiler``). Training code calls these; the executor supplies
+``PROFILER_PORT`` when ``tony.profiler.enabled`` is set."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+from tony_tpu import constants
+
+log = logging.getLogger(__name__)
+
+_started = False
+
+
+def maybe_start_profiler_server() -> int | None:
+    """Start ``jax.profiler.start_server`` on the port the executor
+    reserved (no-op without PROFILER_PORT, so scripts can call this
+    unconditionally). Returns the port, or None."""
+    global _started
+    port = os.environ.get(constants.PROFILER_PORT)
+    if not port or _started:
+        return int(port) if port else None
+    import jax
+
+    jax.profiler.start_server(int(port))
+    _started = True
+    log.info("jax profiler server on port %s", port)
+    return int(port)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a Perfetto/XProf trace of the enclosed steps into
+    ``log_dir`` (viewable in TensorBoard's profile tab or xprof)."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named span in the device trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

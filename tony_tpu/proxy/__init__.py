@@ -1,0 +1,9 @@
+"""TCP proxy — the analogue of ``tony-proxy``
+(tony-proxy/.../ProxyServer.java:29-97): tunnels a local port on the
+gateway host to a service running inside the cluster (the notebook flow:
+browser → localhost:port → proxy → notebook container).
+"""
+
+from tony_tpu.proxy.server import ProxyServer
+
+__all__ = ["ProxyServer"]

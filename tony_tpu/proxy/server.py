@@ -1,0 +1,82 @@
+"""Bidirectional TCP byte pump (ProxyServer.java:33-97: thread per
+connection, two pump loops per tunnel)."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+log = logging.getLogger(__name__)
+
+_BUF = 65536
+
+
+class ProxyServer:
+    def __init__(self, remote_host: str, remote_port: int, local_port: int) -> None:
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self.local_port = local_port
+        self._server: socket.socket | None = None
+        self._stopped = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> int:
+        """Listen on local_port (0 = ephemeral) and serve in background
+        threads; returns the bound port."""
+        self._server = socket.create_server(("127.0.0.1", self.local_port))
+        self.local_port = self._server.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info(
+            "proxy 127.0.0.1:%d -> %s:%d",
+            self.local_port, self.remote_host, self.remote_port,
+        )
+        return self.local_port
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                client, _ = self._server.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                remote = socket.create_connection(
+                    (self.remote_host, self.remote_port), timeout=10
+                )
+            except OSError as exc:
+                log.warning("proxy connect to %s:%d failed: %s",
+                            self.remote_host, self.remote_port, exc)
+                client.close()
+                continue
+            # Pump threads are daemons that exit with their sockets; they
+            # are not tracked (a 24h notebook tunnel would otherwise
+            # accumulate two dead Thread objects per browser connection).
+            for src, dst in ((client, remote), (remote, client)):
+                threading.Thread(
+                    target=self._pump, args=(src, dst), daemon=True
+                ).start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(_BUF)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                s.close()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._server is not None:
+            self._server.close()
