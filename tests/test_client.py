@@ -11,7 +11,7 @@ from pathlib import Path
 
 import pytest
 
-from tony_tpu.client.cli import local_submit
+from tony_tpu.client.cli import cluster_submit, local_submit
 from tony_tpu.conf import keys
 from tony_tpu.client.client import TonyClient
 from tony_tpu.proxy import ProxyServer
@@ -68,6 +68,13 @@ class TestClientE2E:
                        extra=["--conf", "tony.worker.instances=2"])
         )
         assert rc == 0
+
+    def test_cluster_submit_stages_and_cleans_framework(self, tmp_path):
+        rc = cluster_submit(_base_argv(tmp_path, "exit_0.py"))
+        assert rc == 0
+        # Per-submission lib-<uuid> dir is owned and removed by this
+        # submission only (ClusterSubmitter.java:74-80 cleanup analogue).
+        assert not list((tmp_path / "staging").glob("lib-*"))
 
     def test_client_timeout_kills_job(self, tmp_path):
         argv = [

@@ -20,6 +20,7 @@ import sys
 import tempfile
 import threading
 import time
+import uuid
 from pathlib import Path
 
 import tony_tpu
@@ -41,21 +42,22 @@ def cluster_submit(argv: list[str]) -> int:
         client.conf.get_str(keys.K_STAGING_LOCATION)
         or Path.cwd() / constants.TONY_STAGING_DIR
     )
-    libdir = staging_root / "lib"
-    libdir.mkdir(parents=True, exist_ok=True)
+    # Per-submission lib dir (the reference stages its jar under
+    # .tony/<uuid>, ClusterSubmitter.java:59-63): each submission owns a
+    # fresh framework copy and cleans up only its own, so concurrent
+    # submissions never share (or delete) each other's staged code.
+    libdir = staging_root / f"lib-{uuid.uuid4().hex[:8]}"
     pkg_src = Path(tony_tpu.__file__).parent
-    pkg_dst = libdir / "tony_tpu"
-    if not pkg_dst.exists():
-        shutil.copytree(
-            pkg_src, pkg_dst,
-            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
-        )
+    shutil.copytree(
+        pkg_src, libdir / "tony_tpu",
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+    )
     client.conf.set(keys.K_LIB_PATH, str(libdir))
     try:
         return client.run()
     finally:
         # ClusterSubmitter cleans its .tony/<uuid> jar dir on exit (:74-80).
-        shutil.rmtree(pkg_dst, ignore_errors=True)
+        shutil.rmtree(libdir, ignore_errors=True)
 
 
 def local_submit(argv: list[str]) -> int:

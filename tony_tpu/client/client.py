@@ -120,7 +120,11 @@ class TonyClient:
 
     def _connect_rpc(self) -> ApplicationRpcClient | None:
         addr_file = self.app_dir / "coordinator.addr"
-        retries = self.conf.get_int(keys.K_CLIENT_CONNECT_RETRIES, 30)
+        # A fresh interpreter can take tens of seconds to reach prepare()
+        # (e.g. a sitecustomize that imports jax), so the address wait gets
+        # its own generous deadline; per-call retries are a separate knob.
+        timeout_s = self.conf.get_int(keys.K_CLIENT_CONNECT_TIMEOUT_MS, 60000) / 1000.0
+        retries = self.conf.get_int(keys.K_CLIENT_CONNECT_RETRIES, 3)
 
         def read_addr():
             if self.coordinator_proc.poll() is not None:
@@ -133,14 +137,15 @@ class TonyClient:
             return None
 
         addr = utils.poll_till_non_null(read_addr, interval_s=0.2,
-                                        timeout_s=retries)
+                                        timeout_s=timeout_s)
         if addr is None:
             return None
         host, port = addr.rsplit(":", 1)
         secret = None
         if self.conf.get_bool(keys.K_SECURITY_ENABLED):
             secret = self.conf.get_str(keys.K_SECRET_KEY)
-        return ApplicationRpcClient(host, int(port), secret=secret)
+        return ApplicationRpcClient(host, int(port), secret=secret,
+                                    call_retries=retries)
 
     def _print_task_urls_once(self) -> None:
         if self._urls_printed or self.rpc is None:

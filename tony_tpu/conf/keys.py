@@ -29,6 +29,7 @@ K_IS_SINGLE_NODE = APPLICATION_PREFIX + "single-node"
 K_ENABLE_PREPROCESS = APPLICATION_PREFIX + "enable-preprocess"
 K_APPLICATION_TIMEOUT = APPLICATION_PREFIX + "timeout"   # ms, 0 = none
 K_CLIENT_CONNECT_RETRIES = APPLICATION_PREFIX + "num-client-coordinator-connect-retries"
+K_CLIENT_CONNECT_TIMEOUT_MS = APPLICATION_PREFIX + "coordinator-connect-timeout"
 K_SECURITY_ENABLED = APPLICATION_PREFIX + "security.enabled"
 K_NODE_LABEL = APPLICATION_PREFIX + "node-label"
 K_DOCKER_ENABLED = APPLICATION_PREFIX + "docker.enabled"
@@ -105,6 +106,7 @@ DEFAULTS: dict[str, object] = {
     K_ENABLE_PREPROCESS: False,
     K_APPLICATION_TIMEOUT: 0,
     K_CLIENT_CONNECT_RETRIES: 3,
+    K_CLIENT_CONNECT_TIMEOUT_MS: 60000,
     K_SECURITY_ENABLED: False,
     K_NODE_LABEL: "",
     K_DOCKER_ENABLED: False,

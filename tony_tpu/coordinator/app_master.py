@@ -97,6 +97,11 @@ class TonyCoordinator:
         self.started_ms = int(time.time() * 1000)
         self._session_seq = 0
         self._hb_missed: set[str] = set()
+        # Terminal state is masked from the status RPC until stop() has
+        # persisted history + final-status — a client that reacts to the
+        # terminal state (and, say, reads history) must never win a race
+        # against the files being written.
+        self._final_published = threading.Event()
 
         secret = None
         if conf.get_bool(keys.K_SECURITY_ENABLED):
@@ -212,7 +217,7 @@ class TonyCoordinator:
         fails (and retries slice-wide) rather than killing one task."""
         self._hb_missed.add(task_id)
         if self.session is not None:
-            self.session._fail(f"task {task_id} missed too many heartbeats")
+            self.session.fail(f"task {task_id} missed too many heartbeats")
         self._wake.set()
 
     # -- monitor loop (TonyApplicationMaster.monitor:548-610) ---------------
@@ -227,7 +232,7 @@ class TonyCoordinator:
                 session.kill("killed by client")
                 break
             if deadline is not None and time.monotonic() > deadline:
-                session._fail(f"application timed out after {timeout_ms}ms")
+                session.fail(f"application timed out after {timeout_ms}ms")
                 break
             for task in session.all_tasks():
                 if task.handle is None or task.completed():
@@ -255,17 +260,19 @@ class TonyCoordinator:
         self.client_signal_to_finish.clear()
 
     def stop(self, status: SessionStatus) -> SessionStatus:
-        """stop (TonyApplicationMaster.java:621-637): write history, then wait
-        (bounded) for the client's finishApplication signal."""
+        """stop (TonyApplicationMaster.java:621-637): write history, publish
+        the terminal state, then wait (bounded) for the client's
+        finishApplication signal."""
         hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
         if hist:
             job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
             create_history_file(
                 job_dir, JobMetadata.new(self.app_id, self.started_ms, status.value)
             )
-        (self.app_dir / "final-status.json").write_text(
-            json.dumps(self.application_status()) + "\n"
-        )
+        final = self.application_status()
+        final["state"] = status.value  # unmasked: this IS the terminal record
+        (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
+        self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0
         self.client_signal_to_finish.wait(timeout=grace_s)
         return status
@@ -279,6 +286,8 @@ class TonyCoordinator:
             return {"state": "NEW", "diagnostics": ""}
         state = self.session.status.value
         if state == "NEW":
+            state = "RUNNING"
+        if state in ("SUCCEEDED", "FAILED", "KILLED") and not self._final_published.is_set():
             state = "RUNNING"
         return {
             "state": state,

@@ -23,7 +23,8 @@ log = logging.getLogger(__name__)
 
 # Job types excluded from completion accounting: parameter servers run
 # forever by design, so "all workers done" ends the job
-# (TonySession.updateSessionStatus:307-310). Notebook follows ps semantics.
+# (TonySession.updateSessionStatus:307-310). The notebook job type is
+# tracked normally — the notebook CLI makes it the chief instead.
 UNTRACKED_JOB_TYPES = frozenset({constants.PS_JOB_NAME})
 
 
@@ -151,6 +152,12 @@ class TonySession:
                 self._maybe_succeed(chief_done=True)
             else:
                 self._maybe_succeed(chief_done=False)
+
+    def fail(self, why: str) -> None:
+        """Thread-safe failure entry point for callers outside the session
+        (e.g. the liveness-monitor thread, app_master._on_task_deemed_dead)."""
+        with self._lock:
+            self._fail(why)
 
     def _fail(self, why: str) -> None:
         if self.status not in (SessionStatus.SUCCEEDED, SessionStatus.KILLED):

@@ -6,6 +6,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 
 log = logging.getLogger(__name__)
 
@@ -13,10 +14,20 @@ _BUF = 65536
 
 
 class ProxyServer:
-    def __init__(self, remote_host: str, remote_port: int, local_port: int) -> None:
+    def __init__(
+        self,
+        remote_host: str,
+        remote_port: int,
+        local_port: int,
+        connect_deadline_s: float = 20.0,
+    ) -> None:
         self.remote_host = remote_host
         self.remote_port = remote_port
         self.local_port = local_port
+        # Upstream connects retry until this deadline: the tunnel URL is
+        # registered before the notebook process binds its port, so the
+        # first browser connection routinely beats the backend coming up.
+        self.connect_deadline_s = connect_deadline_s
         self._server: socket.socket | None = None
         self._stopped = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -41,22 +52,42 @@ class ProxyServer:
                 client, _ = self._server.accept()
             except OSError:
                 return  # listener closed
+            # Connect (with retries) off the accept loop: browsers open
+            # several parallel connections, and one slow backend must not
+            # head-of-line block the rest.
+            threading.Thread(
+                target=self._open_tunnel, args=(client,), daemon=True
+            ).start()
+
+    def _open_tunnel(self, client: socket.socket) -> None:
+        remote = self._connect_upstream()
+        if remote is None:
+            client.close()
+            return
+        # Pump threads are daemons that exit with their sockets; they
+        # are not tracked (a 24h notebook tunnel would otherwise
+        # accumulate two dead Thread objects per browser connection).
+        for src, dst in ((client, remote), (remote, client)):
+            threading.Thread(
+                target=self._pump, args=(src, dst), daemon=True
+            ).start()
+
+    def _connect_upstream(self) -> socket.socket | None:
+        deadline = time.monotonic() + self.connect_deadline_s
+        while not self._stopped.is_set():
             try:
-                remote = socket.create_connection(
-                    (self.remote_host, self.remote_port), timeout=10
+                sock = socket.create_connection(
+                    (self.remote_host, self.remote_port), timeout=5
                 )
+                sock.settimeout(None)  # pump loops block on idle tunnels
+                return sock
             except OSError as exc:
-                log.warning("proxy connect to %s:%d failed: %s",
-                            self.remote_host, self.remote_port, exc)
-                client.close()
-                continue
-            # Pump threads are daemons that exit with their sockets; they
-            # are not tracked (a 24h notebook tunnel would otherwise
-            # accumulate two dead Thread objects per browser connection).
-            for src, dst in ((client, remote), (remote, client)):
-                threading.Thread(
-                    target=self._pump, args=(src, dst), daemon=True
-                ).start()
+                if time.monotonic() >= deadline:
+                    log.warning("proxy connect to %s:%d failed: %s",
+                                self.remote_host, self.remote_port, exc)
+                    return None
+                time.sleep(0.25)
+        return None
 
     @staticmethod
     def _pump(src: socket.socket, dst: socket.socket) -> None:
