@@ -1,0 +1,13 @@
+"""Asserts the executor imports tony_tpu from the cluster submitter's staged
+per-submission lib dir (``lib-<uuid>/tony_tpu``), not an ambient install —
+the analogue of the reference resolving the submitted fat jar from
+``.tony/<uuid>`` (ClusterSubmitter.java:59-63)."""
+import sys
+
+import tony_tpu
+
+if "lib-" not in tony_tpu.__file__:
+    print(f"tony_tpu resolved from {tony_tpu.__file__}, not a staged lib dir",
+          file=sys.stderr)
+    sys.exit(9)
+sys.exit(0)

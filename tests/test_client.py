@@ -70,7 +70,9 @@ class TestClientE2E:
         assert rc == 0
 
     def test_cluster_submit_stages_and_cleans_framework(self, tmp_path):
-        rc = cluster_submit(_base_argv(tmp_path, "exit_0.py"))
+        # The fixture exits nonzero unless tony_tpu resolved from a staged
+        # lib-<uuid> dir, so rc==0 proves staging actually happened.
+        rc = cluster_submit(_base_argv(tmp_path, "check_staged_framework.py"))
         assert rc == 0
         # Per-submission lib-<uuid> dir is owned and removed by this
         # submission only (ClusterSubmitter.java:74-80 cleanup analogue).

@@ -46,6 +46,7 @@ TASK_NUM = "TASK_NUM"
 SESSION_ID = "SESSION_ID"
 TB_PORT = "TB_PORT"
 PROFILER_PORT = "PROFILER_PORT"
+TONY_LOG_DIR = "TONY_LOG_DIR"
 
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"

@@ -287,7 +287,7 @@ class TonyCoordinator:
         state = self.session.status.value
         if state == "NEW":
             state = "RUNNING"
-        if state in ("SUCCEEDED", "FAILED", "KILLED") and not self._final_published.is_set():
+        if self.session.training_finished() and not self._final_published.is_set():
             state = "RUNNING"
         return {
             "state": state,

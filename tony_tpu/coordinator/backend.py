@@ -24,6 +24,8 @@ import subprocess
 import sys
 from dataclasses import dataclass
 from pathlib import Path
+
+from tony_tpu import constants
 from typing import Mapping, Protocol
 
 from tony_tpu.coordinator.session import TonyTask
@@ -90,6 +92,9 @@ class LocalProcessBackend:
             full_env["PYTHONPATH"] = (
                 pkg_root + (os.pathsep + existing if existing else "")
             )
+        # Writable per-job scratch for user scripts (checkpoints, metrics)
+        # — the analogue of the YARN container log/work dir env.
+        full_env[constants.TONY_LOG_DIR] = str(self.log_dir)
         logfile = self.log_dir / f"{task.job_name}-{task.index}.log"
         out = open(logfile, "ab")
         proc = subprocess.Popen(
