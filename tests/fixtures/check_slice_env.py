@@ -1,0 +1,19 @@
+"""Asserts the coordinator threaded its SlicePlan into the task env as
+TONY_SLICE_TOPOLOGY, readable via tony_tpu.runtime.slice_topology()."""
+import sys
+
+import tony_tpu.runtime as rt
+
+plan = rt.slice_topology()
+if plan is None:
+    print("TONY_SLICE_TOPOLOGY missing", file=sys.stderr)
+    sys.exit(2)
+for field in ("accelerator_type", "num_slices", "hosts_per_slice",
+              "chips_per_slice"):
+    if field not in plan:
+        print(f"slice plan missing {field}: {plan}", file=sys.stderr)
+        sys.exit(3)
+if plan["accelerator_type"] != "v5litepod-4":
+    print(f"unexpected accelerator: {plan}", file=sys.stderr)
+    sys.exit(4)
+sys.exit(0)

@@ -1,0 +1,288 @@
+"""TPU resource model: slice topology planning (plan_slices), conf-driven
+planning (plan_slices_from_conf), and the TpuVmBackend's async
+provision-then-execute lifecycle against a fake TpuApi — the analogue of the
+reference turning tony.<job>.gpus into YARN GPU capabilities and launching
+containers through async RM callbacks (Utils.setCapabilityGPU:146-152,
+TonyApplicationMaster.java:876-885, :980-989)."""
+
+import json
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.backend import (
+    SlicePlan,
+    TpuVmBackend,
+    plan_slices,
+    plan_slices_from_conf,
+)
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.mini import MiniTonyCluster
+
+
+# ---------------------------------------------------------------------------
+# plan_slices
+# ---------------------------------------------------------------------------
+def test_single_host_exact_fit():
+    plan = plan_slices(1, 8, "v5e")
+    assert plan == SlicePlan("v5litepod-8", 1, 1, 8)
+
+
+def test_multi_host_single_slice():
+    # 2 hosts x 8 chips -> v5litepod-16 (2 hosts per slice)
+    plan = plan_slices(2, 8, "v5e")
+    assert plan == SlicePlan("v5litepod-16", 1, 2, 16)
+
+
+def test_every_plan_has_one_host_per_instance():
+    # The invariant the scheduler depends on: one executor per host. 3 hosts
+    # x 4 chips has no 3-host slice, so it becomes 3 DCN-connected
+    # single-host slices — never a slice with host indexes the coordinator
+    # would not launch.
+    plan = plan_slices(3, 4, "v5e")
+    assert plan == SlicePlan("v5litepod-4", 3, 1, 4)
+    assert plan.total_hosts == 3
+
+
+def test_strict_rejects_chip_overshoot():
+    # no 3-chip shape exists; strict refuses to round 3 up to 4
+    with pytest.raises(ValueError, match="strict"):
+        plan_slices(1, 3, "v5e", strict=True)
+    assert plan_slices(1, 3, "v5e").accelerator_type == "v5litepod-4"
+
+
+def test_strict_accepts_exact_tiling():
+    plan = plan_slices(2, 8, "v5e", strict=True)
+    assert plan == SlicePlan("v5litepod-16", 1, 2, 16)
+
+
+def test_strict_accepts_exact_multislice_tiling():
+    # 64 hosts x 8 chips = 512 chips = 2 x v5litepod-256 exactly
+    plan = plan_slices(64, 8, "v5e", strict=True)
+    assert plan == SlicePlan("v5litepod-256", 2, 32, 256)
+
+
+def test_multislice_fallback_beyond_largest_shape():
+    # 64 hosts x 8 chips = 512 chips > v5litepod-256 -> 2 DCN-connected slices
+    plan = plan_slices(64, 8, "v5e")
+    assert plan.num_slices == 2 and plan.chips_per_slice == 256
+
+
+def test_accelerator_type_pinning():
+    # pin v5litepod-8 (1 host/slice): 4 hosts x 8 chips -> 4 slices
+    plan = plan_slices(4, 8, "v5e", accelerator_type="v5litepod-8")
+    assert plan == SlicePlan("v5litepod-8", 4, 1, 8)
+
+
+def test_accelerator_type_strict_mismatch():
+    with pytest.raises(ValueError, match="strict"):
+        plan_slices(1, 4, "v5e", strict=True, accelerator_type="v5litepod-8")
+
+
+def test_unknown_generation_and_accelerator():
+    with pytest.raises(ValueError, match="generation"):
+        plan_slices(1, 8, "v9z")
+    with pytest.raises(ValueError, match="accelerator"):
+        plan_slices(1, 8, "v5e", accelerator_type="v5litepod-7")
+
+
+def test_v4_shapes():
+    assert plan_slices(1, 8, "v4").accelerator_type == "v4-8"
+
+
+# ---------------------------------------------------------------------------
+# plan_slices_from_conf
+# ---------------------------------------------------------------------------
+def _conf(**kv):
+    conf = TonyConfiguration()
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def test_conf_planning_per_job_type():
+    conf = _conf(**{
+        keys.instances_key("worker"): 2,
+        keys.tpus_key("worker"): 8,
+        keys.instances_key("ps"): 1,  # no tpus -> no plan
+    })
+    plans = plan_slices_from_conf(conf)
+    assert set(plans) == {"worker"}
+    assert plans["worker"].chips_per_slice == 16
+
+
+def test_conf_topology_key_selects_shape():
+    conf = _conf(**{
+        keys.instances_key("worker"): 4,
+        keys.tpus_key("worker"): 8,
+        keys.K_TPU_TOPOLOGY: "v5e-8",
+        keys.instances_key("ps"): 0,
+    })
+    plans = plan_slices_from_conf(conf)
+    assert plans["worker"] == SlicePlan("v5litepod-8", 4, 1, 8)
+
+
+def test_conf_accelerator_type_alone_selects_generation():
+    # tony.tpu.accelerator-type=v4-32 must find the v4 family without a
+    # redundant tony.tpu.topology key.
+    conf = _conf(**{
+        keys.instances_key("worker"): 4,
+        keys.tpus_key("worker"): 8,
+        keys.K_TPU_ACCELERATOR_TYPE: "v4-32",
+        keys.instances_key("ps"): 0,
+    })
+    plans = plan_slices_from_conf(conf)
+    assert plans["worker"] == SlicePlan("v4-32", 1, 4, 32)
+
+
+def test_conf_bad_topology_raises():
+    conf = _conf(**{
+        keys.instances_key("worker"): 1,
+        keys.tpus_key("worker"): 8,
+        keys.K_TPU_TOPOLOGY: "v5e-7",
+    })
+    with pytest.raises(ValueError, match="legal"):
+        plan_slices_from_conf(conf)
+
+
+# ---------------------------------------------------------------------------
+# TpuVmBackend against a fake TpuApi
+# ---------------------------------------------------------------------------
+class FakeTpuApi:
+    """Slices become READY after `ready_after` polls; executors exit with
+    `exit_code` after `run_polls` status checks."""
+
+    def __init__(self, ready_after=2, run_polls=1, exit_code=0,
+                 fail_slice=False):
+        self.ready_after = ready_after
+        self.run_polls = run_polls
+        self.exit_code = exit_code
+        self.fail_slice = fail_slice
+        self.created: dict[str, tuple[str, int]] = {}
+        self.deleted: list[str] = []
+        self.started: list[tuple[str, int]] = []
+        self.envs: list[dict] = []
+        self.killed: list[object] = []
+        self._state_polls: dict[str, int] = {}
+
+    def create_slice(self, name, accelerator_type, num_slices):
+        self.created[name] = (accelerator_type, num_slices)
+
+    def slice_state(self, name):
+        if self.fail_slice:
+            return "FAILED"
+        n = self._state_polls.get(name, 0) + 1
+        self._state_polls[name] = n
+        return "READY" if n >= self.ready_after else "CREATING"
+
+    def start_executor(self, name, host_index, env):
+        self.started.append((name, host_index))
+        self.envs.append(dict(env))
+        return {"polls": 0, "env": env}
+
+    def executor_status(self, handle):
+        handle["polls"] += 1
+        return self.exit_code if handle["polls"] >= self.run_polls else None
+
+    def kill_executor(self, handle):
+        self.killed.append(handle)
+
+    def delete_slice(self, name):
+        self.deleted.append(name)
+
+
+def _tpu_session(tmp_path, api, **conf_kv):
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.instances_key("worker"), 2)
+    conf.set(keys.tpus_key("worker"), 8)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_EXECUTES, "unused_on_tpu_backend.py")
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+
+    from tony_tpu.coordinator.app_master import TonyCoordinator
+
+    app_dir = tmp_path / "app"
+    coordinator = TonyCoordinator(
+        conf, app_dir, app_id="application_tpu_1",
+        backend=TpuVmBackend(api, "application_tpu_1"),
+    )
+    status = coordinator.run()
+    return status, coordinator, app_dir
+
+
+def test_tpu_backend_full_session(tmp_path):
+    api = FakeTpuApi()
+    status, coordinator, app_dir = _tpu_session(tmp_path, api)
+    assert status is SessionStatus.SUCCEEDED
+    # one slice group created for the worker job, then deleted on teardown
+    assert api.created == {"application_tpu_1-worker": ("v5litepod-16", 1)}
+    assert api.deleted == ["application_tpu_1-worker"]
+    # both hosts got an executor only after the slice went READY
+    assert sorted(api.started) == [
+        ("application_tpu_1-worker", 0), ("application_tpu_1-worker", 1)
+    ]
+    assert coordinator.slice_plans["worker"].chips_per_slice == 16
+    # final-status.json records the planned slice
+    final = json.loads((app_dir / "final-status.json").read_text())
+    assert final["slices"]["worker"]["accelerator_type"] == "v5litepod-16"
+
+
+def test_tpu_backend_slice_failure_fails_session(tmp_path):
+    api = FakeTpuApi(fail_slice=True)
+    status, coordinator, _ = _tpu_session(tmp_path, api)
+    assert status is SessionStatus.FAILED
+
+
+def test_tpu_backend_env_carries_topology(tmp_path):
+    api = FakeTpuApi()
+    _tpu_session(tmp_path, api)
+    assert api.envs, "no executor env captured"
+    for env in api.envs:
+        plan = json.loads(env[constants.TONY_SLICE_TOPOLOGY])
+        assert plan["accelerator_type"] == "v5litepod-16"
+
+
+def test_mixed_tpu_cpu_job_fails_gracefully(tmp_path):
+    """A job type without a tpus ask on the TPU-only backend must fail the
+    session through stop() (terminal status + history), not crash the
+    coordinator."""
+    api = FakeTpuApi()
+    status, coordinator, app_dir = _tpu_session(
+        tmp_path, api, **{keys.instances_key("ps"): 1}
+    )
+    assert status is SessionStatus.FAILED
+    assert "scheduling failed" in coordinator.session.diagnostics
+    assert (app_dir / "final-status.json").is_file()
+
+
+def test_planning_failure_is_not_retried(tmp_path):
+    """A conf-derived planning error is deterministic: with retries
+    configured, the coordinator must fail once, not re-plan K times."""
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.tpus_key("worker"), 3)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TPU_SLICE_STRICT, True)
+    conf.set(keys.K_AM_RETRY_COUNT, 3)
+    conf.set(keys.K_EXECUTES, "unused.py")
+    status, coordinator = cluster.run_job(conf, timeout_s=30)
+    assert status is SessionStatus.FAILED
+    assert coordinator.session.session_id == 1  # one session, no retries
+
+
+def test_strict_illegal_topology_fails_session(tmp_path):
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.instances_key("worker"), 3)
+    conf.set(keys.tpus_key("worker"), 3)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TPU_SLICE_STRICT, True)
+    conf.set(keys.K_EXECUTES, "unused.py")
+    status, coordinator = cluster.run_job(conf, timeout_s=30)
+    assert status is SessionStatus.FAILED
+    assert "slice planning failed" in coordinator.session.diagnostics

@@ -72,6 +72,16 @@ def test_gang_barrier_with_ps(cluster):
     assert spec is not None and len(spec["worker"]) == 2 and len(spec["ps"]) == 1
 
 
+def test_slice_topology_reaches_user_script(cluster):
+    """tony.worker.tpus=4 -> coordinator plans a v5litepod-4 slice and the
+    user script reads it via tony_tpu.runtime.slice_topology()."""
+    conf = _job(cluster, "check_slice_env.py")
+    conf.set(keys.tpus_key("worker"), 4)
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED, coord.session.diagnostics
+    assert coord.slice_plans["worker"].accelerator_type == "v5litepod-4"
+
+
 def test_cross_process_psum(cluster):
     """A REAL jax.distributed collective through the full stack: 2 executor
     subprocesses each call tony_tpu.runtime.initialize() and run a pmap psum

@@ -18,13 +18,19 @@ import logging
 import os
 import threading
 import time
+from dataclasses import asdict
 from pathlib import Path
 from typing import Any
 
 from tony_tpu import constants, utils
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
-from tony_tpu.coordinator.backend import ContainerBackend, LocalProcessBackend
+from tony_tpu.coordinator.backend import (
+    ContainerBackend,
+    LocalProcessBackend,
+    SlicePlan,
+    plan_slices_from_conf,
+)
 from tony_tpu.coordinator.liveness import LivenessMonitor
 from tony_tpu.coordinator.session import SessionStatus, TonySession, TonyTask
 from tony_tpu.history import JobMetadata, setup_job_dir
@@ -90,10 +96,12 @@ class TonyCoordinator:
         self.app_id = app_id or f"application_{int(time.time() * 1000)}_{os.getpid()}"
         self.backend = backend or LocalProcessBackend(self.app_dir / "logs")
         self.session: TonySession | None = None
+        self.slice_plans: dict[str, SlicePlan] = {}
         self.tensorboard_url: str | None = None
         self.client_signal_to_finish = threading.Event()
         self._wake = threading.Event()  # interrupts the monitor poll
         self._killed = threading.Event()
+        self._fatal = False  # conf-shaped failure: never retried
         self.started_ms = int(time.time() * 1000)
         self._session_seq = 0
         self._hb_missed: set[str] = set()
@@ -140,7 +148,7 @@ class TonyCoordinator:
                 status = self._run_one_session()
                 if status is SessionStatus.SUCCEEDED or self._killed.is_set():
                     break
-                if retries_left <= 0:
+                if retries_left <= 0 or self._fatal:
                     break
                 retries_left -= 1
                 log.warning("session failed; retrying (%d retries left)", retries_left)
@@ -159,7 +167,31 @@ class TonyCoordinator:
         self._session_seq += 1
         self.session = TonySession(self.conf, session_id=self._session_seq)
         self.session.status = SessionStatus.RUNNING
-        self._schedule_tasks()
+        # TPU resource model: turn tony.<job>.tpus + tony.tpu.* into slice
+        # plans before anything launches (the analogue of translating
+        # tony.<job>.gpus into container capabilities at schedule time,
+        # TonyApplicationMaster.java:876-885). An illegal topology fails the
+        # session, with strict mode rejecting any shape adaptation.
+        try:
+            self.slice_plans = plan_slices_from_conf(self.conf)
+        except ValueError as exc:
+            # Conf-derived and deterministic: retrying cannot help.
+            self._fatal = True
+            self.session.fail(f"TPU slice planning failed: {exc}")
+            return self.session.status
+        if self.slice_plans:
+            log.info("slice plans: %s", self.slice_plans)
+            if hasattr(self.backend, "prepare_slices"):
+                self.backend.prepare_slices(self.slice_plans)
+        try:
+            self._schedule_tasks()
+        except ValueError as exc:
+            # e.g. a job type with no slice plan on a TPU-only backend —
+            # also conf-shaped; fail the session so stop() still publishes
+            # a terminal status + history.
+            self._fatal = True
+            self.session.fail(f"task scheduling failed: {exc}")
+            return self.session.status
         return self._monitor()
 
     def _schedule_tasks(self) -> None:
@@ -175,7 +207,7 @@ class TonyCoordinator:
     def _task_env(self, task: TonyTask) -> dict[str, str]:
         assert self.session is not None
         n = len(self.session.tasks[task.job_name])
-        return {
+        env = {
             constants.JOB_NAME: task.job_name,
             constants.TASK_INDEX: str(task.index),
             constants.TASK_NUM: str(n),
@@ -183,6 +215,13 @@ class TonyCoordinator:
             constants.TONY_AM_ADDRESS: f"127.0.0.1:{self.rpc_server.port}",
             constants.TONY_CONF_PATH: str(self.app_dir / constants.TONY_FINAL_CONF),
         }
+        plan = self.slice_plans.get(task.job_name)
+        if plan is not None:
+            # The slice topology env the runtime reads to build its Mesh
+            # (constants.TONY_SLICE_TOPOLOGY; the TPU analogue of the
+            # reference exporting GPU capabilities into the container).
+            env[constants.TONY_SLICE_TOPOLOGY] = json.dumps(asdict(plan))
+        return env
 
     # -- rendezvous + fault injection hooks --------------------------------
     def on_register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
@@ -271,6 +310,8 @@ class TonyCoordinator:
             )
         final = self.application_status()
         final["state"] = status.value  # unmasked: this IS the terminal record
+        if self.slice_plans:
+            final["slices"] = {j: asdict(p) for j, p in self.slice_plans.items()}
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0

@@ -6,8 +6,10 @@ interface with two implementations:
 * ``LocalProcessBackend`` — subprocesses on this host (the tony-mini
   analogue, and the substrate for every e2e test).
 * ``TpuVmBackend`` — maps the job's ``instances × tpus`` ask onto a legal
-  TPU slice topology and would drive the Cloud TPU API; topology planning
-  is real and unit-tested, the cloud calls are gated (no egress here).
+  TPU slice topology (``plan_slices``) and drives slice provisioning +
+  remote executor lifecycle through an injectable ``TpuApi`` client (the
+  concrete cloud REST client is injected by the deployment; tests inject a
+  fake — this environment has no egress).
 
 A TPU slice is inherently gang-scheduled — ICI makes the slice atomic — so
 the reference's per-container allocation machinery (allocation ids, one
@@ -22,6 +24,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -172,59 +175,255 @@ class SlicePlan:
 
 def plan_slices(
     num_instances: int, tpus_per_instance: int, generation: str = "v5e",
-    strict: bool = False,
+    strict: bool = False, accelerator_type: str = "",
 ) -> SlicePlan:
     """Map ``instances × tpus`` onto legal slice shapes.
 
-    Each instance is one *host process*; ``tpus_per_instance`` is the chips
-    it should see. We first try a single slice whose host count equals the
-    instance count; multi-slice (DCN-connected) is the fallback for asks
-    that exceed the largest shape."""
+    Each instance is one *host process*, so every returned plan satisfies
+    ``total_hosts == num_instances`` — the scheduler launches exactly one
+    executor per host and a plan with a different host count could not be
+    driven. Within that invariant we prefer the fewest slices (largest
+    shape), then the least chip overshoot; multi-slice plans are
+    DCN-connected.
+
+    ``accelerator_type`` (from ``tony.tpu.accelerator-type`` or a
+    ``tony.tpu.topology`` like ``v5e-8``) pins the slice shape. With
+    ``strict`` (``tony.tpu.strict-slice-shapes``) chip overshoot is rejected
+    instead of absorbed (SURVEY §7 hard part c: TPU slices are quantized,
+    YARN containers are not); exact multi-slice tilings are always legal."""
     shapes = SLICE_SHAPES.get(generation)
     if shapes is None:
         raise ValueError(f"unknown TPU generation {generation!r}")
     total_chips = num_instances * tpus_per_instance
-    for chips, (accel, hosts) in sorted(shapes.items()):
-        if chips >= total_chips and hosts == num_instances:
-            return SlicePlan(accel, 1, hosts, chips)
-    # exact-chip single slice even if host count differs (non-strict)
-    if not strict:
-        for chips, (accel, hosts) in sorted(shapes.items()):
-            if chips >= total_chips:
-                return SlicePlan(accel, 1, hosts, chips)
-    largest_chips, (accel, hosts) = max(shapes.items())
-    if total_chips % largest_chips == 0:
-        return SlicePlan(accel, total_chips // largest_chips, hosts, largest_chips)
-    raise ValueError(
-        f"cannot map {num_instances} instances x {tpus_per_instance} TPUs "
-        f"onto legal {generation} slice shapes {sorted(shapes)}"
-    )
+
+    if accelerator_type:
+        match = [
+            (chips, hosts)
+            for chips, (accel, hosts) in shapes.items()
+            if accel == accelerator_type
+        ]
+        if not match:
+            raise ValueError(
+                f"unknown accelerator type {accelerator_type!r} for "
+                f"{generation}; legal: "
+                f"{sorted(a for a, _ in shapes.values())}"
+            )
+        candidates = match
+    else:
+        candidates = [(c, h) for c, (_, h) in shapes.items()]
+
+    # Host tiling is mandatory; among legal tilings prefer fewest slices,
+    # then least chip overshoot.
+    best: tuple[int, int, int, int] | None = None  # (n_slices, over, chips, hosts)
+    for chips, hosts in candidates:
+        if num_instances % hosts:
+            continue
+        n_slices = num_instances // hosts
+        overshoot = n_slices * chips - total_chips
+        if overshoot < 0:
+            continue
+        if strict and overshoot != 0:
+            continue
+        key = (n_slices, overshoot, chips, hosts)
+        if best is None or key < best:
+            best = key
+    if best is None:
+        raise ValueError(
+            f"cannot map {num_instances} instances x {tpus_per_instance} "
+            f"TPUs onto legal {generation} slice shapes "
+            f"{sorted(c for c, _ in candidates)}"
+            + (" (strict)" if strict else "")
+            + (f" pinned to {accelerator_type}" if accelerator_type else "")
+        )
+    n_slices, _, chips, hosts = best
+    accel = accelerator_type or shapes[chips][0]
+    return SlicePlan(accel, n_slices, hosts, chips)
+
+
+def plan_slices_from_conf(conf) -> dict[str, SlicePlan]:
+    """Read the TPU resource keys and plan one slice group per job type that
+    asks for chips (``tony.<job>.tpus`` > 0) — the analogue of the reference
+    turning ``tony.<job>.gpus`` into YARN GPU capabilities
+    (Utils.setCapabilityGPU:146-152, TonyApplicationMaster.java:876-885)."""
+    from tony_tpu.conf import keys
+    from tony_tpu.utils import parse_container_requests
+
+    topology = conf.get_str(keys.K_TPU_TOPOLOGY, "")
+    accelerator_type = conf.get_str(keys.K_TPU_ACCELERATOR_TYPE, "")
+    strict = conf.get_bool(keys.K_TPU_SLICE_STRICT, False)
+    generation = "v5e"
+    if accelerator_type and not topology:
+        # An accelerator type alone pins the generation too — find which
+        # family it belongs to.
+        for gen, shapes in SLICE_SHAPES.items():
+            if any(a == accelerator_type for a, _ in shapes.values()):
+                generation = gen
+                break
+        else:
+            raise ValueError(
+                f"unknown accelerator type {accelerator_type!r}; legal: "
+                f"{sorted(a for s in SLICE_SHAPES.values() for a, _ in s.values())}"
+            )
+    if topology:
+        generation, _, chip_str = topology.partition("-")
+        if not accelerator_type:
+            shapes = SLICE_SHAPES.get(generation)
+            if shapes is None:
+                raise ValueError(f"unknown TPU generation in topology {topology!r}")
+            try:
+                accelerator_type = shapes[int(chip_str)][0]
+            except (KeyError, ValueError):
+                raise ValueError(
+                    f"topology {topology!r} is not a legal {generation} "
+                    f"shape; legal chip counts: {sorted(shapes)}"
+                ) from None
+    plans: dict[str, SlicePlan] = {}
+    for job, req in parse_container_requests(conf).items():
+        if req.tpus > 0:
+            plans[job] = plan_slices(
+                req.num_instances, req.tpus, generation,
+                strict=strict, accelerator_type=accelerator_type,
+            )
+    return plans
+
+
+class TpuApi(Protocol):
+    """The injectable seam to the Cloud TPU control plane. The production
+    implementation wraps the queued-resource / TPU-VM REST API; tests inject
+    a fake (this environment has no egress, so no concrete cloud client
+    ships in-tree). One method per lifecycle edge the backend needs."""
+
+    def create_slice(
+        self, name: str, accelerator_type: str, num_slices: int
+    ) -> None:
+        """Request creation of ``num_slices`` slices under one name."""
+
+    def slice_state(self, name: str) -> str:
+        """"CREATING" | "READY" | "FAILED"."""
+
+    def start_executor(
+        self, name: str, host_index: int, env: Mapping[str, str]
+    ) -> object:
+        """Start the tony_tpu executor on host ``host_index`` of the slice
+        group; returns an opaque command handle."""
+
+    def executor_status(self, handle: object) -> int | None:
+        """Exit code if the remote executor finished, else None."""
+
+    def kill_executor(self, handle: object) -> None:
+        ...
+
+    def delete_slice(self, name: str) -> None:
+        ...
+
+
+@dataclass
+class _TpuHandle:
+    task_id: str
+    slice_name: str
+    host_index: int
+    env: dict[str, str]
+    remote: object | None = None  # None until the slice is READY
+    exit_code: int | None = None
 
 
 class TpuVmBackend:
-    """Cloud TPU-VM backend: plans slices, then drives the Cloud TPU API to
-    create them and run the executor on every host. The API layer is a
-    deliberate stub — this environment has no egress — but the planning
-    logic above is the part the scheduler depends on."""
+    """Cloud TPU-VM backend: provisions one slice group per job type from
+    the coordinator's ``SlicePlan`` and runs the executor on every host.
 
-    def __init__(self, generation: str = "v5e", strict: bool = False) -> None:
-        self.generation = generation
-        self.strict = strict
+    Provisioning is asynchronous and driven by the coordinator's monitor
+    loop: ``launch`` returns immediately with a pending handle, and each
+    ``poll`` advances it — slice CREATING → READY starts the remote
+    executor; slice FAILED surfaces as task exit 1 (which fails the session
+    and triggers the whole-session retry, the slice-wide restart SURVEY §7
+    hard part (b) calls for). This mirrors the reference's async
+    RMCallbackHandler.onContainersAllocated → ContainerLauncher flow
+    (TonyApplicationMaster.java:980-989) without the callback machinery."""
 
-    def plan(self, num_instances: int, tpus_per_instance: int) -> SlicePlan:
-        return plan_slices(num_instances, tpus_per_instance, self.generation, self.strict)
+    # Non-terminal slice states are re-polled at most this often, however
+    # many pending host handles share the slice — a 32-host slice must not
+    # multiply control-plane requests by 32 every monitor tick.
+    STATE_CACHE_TTL_S = 1.0
 
-    def launch(self, task: TonyTask, env: Mapping[str, str]) -> object:
-        raise NotImplementedError(
-            "Cloud TPU provisioning requires network access; use "
-            "LocalProcessBackend for local runs and tests."
-        )
+    def __init__(self, api: TpuApi, app_id: str) -> None:
+        self.api = api
+        self.app_id = app_id
+        self._plans: dict[str, SlicePlan] = {}
+        self._created: set[str] = set()
+        self._handles: list[_TpuHandle] = []
+        self._state_cache: dict[str, tuple[float, str]] = {}
 
-    def poll(self, handle: object) -> int | None:
-        raise NotImplementedError
+    def _slice_state(self, name: str) -> str:
+        now = time.monotonic()
+        hit = self._state_cache.get(name)
+        if hit is not None and (
+            hit[1] in ("READY", "FAILED") or now - hit[0] < self.STATE_CACHE_TTL_S
+        ):
+            return hit[1]
+        state = self.api.slice_state(name)
+        self._state_cache[name] = (now, state)
+        return state
 
-    def kill(self, handle: object) -> None:
-        raise NotImplementedError
+    def prepare_slices(self, plans: Mapping[str, SlicePlan]) -> None:
+        """Receive the coordinator's per-job-type slice plans (called before
+        any launch)."""
+        self._plans = dict(plans)
+
+    def _slice_name(self, job_name: str) -> str:
+        return f"{self.app_id}-{job_name}"
+
+    def launch(self, task: TonyTask, env: Mapping[str, str]) -> _TpuHandle:
+        plan = self._plans.get(task.job_name)
+        if plan is None:
+            raise ValueError(
+                f"no slice plan for job type {task.job_name!r} — it has no "
+                f"tony.{task.job_name}.tpus ask; TpuVmBackend schedules TPU "
+                f"jobs only"
+            )
+        name = self._slice_name(task.job_name)
+        if name not in self._created:
+            log.info(
+                "creating %d x %s (%d hosts each) as %s",
+                plan.num_slices, plan.accelerator_type, plan.hosts_per_slice,
+                name,
+            )
+            self.api.create_slice(name, plan.accelerator_type, plan.num_slices)
+            self._created.add(name)
+        handle = _TpuHandle(task.id, name, task.index, dict(env))
+        self._handles.append(handle)
+        return handle
+
+    def poll(self, handle: _TpuHandle) -> int | None:
+        if handle.exit_code is not None:
+            return handle.exit_code
+        if handle.remote is None:
+            state = self._slice_state(handle.slice_name)
+            if state == "FAILED":
+                log.error("slice %s failed to provision", handle.slice_name)
+                handle.exit_code = 1
+                return 1
+            if state != "READY":
+                return None
+            handle.remote = self.api.start_executor(
+                handle.slice_name, handle.host_index, handle.env
+            )
+            log.info("slice %s ready; started executor for %s",
+                     handle.slice_name, handle.task_id)
+            return None
+        handle.exit_code = self.api.executor_status(handle.remote)
+        return handle.exit_code
+
+    def kill(self, handle: _TpuHandle) -> None:
+        if handle.remote is not None and handle.exit_code is None:
+            self.api.kill_executor(handle.remote)
 
     def stop_all(self) -> None:
-        pass
+        for h in self._handles:
+            self.kill(h)
+        self._handles.clear()
+        for name in self._created:
+            try:
+                self.api.delete_slice(name)
+            except Exception:
+                log.warning("could not delete slice %s", name, exc_info=True)
+        self._created.clear()

@@ -74,3 +74,11 @@ def initialize(**kwargs) -> TaskContext:
 def tensorboard_port() -> int | None:
     raw = os.environ.get(constants.TB_PORT)
     return int(raw) if raw else None
+
+
+def slice_topology() -> dict | None:
+    """The coordinator's planned slice for this job type (accelerator_type,
+    num_slices, hosts_per_slice, chips_per_slice), or None off-TPU. Use it
+    to size a ``jax.sharding.Mesh`` without hardcoding the device count."""
+    raw = os.environ.get(constants.TONY_SLICE_TOPOLOGY)
+    return json.loads(raw) if raw else None
