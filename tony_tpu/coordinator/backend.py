@@ -427,3 +427,6 @@ class TpuVmBackend:
             except Exception:
                 log.warning("could not delete slice %s", name, exc_info=True)
         self._created.clear()
+        # A retried session re-creates slices under the same names; stale
+        # terminal states must not short-circuit its polls.
+        self._state_cache.clear()

@@ -83,38 +83,34 @@ def _flash_fwd_kernel(
     # self-attention; the tile copy still streams, hidden by the pipeline).
     live = k_start <= q_off + (qi + 1) * block_q - 1 if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _scores():
         q = q_ref[0].astype(jnp.float32) * scale
         k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        return jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        k_pos = k_start + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        if t_k % block_k:
-            # Final block is padding past t_k; mask the tail keys.
-            s = jnp.where(k_pos < t_k, s, NEG_INF)
-        if causal:
-            q_pos = (
-                q_off + qi * block_q
-                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    def _accumulate(s, *, may_be_masked: bool):
+        """Online-softmax update. The unmasked variant drops every
+        NEG_INF guard: with only real scores m_new is always finite, and
+        alpha = exp(m - m_new) underflows cleanly to 0 on the first live
+        block (m = NEG_INF)."""
+        v_blk = v_ref[0].astype(jnp.float32)
         # Lanes of m/l hold identical values; a lane-max reads them back.
         m = jnp.max(m_ref[...], axis=1)
         l = jnp.max(l_ref[...], axis=1)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        # Fully-masked rows keep m_new at NEG_INF; shift to 0 so exp is safe.
-        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
-        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        if may_be_masked:
+            # Fully-masked rows keep m_new at NEG_INF; shift to 0 for exp.
+            m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+            p = jnp.exp(s - m_safe[:, None])
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
+            alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        else:
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
@@ -122,6 +118,45 @@ def _flash_fwd_kernel(
         )
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    # Mask work only happens where the block straddles the causal diagonal
+    # or holds padded tail keys; interior blocks take a branch with no iota
+    # and no where — pure matmul + online softmax.
+    tail_pad = bool(t_k % block_k)
+    if causal or tail_pad:
+        needs_mask = False
+        if tail_pad:
+            needs_mask = needs_mask | (ki == num_k - 1)
+        if causal:
+            needs_mask = needs_mask | (
+                k_start + block_k - 1 > q_off + qi * block_q
+            )
+
+        @pl.when(live & jnp.logical_not(needs_mask))
+        def _compute_fast():
+            _accumulate(_scores(), may_be_masked=False)
+
+        @pl.when(live & needs_mask)
+        def _compute_masked():
+            s = _scores()
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            if tail_pad:
+                # Final block is padding past t_k; mask the tail keys.
+                s = jnp.where(k_pos < t_k, s, NEG_INF)
+            if causal:
+                q_pos = (
+                    q_off + qi * block_q
+                    + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            _accumulate(s, may_be_masked=True)
+    else:
+
+        @pl.when(live)
+        def _compute():
+            _accumulate(_scores(), may_be_masked=False)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -266,8 +301,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     force_jax: bool = False,
 ) -> jax.Array:
     """Memory-efficient exact attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
