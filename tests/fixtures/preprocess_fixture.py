@@ -1,0 +1,20 @@
+"""Dual-role fixture for the preprocess AM mode (doPreprocessingJob,
+TonyApplicationMaster.java:640-703): run as the preprocess job it emits a
+``Model parameters:`` line; run as a task it asserts that line arrived in
+the MODEL_PARAMS env. ``PREPROCESS_SHOULD_FAIL`` makes the preprocess run
+exit nonzero (to test that scheduling is gated on preprocess success)."""
+import os
+import sys
+
+if os.environ.get("PREPROCESSING_JOB") == "true":
+    if os.environ.get("PREPROCESS_SHOULD_FAIL"):
+        print("preprocess failing on purpose", file=sys.stderr)
+        sys.exit(3)
+    print("Model parameters: --lr 0.1 --layers 4")
+    sys.exit(0)
+
+if os.environ.get("MODEL_PARAMS") != "--lr 0.1 --layers 4":
+    print(f"MODEL_PARAMS wrong: {os.environ.get('MODEL_PARAMS')!r}",
+          file=sys.stderr)
+    sys.exit(4)
+sys.exit(0)

@@ -78,6 +78,18 @@ class TestClientE2E:
         # submission only (ClusterSubmitter.java:74-80 cleanup analogue).
         assert not list((tmp_path / "staging").glob("lib-*"))
 
+    def test_am_crash_fails_job(self, tmp_path, monkeypatch):
+        """TEST_AM_CRASH makes the coordinator subprocess die mid-session;
+        the client must observe the death and return nonzero — the analogue
+        of TestTonyE2E.testAMCrashTonyShouldFail (:178-192). Runs through
+        the client path because an in-process coordinator would os._exit
+        the test runner."""
+        from tony_tpu import constants
+
+        monkeypatch.setenv(constants.TEST_AM_CRASH, "1")
+        rc = TonyClient().init(_base_argv(tmp_path, "exit_0.py")).run()
+        assert rc == 1
+
     def test_client_timeout_kills_job(self, tmp_path):
         argv = [
             "--executes", "-c 'import time; time.sleep(600)'",

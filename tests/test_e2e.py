@@ -109,6 +109,48 @@ def test_task_urls_point_at_logs(cluster):
     assert all(u.url.startswith("file://") for u in urls)
 
 
+def test_single_node_mode_succeeds(cluster):
+    """K_IS_SINGLE_NODE: the user command runs inside the coordinator, no
+    executors launch (doPreprocessingJob + early exit, reference :483-497)."""
+    conf = _job(cluster, "exit_0.py", workers=2)
+    conf.set(keys.K_IS_SINGLE_NODE, True)
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED
+    # no executor ever launched (their logs would exist otherwise)
+    logs = list((coord.app_dir / "logs").glob("worker-*.log"))
+    assert logs == []
+    assert list((coord.app_dir / "logs").glob("preprocess-*.log"))
+
+
+def test_single_node_failure_never_retries(cluster):
+    conf = _job(cluster, "exit_1.py")
+    conf.set(keys.K_IS_SINGLE_NODE, True)
+    conf.set(keys.K_AM_RETRY_COUNT, 3)
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.FAILED
+    assert coord.session.session_id == 1  # reference :365: no single-node retry
+
+
+def test_preprocess_gates_and_forwards_model_params(cluster):
+    """K_ENABLE_PREPROCESS: same script runs first in the coordinator
+    (emitting 'Model parameters: ...'), then as tasks that must see
+    MODEL_PARAMS (reference :684-701)."""
+    conf = _job(cluster, "preprocess_fixture.py", workers=2)
+    conf.set(keys.K_ENABLE_PREPROCESS, True)
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED, coord.session.diagnostics
+
+
+def test_preprocess_failure_blocks_scheduling(cluster):
+    conf = _job(cluster, "preprocess_fixture.py", workers=2)
+    conf.set(keys.K_ENABLE_PREPROCESS, True)
+    conf.set(keys.K_SHELL_ENV, "PREPROCESS_SHOULD_FAIL=1")
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.FAILED
+    assert "preprocess job exited with 3" in coord.session.diagnostics
+    assert list((coord.app_dir / "logs").glob("worker-*.log")) == []
+
+
 def test_application_timeout(cluster):
     conf = _job(cluster, "exit_0.py")
     # make the worker hang forever via a sleep command instead of the fixture

@@ -162,7 +162,14 @@ class TonyClient:
         interval_s = self.conf.get_int(keys.K_CLIENT_MONITOR_INTERVAL_MS, 1000) / 1000
         timeout_ms = self.conf.get_int(keys.K_APPLICATION_TIMEOUT, 0)
         deadline = time.monotonic() + timeout_ms / 1000 if timeout_ms else None
-        self.rpc = self._connect_rpc()
+        try:
+            self.rpc = self._connect_rpc()
+        except RuntimeError as exc:
+            # Coordinator died before advertising RPC (the AM-crash path in
+            # the reference e2e matrix): a failed submission, not a client
+            # bug.
+            log.error("%s", exc)
+            return 1
         if self.rpc is None:
             log.error("could not reach coordinator RPC")
             return 1

@@ -47,6 +47,9 @@ SESSION_ID = "SESSION_ID"
 TB_PORT = "TB_PORT"
 PROFILER_PORT = "PROFILER_PORT"
 TONY_LOG_DIR = "TONY_LOG_DIR"
+# Preprocess / single-node AM mode (Constants.java:34,48)
+PREPROCESSING_JOB = "PREPROCESSING_JOB"
+TASK_PARAM_KEY = "MODEL_PARAMS"
 
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"

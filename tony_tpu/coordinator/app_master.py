@@ -102,6 +102,7 @@ class TonyCoordinator:
         self._wake = threading.Event()  # interrupts the monitor poll
         self._killed = threading.Event()
         self._fatal = False  # conf-shaped failure: never retried
+        self._model_params: str | None = None  # from a preprocess run
         self.started_ms = int(time.time() * 1000)
         self._session_seq = 0
         self._hb_missed: set[str] = set()
@@ -167,6 +168,29 @@ class TonyCoordinator:
         self._session_seq += 1
         self.session = TonySession(self.conf, session_id=self._session_seq)
         self.session.status = SessionStatus.RUNNING
+        # Preprocess / single-node AM mode (doPreprocessingJob,
+        # TonyApplicationMaster.java:483-497, 640-703): run the user command
+        # inside the coordinator. Single-node jobs end here (no containers,
+        # no retry — reference :365); preprocess jobs gate task scheduling
+        # on the script succeeding and forward an extracted
+        # "Model parameters: ..." line to every task as MODEL_PARAMS.
+        single_node = self.conf.get_bool(keys.K_IS_SINGLE_NODE, False)
+        preprocess = self.conf.get_bool(keys.K_ENABLE_PREPROCESS, False)
+        if single_node or preprocess:
+            exit_code = self._do_preprocess(single_node)
+            if single_node:
+                self._fatal = True  # single node never retries
+                if exit_code == 0:
+                    self.session.status = SessionStatus.SUCCEEDED
+                    self.session.diagnostics = "single node job succeeded"
+                else:
+                    self.session.fail(
+                        f"single node job exited with {exit_code}"
+                    )
+                return self.session.status
+            if exit_code != 0:
+                self.session.fail(f"preprocess job exited with {exit_code}")
+                return self.session.status
         # TPU resource model: turn tony.<job>.tpus + tony.tpu.* into slice
         # plans before anything launches (the analogue of translating
         # tony.<job>.gpus into container capabilities at schedule time,
@@ -194,6 +218,78 @@ class TonyCoordinator:
             return self.session.status
         return self._monitor()
 
+    def _do_preprocess(self, single_node: bool) -> int:
+        """Run the user command in the coordinator process's context,
+        capturing stdout to ``logs/preprocess.log`` — the analogue of
+        ``doPreprocessingJob`` (TonyApplicationMaster.java:640-703) scanning
+        the AM stdout file. A ``Model parameters: <...>`` line is forwarded
+        to scheduled tasks via the MODEL_PARAMS env (Constants.java:48)."""
+        import shutil
+        import subprocess
+
+        try:
+            command, venv_dir = utils.build_user_command(
+                self.conf, f"preprocess-{os.getpid()}"
+            )
+        except ValueError as exc:
+            log.error("preprocess: %s", exc)
+            return 1
+        env = dict(os.environ)
+        env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
+        env[constants.PREPROCESSING_JOB] = "true"
+        log_dir = self.app_dir / "logs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        env[constants.TONY_LOG_DIR] = str(log_dir)
+        if single_node:
+            # Single-node notebooks/trainers get a TB port and its URL is
+            # registered the way executors register theirs (:649-658).
+            tb_port = utils.reserve_port()
+            env[constants.TB_PORT] = str(tb_port)
+            self.tensorboard_url = f"http://127.0.0.1:{tb_port}"
+        timeout_ms = self.conf.get_int(keys.K_WORKER_TIMEOUT, 0)
+        # Per-session log: a retried session must not read a previous
+        # attempt's "Model parameters:" line.
+        logfile = log_dir / f"preprocess-{self.session.session_id}.log"
+        log.info("preprocess: executing %r (log %s)", command, logfile)
+        try:
+            with open(logfile, "wb") as out:
+                proc = subprocess.Popen(
+                    ["bash", "-c", command], env=env,
+                    cwd=self._preprocess_cwd(),
+                    stdout=out, stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+                try:
+                    rc = proc.wait(
+                        timeout=timeout_ms / 1000.0 if timeout_ms else None
+                    )
+                except subprocess.TimeoutExpired:
+                    import signal
+
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    proc.wait()
+                    rc = 124
+        finally:
+            if venv_dir is not None:
+                shutil.rmtree(venv_dir, ignore_errors=True)
+        if rc == 0 and not single_node:
+            marker = "Model parameters: "
+            for line in logfile.read_text(errors="replace").splitlines():
+                if marker in line:
+                    self._model_params = line.split(marker, 1)[1].strip()
+                    log.info("preprocess model params: %s", self._model_params)
+                    break
+        return rc
+
+    def _preprocess_cwd(self) -> str | None:
+        """Run relative to the unpacked job archive when there is one (the
+        reference's AM cwd is the localized container dir)."""
+        workdir = self.app_dir / "workdir"
+        return str(workdir) if workdir.is_dir() else None
+
     def _schedule_tasks(self) -> None:
         """scheduleTasks (TonyApplicationMaster.java:507-524) + the
         ContainerLauncher env contract (:1017-1092)."""
@@ -215,6 +311,8 @@ class TonyCoordinator:
             constants.TONY_AM_ADDRESS: f"127.0.0.1:{self.rpc_server.port}",
             constants.TONY_CONF_PATH: str(self.app_dir / constants.TONY_FINAL_CONF),
         }
+        if self._model_params is not None:
+            env[constants.TASK_PARAM_KEY] = self._model_params
         plan = self.slice_plans.get(task.job_name)
         if plan is not None:
             # The slice topology env the runtime reads to build its Mesh

@@ -84,6 +84,7 @@ class TaskExecutor:
         self.tb_port: int | None = None
         self.profiler_port: int | None = None
         self.heartbeater: Heartbeater | None = None
+        self._venv_dir: Path | None = None
 
     def _local_mode(self) -> bool:
         return self.am_host in ("127.0.0.1", "localhost")
@@ -139,27 +140,13 @@ class TaskExecutor:
         return env
 
     def build_task_command(self) -> str:
-        """Interpreter + script + params (TonySession.getTaskCommand:74-94),
-        preferring an unpacked venv's interpreter when one is shipped."""
-        executes = self.conf.get_str(keys.K_EXECUTES)
-        if not executes:
-            raise ValueError(f"{keys.K_EXECUTES} is required")
-        python = self.conf.get_str(keys.K_PYTHON_BINARY, "python") or "python"
-        venv_zip = self.conf.get_str(keys.K_PYTHON_VENV)
-        if venv_zip:
-            # Per-task extraction dir: executors sharing a cwd (the local
-            # backend case) must not race on one ./venv, and a stale venv
-            # from a previous job must never be silently reused.
-            venv_dir = Path(f"venv-{self.job_name}-{self.task_index}-{os.getpid()}")
-            utils.unzip(venv_zip, venv_dir)
-            candidate = venv_dir / "bin" / "python"
-            if candidate.exists():
-                candidate.chmod(0o755)
-                python = str(candidate)
-            else:
-                log.warning("venv %s has no bin/python; using %r", venv_zip, python)
-        params = self.conf.get_str(keys.K_TASK_PARAMS)
-        return f"{python} {executes} {params}".strip()
+        """Interpreter + script + params via the shared builder
+        (utils.build_user_command); the per-task venv extraction dir is
+        remembered for cleanup after the user process exits."""
+        command, self._venv_dir = utils.build_user_command(
+            self.conf, f"{self.job_name}-{self.task_index}-{os.getpid()}"
+        )
+        return command
 
     def _maybe_sleep_for_skew(self) -> None:
         """TEST_TASK_EXECUTOR_SKEW="job#idx#ms" straggler simulation
@@ -216,6 +203,11 @@ class TaskExecutor:
         log.info("executing: %s", command)
         rc = utils.execute_shell(command, timeout_ms=timeout_ms, extra_env=env)
         log.info("user process exited with %d", rc)
+        if self._venv_dir is not None:
+            # Per-task venv extractions are scratch; don't litter the host.
+            import shutil
+
+            shutil.rmtree(self._venv_dir, ignore_errors=True)
         try:
             self.client.register_execution_result(
                 rc, self.job_name, str(self.task_index), self.session_id

@@ -174,17 +174,24 @@ def _flash_attention_pallas(
     t_k = k.shape[1]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
-    # Pad keys to a block multiple: the final partial tile would otherwise
-    # alias real rows when the BlockSpec clamps its window.
+    # Pad BOTH sequence axes to block multiples: a final partial tile would
+    # otherwise alias real rows when the BlockSpec clamps its window — on
+    # the q side that rewrites earlier rows with wrong positions (silently
+    # non-causal output), on the k side it double-counts keys. Padded q rows
+    # compute garbage that is sliced off below; the kernel's position math
+    # uses the real t_q/t_k.
     pad_k = (-t_k) % block_k
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
-    grid = (bh, pl.cdiv(t_q, block_q), (t_k + pad_k) // block_k)
+    pad_q = (-t_q) % block_q
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    grid = (bh, (t_q + pad_q) // block_q, (t_k + pad_k) // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, t_k=t_k, t_q=t_q,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -193,7 +200,7 @@ def _flash_attention_pallas(
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q + pad_q, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -204,6 +211,7 @@ def _flash_attention_pallas(
         ),
         interpret=interpret,
     )(q, k, v)
+    return out[:, :t_q] if pad_q else out
 
 
 # ---------------------------------------------------------------------------

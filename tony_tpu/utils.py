@@ -86,6 +86,40 @@ def unzip(src_zip: str | os.PathLike[str], dst_dir: str | os.PathLike[str]) -> N
         zf.extractall(dst_dir)
 
 
+def build_user_command(
+    conf: TonyConfiguration, venv_tag: str
+) -> tuple[str, Path | None]:
+    """Interpreter + script + params (TonySession.getTaskCommand:74-94),
+    preferring a shipped venv's interpreter. The single builder used by
+    executors AND the coordinator's preprocess mode, so both run the same
+    interpreter. Returns ``(command, venv_dir)`` — the caller owns cleaning
+    up the per-run ``venv-<tag>`` extraction dir (None when no venv)."""
+    executes = conf.get_str(keys.K_EXECUTES)
+    if not executes:
+        raise ValueError(f"{keys.K_EXECUTES} is required")
+    python = conf.get_str(keys.K_PYTHON_BINARY, "python") or "python"
+    venv_dir: Path | None = None
+    venv_zip = conf.get_str(keys.K_PYTHON_VENV)
+    if venv_zip:
+        # Per-run extraction dir: concurrent runs sharing a cwd must not
+        # race on one ./venv, and a stale venv from a previous job must
+        # never be silently reused.
+        venv_dir = Path(f"venv-{venv_tag}")
+        unzip(venv_zip, venv_dir)
+        candidate = venv_dir / "bin" / "python"
+        if candidate.exists():
+            candidate.chmod(0o755)
+            python = str(candidate)
+        else:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "venv %s has no bin/python; using %r", venv_zip, python
+            )
+    params = conf.get_str(keys.K_TASK_PARAMS)
+    return f"{python} {executes} {params}".strip(), venv_dir
+
+
 # ---------------------------------------------------------------------------
 # Ports
 # ---------------------------------------------------------------------------
