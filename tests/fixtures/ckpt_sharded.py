@@ -1,0 +1,61 @@
+"""Multi-process sharded checkpoint fixture: 2 executor processes hold a
+global array sharded across both (non-fully-addressable from each), save
+per-process shards, then restore and verify — the path single-process unit
+tests cannot reach."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import tony_tpu.runtime as rt
+from tony_tpu.checkpoint import CheckpointManager
+
+ctx = rt.initialize()
+if not ctx.is_distributed:
+    print("expected 2+ processes", file=sys.stderr)
+    sys.exit(6)
+
+from jax.experimental import multihost_utils
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+sharding = NamedSharding(mesh, P("dp"))
+n = jax.device_count() * 2  # 2 rows per device
+local = jax.local_device_count() * 2
+lo = ctx.process_id * local
+local_data = np.arange(lo, lo + local, dtype=np.float32)
+x = jax.make_array_from_process_local_data(sharding, local_data, (n,))
+assert not x.is_fully_addressable, "fixture needs a cross-process array"
+
+mgr = CheckpointManager(
+    os.environ["CKPT_DIR"],
+    process_id=ctx.process_id,
+    num_processes=ctx.num_processes,
+)
+mgr.save(1, {"x": x}, blocking=True)
+multihost_utils.sync_global_devices("ckpt-written")
+
+restored = mgr.restore({"x": x})
+if restored is None:
+    print("restore returned None", file=sys.stderr)
+    sys.exit(7)
+y = restored["x"]
+if y.sharding != x.sharding or y.shape != x.shape:
+    print("sharding/shape mismatch after restore", file=sys.stderr)
+    sys.exit(8)
+for shard in y.addressable_shards:
+    want = np.arange(n, dtype=np.float32)[shard.index]
+    if not np.array_equal(np.asarray(shard.data), want):
+        print(f"shard {shard.index} wrong: {shard.data} != {want}",
+              file=sys.stderr)
+        sys.exit(9)
+print(f"process {ctx.process_id}: sharded checkpoint roundtrip OK")
+sys.exit(0)

@@ -1,0 +1,43 @@
+"""Checkpoint/resume fixture: trains 10 steps with CheckpointManager,
+crashing at step 5 on the first session; the retried session must restore
+from the latest complete checkpoint (step > 0), finish training, and end
+with state that proves no steps were lost or repeated."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from tony_tpu.checkpoint import CheckpointManager
+
+TOTAL_STEPS = 10
+CRASH_AT = 5
+
+session = os.environ.get("SESSION_ID", "1")
+mgr = CheckpointManager(Path(os.environ["CKPT_DIR"]))
+template = {"step": jnp.zeros((), jnp.int32), "w": jnp.zeros((4,))}
+restored = mgr.restore(template)
+start = int(restored["step"]) if restored is not None else 0
+state = restored if restored is not None else template
+print(f"session {session}: starting from step {start}", flush=True)
+
+if session != "1" and start == 0:
+    print("retried session did not resume from a checkpoint", file=sys.stderr)
+    sys.exit(7)
+
+for step in range(start, TOTAL_STEPS):
+    state = {
+        "step": jnp.asarray(step + 1, jnp.int32),
+        "w": state["w"] + 1.0,
+    }
+    mgr.save(step + 1, state, blocking=True)
+    if step + 1 == CRASH_AT and session == "1":
+        print("simulated crash mid-training", file=sys.stderr)
+        sys.exit(1)
+
+if float(state["w"][0]) != float(TOTAL_STEPS):
+    print(f"lost or repeated steps: w={state['w']}", file=sys.stderr)
+    sys.exit(8)
+sys.exit(0)

@@ -1,0 +1,292 @@
+"""Async, per-process-sharded train-state checkpointing.
+
+The reference delegates checkpoints entirely to the user script and uses
+AM-session retry as the resume path (SURVEY §5.4: "the AM-retry mechanism
+is the resume path: a restarted session reruns the user script, which is
+expected to restore from its own checkpoints" — e.g. the ``working_dir``
+flag in tony-examples/mnist-tensorflow/mnist_distributed.py:46-48). This
+module is the training-library half of that contract, built TPU-first:
+
+* **Async**: ``save`` snapshots device arrays to host synchronously (the
+  caller may donate the buffers to the next train step immediately after)
+  and hands serialization + fsync + atomic rename to a background writer
+  thread — the TPU never waits on disk (the Orbax async-checkpoint shape).
+  Writer failures re-raise from ``wait()`` or the next ``save()`` — a
+  checkpoint is never silently lost. Call ``wait()`` before process exit;
+  the writer is a daemon thread.
+* **Per-process sharded**: each jax process writes only its *addressable*
+  shards to its own file (``leaf.addressable_shards`` for global arrays
+  spanning hosts), so no process ever fetches remote data. A checkpoint
+  step is complete only when all ``num_processes`` files exist. Restore
+  assumes the same mesh/sharding topology that saved (no resharding —
+  the session-retry resume path reruns the identical job).
+* **Crash-safe**: payload and metadata both go through
+  write-tmp → flush → fsync → rename, and readers require the complete
+  per-process set, so a torn write can never be read back. Torn step dirs
+  older than the kept window are garbage-collected.
+* **Dtype-exact**: leaves are stored as raw bytes + a dtype/shape manifest,
+  so bfloat16 (and any ml_dtypes type numpy can't round-trip through npz)
+  restores exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+import threading
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_MANIFEST = "__manifest__"
+
+
+def _snapshot_leaf(leaf: Any) -> tuple[list[np.ndarray], dict]:
+    """Host copies of this process's pieces of ``leaf`` plus manifest info.
+    Fully-addressable arrays (single process, or replicated locally) are one
+    piece; global arrays contribute one piece per addressable shard."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        shards = leaf.addressable_shards
+        pieces = [np.asarray(s.data) for s in shards]
+        return pieces, {
+            "dtype": str(leaf.dtype),
+            "shape": list(leaf.shape),
+            "num_shards": len(pieces),
+            "shard_shapes": [list(p.shape) for p in pieces],
+        }
+    arr = np.asarray(jax.device_get(leaf))
+    return [arr], {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "num_shards": 1,
+        "shard_shapes": [list(arr.shape)],
+    }
+
+
+def _encode(arr: np.ndarray) -> np.ndarray:
+    """Raw little-endian bytes: np.savez corrupts ml_dtypes (bfloat16 comes
+    back as void), so every array is stored as uint8 and reshaped back via
+    the manifest."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def _decode(raw: np.ndarray, dtype: str, shape: list[int]) -> np.ndarray:
+    return raw.view(np.dtype(dtype)).reshape(shape)
+
+
+def _tree_paths(tree: Any) -> list[tuple[str, Any]]:
+    """Stable (joined-path, leaf) list for any pytree."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _fsync_write(path: Path, tmp: Path, data: bytes) -> None:
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.rename(path)  # atomic: readers never see a torn file
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str | os.PathLike[str],
+        *,
+        process_id: int = 0,
+        num_processes: int = 1,
+        max_to_keep: int = 3,
+    ) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.max_to_keep = max_to_keep
+        self._writer: threading.Thread | None = None
+        self._writer_exc: BaseException | None = None
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: Any, *, blocking: bool = False) -> None:
+        """Snapshot ``state`` at ``step``. Device→host copies happen before
+        returning (the caller may donate the buffers to the next train step
+        immediately); disk IO runs on a background thread unless
+        ``blocking``. Raises a prior async write's failure rather than
+        piling new checkpoints on top of a broken disk."""
+        self.wait()  # one in-flight write at a time; re-raises past failure
+        manifest: dict[str, dict] = {}
+        blobs: dict[str, np.ndarray] = {}
+        for path, leaf in _tree_paths(state):
+            pieces, info = _snapshot_leaf(leaf)
+            manifest[path] = info
+            for i, piece in enumerate(pieces):
+                blobs[f"{path}#s{i}"] = _encode(piece)
+
+        def write() -> None:
+            step_dir = self.directory / f"step_{step}"
+            step_dir.mkdir(parents=True, exist_ok=True)
+            import io
+
+            buf = io.BytesIO()
+            np.savez(
+                buf,
+                **blobs,
+                **{_MANIFEST: np.frombuffer(
+                    json.dumps(manifest).encode(), dtype=np.uint8
+                )},
+            )
+            _fsync_write(
+                step_dir / f"process_{self.process_id}.npz",
+                step_dir / f".tmp_process_{self.process_id}.npz",
+                buf.getvalue(),
+            )
+            if self.process_id == 0:
+                _fsync_write(
+                    step_dir / "metadata.json",
+                    step_dir / ".tmp_metadata.json",
+                    json.dumps(
+                        {"step": step, "num_processes": self.num_processes}
+                    ).encode(),
+                )
+            self._gc()
+            log.info("checkpoint step %d written to %s", step, step_dir)
+
+        if blocking:
+            write()
+        else:
+            def guarded() -> None:
+                try:
+                    write()
+                except BaseException as exc:  # surfaced by wait()/next save
+                    self._writer_exc = exc
+
+            self._writer = threading.Thread(
+                target=guarded, name="ckpt-writer", daemon=True
+            )
+            self._writer.start()
+
+    def wait(self) -> None:
+        """Block until the in-flight async write (if any) is durable;
+        re-raises the writer's exception if it failed."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+        if self._writer_exc is not None:
+            exc, self._writer_exc = self._writer_exc, None
+            raise RuntimeError("async checkpoint write failed") from exc
+
+    # -- restore ------------------------------------------------------------
+    def _complete_steps(self) -> list[int]:
+        steps = []
+        for child in self.directory.iterdir() if self.directory.is_dir() else []:
+            m = _STEP_RE.match(child.name)
+            if not m:
+                continue
+            if not (child / "metadata.json").is_file():
+                continue
+            try:
+                meta = json.loads((child / "metadata.json").read_text())
+            except (OSError, ValueError):
+                continue
+            n = int(meta.get("num_processes", self.num_processes))
+            if all(
+                (child / f"process_{p}.npz").is_file() for p in range(n)
+            ):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self._complete_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any | None:
+        """Load the newest complete checkpoint (or ``step``, if complete)
+        into the structure — and shardings — of ``state_template``. Returns
+        None when nothing restorable exists (including an explicit ``step``
+        that is missing or torn)."""
+        complete = self._complete_steps()
+        if step is None:
+            if not complete:
+                return None
+            step = complete[-1]
+        elif step not in complete:
+            return None
+        path = self.directory / f"step_{step}" / f"process_{self.process_id}.npz"
+        with np.load(path) as data:
+            manifest = json.loads(bytes(data[_MANIFEST]).decode())
+            blobs = {k: data[k] for k in data.files if k != _MANIFEST}
+        flat = jax.tree_util.tree_flatten_with_path(state_template)
+        leaves = []
+        for key_path, leaf in flat[0]:
+            key = jax.tree_util.keystr(key_path)
+            info = manifest.get(key)
+            if info is None:
+                raise ValueError(
+                    f"checkpoint step {step} is missing leaf {key!r} — "
+                    f"model/optimizer structure changed since it was written"
+                )
+            pieces = [
+                _decode(blobs[f"{key}#s{i}"], info["dtype"],
+                        info["shard_shapes"][i])
+                for i in range(info["num_shards"])
+            ]
+            leaves.append(self._restore_leaf(leaf, pieces, info, key))
+        return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+    def _restore_leaf(
+        self, template: Any, pieces: list[np.ndarray], info: dict, key: str
+    ) -> Any:
+        sharding = getattr(template, "sharding", None)
+        if (
+            isinstance(template, jax.Array)
+            and not template.is_fully_addressable
+        ):
+            shards = template.addressable_shards
+            if len(shards) != len(pieces):
+                raise ValueError(
+                    f"leaf {key!r}: checkpoint has {len(pieces)} local "
+                    f"shards but the template sharding expects "
+                    f"{len(shards)} — save/restore topologies must match"
+                )
+            arrays = [
+                jax.device_put(piece, shard.device)
+                for piece, shard in zip(pieces, shards)
+            ]
+            return jax.make_array_from_single_device_arrays(
+                tuple(info["shape"]), template.sharding, arrays
+            )
+        value = pieces[0]
+        if sharding is not None:
+            return jax.device_put(value, sharding)
+        return value
+
+    # -- gc -----------------------------------------------------------------
+    def _gc(self) -> None:
+        """Process 0 prunes old steps — complete ones beyond ``max_to_keep``
+        AND torn/incomplete dirs older than the oldest kept complete step
+        (crash leftovers must not accumulate forever). The checkpoint dir is
+        shared storage in multi-process deployments; a lone writer avoids
+        deletion races."""
+        if self.process_id != 0 or not self.max_to_keep:
+            return
+        complete = self._complete_steps()
+        kept = set(complete[-self.max_to_keep:])
+        threshold = min(kept) if kept else None
+        for child in list(self.directory.iterdir()):
+            m = _STEP_RE.match(child.name)
+            if not m:
+                continue
+            n = int(m.group(1))
+            stale_complete = n in set(complete) - kept
+            torn_and_old = (
+                n not in complete and threshold is not None and n < threshold
+            )
+            if stale_complete or torn_and_old:
+                shutil.rmtree(child, ignore_errors=True)
