@@ -56,7 +56,7 @@ def test_layering_order(tmp_path):
     # default ⟵ job file ⟵ CLI override
     assert conf.get_str(keys.K_FRAMEWORK) == "pytorch"
     assert conf.get_int(keys.instances_key("worker")) == 8
-    assert conf.get_str(keys.K_AM_MEMORY) == "2g"  # untouched default
+    assert conf.get_str(keys.memory_key("worker")) == "2g"  # untouched default
 
 
 def test_site_config_layer(tmp_path, monkeypatch):

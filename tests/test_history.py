@@ -91,3 +91,86 @@ class TestHistoryServer:
                 assert e.code == 404
         finally:
             server.stop()
+
+    def test_secrets_redacted_in_history_and_responses(self, tmp_path):
+        """ADVICE r1 (medium): the history path must never expose
+        tony.secret.key — anyone reading it could authenticate to a live
+        job's RPC. Redacted at write time AND at serve time."""
+        now = int(time.time() * 1000)
+        job_dir = setup_job_dir(str(tmp_path), "application_3_0001", now)
+        conf = TonyConfiguration()
+        conf.set("tony.secret.key", "hunter2")
+        write_config_file(job_dir, conf)
+        create_history_file(
+            job_dir, JobMetadata.new("application_3_0001", now, "SUCCEEDED")
+        )
+        on_disk = (job_dir / "config.json").read_text()
+        assert "hunter2" not in on_disk
+
+        # serve-time defense in depth: plant an unredacted legacy config
+        legacy = json.loads(on_disk)
+        legacy["tony.secret.key"] = "hunter2"
+        (job_dir / "config.json").write_text(json.dumps(legacy))
+        server = HistoryServer(str(tmp_path), port=0)
+        port = server.serve_background()
+        try:
+            body = urllib.request.urlopen(
+                f"http://localhost:{port}/api/config/application_3_0001"
+            ).read().decode()
+            assert "hunter2" not in body and "<redacted>" in body
+        finally:
+            server.stop()
+
+    def test_binds_localhost_by_default(self, tmp_path):
+        server = HistoryServer(str(tmp_path), port=0)
+        assert server.httpd.server_address[0] == "127.0.0.1"
+        server.stop()
+
+    def test_from_conf_port_selection(self, tmp_path):
+        from tony_tpu.conf import keys
+        import pytest
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_HISTORY_LOCATION, str(tmp_path))
+        with pytest.raises(ValueError, match="disabled"):
+            HistoryServer.from_conf(conf)  # default http.port=disabled
+        conf.set(keys.K_HTTP_PORT, "0")
+        server = HistoryServer.from_conf(conf)
+        assert server.scheme == "http"
+        server.stop()
+
+    def test_https_with_pem_pair(self, tmp_path):
+        """tony.https.cert/key serve TLS (keystore analogue,
+        TonyConfigurationKeys.java:41-63)."""
+        import ssl
+        import subprocess
+
+        from tony_tpu.conf import keys
+
+        cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        now = int(time.time() * 1000)
+        _make_job(tmp_path / "hist", "application_4_0001", now)
+        conf = TonyConfiguration()
+        conf.set(keys.K_HISTORY_LOCATION, str(tmp_path / "hist"))
+        conf.set(keys.K_HTTPS_PORT, 0)
+        conf.set(keys.K_HTTPS_CERT, str(cert))
+        conf.set(keys.K_HTTPS_KEY, str(key))
+        server = HistoryServer.from_conf(conf)
+        assert server.scheme == "https"
+        port = server.serve_background()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            body = urllib.request.urlopen(
+                f"https://localhost:{port}/api/jobs", context=ctx
+            ).read()
+            assert b"application_4_0001" in body
+        finally:
+            server.stop()

@@ -55,6 +55,23 @@ def test_zip_roundtrip(tmp_path):
     assert (out / "sub" / "b.txt").read_text() == "b"
 
 
+def test_build_user_command_docker_passthrough():
+    """tony.application.docker.* wraps the user process in the image with
+    host networking (so the injected rendezvous env still works)."""
+    conf = TonyConfiguration()
+    conf.set(keys.K_EXECUTES, "train.py")
+    conf.set(keys.K_DOCKER_ENABLED, True)
+    conf.set(keys.K_DOCKER_IMAGE, "ghcr.io/acme/trainer:1")
+    cmd, venv = utils.build_user_command(conf, "t")
+    assert cmd.startswith("docker run --rm --network=host")
+    assert "ghcr.io/acme/trainer:1 python train.py" in cmd
+    assert venv is None
+
+    conf.set(keys.K_DOCKER_IMAGE, "")
+    with pytest.raises(ValueError, match="docker.image"):
+        utils.build_user_command(conf, "t")
+
+
 def test_parse_container_requests():
     """Analogue of TestUtils.testParseContainerRequests (reference :55-78):
     arbitrary job types via the instances regex, with resources."""

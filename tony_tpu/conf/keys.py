@@ -31,7 +31,6 @@ K_APPLICATION_TIMEOUT = APPLICATION_PREFIX + "timeout"   # ms, 0 = none
 K_CLIENT_CONNECT_RETRIES = APPLICATION_PREFIX + "num-client-coordinator-connect-retries"
 K_CLIENT_CONNECT_TIMEOUT_MS = APPLICATION_PREFIX + "coordinator-connect-timeout"
 K_SECURITY_ENABLED = APPLICATION_PREFIX + "security.enabled"
-K_NODE_LABEL = APPLICATION_PREFIX + "node-label"
 K_DOCKER_ENABLED = APPLICATION_PREFIX + "docker.enabled"
 K_DOCKER_IMAGE = APPLICATION_PREFIX + "docker.image"
 # Job payload (the reference passes these as TonyClient CLI args --executes/
@@ -52,11 +51,11 @@ K_TASK_REGISTRATION_TIMEOUT_MS = TASK_PREFIX + "registration-timeout"
 K_TASK_REGISTRATION_RETRY_MS = TASK_PREFIX + "registration-retry-interval"
 
 # --- coordinator (AM analogue) --------------------------------------------
+# Descoped from the reference (see README "descoped keys"): tony.am.memory/
+# vcores/gpus sized the AM's YARN container; the coordinator here is a plain
+# subprocess with no resource caps to request.
 AM_PREFIX = TONY_PREFIX + "am."
 K_AM_RETRY_COUNT = AM_PREFIX + "retry-count"
-K_AM_MEMORY = AM_PREFIX + "memory"
-K_AM_VCORES = AM_PREFIX + "vcores"
-K_AM_GPUS = AM_PREFIX + "gpus"
 K_AM_MONITOR_INTERVAL_MS = AM_PREFIX + "monitor-interval"
 K_AM_RPC_PORT_RANGE = AM_PREFIX + "rpc-port-range"       # "10000-15000"
 K_AM_STOP_GRACE_MS = AM_PREFIX + "stop-grace"            # wait for client finish signal
@@ -77,18 +76,20 @@ K_TPU_ACCELERATOR_TYPE = TPU_PREFIX + "accelerator-type" # e.g. "v5litepod-8"
 K_TPU_SLICE_STRICT = TPU_PREFIX + "strict-slice-shapes"  # reject illegal topologies
 
 # --- storage / staging -----------------------------------------------------
+# Descoped from the reference (README "descoped keys"): tony.other.namenodes
+# (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
 K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
 K_LIB_PATH = TONY_PREFIX + "lib.path"                    # staged framework copy for executors
 K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
-K_OTHER_NAMENODES = TONY_PREFIX + "other.namenodes"      # extra filesystems to token
 
-# --- history server --------------------------------------------------------
+# --- history server (TonyConfigurationKeys.java:41-63) ---------------------
 K_HTTP_PORT = TONY_PREFIX + "http.port"                  # "disabled" or int
 K_HTTPS_PORT = TONY_PREFIX + "https.port"
+K_HTTPS_CERT = TONY_PREFIX + "https.cert"                # PEM cert chain path
+K_HTTPS_KEY = TONY_PREFIX + "https.key"                  # PEM private key path
 K_SECRET_KEY = TONY_PREFIX + "secret.key"
 
 # --- client ---------------------------------------------------------------
-K_YARN_QUEUE = TONY_PREFIX + "yarn.queue"                # kept for conf parity
 K_CLIENT_MONITOR_INTERVAL_MS = TONY_PREFIX + "client.monitor-interval"
 
 # --- profiler / tensorboard seam ------------------------------------------
@@ -108,7 +109,6 @@ DEFAULTS: dict[str, object] = {
     K_CLIENT_CONNECT_RETRIES: 3,
     K_CLIENT_CONNECT_TIMEOUT_MS: 60000,
     K_SECURITY_ENABLED: False,
-    K_NODE_LABEL: "",
     K_DOCKER_ENABLED: False,
     K_DOCKER_IMAGE: "",
     K_EXECUTES: "",
@@ -122,9 +122,6 @@ DEFAULTS: dict[str, object] = {
     K_TASK_REGISTRATION_TIMEOUT_MS: 0,
     K_TASK_REGISTRATION_RETRY_MS: 500,
     K_AM_RETRY_COUNT: 0,
-    K_AM_MEMORY: "2g",
-    K_AM_VCORES: 1,
-    K_AM_GPUS: 0,
     K_AM_MONITOR_INTERVAL_MS: 200,
     K_AM_RPC_PORT_RANGE: "10000-15000",
     K_AM_STOP_GRACE_MS: 30000,
@@ -137,11 +134,11 @@ DEFAULTS: dict[str, object] = {
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",
-    K_OTHER_NAMENODES: "",
     K_HTTP_PORT: "disabled",
     K_HTTPS_PORT: 19886,
+    K_HTTPS_CERT: "",
+    K_HTTPS_KEY: "",
     K_SECRET_KEY: "dev",
-    K_YARN_QUEUE: "default",
     K_CLIENT_MONITOR_INTERVAL_MS: 1000,
     K_PROFILER_ENABLED: False,
     K_TENSORBOARD_ENABLED: True,

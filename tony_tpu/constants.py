@@ -51,6 +51,17 @@ TONY_LOG_DIR = "TONY_LOG_DIR"
 PREPROCESSING_JOB = "PREPROCESSING_JOB"
 TASK_PARAM_KEY = "MODEL_PARAMS"
 
+# The env contract forwarded into docker containers (utils.build_user_command
+# emits one `-e VAR` per name; values resolve from the launching env).
+DOCKER_FORWARD_ENV = (
+    JOB_NAME, TASK_INDEX, TASK_NUM, SESSION_ID,
+    CLUSTER_SPEC, TF_CONFIG,
+    INIT_METHOD, RANK, WORLD, WORLD_SIZE, MASTER_ADDR, MASTER_PORT,
+    JAX_COORDINATOR_ADDRESS, TONY_COORDINATOR_ADDRESS,
+    TONY_NUM_PROCESSES, TONY_PROCESS_ID, TONY_SLICE_TOPOLOGY,
+    TB_PORT, PROFILER_PORT, TONY_LOG_DIR, PREPROCESSING_JOB, TASK_PARAM_KEY,
+)
+
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"
 TONY_TASK_COMMAND = "TONY_TASK_COMMAND"

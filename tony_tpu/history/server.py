@@ -17,8 +17,13 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tony_tpu.history.reader import TtlCache, job_config, list_jobs
+from tony_tpu.history.writer import redact_config
 
 log = logging.getLogger(__name__)
+
+
+class NothingToServe(ValueError):
+    """from_conf: no http port and no https cert configured."""
 
 _PAGE = """<!doctype html><html><head><title>tony-tpu history</title>
 <style>
@@ -69,9 +74,13 @@ class HistoryHandler(BaseHTTPRequestHandler):
         )
 
     def _config(self, app_id: str):
-        return self.cache.get_or_load(
+        cfg = self.cache.get_or_load(
             ("config", app_id), lambda: job_config(self.history_location, app_id)
         )
+        # Defense in depth: the write path redacts secrets, but re-redact at
+        # serve time so configs written by older versions can't leak the RPC
+        # secret either.
+        return None if cfg is None else redact_config(cfg)
 
     # -- pages ---------------------------------------------------------------
     def _jobs_page(self) -> str:
@@ -119,35 +128,117 @@ class HistoryHandler(BaseHTTPRequestHandler):
 
 
 class HistoryServer:
-    def __init__(self, history_location: str, port: int = 0) -> None:
+    """Binds localhost by default (serving job metadata to the open network
+    is an explicit opt-in via ``host="0.0.0.0"``); HTTPS when a PEM
+    cert/key pair is supplied — the analogue of the reference's
+    ``tony.https.*`` keystore support (TonyConfigurationKeys.java:41-63)."""
+
+    def __init__(
+        self,
+        history_location: str,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        certfile: str | None = None,
+        keyfile: str | None = None,
+    ) -> None:
         handler = type(
             "BoundHandler", (HistoryHandler,),
             {"history_location": history_location, "cache": TtlCache(30.0)},
         )
-        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.scheme = "http"
+        if certfile:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile or None)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
+            self.scheme = "https"
         self.port = self.httpd.server_address[1]
+
+    @classmethod
+    def from_conf(
+        cls, conf, history_location: str | None = None,
+        host: str = "127.0.0.1",
+    ) -> "HistoryServer":
+        """Build from tony.* keys: tony.http.port ("disabled" or int) vs
+        tony.https.port + tony.https.cert/key — https wins when a cert is
+        configured, mirroring the reference's port selection."""
+        from tony_tpu.conf import keys
+
+        location = history_location or conf.get_str(keys.K_HISTORY_LOCATION)
+        cert = conf.get_str(keys.K_HTTPS_CERT) or None
+        if cert:
+            return cls(
+                location,
+                port=conf.get_int(keys.K_HTTPS_PORT, 19886),
+                host=host,
+                certfile=cert,
+                keyfile=conf.get_str(keys.K_HTTPS_KEY) or None,
+            )
+        http_port = conf.get_str(keys.K_HTTP_PORT, "disabled")
+        if http_port == "disabled":
+            raise NothingToServe(
+                f"{keys.K_HTTP_PORT} is 'disabled' and no {keys.K_HTTPS_CERT} "
+                f"is configured — nothing to serve on"
+            )
+        return cls(location, port=int(http_port), host=host)
+
+    _serving = False
 
     def serve_background(self) -> int:
         import threading
 
+        self._serving = True
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         t.start()
-        log.info("history server on http://localhost:%d", self.port)
+        log.info("history server on %s://localhost:%d", self.scheme, self.port)
         return self.port
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        # shutdown() blocks until serve_forever acknowledges — calling it
+        # when the loop never started would hang forever.
+        if self._serving:
+            self.httpd.shutdown()
+            self._serving = False
         self.httpd.server_close()
 
 
 def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser(description="tony_tpu history server")
-    p.add_argument("--history-location", required=True)
-    p.add_argument("--port", type=int, default=19886)
+    p.add_argument("--history-location", default=None)
+    p.add_argument("--conf_file", default=None,
+                   help="job config supplying tony.http(s).* keys")
+    p.add_argument("--port", type=int, default=None,
+                   help="override the configured port")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 is an explicit opt-in)")
     args = p.parse_args(argv)
-    server = HistoryServer(args.history_location, args.port)
-    print(f"history server on http://localhost:{server.port}")
+    from tony_tpu.conf import keys
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(conf_file=args.conf_file)
+    location = args.history_location or conf.get_str(keys.K_HISTORY_LOCATION)
+    if not location:
+        p.error("--history-location (or tony.history.location) is required")
+    cert = conf.get_str(keys.K_HTTPS_CERT) or None
+    keyf = conf.get_str(keys.K_HTTPS_KEY) or None
+    if args.port is not None:
+        # Port override keeps the configured TLS material — --port must
+        # never silently downgrade an https deployment to plaintext.
+        server = HistoryServer(location, args.port, host=args.host,
+                               certfile=cert, keyfile=keyf)
+    else:
+        try:
+            server = HistoryServer.from_conf(conf, location, host=args.host)
+        except NothingToServe:
+            # nothing configured: starting the server IS the opt-in, so
+            # fall back to plain http on the reference's default port
+            server = HistoryServer(location, 19886, host=args.host)
+    print(f"history server on {server.scheme}://localhost:{server.port}")
     try:
         server.httpd.serve_forever()
     except KeyboardInterrupt:

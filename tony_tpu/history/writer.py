@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import getpass
 import json
+import re
 import time
 from dataclasses import asdict, dataclass
 from pathlib import Path
@@ -69,8 +70,35 @@ def setup_job_dir(history_location: str, app_id: str, started_ms: int) -> Path:
     return job_dir
 
 
+# Keys whose values must never land in the (browsable) history: the shared
+# RPC secret in particular — serving it would let anyone who can reach the
+# history port authenticate to a live job's RPC (e.g. finish_application).
+_SECRET_KEY_RE = re.compile(r"secret|password|token", re.IGNORECASE)
+REDACTED = "<redacted>"
+
+
+def redact_config(cfg: dict) -> dict:
+    return {
+        k: (REDACTED if _SECRET_KEY_RE.search(k) else v)
+        for k, v in cfg.items()
+    }
+
+
 def write_config_file(job_dir: Path, conf: TonyConfiguration) -> None:
-    conf.write_final(job_dir / "config.json")
+    """The history copy of the job config, with secret-bearing keys
+    redacted (the live tony-final.json in the staging dir keeps the real
+    values — only executors and the client read that one). Atomic: a
+    concurrently-scanning history server must never read a half-written
+    file."""
+    import os
+
+    target = job_dir / "config.json"
+    tmp = job_dir / ".config.json.tmp"
+    tmp.write_text(
+        json.dumps(redact_config(conf.to_dict()), indent=2, sort_keys=True)
+        + "\n"
+    )
+    os.replace(tmp, target)
 
 
 def create_history_file(job_dir: Path, metadata: JobMetadata) -> Path:

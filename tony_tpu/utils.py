@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Mapping, Sequence, TypeVar
 
+from tony_tpu import constants
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
 
@@ -117,7 +118,38 @@ def build_user_command(
                 "venv %s has no bin/python; using %r", venv_zip, python
             )
     params = conf.get_str(keys.K_TASK_PARAMS)
-    return f"{python} {executes} {params}".strip(), venv_dir
+    command = f"{python} {executes} {params}".strip()
+    if conf.get_bool(keys.K_DOCKER_ENABLED, False):
+        # Docker pass-through (the reference delegates this to YARN's
+        # docker runtime via tony.application.docker.*): the user process
+        # runs inside the image with the cwd mounted and host networking,
+        # so the injected env contract (rendezvous ports, coordinator
+        # address) still works. The contract env is forwarded explicitly
+        # (`-e VAR` picks the value up from the launching environment) —
+        # piping the whole host env through an env-file breaks on multiline
+        # values like exported bash functions.
+        if venv_dir is not None:
+            raise ValueError(
+                f"{keys.K_PYTHON_VENV} and {keys.K_DOCKER_ENABLED} are "
+                f"mutually exclusive — a host-extracted venv interpreter "
+                f"cannot run inside the image; bake dependencies into the "
+                f"image instead"
+            )
+        image = conf.get_str(keys.K_DOCKER_IMAGE)
+        if not image:
+            raise ValueError(
+                f"{keys.K_DOCKER_ENABLED} is set but {keys.K_DOCKER_IMAGE} "
+                f"is empty"
+            )
+        forwarded = list(constants.DOCKER_FORWARD_ENV) + sorted(
+            parse_key_values(conf.get_str(keys.K_SHELL_ENV))
+        )
+        env_flags = " ".join(f"-e {name}" for name in forwarded)
+        command = (
+            f"docker run --rm --network=host {env_flags} "
+            f"-v \"$PWD\":/workdir -w /workdir {image} {command}"
+        )
+    return command, venv_dir
 
 
 # ---------------------------------------------------------------------------
