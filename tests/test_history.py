@@ -121,6 +121,20 @@ class TestHistoryServer:
         finally:
             server.stop()
 
+    def test_shell_env_values_redacted_names_kept(self):
+        """--shell_env values routinely carry tokens the key-name heuristic
+        can't see (HF_TOKEN=...); names stay browsable, values do not."""
+        from tony_tpu.history.writer import redact_config
+
+        out = redact_config({
+            "tony.application.shell-env": "HF_TOKEN=supersecret,MODE=fast",
+            "tony.worker.env": "API_KEY=abc",
+            "tony.application.name": "keepme",
+        })
+        assert "supersecret" not in str(out) and "abc" not in str(out)
+        assert out["tony.application.shell-env"].startswith("HF_TOKEN=<redacted>")
+        assert out["tony.application.name"] == "keepme"
+
     def test_binds_localhost_by_default(self, tmp_path):
         server = HistoryServer(str(tmp_path), port=0)
         assert server.httpd.server_address[0] == "127.0.0.1"

@@ -71,6 +71,13 @@ def test_build_user_command_docker_passthrough():
     with pytest.raises(ValueError, match="docker.image"):
         utils.build_user_command(conf, "t")
 
+    # venv + docker rejected BEFORE extraction (the nonexistent zip would
+    # raise OSError if the order were wrong, and nothing may leak on disk)
+    conf.set(keys.K_DOCKER_IMAGE, "img")
+    conf.set(keys.K_PYTHON_VENV, "does-not-exist.zip")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        utils.build_user_command(conf, "t")
+
 
 def test_parse_container_requests():
     """Analogue of TestUtils.testParseContainerRequests (reference :55-78):

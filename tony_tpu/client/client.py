@@ -62,6 +62,11 @@ class TonyClient:
     def init(self, argv: list[str]) -> "TonyClient":
         args, _ = build_arg_parser().parse_known_args(argv)
         self.conf = load_job_config(conf_file=args.conf_file, overrides=args.conf)
+        # Build stamp rides the frozen conf into every process + history
+        # (VersionInfo.injectVersionInfo at TonyClient.java:139).
+        from tony_tpu.version import inject_version_info
+
+        inject_version_info(self.conf)
         cli_map = {
             keys.K_EXECUTES: args.executes,
             keys.K_SRC_DIR: args.src_dir,

@@ -96,9 +96,15 @@ K_CLIENT_MONITOR_INTERVAL_MS = TONY_PREFIX + "client.monitor-interval"
 K_PROFILER_ENABLED = TONY_PREFIX + "profiler.enabled"
 K_TENSORBOARD_ENABLED = TONY_PREFIX + "tensorboard.enabled"
 
-# --- version info (gradle/version-info.gradle analogue) --------------------
+# --- version info (gradle/version-info.gradle analogue; stamped into the
+# conf at submission by tony_tpu.version.inject_version_info) ---------------
 VERSION_INFO_PREFIX = TONY_PREFIX + "version-info."
 K_VERSION_INFO_VERSION = VERSION_INFO_PREFIX + "version"
+K_VERSION_INFO_REVISION = VERSION_INFO_PREFIX + "revision"
+K_VERSION_INFO_BRANCH = VERSION_INFO_PREFIX + "branch"
+K_VERSION_INFO_USER = VERSION_INFO_PREFIX + "user"
+K_VERSION_INFO_DATE = VERSION_INFO_PREFIX + "date"
+K_VERSION_INFO_URL = VERSION_INFO_PREFIX + "url"
 
 DEFAULTS: dict[str, object] = {
     K_APPLICATION_NAME: "TonyTpuApplication",
@@ -143,6 +149,11 @@ DEFAULTS: dict[str, object] = {
     K_PROFILER_ENABLED: False,
     K_TENSORBOARD_ENABLED: True,
     K_VERSION_INFO_VERSION: "",
+    K_VERSION_INFO_REVISION: "",
+    K_VERSION_INFO_BRANCH: "",
+    K_VERSION_INFO_USER: "",
+    K_VERSION_INFO_DATE: "",
+    K_VERSION_INFO_URL: "",
 }
 
 # --- dynamic per-job-type key families -------------------------------------

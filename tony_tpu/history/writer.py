@@ -74,14 +74,32 @@ def setup_job_dir(history_location: str, app_id: str, started_ms: int) -> Path:
 # RPC secret in particular — serving it would let anyone who can reach the
 # history port authenticate to a live job's RPC (e.g. finish_application).
 _SECRET_KEY_RE = re.compile(r"secret|password|token", re.IGNORECASE)
+# Keys whose VALUES are user env assignments ("K=V,K2=V2"): the variable
+# names stay visible, the values (which routinely carry tokens the key-name
+# heuristic can't see, e.g. --shell_env HF_TOKEN=...) do not.
+_ENV_VALUED_KEY_RE = re.compile(r"\.(shell-env|env)$")
 REDACTED = "<redacted>"
 
 
+def _redact_env_assignments(value: object) -> object:
+    if not isinstance(value, str) or not value:
+        return value
+    return ",".join(
+        f"{pair.split('=', 1)[0]}={REDACTED}" if "=" in pair else pair
+        for pair in value.split(",")
+    )
+
+
 def redact_config(cfg: dict) -> dict:
-    return {
-        k: (REDACTED if _SECRET_KEY_RE.search(k) else v)
-        for k, v in cfg.items()
-    }
+    out = {}
+    for k, v in cfg.items():
+        if _SECRET_KEY_RE.search(k):
+            out[k] = REDACTED
+        elif _ENV_VALUED_KEY_RE.search(k):
+            out[k] = _redact_env_assignments(v)
+        else:
+            out[k] = v
+    return out
 
 
 def write_config_file(job_dir: Path, conf: TonyConfiguration) -> None:

@@ -1,0 +1,4 @@
+from tony_tpu.integrations.workflow import (  # noqa: F401
+    props_to_argv,
+    submit_from_props,
+)

@@ -1,0 +1,96 @@
+"""Workflow-engine integration — the analogue of ``tony-azkaban``'s
+``TensorFlowJob`` job type (tony-azkaban/.../TensorFlowJob.java:24-140 and
+``TensorFlowJobArg.java:8-25``): an external scheduler hands over a flat
+properties map; we translate it into a tony_tpu submission.
+
+Mapping (mirroring ``getMainArguments:86-140``):
+
+* ``executes`` / ``src_dir`` / ``python_binary_path`` / ``python_venv`` /
+  ``task_params`` → the matching ``--<name>`` CLI args.
+* ``worker_env.<NAME>`` → one ``--shell_env NAME=value`` each
+  (``WORKER_ENV_PREFIX`` handling at :98-101).
+* every ``tony.*`` prop → collected into a generated per-job config file
+  (the ``_tony-conf-<jobid>/tony.xml`` trick at :123-135) passed as
+  ``--conf_file``, so scheduler-level tuning reaches the job without
+  touching its sources.
+
+Any workflow engine with a "run this Python callable/CLI" job type (Airflow
+operator, Luigi task, a plain cron) can call ``submit_from_props``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Mapping
+
+log = logging.getLogger(__name__)
+
+WORKER_ENV_PREFIX = "worker_env."  # TensorFlowJob.java:27
+TONY_CONF_PREFIX = "tony."
+
+# props that map 1:1 onto CLI args (TensorFlowJobArg.java:8-25; hdfs_classpath
+# has no substrate here — the cluster submitter stages the framework itself).
+_DIRECT_ARGS = (
+    "executes",
+    "src_dir",
+    "python_binary_path",
+    "python_venv",
+    "task_params",
+    "framework",
+    "app_name",
+)
+
+
+def props_to_argv(
+    props: Mapping[str, str], job_id: str, working_dir: str | Path = "."
+) -> list[str]:
+    """Translate a scheduler's flat props into CLI argv. ``tony.*`` props
+    are written to ``<working_dir>/_tony-conf-<job_id>/tony.json`` and
+    passed via ``--conf_file``."""
+    # --name=value form throughout: argparse would reject a bare
+    # option-like value (e.g. task_params="--fast") as a missing argument.
+    argv: list[str] = []
+    for name in _DIRECT_ARGS:
+        value = props.get(name)
+        if value is not None:
+            argv.append(f"--{name}={value}")
+    for key, value in sorted(props.items()):
+        if key.startswith(WORKER_ENV_PREFIX):
+            env_name = key[len(WORKER_ENV_PREFIX):]
+            argv.append(f"--shell_env={env_name}={value}")
+    tony_confs = {
+        k: v for k, v in props.items() if k.startswith(TONY_CONF_PREFIX)
+    }
+    if tony_confs:
+        conf_dir = Path(working_dir) / f"_tony-conf-{job_id}"
+        conf_dir.mkdir(parents=True, exist_ok=True)
+        conf_file = conf_dir / "tony.json"
+        conf_file.write_text(json.dumps(tony_confs, indent=2, sort_keys=True))
+        argv.append(f"--conf_file={conf_file}")
+    return argv
+
+
+def submit_from_props(
+    props: Mapping[str, str],
+    job_id: str,
+    *,
+    submitter: str = "cluster",
+    working_dir: str | Path = ".",
+) -> int:
+    """Run a submission from scheduler props (the ``TensorFlowJob.run``
+    analogue). ``submitter`` picks the CLI mode (cluster | local |
+    notebook); returns the exit status."""
+    from tony_tpu.client.cli import SUBMITTERS
+
+    try:
+        submit = SUBMITTERS[submitter]
+    except KeyError:
+        raise ValueError(
+            f"unknown submitter {submitter!r}; expected one of "
+            f"{sorted(SUBMITTERS)}"
+        ) from None
+    argv = props_to_argv(props, job_id, working_dir)
+    log.info("workflow job %s: submitting with argv %s", job_id, argv)
+    return submit(argv)

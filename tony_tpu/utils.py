@@ -99,8 +99,18 @@ def build_user_command(
     if not executes:
         raise ValueError(f"{keys.K_EXECUTES} is required")
     python = conf.get_str(keys.K_PYTHON_BINARY, "python") or "python"
+    docker_enabled = conf.get_bool(keys.K_DOCKER_ENABLED, False)
     venv_dir: Path | None = None
     venv_zip = conf.get_str(keys.K_PYTHON_VENV)
+    if venv_zip and docker_enabled:
+        # Checked BEFORE extraction: raising afterwards would leak the
+        # extracted venv-<tag> dir (the caller never gets it to clean up).
+        raise ValueError(
+            f"{keys.K_PYTHON_VENV} and {keys.K_DOCKER_ENABLED} are "
+            f"mutually exclusive — a host-extracted venv interpreter "
+            f"cannot run inside the image; bake dependencies into the "
+            f"image instead"
+        )
     if venv_zip:
         # Per-run extraction dir: concurrent runs sharing a cwd must not
         # race on one ./venv, and a stale venv from a previous job must
@@ -119,7 +129,7 @@ def build_user_command(
             )
     params = conf.get_str(keys.K_TASK_PARAMS)
     command = f"{python} {executes} {params}".strip()
-    if conf.get_bool(keys.K_DOCKER_ENABLED, False):
+    if docker_enabled:
         # Docker pass-through (the reference delegates this to YARN's
         # docker runtime via tony.application.docker.*): the user process
         # runs inside the image with the cwd mounted and host networking,
@@ -128,13 +138,6 @@ def build_user_command(
         # (`-e VAR` picks the value up from the launching environment) —
         # piping the whole host env through an env-file breaks on multiline
         # values like exported bash functions.
-        if venv_dir is not None:
-            raise ValueError(
-                f"{keys.K_PYTHON_VENV} and {keys.K_DOCKER_ENABLED} are "
-                f"mutually exclusive — a host-extracted venv interpreter "
-                f"cannot run inside the image; bake dependencies into the "
-                f"image instead"
-            )
         image = conf.get_str(keys.K_DOCKER_IMAGE)
         if not image:
             raise ValueError(

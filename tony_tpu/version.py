@@ -1,0 +1,66 @@
+"""Build/version stamping — the analogue of ``VersionInfo.java`` +
+``gradle/version-info.gradle:8-60``: the reference bakes git
+revision/branch/user/date into ``version-info.properties`` at build time and
+injects it into the job conf at submission (``TonyClient.java:139``), so
+every frozen config and history record says exactly which build ran it.
+
+Python has no build step to bake at, so the stamp is collected at
+submission time: the package ``__version__`` always; git
+revision/branch/url only when the framework runs from its own checkout
+(``Unknown`` from an installed copy)."""
+
+from __future__ import annotations
+
+import getpass
+import subprocess
+import time
+from pathlib import Path
+
+import tony_tpu
+from tony_tpu.conf import keys
+
+_UNKNOWN = "Unknown"
+
+
+def _git(args: list[str], cwd: Path) -> str:
+    try:
+        out = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True, timeout=10
+        )
+        return out.stdout.strip() if out.returncode == 0 else _UNKNOWN
+    except (OSError, subprocess.TimeoutExpired):
+        return _UNKNOWN
+
+
+def collect_version_info() -> dict[str, str]:
+    repo = Path(tony_tpu.__file__).resolve().parent.parent
+    # Only trust git when the framework actually runs from its own checkout
+    # (.git beside the package). From site-packages, `git` would walk up
+    # and stamp whatever repo happens to ENCLOSE the virtualenv — the
+    # user's project, not this framework.
+    if (repo / ".git").exists():
+        revision = _git(["rev-parse", "HEAD"], repo)
+        branch = _git(["rev-parse", "--abbrev-ref", "HEAD"], repo)
+        url = _git(["remote", "get-url", "origin"], repo)
+    else:
+        revision = branch = url = _UNKNOWN
+    return {
+        "version": getattr(tony_tpu, "__version__", _UNKNOWN),
+        "revision": revision or _UNKNOWN,
+        "branch": branch or _UNKNOWN,
+        "user": getpass.getuser(),
+        "date": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "url": url or _UNKNOWN,
+    }
+
+
+def inject_version_info(conf) -> None:
+    """Stamp the job conf (TonyClient.java:139 analogue); the stamp rides
+    the frozen tony-final.json into every process and the history record."""
+    info = collect_version_info()
+    conf.set(keys.K_VERSION_INFO_VERSION, info["version"])
+    conf.set(keys.K_VERSION_INFO_REVISION, info["revision"])
+    conf.set(keys.K_VERSION_INFO_BRANCH, info["branch"])
+    conf.set(keys.K_VERSION_INFO_USER, info["user"])
+    conf.set(keys.K_VERSION_INFO_DATE, info["date"])
+    conf.set(keys.K_VERSION_INFO_URL, info["url"])
