@@ -1,0 +1,29 @@
+"""Data-plane handoff fixture: each executor process builds a reader via
+tony_tpu.runtime.sharded_reader (identity from the injected env) and writes
+the record ids it read to TONY_LOG_DIR; the test asserts the shards form an
+exact cover — every record read exactly once across the job."""
+import json
+import os
+import sys
+
+import tony_tpu.runtime as rt
+
+ctx = rt.task_context()
+data = os.environ["READER_DATA"]
+reader = rt.sharded_reader([data], fmt="jsonl", batch_size=4)
+schema = json.loads(reader.schema_json())
+if schema["format"] != "jsonl":
+    print(f"bad schema: {schema}", file=sys.stderr)
+    sys.exit(5)
+
+ids = []
+for batch in reader:
+    ids.extend(rec["id"] for rec in batch)
+reader.close()
+
+out = os.path.join(os.environ["TONY_LOG_DIR"],
+                   f"reader-shard-{ctx.process_id}.json")
+with open(out, "w") as f:
+    json.dump(ids, f)
+print(f"process {ctx.process_id} read {len(ids)} records")
+sys.exit(0)

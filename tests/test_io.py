@@ -121,3 +121,60 @@ class TestTokenReader:
         for b in batches:
             assert b.shape == (16, rl)
             assert len(b.sharding.device_set) == 8
+
+
+class TestConsumerApis:
+    """Schema introspection + spill-to-file (HdfsAvroFileSplitReader
+    getSchemaJson:446-463, nextBatchFile/LocalSpill:503-542 analogues)."""
+
+    def _jsonl(self, tmp_path, n=10):
+        p = tmp_path / "d.jsonl"
+        p.write_text("".join(
+            json.dumps({"id": i, "text": f"t{i}"}) + "\n" for i in range(n)
+        ))
+        return str(p)
+
+    def test_schema_json_jsonl(self, tmp_path):
+        with ShardedRecordReader([self._jsonl(tmp_path)]) as r:
+            schema = json.loads(r.schema_json())
+        assert schema == {
+            "format": "jsonl", "fields": {"id": "int", "text": "str"}
+        }
+
+    def test_schema_json_does_not_consume_records(self, tmp_path):
+        with ShardedRecordReader(
+            [self._jsonl(tmp_path, 6)], batch_size=100
+        ) as r:
+            r.schema_json()
+            batch = r.next_batch()
+        assert [rec["id"] for rec in batch] == list(range(6))
+
+    def test_schema_json_tokens(self, tmp_path):
+        p = tmp_path / "t.bin"
+        np.arange(32, dtype=np.uint16).tofile(p)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=8, dtype=np.uint16
+        ) as r:
+            schema = json.loads(r.schema_json())
+        assert schema == {"format": "tokens", "dtype": "uint16",
+                          "record_len": 8}
+
+    def test_next_batch_file_tokens_mmap_ready(self, tmp_path):
+        p = tmp_path / "t.bin"
+        np.arange(64, dtype=np.uint16).tofile(p)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=8, dtype=np.uint16,
+            batch_size=4,
+        ) as r:
+            path = r.next_batch_file(tmp_path)
+        arr = np.load(path, mmap_mode="r")
+        assert arr.shape == (4, 8) and arr[0, 0] == 0
+
+    def test_next_batch_file_jsonl_and_eof(self, tmp_path):
+        with ShardedRecordReader(
+            [self._jsonl(tmp_path, 3)], batch_size=10
+        ) as r:
+            path = r.next_batch_file(tmp_path)
+            lines = open(path).read().splitlines()
+            assert [json.loads(l)["id"] for l in lines] == [0, 1, 2]
+            assert r.next_batch_file(tmp_path) is None

@@ -103,7 +103,16 @@ class TonyClient:
             # Executors must unzip the *staged* copy: on a remote deployment
             # only the staging location is shared, not the client's home dir.
             self.conf.set(keys.K_PYTHON_VENV, str(staged))
-        self.conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+        # Fresh per-job credentials (TonyClient.getTokens analogue); the
+        # frozen conf carries them, so restrict it to the submitting user.
+        from tony_tpu import security
+
+        security.prepare_job_security(self.conf)
+        secure = self.conf.get_bool(keys.K_SECURITY_ENABLED)
+        self.conf.write_final(
+            app_dir / constants.TONY_FINAL_CONF,
+            mode=0o600 if secure else None,
+        )
         return app_dir
 
     # -- submit + monitor (TonyClient.run:146-208) --------------------------
@@ -148,7 +157,11 @@ class TonyClient:
         host, port = addr.rsplit(":", 1)
         secret = None
         if self.conf.get_bool(keys.K_SECURITY_ENABLED):
-            secret = self.conf.get_str(keys.K_SECRET_KEY)
+            from tony_tpu import security
+
+            secret = security.role_token(
+                self.conf.get_str(keys.K_SECRET_KEY), security.CLIENT_ROLE
+            )
         return ApplicationRpcClient(host, int(port), secret=secret,
                                     call_retries=retries)
 

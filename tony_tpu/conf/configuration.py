@@ -117,13 +117,24 @@ class TonyConfiguration:
         return sorted(names)
 
     # -- freeze / thaw -----------------------------------------------------
-    def write_final(self, path: str | os.PathLike[str]) -> None:
+    def write_final(
+        self, path: str | os.PathLike[str], mode: int | None = None
+    ) -> None:
+        """Atomically freeze to ``path``. ``mode`` (e.g. 0o600 for a conf
+        carrying job credentials) is applied to the temp file BEFORE the
+        rename, so the content is never readable under a wider mode."""
         p = Path(path)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.with_suffix(p.suffix + ".tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
+        fd = os.open(
+            tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+            mode if mode is not None else 0o644,
+        )
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump(self._props, f, indent=2, sort_keys=True)
             f.write("\n")
+        if mode is not None:
+            os.chmod(tmp, mode)  # O_CREAT mode is masked by umask; force it
         os.replace(tmp, p)
 
     @classmethod

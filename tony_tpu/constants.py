@@ -64,6 +64,7 @@ DOCKER_FORWARD_ENV = (
 
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"
+TONY_EXECUTOR_TOKEN = "TONY_EXECUTOR_TOKEN"  # role credential, not the secret
 TONY_TASK_COMMAND = "TONY_TASK_COMMAND"
 TONY_CONF_PATH = "TONY_CONF_PATH"
 
@@ -72,6 +73,7 @@ TONY_CONF_PATH = "TONY_CONF_PATH"
 # ---------------------------------------------------------------------------
 TONY_ARCHIVE = "tony.zip"
 TONY_FINAL_CONF = "tony-final.json"
+TONY_EXECUTOR_CONF = "tony-executor.json"  # secret-stripped, executor audience
 TONY_DEFAULT_CONF = "tony-default.json"
 TONY_SITE_CONF = "tony-site.json"
 TONY_JOB_CONF = "tony.json"

@@ -112,12 +112,25 @@ class TonyCoordinator:
         # against the files being written.
         self._final_published = threading.Event()
 
-        secret = None
+        tokens = None
+        self._executor_token: str | None = None
         if conf.get_bool(keys.K_SECURITY_ENABLED):
+            # Per-role credentials derived from the job secret, enforced
+            # against security.METHOD_ACL (the ClientToAM-token +
+            # TFPolicyProvider analogue). Executors receive ONLY their
+            # derived token (env) plus a secret-stripped conf — never the
+            # job secret, or they could mint the client role themselves.
+            from tony_tpu import security
+
             secret = conf.get_str(keys.K_SECRET_KEY)
+            tokens = security.role_tokens(secret)
+            self._executor_token = security.role_token(
+                secret, security.EXECUTOR_ROLE
+            )
         lo, hi = (int(x) for x in conf.get_str(keys.K_AM_RPC_PORT_RANGE, "10000-15000").split("-"))
         self.rpc_server = ApplicationRpcServer(
-            _RpcForClient(self), host="0.0.0.0", port_range=(lo, hi), secret=secret
+            _RpcForClient(self), host="0.0.0.0", port_range=(lo, hi),
+            role_tokens=tokens,
         )
         self.liveness = LivenessMonitor(
             heartbeat_interval_ms=conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000),
@@ -134,6 +147,15 @@ class TonyCoordinator:
         (self.app_dir / "coordinator.addr").write_text(
             f"127.0.0.1:{self.rpc_server.port}\n"
         )
+        if self._executor_token is not None:
+            # Executor-audience conf: everything but the job secret. Tasks
+            # get pointed at this copy (plus TONY_EXECUTOR_TOKEN), the way
+            # the reference ships containers credentials, not the secret
+            # manager (setupContainerCredentials:858-874).
+            stripped = TonyConfiguration(load_defaults=False)
+            stripped.set_all(self.conf.to_dict())
+            stripped.set(keys.K_SECRET_KEY, "")
+            stripped.write_final(self.app_dir / constants.TONY_EXECUTOR_CONF)
         hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
         if hist:
             job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
@@ -309,8 +331,16 @@ class TonyCoordinator:
             constants.TASK_NUM: str(n),
             constants.SESSION_ID: str(self.session.session_id),
             constants.TONY_AM_ADDRESS: f"127.0.0.1:{self.rpc_server.port}",
-            constants.TONY_CONF_PATH: str(self.app_dir / constants.TONY_FINAL_CONF),
+            constants.TONY_CONF_PATH: str(
+                self.app_dir / (
+                    constants.TONY_EXECUTOR_CONF
+                    if self._executor_token is not None
+                    else constants.TONY_FINAL_CONF
+                )
+            ),
         }
+        if self._executor_token is not None:
+            env[constants.TONY_EXECUTOR_TOKEN] = self._executor_token
         if self._model_params is not None:
             env[constants.TASK_PARAM_KEY] = self._model_params
         plan = self.slice_plans.get(task.job_name)

@@ -71,9 +71,10 @@ class TaskExecutor:
         self.am_host, _, am_port = env[constants.TONY_AM_ADDRESS].rpartition(":")
         self.am_port = int(am_port)
         self.conf = TonyConfiguration.from_final(env[constants.TONY_CONF_PATH])
-        secret = None
-        if self.conf.get_bool(keys.K_SECURITY_ENABLED):
-            secret = self.conf.get_str(keys.K_SECRET_KEY)
+        # The coordinator hands executors their role credential directly —
+        # the conf they can read is secret-stripped, so they cannot derive
+        # any other role's token (privilege separation, security.py).
+        secret = env.get(constants.TONY_EXECUTOR_TOKEN)
         self.client = ApplicationRpcClient(self.am_host, self.am_port, secret=secret)
         # The rendezvous port: what this task advertises as host:port. Under
         # the JAX runtime, chief:0's port becomes the jax.distributed

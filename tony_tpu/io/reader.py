@@ -158,7 +158,49 @@ class ShardedRecordReader:
                 if line:
                     yield json.loads(line)
 
-    # -- consumer API (nextBatch*, :503-542) --------------------------------
+    # -- consumer API (getSchemaJson:446-463, nextBatch*:503-542) -----------
+    def schema_json(self) -> str:
+        """Schema introspection (the getSchemaJson analogue). ``tokens``
+        describes the fixed record layout; ``jsonl`` reports the field
+        names/types of the shard's first record (without consuming it)."""
+        if self.fmt == "tokens":
+            return json.dumps({
+                "format": "tokens",
+                "dtype": self.dtype.name,
+                "record_len": self.record_len,
+            })
+        for seg in self.segments:
+            for rec in self._iter_jsonl(seg):
+                fields = (
+                    {k: type(v).__name__ for k, v in rec.items()}
+                    if isinstance(rec, dict) else type(rec).__name__
+                )
+                return json.dumps({"format": "jsonl", "fields": fields})
+        return json.dumps({"format": "jsonl", "fields": {}})
+
+    def next_batch_file(self, directory: str | os.PathLike[str] = ".") -> str | None:
+        """One batch spilled to a local file, returning its path — the
+        nextBatchFile/LocalSpill analogue (:503-542) for consumers that
+        want to mmap large batches instead of holding them in the Python
+        heap. ``tokens`` batches land as ``.npy`` (np.load/mmap_mode
+        ready); ``jsonl`` batches as newline-delimited ``.jsonl``. The
+        caller owns deleting the file."""
+        import tempfile
+
+        batch = self.next_batch()
+        if batch is None:
+            return None
+        if self.fmt == "tokens":
+            fd, path = tempfile.mkstemp(suffix=".npy", dir=str(directory))
+            with os.fdopen(fd, "wb") as f:
+                np.save(f, batch)
+        else:
+            fd, path = tempfile.mkstemp(suffix=".jsonl", dir=str(directory))
+            with os.fdopen(fd, "w") as f:
+                for rec in batch:
+                    f.write(json.dumps(rec) + "\n")
+        return path
+
     def next_batch(self) -> list[Any] | np.ndarray | None:
         """One batch, or None at end of shard (batches may be short at the
         tail). Token format returns [batch, record_len] arrays."""

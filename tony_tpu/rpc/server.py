@@ -59,9 +59,14 @@ class ApplicationRpcServer:
         host: str = "0.0.0.0",
         port_range: tuple[int, int] = (10000, 15000),
         secret: str | None = None,
+        role_tokens: dict[str, str] | None = None,
     ) -> None:
+        """``secret`` is the flat shared-secret mode; ``role_tokens``
+        (token → role) additionally enforces ``security.METHOD_ACL`` per
+        caller role — the TFPolicyProvider analogue."""
         self._impl = impl
         self._secret = secret
+        self._role_tokens = role_tokens
         self.host = host
         self.port = self._bind(host, port_range)
         self._thread: threading.Thread | None = None
@@ -97,11 +102,27 @@ class ApplicationRpcServer:
     def dispatch(self, req: Any) -> dict[str, Any]:
         if not isinstance(req, dict):
             return {"ok": False, "error": "request must be an object"}
-        if self._secret is not None and req.get("auth") != self._secret:
+        role: str | None = None
+        if self._role_tokens is not None:
+            auth = req.get("auth")
+            role = (
+                self._role_tokens.get(auth) if isinstance(auth, str) else None
+            )
+            if role is None:
+                return {"ok": False, "error": "authentication failed"}
+        elif self._secret is not None and req.get("auth") != self._secret:
             return {"ok": False, "error": "authentication failed"}
         method = req.get("method")
         if method not in RPC_METHODS:
             return {"ok": False, "error": f"unknown method {method!r}"}
+        if role is not None:
+            from tony_tpu.security import METHOD_ACL
+
+            if role not in METHOD_ACL.get(method, frozenset()):
+                return {
+                    "ok": False,
+                    "error": f"role {role!r} is not permitted to call {method}",
+                }
         wanted = RPC_METHODS[method]
         args = req.get("args") or {}
         if set(args) != set(wanted):

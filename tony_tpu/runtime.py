@@ -76,6 +76,32 @@ def tensorboard_port() -> int | None:
     return int(raw) if raw else None
 
 
+def sharded_reader(paths: list[str], **kwargs):
+    """The executor ↔ user-script data-plane handoff. Where the reference
+    hands user Python an HDFS reader over py4j
+    (TaskExecutor.getHdfsAvroFileSplitReader:281-294), here the user script
+    shares the executor's process tree and just asks for a reader sharded
+    by its injected identity::
+
+        reader = tony_tpu.runtime.sharded_reader(
+            ["data/*.jsonl" files...], fmt="jsonl")
+        print(reader.schema_json())
+        for batch in reader: ...
+
+    Sharding uses the global process identity (process_id/num_processes),
+    so every record is read exactly once across the whole job regardless of
+    job-type layout. All ShardedRecordReader kwargs pass through."""
+    from tony_tpu.io.reader import ShardedRecordReader
+
+    ctx = task_context()
+    return ShardedRecordReader(
+        paths,
+        task_index=ctx.process_id,
+        num_tasks=ctx.num_processes,
+        **kwargs,
+    )
+
+
 def slice_topology() -> dict | None:
     """The coordinator's planned slice for this job type (accelerator_type,
     num_slices, hosts_per_slice, chips_per_slice), or None off-TPU. Use it

@@ -1,0 +1,80 @@
+"""Job credentials + RPC method ACLs.
+
+The analogue of the reference's security plumbing, re-based from Kerberos
+onto per-job HMAC tokens:
+
+* ``TonyClient.getTokens:568-621`` fetched fresh delegation tokens for
+  every submission → ``prepare_job_security`` mints a fresh random job
+  secret per submission when security is enabled (a static shared password
+  in the conf defeats the point; the explicit-key path remains for
+  deployments that manage secrets externally).
+* The ClientToAM token (``TonyApplicationMaster.prepare:401-411``,
+  ``TFClientSecurityInfo.java:24-50``) → per-role tokens derived from the
+  job secret with HMAC-SHA256, so the client and the executors present
+  different credentials.
+* ``TFPolicyProvider.java:15-26`` (protocol ACLs) → ``METHOD_ACL``: which
+  role may invoke which RPC method. An executor's credential cannot call
+  ``finish_application``; a client's cannot join the rendezvous.
+
+Tokens ride the frozen ``tony-final.json`` (mode 0600 when security is on)
+exactly as the reference ships credentials in the container launch context
+(``setupContainerCredentials:858-874``).
+
+Distribution keeps the roles separated: the job secret lives only in the
+client/coordinator's ``tony-final.json`` (written mode 0600); executors are
+pointed at a secret-STRIPPED ``tony-executor.json`` and receive just their
+derived role token via ``TONY_EXECUTOR_TOKEN`` — a compromised executor
+cannot mint any other role's credential.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets as _secrets
+
+from tony_tpu.conf import keys
+
+CLIENT_ROLE = "client"
+EXECUTOR_ROLE = "executor"
+
+# The TFPolicyProvider analogue: RPC method → roles allowed to call it.
+METHOD_ACL: dict[str, frozenset[str]] = {
+    "register_worker_spec": frozenset({EXECUTOR_ROLE}),
+    "task_executor_heartbeat": frozenset({EXECUTOR_ROLE}),
+    "register_execution_result": frozenset({EXECUTOR_ROLE}),
+    "register_tensorboard_url": frozenset({EXECUTOR_ROLE}),
+    "get_cluster_spec": frozenset({EXECUTOR_ROLE, CLIENT_ROLE}),
+    "get_task_urls": frozenset({CLIENT_ROLE}),
+    "get_application_status": frozenset({CLIENT_ROLE}),
+    "finish_application": frozenset({CLIENT_ROLE}),
+}
+
+_PLACEHOLDER_SECRETS = ("", "dev")  # never acceptable as live credentials
+
+
+def generate_job_secret() -> str:
+    return _secrets.token_hex(16)
+
+
+def role_token(job_secret: str, role: str) -> str:
+    return hmac.new(
+        job_secret.encode(), role.encode(), hashlib.sha256
+    ).hexdigest()
+
+
+def role_tokens(job_secret: str) -> dict[str, str]:
+    """token → role map the RPC server authenticates against."""
+    return {
+        role_token(job_secret, role): role
+        for role in (CLIENT_ROLE, EXECUTOR_ROLE)
+    }
+
+
+def prepare_job_security(conf) -> None:
+    """Client-side, at staging (the getTokens seam): with security enabled,
+    mint a fresh per-job secret unless the deployment supplied a real one."""
+    if not conf.get_bool(keys.K_SECURITY_ENABLED):
+        return
+    if conf.get_str(keys.K_SECRET_KEY) in _PLACEHOLDER_SECRETS:
+        conf.set(keys.K_SECRET_KEY, generate_job_secret())
