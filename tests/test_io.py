@@ -178,3 +178,98 @@ class TestConsumerApis:
             lines = open(path).read().splitlines()
             assert [json.loads(l)["id"] for l in lines] == [0, 1, 2]
             assert r.next_batch_file(tmp_path) is None
+
+
+class TestNativeDecoder:
+    """Native C++ data-plane kernels (native/tony_io.cc) pinned to the
+    pure-Python paths; all tests skip when the library isn't built
+    (`make -C native`)."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from tony_tpu.io import native
+
+        if not native.available():
+            pytest.skip("libtony_io.so not built")
+
+    def test_scan_record_starts_matches_python(self):
+        from tony_tpu.io import native
+
+        chunk = b'{"a":1}\n{"b":2}\n{"c":3}\npartial'
+        got = native.scan_record_starts(chunk)
+        want = [m + 1 for m in range(len(chunk) - 1) if chunk[m:m + 1] == b"\n"]
+        assert got == want == [8, 16, 24]
+        assert native.count_records(chunk) == 3
+        assert native.scan_record_starts(b"") == []
+        assert native.scan_record_starts(b"no newline") == []
+        # trailing newline: no successor byte, so no start offset
+        assert native.scan_record_starts(b"x\n") == []
+
+    def test_token_read_matches_python_fallback(self, tmp_path, monkeypatch):
+        p = tmp_path / "t.bin"
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 2**16, size=(67, 8)).astype(np.uint16)
+        data.tofile(p)
+
+        def read_all(force_fallback):
+            from tony_tpu.io import native
+
+            if force_fallback:
+                monkeypatch.setattr(native, "available", lambda: False)
+            r = ShardedRecordReader(
+                [str(p)], fmt="tokens", record_len=8, dtype=np.uint16,
+                batch_size=67,
+            )
+            try:
+                return r.next_batch()
+            finally:
+                r.close()
+                monkeypatch.undo()
+
+        native_batch = read_all(False)
+        python_batch = read_all(True)
+        np.testing.assert_array_equal(native_batch, python_batch)
+        np.testing.assert_array_equal(native_batch, data)
+
+    def test_native_read_chunking_boundaries(self, tmp_path):
+        # more records than one native chunk -> multiple preads
+        p = tmp_path / "big.bin"
+        n = ShardedRecordReader._CHUNK_RECORDS * 2 + 7
+        data = np.arange(n * 4, dtype=np.uint16).reshape(n, 4)
+        data.tofile(p)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=4, dtype=np.uint16,
+            batch_size=n,
+        ) as r:
+            batch = r.next_batch()
+        np.testing.assert_array_equal(batch, data)
+
+    def test_exactly_once_with_native_path(self, tmp_path):
+        p = tmp_path / "s.bin"
+        np.arange(40 * 4, dtype=np.uint16).tofile(p)
+        seen = []
+        for idx in range(3):
+            with ShardedRecordReader(
+                [str(p)], task_index=idx, num_tasks=3, fmt="tokens",
+                record_len=4, dtype=np.uint16, batch_size=100,
+            ) as r:
+                b = r.next_batch()
+                if b is not None:
+                    seen.extend(int(row[0]) for row in b)
+        assert sorted(seen) == [i * 4 for i in range(40)]
+
+    def test_batches_are_writable_both_paths(self, tmp_path, monkeypatch):
+        from tony_tpu.io import native
+
+        p = tmp_path / "w.bin"
+        np.arange(32, dtype=np.uint16).tofile(p)
+        for force_py in (False, True):
+            if force_py:
+                monkeypatch.setattr(native, "available", lambda: False)
+            with ShardedRecordReader(
+                [str(p)], fmt="tokens", record_len=8, dtype=np.uint16,
+                batch_size=2,
+            ) as r:
+                b = r.next_batch()
+                b *= 2  # consumers mutate in place (e.g. masking)
+            monkeypatch.undo()

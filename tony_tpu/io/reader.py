@@ -68,7 +68,13 @@ class ShardedRecordReader:
             self.segments = [self._align_tokens(s) for s in self.segments]
             self.segments = [s for s in self.segments if s.length > 0]
 
-        self._queue: queue.Queue = queue.Queue(maxsize=max(buffer_records, 1))
+        # Chunk-granular streams carry ~_CHUNK_RECORDS rows per queue item.
+        maxsize = max(buffer_records, 1)
+        if self.fmt == "tokens" and not shuffle:
+            maxsize = max(maxsize // self._CHUNK_RECORDS, 2)
+        self._queue: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._pending: list[np.ndarray] = []
+        self._pending_rows = 0
         self._stop = threading.Event()
         self._fetcher = threading.Thread(target=self._fetch_loop, daemon=True)
         self._fetcher.start()
@@ -89,7 +95,23 @@ class ShardedRecordReader:
         return FileSegment(seg.path, start, max(0, end - start))
 
     # -- fetcher thread ------------------------------------------------------
+    @property
+    def _chunk_granular(self) -> bool:
+        """Tokens without shuffle move [n, record_len] chunks through the
+        queue (256x fewer queue hops); shuffle needs single records."""
+        return self.fmt == "tokens" and not self.shuffle
+
     def _fetch_loop(self) -> None:
+        if self._chunk_granular:
+            try:
+                for seg in self.segments:
+                    for chunk in self._iter_token_chunks(seg):
+                        if self._stop.is_set():
+                            return
+                        self._put(chunk)
+            finally:
+                self._put(_SENTINEL)
+            return
         pool: list[Any] = []
         try:
             for rec in self._iter_records():
@@ -126,17 +148,78 @@ class ShardedRecordReader:
             else:
                 yield from self._iter_jsonl(seg)
 
-    def _iter_tokens(self, seg: FileSegment) -> Iterator[np.ndarray]:
+    # Records per read chunk: large enough to amortize the syscall and the
+    # prefetch-queue hop, small enough that one chunk never dominates the
+    # buffer.
+    _CHUNK_RECORDS = 256
+
+    def _iter_token_chunks(self, seg: FileSegment) -> Iterator[np.ndarray]:
+        """[n, record_len] arrays, up to _CHUNK_RECORDS rows each. The
+        tokens pipeline is chunk-granular end to end — per-record Python
+        hops cost more than the decode itself. Uses the native pread
+        kernel (native/tony_io.cc) when built; the Python fallback reads
+        the same chunk sizes."""
         rb = self._record_bytes()
+        from tony_tpu.io import native
+
+        if native.available():
+            # One ctypes hop per 4 chunks (the per-call overhead is ~5us;
+            # 1024-record preads amortize it below the memcpy cost), then
+            # zero-copy chunk views into the queue.
+            fd = os.open(seg.path, os.O_RDONLY)
+            try:
+                offset, remaining = seg.offset, seg.length // rb
+                while remaining > 0:
+                    n = min(self._CHUNK_RECORDS * 4, remaining)
+                    arr = native.pread_records(fd, offset, rb, n)
+                    if arr is None:
+                        # IO error, not EOF: surface it like the Python
+                        # path's OSError would, never silently truncate.
+                        raise OSError(
+                            f"native pread failed on {seg.path} at byte "
+                            f"{offset}"
+                        )
+                    if len(arr) == 0:
+                        return
+                    rows = (
+                        arr.reshape(-1).view(self.dtype)
+                        .reshape(len(arr), -1)
+                    )
+                    for lo in range(0, len(rows), self._CHUNK_RECORDS):
+                        yield rows[lo: lo + self._CHUNK_RECORDS]
+                    offset += len(arr) * rb
+                    remaining -= len(arr)
+                    if len(arr) < n:
+                        return
+            finally:
+                os.close(fd)
+            return
         with open(seg.path, "rb") as f:
             f.seek(seg.offset)
-            remaining = seg.length
-            while remaining >= rb:
-                buf = f.read(rb)
-                if len(buf) < rb:
+            remaining = seg.length // rb
+            record_len = rb // self.dtype.itemsize
+            while remaining > 0:
+                n = min(self._CHUNK_RECORDS, remaining)
+                # fromfile, not read+frombuffer: consumers get writable
+                # batches on this path too (frombuffer over bytes is
+                # read-only).
+                arr = np.fromfile(f, dtype=self.dtype, count=n * record_len)
+                got = len(arr) // record_len
+                if got == 0:
                     return
-                remaining -= rb
-                yield np.frombuffer(buf, dtype=self.dtype)
+                yield arr[: got * record_len].reshape(got, -1)
+                remaining -= got
+                if got < n:
+                    return
+
+    def _iter_tokens(self, seg: FileSegment) -> Iterator[np.ndarray]:
+        """Record-granular path (shuffle needs single records). Rows are
+        COPIED out of the chunk: the shuffle pool retains individual rows
+        for a long time, and a view would pin its entire chunk buffer
+        (up to _CHUNK_RECORDS x the intended footprint)."""
+        for chunk in self._iter_token_chunks(seg):
+            for row in chunk:
+                yield row.copy()
 
     def _iter_jsonl(self, seg: FileSegment) -> Iterator[Any]:
         with open(seg.path, "rb") as f:
@@ -204,6 +287,8 @@ class ShardedRecordReader:
     def next_batch(self) -> list[Any] | np.ndarray | None:
         """One batch, or None at end of shard (batches may be short at the
         tail). Token format returns [batch, record_len] arrays."""
+        if self._chunk_granular:
+            return self._next_batch_from_chunks()
         out: list[Any] = []
         while len(out) < self.batch_size:
             item = self._queue.get()
@@ -215,6 +300,29 @@ class ShardedRecordReader:
             return None
         if self.fmt == "tokens":
             return np.stack(out)
+        return out
+
+    def _next_batch_from_chunks(self) -> np.ndarray | None:
+        """Reassemble exact batch_size batches from queued chunks; a
+        leftover tail carries into the next call, so batch boundaries are
+        identical to the record-granular path."""
+        while self._pending_rows < self.batch_size:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                self._queue.put(_SENTINEL)
+                break
+            self._pending.append(item)
+            self._pending_rows += len(item)
+        if self._pending_rows == 0:
+            return None
+        buf = (
+            np.concatenate(self._pending)
+            if len(self._pending) > 1 else self._pending[0]
+        )
+        take = min(self.batch_size, len(buf))
+        out, rest = buf[:take], buf[take:]
+        self._pending = [rest] if len(rest) else []
+        self._pending_rows = len(rest)
         return out
 
     def __iter__(self) -> Iterator[Any]:
