@@ -117,6 +117,45 @@ def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 30):
     }
 
 
+def bench_resnet50(batch: int = 32, size: int = 224, measure: int = 20):
+    """ResNet-50 full train step (fwd+loss+grad+adam), images/sec/chip —
+    the BASELINE config-5 workload."""
+    from tony_tpu.models import (
+        ResNetConfig,
+        make_image_classifier_step,
+        resnet_apply,
+        resnet_init,
+    )
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg = ResNetConfig(depth=50, width=64, n_classes=1000, dtype="bfloat16")
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    init_fn, step_fn = make_image_classifier_step(
+        lambda key: resnet_init(key, cfg),
+        lambda params, images: resnet_apply(params, images, cfg),
+        mesh,
+    )
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(batch, size, size, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    with jax.sharding.set_mesh(mesh):
+        state = init_fn(jax.random.key(0))
+        for _ in range(3):
+            state, metrics = step_fn(state, images, labels)
+        float(metrics["loss"])  # host readback = real fence
+        t0 = time.perf_counter()
+        for _ in range(measure):
+            state, metrics = step_fn(state, images, labels)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+    return {
+        "images_per_sec_per_chip": round(batch * measure / dt, 1),
+        "batch": batch,
+        "image_size": size,
+        "step_ms": round(dt / measure * 1000, 2),
+    }
+
+
 def bench_flash_attention(seq: int, batch: int, heads: int = 8,
                           head_dim: int = 64, measure: int = 30):
     """Pallas flash kernel vs the blockwise-XLA fallback (force_jax=True),
@@ -161,6 +200,7 @@ def main() -> None:
     if jax.devices()[0].platform in ("tpu", "axon"):
         extras = {
             "transformer": bench_transformer(),
+            "resnet50": bench_resnet50(),
             "flash_attention_2k": bench_flash_attention(seq=2048, batch=4),
             "flash_attention_8k": bench_flash_attention(seq=8192, batch=1),
             "device": jax.devices()[0].device_kind,

@@ -1,0 +1,79 @@
+"""BASELINE config 5 fixture: gang-scheduled ResNet training with
+fault-restart. Each worker trains the in-framework ResNet (tiny depth-18
+shape for CI) with checkpointing; worker 0 crashes mid-run on the first
+session, the retried session resumes from the latest checkpoint and
+finishes."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass
+
+import jax.numpy as jnp
+import numpy as np
+
+import tony_tpu.runtime as rt
+from tony_tpu.checkpoint import CheckpointManager
+from tony_tpu.models import (
+    ResNetConfig,
+    make_image_classifier_step,
+    resnet_apply,
+    resnet_init,
+)
+from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+TOTAL_STEPS = 6
+CRASH_AT = 3
+
+ctx = rt.initialize()
+session = os.environ.get("SESSION_ID", "1")
+cfg = ResNetConfig(depth=18, width=8, n_classes=10, dtype="float32")
+mesh = build_mesh(MeshSpec.auto(jax.local_device_count()),
+                  devices=jax.local_devices())
+init_fn, step_fn = make_image_classifier_step(
+    lambda key: resnet_init(key, cfg),
+    lambda params, images: resnet_apply(params, images, cfg),
+    mesh,
+)
+
+rng = np.random.default_rng(ctx.process_id)
+images = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+labels = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+
+mgr = CheckpointManager(
+    os.path.join(os.environ["CKPT_DIR"], f"proc-{ctx.process_id}")
+)
+with jax.sharding.set_mesh(mesh):
+    state = init_fn(jax.random.key(0))
+    restored = mgr.restore(state)
+    start = 0
+    if restored is not None:
+        state, start = restored, int(restored.step)
+    print(f"[{ctx.process_id}] session {session}: start step {start}",
+          flush=True)
+    if session != "1" and start == 0:
+        print("retried session did not resume", file=sys.stderr)
+        sys.exit(7)
+    if start >= TOTAL_STEPS:
+        # This worker had already finished before the gang restart (only
+        # the chief crashes; a fast non-chief can complete session 1).
+        print(f"[{ctx.process_id}] already complete at step {start}",
+              flush=True)
+        sys.exit(0)
+    for step in range(start, TOTAL_STEPS):
+        state, metrics = step_fn(state, images, labels)
+        mgr.save(int(state.step), state, blocking=True)
+        if (
+            step + 1 == CRASH_AT and session == "1"
+            and ctx.process_id == 0
+        ):
+            print("simulated worker crash", file=sys.stderr)
+            sys.exit(1)
+    loss = float(metrics["loss"])
+print(f"[{ctx.process_id}] final loss {loss:.4f}", flush=True)
+sys.exit(0 if np.isfinite(loss) else 8)

@@ -195,6 +195,24 @@ def test_sharded_save_restore_across_processes_e2e(tmp_path):
     assert status is SessionStatus.SUCCEEDED, coord.session.diagnostics
 
 
+def test_resnet_gang_fault_restart_e2e(tmp_path):
+    """BASELINE config 5 (CI-scaled): 2 gang-scheduled workers train the
+    in-framework ResNet; worker 0 crashes mid-run, the whole session
+    restarts, both workers resume from checkpoints and finish."""
+    cluster = MiniTonyCluster(tmp_path / "cluster")
+    conf = cluster.base_conf()
+    conf.set(keys.K_FRAMEWORK, "jax")
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "resnet_train.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 2)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_AM_RETRY_COUNT, 1)
+    conf.set(keys.K_SHELL_ENV, f"CKPT_DIR={tmp_path}/ckpt")
+    status, coord = cluster.run_job(conf, timeout_s=600)
+    assert status is SessionStatus.SUCCEEDED, coord.session.diagnostics
+    assert coord.session.session_id == 2  # fault-restarted once
+
+
 def test_restore_on_session_retry_e2e(tmp_path):
     """Full-stack resume: session 1 checkpoints every step and crashes at
     step 5; the retried session restores from step 5 and finishes — the

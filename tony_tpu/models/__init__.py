@@ -23,7 +23,13 @@ from tony_tpu.models.transformer import (
     param_roles,
 )
 from tony_tpu.models.mnist import MnistConfig, mnist_init, mnist_apply
-from tony_tpu.models.train import TrainState, make_train_step, lm_loss
+from tony_tpu.models.resnet import ResNetConfig, resnet_init, resnet_apply
+from tony_tpu.models.train import (
+    TrainState,
+    lm_loss,
+    make_image_classifier_step,
+    make_train_step,
+)
 
 __all__ = [
     "TransformerConfig",
@@ -34,7 +40,11 @@ __all__ = [
     "MnistConfig",
     "mnist_init",
     "mnist_apply",
+    "ResNetConfig",
+    "resnet_init",
+    "resnet_apply",
     "TrainState",
     "make_train_step",
+    "make_image_classifier_step",
     "lm_loss",
 ]

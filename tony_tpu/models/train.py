@@ -162,13 +162,32 @@ def make_classifier_step(
     *,
     learning_rate: float = 1e-3,
 ):
-    """Data-parallel supervised step for the MNIST models: batch split over
-    (dp, ep); params replicated (they're KB-scale — fsdp would be pure
-    overhead). Returns (init_fn, step_fn)."""
+    """Data-parallel supervised step for the MNIST models (see
+    make_image_classifier_step)."""
+    return make_image_classifier_step(
+        lambda key: mnist_init(key, cfg),
+        lambda params, images: mnist_apply(params, images, cfg),
+        mesh,
+        learning_rate=learning_rate,
+    )
+
+
+def make_image_classifier_step(
+    init_params_fn,
+    apply_fn,
+    mesh: Mesh,
+    *,
+    learning_rate: float = 1e-3,
+):
+    """Data-parallel supervised step for any image classifier
+    ``(params, images) -> logits``: batch split over (dp, ep); params
+    replicated (MB-scale at most — fsdp would be pure overhead; the
+    transformer path owns the sharded-weights story). Returns
+    (init_fn, step_fn)."""
     opt = optax.adam(learning_rate)
 
     def init_fn(key):
-        params = mnist_init(key, cfg)
+        params = init_params_fn(key)
         return TrainState(jnp.zeros((), jnp.int32), params, opt.init(params))
 
     repl = NamedSharding(mesh, P())
@@ -178,7 +197,7 @@ def make_classifier_step(
     batch_sh = NamedSharding(mesh, P(("dp", "ep")))
 
     def loss_fn(params, images, labels):
-        logits = mnist_apply(params, images, cfg)
+        logits = apply_fn(params, images)
         loss = softmax_cross_entropy(logits, labels)
         acc = (logits.argmax(-1) == labels).mean()
         return loss, acc
