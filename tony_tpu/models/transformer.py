@@ -62,6 +62,11 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     dtype: str = "bfloat16"
     remat: bool = True
+    # "full": recompute the whole layer in backward (min memory);
+    # "dots": save matmul outputs, recompute elementwise (XLA's
+    # dots_with_no_batch_dims_saveable) — more memory, fewer recomputed
+    # flops, usually the better MFU point when the model fits.
+    remat_policy: str = "full"
 
     @property
     def compute_dtype(self):
@@ -262,6 +267,18 @@ def _decoder_layer(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
 # GSPMD trunk (pp == 1)
 # ---------------------------------------------------------------------------
 
+def _remat_policy(cfg: TransformerConfig):
+    """None = save nothing (full recompute); the "dots" policy keeps matmul
+    outputs resident so the backward re-runs only elementwise work."""
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"unknown remat_policy {cfg.remat_policy!r}; expected full|dots"
+    )
+
+
 def forward(
     params: dict, tokens: jax.Array, cfg: TransformerConfig,
     mesh: Mesh | None = None,
@@ -277,7 +294,7 @@ def forward(
         _decoder_layer, cfg=cfg, cos=cos, sin=sin, manual=False, mesh=mesh
     )
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
     def scan_body(carry, lp):
         return layer_fn(carry, lp), None
@@ -343,7 +360,7 @@ def forward_pipeline(
             _decoder_layer, cfg=cfg, cos=cos, sin=sin, manual=True, mesh=None
         )
         if cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn)
+            layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
         def body(carry, lp):
             return layer_fn(carry, lp), None

@@ -47,7 +47,7 @@ _LANES = 128  # TPU vreg lane count; m/l scratch rows broadcast across lanes
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale, causal, t_k, t_q,
 ):
     """One program = one (batch*head, q-block, kv-block). The kv axis is the
@@ -160,14 +160,23 @@ def _flash_fwd_kernel(
 
     @pl.when(ki == num_k - 1)
     def _finalize():
+        m = jnp.max(m_ref[...], axis=1)
         l = jnp.maximum(jnp.max(l_ref[...], axis=1), 1e-30)
         o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        # Log-sum-exp per row, saved for the backward kernels. Fully-masked
+        # rows get a finite value (log l_min) so exp(NEG_INF - lse)
+        # underflows to 0 instead of NaN-ing.
+        lse = jnp.where(m <= NEG_INF / 2, 0.0, m) + jnp.log(l)
+        lse_ref[...] = lse[None, None, :]
 
 
 def _flash_attention_pallas(
-    q, k, v, *, causal, scale, block_q, block_k, interpret=False
+    q, k, v, *, causal, scale, block_q, block_k, interpret=False,
+    return_lse=False,
 ):
-    """q,k,v: [BH, T, D] (batch and heads pre-flattened)."""
+    """q,k,v: [BH, T, D] (batch and heads pre-flattened). With
+    ``return_lse`` also returns the per-row log-sum-exp [BH, T] the
+    backward kernels consume."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t_q, d = q.shape
@@ -191,7 +200,7 @@ def _flash_attention_pallas(
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, t_k=t_k, t_q=t_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -199,8 +208,16 @@ def _flash_attention_pallas(
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q + pad_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # [bh, 1, T] layout: a (1, 1, block_q) block satisfies the TPU
+            # (8, 128) tiling rule (second-to-last dim equals the array's).
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q + pad_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t_q + pad_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -211,7 +228,217 @@ def _flash_attention_pallas(
         ),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :t_q] if pad_q else out
+    lse = lse[:, 0]
+    if pad_q:
+        out, lse = out[:, :t_q], lse[:, :t_q]
+    return (out, lse) if return_lse else out
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (Dao-style two-pass flash backward)
+# ---------------------------------------------------------------------------
+# p = exp(s - lse) is reconstructed from the saved per-row log-sum-exp, so
+# the backward never materializes [T, T]; dq accumulates over kv blocks and
+# (dk, dv) over q blocks, each as its own kernel with the reduction axis as
+# the innermost sequential grid dimension. All masks (causal, tail padding,
+# padded q rows) are applied unconditionally here — backward cost is
+# dominated by the five matmuls per block, not the wheres.
+
+
+def _bwd_masked_p(s, lse_row, *, qi, ki, block_q, block_k, q_off, t_q, t_k,
+                  causal):
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    q_row = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    valid = (k_pos < t_k) & (q_row < t_q)
+    if causal:
+        valid &= (q_off + q_row) >= k_pos
+    p = jnp.exp(s - lse_row[:, None])
+    return jnp.where(valid, p, 0.0)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale, causal, t_k, t_q,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    q_off = t_k - t_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = k_start <= q_off + (qi + 1) * block_q - 1 if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = _bwd_masked_p(
+            s, lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
+            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, t_k, t_q,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    q_off = t_k - t_q
+    k_start = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal skip mirrored from the dq kernel: a q block entirely above the
+    # diagonal contributes nothing to this kv block.
+    live = q_off + (qi + 1) * block_q - 1 >= k_start if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = _bwd_masked_p(
+            s, lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
+            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_attention_pallas_bwd(
+    q, k, v, out, lse, do, *, causal, scale, block_q, block_k,
+    interpret=False,
+):
+    """Backward for the Pallas forward. All inputs [BH, T, D] (lse/delta
+    [BH, T]); returns (dq, dk, dv)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_k) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, pad_q), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, pad_q)))
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    n_q = (t_q + pad_q) // block_q
+    n_k = (t_k + pad_k) // block_k
+
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            t_k=t_k, t_q=t_q,
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t_q + pad_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    # dkv grid: kv blocks parallel, q blocks sequential (innermost).
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            t_k=t_k, t_q=t_q,
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_k + pad_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k + pad_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+    if pad_q:
+        dq = dq[:, :t_q]
+    if pad_k:
+        dk, dv = dk[:, :t_k], dv[:, :t_k]
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -282,11 +509,27 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, force_jax):
 
 
 def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, force_jax):
-    out = _flash_core(q, k, v, causal, scale, block_q, block_k, force_jax)
+    if _on_tpu() and not force_jax:
+        out, lse = _flash_attention_pallas(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+        )
+        return out, (q, k, v, out, lse)
+    out = _blockwise_attention_jax(
+        q, k, v, causal=causal, scale=scale, block_k=block_k
+    )
     return out, (q, k, v)
 
 
 def _flash_core_bwd(causal, scale, block_q, block_k, force_jax, res, g):
+    if _on_tpu() and not force_jax:
+        # Pallas two-pass backward from the saved lse — never rebuilds the
+        # [T, T] score matrix and never re-runs the forward.
+        q, k, v, out, lse = res
+        return _flash_attention_pallas_bwd(
+            q, k, v, out, lse, g, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
     q, k, v = res
     # Recompute-based backward through the blockwise scan: O(T·block)
     # memory, identical math to the forward kernel.
