@@ -83,6 +83,10 @@ def main() -> int:
     ap.add_argument("--learning_rate", type=float, default=1e-2)
     ap.add_argument("--working_dir", default=os.environ.get("TONY_LOG_DIR", "."),
                     help="where the chief writes final metrics")
+    ap.add_argument("--checkpoint_dir", default="",
+                    help="enable save/resume via tony_tpu.checkpoint "
+                         "(sessions retried by the coordinator resume "
+                         "from the latest complete step)")
     args = ap.parse_args()
 
     # The one framework call: no-op standalone, jax.distributed when the
@@ -120,10 +124,33 @@ def main() -> int:
 
     p_train_step = jax.pmap(train_step, axis_name="batch")
 
+    # Optional checkpoint/resume: the framework half of the AM-retry
+    # resume contract (a retried session restores and continues).
+    mgr = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from tony_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(
+            args.checkpoint_dir,
+            process_id=ctx.process_id,
+            num_processes=max(ctx.num_processes, 1),
+        )
+        restored = mgr.restore({"params": params, "opt_state": opt_state,
+                                "step": jnp.zeros((), jnp.int32)})
+        if restored is not None:
+            params, opt_state = restored["params"], restored["opt_state"]
+            start_step = int(restored["step"])
+            print(f"resumed from checkpoint step {start_step}", flush=True)
+
+    if start_step >= args.steps:
+        print(f"training already complete at step {start_step}", flush=True)
+        return 0
+
     per_step = args.batch_size * n_local
     t0 = time.time()
     loss = acc = float("nan")
-    for step in range(args.steps):
+    for step in range(start_step, args.steps):
         lo = (step * per_step) % (len(images) - per_step or 1)
         bi = images[lo: lo + per_step].reshape(
             n_local, args.batch_size, 28, 28, 1
@@ -133,19 +160,31 @@ def main() -> int:
             params, opt_state, jnp.asarray(bi), jnp.asarray(bl)
         )
         loss, acc = float(loss_d[0]), float(acc_d[0])
+        # Checkpoint cadence: every 10th step and the last one — a
+        # per-step save would serialize training against the previous
+        # write's fsync.
+        if mgr is not None and (step % 10 == 9 or step == args.steps - 1):
+            mgr.save(
+                step + 1,
+                {"params": params, "opt_state": opt_state,
+                 "step": jnp.asarray(step + 1, jnp.int32)},
+            )
         if step % 10 == 0 or step == args.steps - 1:
             print(f"step {step}: loss={loss:.4f} acc={acc:.3f}", flush=True)
+    if mgr is not None:
+        mgr.wait()  # async writes must be durable before exit
     elapsed = time.time() - t0
 
     if not np.isfinite(loss):
         print("non-finite loss", file=sys.stderr)
         return 1
     if ctx.process_id == 0:
+        executed = args.steps - start_step
         metrics = {
             "final_loss": loss,
             "final_acc": acc,
             "steps": args.steps,
-            "steps_per_sec": args.steps / max(elapsed, 1e-9),
+            "steps_per_sec": executed / max(elapsed, 1e-9),
             "num_processes": ctx.num_processes,
         }
         path = os.path.join(args.working_dir, "mnist_metrics.json")

@@ -1,0 +1,53 @@
+"""Profiler seam: the jax.profiler integration behind the reserved
+TB/profiler ports (SURVEY §5.1; TaskExecutor.java:121-124 analogue)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu import constants, profiling
+
+
+def _work():
+    x = jnp.ones((64, 64))
+    return float(jnp.sum(jax.jit(lambda a: a @ a)(x)))
+
+
+def test_trace_writes_capture(tmp_path):
+    with profiling.trace(str(tmp_path)):
+        _work()
+    # jax writes plugins/profile/<run>/*.xplane.pb under the trace dir
+    captured = [p for p in tmp_path.rglob("*") if p.is_file()]
+    assert captured, "trace produced no files"
+
+
+def test_trace_defaults_to_tony_log_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(constants.TONY_LOG_DIR, str(tmp_path))
+    assert profiling.default_trace_dir() == str(tmp_path / "profile")
+    with profiling.trace():
+        _work()
+    assert list((tmp_path / "profile").rglob("*.pb"))
+
+
+def test_step_profiler_window(tmp_path):
+    prof = profiling.StepProfiler(start=2, num=2, log_dir=str(tmp_path))
+    for step in range(6):
+        prof.before_step(step)
+        _work()
+        prof.after_step(step)
+    assert not prof._active
+    assert list(tmp_path.rglob("*.pb"))
+
+
+def test_step_profiler_close_mid_window(tmp_path):
+    prof = profiling.StepProfiler(start=0, num=100, log_dir=str(tmp_path))
+    prof.before_step(0)
+    _work()
+    prof.close()
+    assert not prof._active
+
+
+def test_maybe_start_profiler_server_no_env(monkeypatch):
+    monkeypatch.delenv(constants.PROFILER_PORT, raising=False)
+    assert profiling.maybe_start_profiler_server() is None

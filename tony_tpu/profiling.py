@@ -33,14 +33,70 @@ def maybe_start_profiler_server() -> int | None:
     return int(port)
 
 
+def default_trace_dir() -> str:
+    """Traces default to the job's writable scratch (the executor exports
+    TONY_LOG_DIR), so captured profiles land next to the task logs that
+    task URLs already point at."""
+    root = os.environ.get(constants.TONY_LOG_DIR, ".")
+    return os.path.join(root, "profile")
+
+
 @contextlib.contextmanager
-def trace(log_dir: str):
+def trace(log_dir: str | None = None):
     """Capture a Perfetto/XProf trace of the enclosed steps into
-    ``log_dir`` (viewable in TensorBoard's profile tab or xprof)."""
+    ``log_dir`` (default: ``$TONY_LOG_DIR/profile``; viewable in
+    TensorBoard's profile tab or xprof)."""
     import jax
 
-    with jax.profiler.trace(log_dir):
+    with jax.profiler.trace(log_dir or default_trace_dir()):
         yield
+
+
+class StepProfiler:
+    """Capture a window of training steps — the usual pattern of profiling
+    steps [start, start+num) once compilation and input pipelines are warm::
+
+        prof = profiling.StepProfiler(start=10, num=5)
+        for step in range(steps):
+            prof.before_step(step)
+            state, metrics = train_step(state, batch)
+            prof.after_step(step)
+
+    No-ops outside the window, so it can stay in production loops."""
+
+    def __init__(self, start: int = 10, num: int = 5,
+                 log_dir: str | None = None) -> None:
+        self.start = start
+        self.stop = start + num
+        self.log_dir = log_dir or default_trace_dir()
+        self._active = False
+
+    def before_step(self, step: int) -> None:
+        # >= start (not ==): a loop resumed mid-window must still profile
+        # its remaining in-window steps.
+        if self.start <= step < self.stop and not self._active:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            log.info("profiling steps %d..%d into %s",
+                     self.start, self.stop - 1, self.log_dir)
+
+    def after_step(self, step: int) -> None:
+        if self._active and step >= self.stop - 1:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        """Stop an in-flight trace (e.g. the loop ended inside the
+        window)."""
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
 
 
 def annotate(name: str):
