@@ -1,0 +1,147 @@
+"""Distributed MNIST in TensorFlow, submitted through tony_tpu with
+``--framework tensorflow`` — the analogue of the reference's
+tony-examples/mnist-tensorflow/mnist_distributed.py:188-220.
+
+The executor's TensorFlowRuntime injects a byte-compatible ``TF_CONFIG``
+(plus ``CLUSTER_SPEC``), so ``tf.distribute`` strategies construct their
+cluster resolvers with no arguments. This example uses
+MultiWorkerMirroredStrategy (the modern replacement for the reference
+example's PS/replica_device_setter graph code); run 1 ps + N workers with
+ParameterServerStrategy if you want the reference's exact topology.
+
+``ps`` tasks start a ``tf.distribute.Server`` and join (the reference
+example's ``server.join()`` pattern) — they serve until the chief finishes
+and the coordinator reaps them (ps is untracked in completion accounting).
+Workers then run MWMS over the worker subcluster. The script exits 0 with
+a notice when TF is absent so submissions degrade gracefully on jax-only
+images. Submit::
+
+    python -m tony_tpu.client.cli local \
+        --executes examples/mnist_tensorflow.py \
+        --framework tensorflow \
+        --conf tony.worker.instances=2
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def synthetic_mnist(seed: int, n: int = 4096):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=(n,))
+    images = rng.normal(0.0, 0.3, size=(n, 28, 28, 1)).astype(np.float32)
+    for i, lbl in enumerate(labels):
+        r, c = divmod(int(lbl), 4)
+        images[i, 4 + 5 * r: 9 + 5 * r, 4 + 6 * c: 10 + 6 * c, 0] += 1.5
+    return images, labels.astype(np.int64)
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=64,
+                    help="number of training batches")
+    args = ap.parse_args()
+    try:
+        import tensorflow as tf
+    except ImportError:
+        print("tensorflow not installed; TF example skipped "
+              "(TF_CONFIG was injected: %s)"
+              % bool(os.environ.get("TF_CONFIG")), flush=True)
+        return 0
+
+    tf_config = json.loads(os.environ.get("TF_CONFIG", "{}"))
+    print(f"TF_CONFIG: {tf_config}", flush=True)
+    task = tf_config.get("task", {})
+    if task.get("type") == "ps":
+        # Parameter servers serve until the session ends (the reference
+        # example's server.join(); the coordinator reaps ps when the chief
+        # finishes — ps is untracked in completion accounting).
+        server = tf.distribute.Server(
+            tf.train.ClusterSpec(tf_config["cluster"]),
+            job_name="ps", task_index=int(task.get("index", 0)),
+        )
+        # join() never returns — the coordinator reaps ps processes after
+        # the chief finishes (ps is untracked in completion accounting).
+        server.join()
+        raise AssertionError("tf.distribute.Server.join() returned")
+    cluster = dict(tf_config.get("cluster", {}))
+    if "ps" in cluster:
+        # MWMS spans workers only; ps entries would make it wait on hosts
+        # that never join the collective.
+        cluster.pop("ps")
+        tf_config["cluster"] = cluster
+        os.environ["TF_CONFIG"] = json.dumps(tf_config)
+    if cluster:
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    else:
+        strategy = tf.distribute.get_strategy()  # standalone run
+    images, labels = synthetic_mnist(seed=0)
+
+    # Explicit distributed train loop (Keras 3 dropped model.fit support
+    # for MultiWorkerMirroredStrategy): per-replica grads all-reduced to a
+    # mean, SGD applied in place — the same hand-rolled shape as the
+    # reference's examples.
+    with strategy.scope():
+        init = tf.random.stateless_normal
+        w1 = tf.Variable(init((784, 128), seed=(0, 1)) * 0.05)
+        b1 = tf.Variable(tf.zeros((128,)))
+        w2 = tf.Variable(init((128, 10), seed=(0, 2)) * 0.05)
+        b2 = tf.Variable(tf.zeros((10,)))
+    trainable = (w1, b1, w2, b2)
+
+    @tf.function
+    def train_step(dist_x, dist_y):
+        def replica_fn(x, y):
+            with tf.GradientTape() as tape:
+                flat = tf.reshape(x, (tf.shape(x)[0], -1))
+                h = tf.nn.relu(flat @ w1 + b1)
+                logits = h @ w2 + b2
+                loss = tf.reduce_mean(
+                    tf.nn.sparse_softmax_cross_entropy_with_logits(
+                        labels=y, logits=logits
+                    )
+                )
+            grads = tape.gradient(loss, trainable)
+            ctx = tf.distribute.get_replica_context()
+            if ctx is not None:
+                grads = [
+                    ctx.all_reduce(tf.distribute.ReduceOp.MEAN, g)
+                    for g in grads
+                ]
+            for var, g in zip(trainable, grads):
+                var.assign_sub(0.01 * g)
+            return loss
+
+        per_replica = strategy.run(replica_fn, args=(dist_x, dist_y))
+        return strategy.reduce(
+            tf.distribute.ReduceOp.MEAN, per_replica, axis=None
+        )
+
+    ds = (
+        tf.data.Dataset.from_tensor_slices((images, labels))
+        .batch(64).take(args.steps)
+    )
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.DATA
+    )
+    dist_ds = strategy.experimental_distribute_dataset(
+        ds.with_options(options)
+    )
+    loss = None
+    for step, (x, y) in enumerate(dist_ds):
+        loss = float(train_step(x, y))
+        if step % 20 == 0:
+            print(f"step {step}: loss={loss:.4f}", flush=True)
+    print(f"final loss={loss:.4f}", flush=True)
+    return 0 if loss is not None and np.isfinite(loss) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

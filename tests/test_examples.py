@@ -63,3 +63,14 @@ def test_pytorch_example_ddp():
     """BASELINE config 3: PyTorch DDP-style MNIST, 2 workers over gloo."""
     proc = _submit("mnist_pytorch.py", "pytorch", workers=2)
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_tensorflow_example_multiworker():
+    """BASELINE configs 2/4 TF shape: 2 MWMS workers + the default ps task
+    serving tf.distribute.Server until the chief finishes, all wired from
+    the injected TF_CONFIG. Skips (not vacuously passes) without TF."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    proc = _submit("mnist_tensorflow.py", "tensorflow", workers=2)
+    assert proc.returncode == 0, proc.stderr[-2000:]
