@@ -206,6 +206,9 @@ def main() -> None:
     if jax.devices()[0].platform in ("tpu", "axon"):
         extras = {
             "transformer": bench_transformer(),
+            "transformer_long_context": bench_transformer(
+                batch=2, seq=8192, measure=8
+            ),
             "resnet50": bench_resnet50(),
             "flash_attention_2k": bench_flash_attention(seq=2048, batch=4),
             "flash_attention_8k": bench_flash_attention(seq=8192, batch=1),

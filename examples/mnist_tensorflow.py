@@ -134,13 +134,13 @@ def main() -> int:
     dist_ds = strategy.experimental_distribute_dataset(
         ds.with_options(options)
     )
-    loss = None
+    loss = float("nan")
     for step, (x, y) in enumerate(dist_ds):
         loss = float(train_step(x, y))
         if step % 20 == 0:
             print(f"step {step}: loss={loss:.4f}", flush=True)
     print(f"final loss={loss:.4f}", flush=True)
-    return 0 if loss is not None and np.isfinite(loss) else 1
+    return 0 if np.isfinite(loss) else 1
 
 
 if __name__ == "__main__":
