@@ -295,3 +295,24 @@ class TestPipeline:
                 lambda p, x: x, {"w": jnp.zeros((2, 1))},
                 jnp.zeros((7, 4)), mesh=mesh, num_microbatches=2,
             )
+
+
+def test_ring_cross_length_causal_skip_exact():
+    """The causal ring-step skip must compare GLOBAL positions: with
+    t_q != t_k a 'future' kv owner can still hold visible keys."""
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(sp=2, dp=2, tp=2))
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, 16, 2, 8)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 8, 2, 8)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 8, 2, 8)), dtype=jnp.float32)
+    out = ring_attention(q, k, v, mesh, causal=True, block_k=4)
+    # dense reference with plain global positions (ring convention)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (8 ** -0.5)
+    q_pos = jnp.arange(16)[:, None]
+    k_pos = jnp.arange(8)[None, :]
+    s = jnp.where((q_pos >= k_pos)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -126,12 +126,31 @@ def ring_attention_local(
     def step(carry, s):
         o, m, l, k_blk, v_blk = carry
         kv_owner = (my_idx - s) % axis_size
-        o_blk, m_blk, l_blk = _chunk_attention(
-            q, k_blk, v_blk,
-            q_start=my_idx * t_q, k_start=kv_owner * t_k,
-            causal=causal, scale=scale, block_k=block_k,
-        )
-        o, m, l = _merge(o, m, l, o_blk, m_blk, l_blk)
+
+        def attend(o, m, l):
+            o_blk, m_blk, l_blk = _chunk_attention(
+                q, k_blk, v_blk,
+                q_start=my_idx * t_q, k_start=kv_owner * t_k,
+                causal=causal, scale=scale, block_k=block_k,
+            )
+            return _merge(o, m, l, o_blk, m_blk, l_blk)
+
+        if causal:
+            # A ring step whose kv shard sits entirely in this shard's
+            # future is fully masked — skip its matmuls (roughly half the
+            # ring steps on average; the ppermute still rotates the block
+            # so the ring stays in lockstep). Compared in global positions
+            # so cross-length attention (t_q != t_k) stays exact: skip iff
+            # the block's first key comes after our last query.
+            fully_masked = kv_owner * t_k >= (my_idx + 1) * t_q
+            o, m, l = lax.cond(
+                fully_masked,
+                lambda o, m, l: (o, m, l),
+                attend,
+                o, m, l,
+            )
+        else:
+            o, m, l = attend(o, m, l)
         # Rotate K/V around the ring (skipped work on the last step is
         # dead-code-eliminated only when axis_size is static — it is).
         k_nxt = lax.ppermute(k_blk, axis_name, fwd_perm)
