@@ -92,3 +92,33 @@ def test_retries_exhausted_still_fails(cluster):
     status, coord = cluster.run_job(conf, timeout_s=90)
     assert status is SessionStatus.FAILED
     assert coord.session.session_id == 2
+
+
+def test_final_status_carries_run_stats(cluster, tmp_path):
+    """final-status.json is self-describing: session count, failed tasks,
+    missed-heartbeat tasks, wall time (the reference declares metrics-core
+    and never uses it — SURVEY 5.5)."""
+    import json
+
+    marker = tmp_path / "attempt.marker"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if m.exists():\n"
+        "    sys.exit(0)\n"
+        "m.touch()\n"
+        "sys.exit(1)\n"
+    )
+    conf = _job(cluster, "exit_0.py")
+    conf.set(keys.K_EXECUTES, str(script))
+    conf.set(keys.K_AM_RETRY_COUNT, 1)
+    status, coord = cluster.run_job(conf, timeout_s=90)
+    assert status is SessionStatus.SUCCEEDED
+    stats = json.loads(
+        (coord.app_dir / "final-status.json").read_text()
+    )["stats"]
+    assert stats["sessions_run"] == 2
+    assert stats["tasks_failed"] == 1
+    assert stats["heartbeat_missed_tasks"] == []
+    assert stats["wall_ms"] > 0

@@ -103,6 +103,7 @@ class TonyCoordinator:
         self._killed = threading.Event()
         self._fatal = False  # conf-shaped failure: never retried
         self._model_params: str | None = None  # from a preprocess run
+        self._tasks_failed = 0  # cumulative across session retries
         self.started_ms = int(time.time() * 1000)
         self._session_seq = 0
         self._hb_missed: set[str] = set()
@@ -407,6 +408,8 @@ class TonyCoordinator:
                 code = self.backend.poll(task.handle)
                 if code is not None:
                     self.liveness.unregister(task.id)
+                    if code != 0:
+                        self._tasks_failed += 1
                     session.on_task_completed(task.job_name, task.index, code)
             self._wake.wait(interval_s)
             self._wake.clear()
@@ -440,6 +443,15 @@ class TonyCoordinator:
         final["state"] = status.value  # unmasked: this IS the terminal record
         if self.slice_plans:
             final["slices"] = {j: asdict(p) for j, p in self.slice_plans.items()}
+        # Run statistics — the reference declares metrics-core but never
+        # uses it (SURVEY 5.5); these counters make the terminal record
+        # self-describing for tooling and the history UI.
+        final["stats"] = {
+            "sessions_run": self._session_seq,
+            "tasks_failed": self._tasks_failed,
+            "heartbeat_missed_tasks": sorted(self._hb_missed),
+            "wall_ms": int(time.time() * 1000) - self.started_ms,
+        }
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0
