@@ -22,6 +22,7 @@ from tony_tpu.models.transformer import (
     forward_pipeline,
     param_roles,
 )
+from tony_tpu.models.decode import advance, generate, init_cache
 from tony_tpu.models.mnist import MnistConfig, mnist_init, mnist_apply
 from tony_tpu.models.resnet import ResNetConfig, resnet_init, resnet_apply
 from tony_tpu.models.train import (
@@ -47,4 +48,7 @@ __all__ = [
     "make_train_step",
     "make_image_classifier_step",
     "lm_loss",
+    "advance",
+    "generate",
+    "init_cache",
 ]

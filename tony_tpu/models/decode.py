@@ -1,0 +1,175 @@
+"""KV-cache decoding / generation for the flagship transformer.
+
+The reference is a training orchestrator with no model code at all; this
+inference path completes the model family the rebuild adds. TPU-first
+choices:
+
+* One jittable ``advance`` handles both prefill (S = prompt length) and
+  single-token steps (S = 1): static shapes per call site, so XLA compiles
+  exactly two executables for a whole generation loop.
+* The cache is a stacked [L, B, Tmax, H, Dh] pair updated with
+  ``dynamic_update_slice`` at a traced offset; the layer loop stays one
+  ``lax.scan`` over the stacked layer params (same trunk layout as
+  training, so trained checkpoints drop in).
+* Decode attention is a dense matvec against the cache with a global
+  causal position mask (t_q is 1 or the prompt length — flash blocking
+  buys nothing there), fp32 softmax like the training kernels.
+
+Dense trunk only (MoE decode needs expert caching; ``generate`` rejects
+``n_experts > 0`` explicitly). Sampling: greedy at ``temperature=0``,
+else temperature sampling with a caller-provided key.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.transformer import TransformerConfig, _dense_mlp
+from tony_tpu.ops import apply_rope, rms_norm, rope_frequencies
+
+NEG_INF = -1e30
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    dt = cfg.compute_dtype
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
+    """One decoder layer over S new tokens at positions [length, length+S).
+    x: [B, S, d]; caches [B, Tmax, H, Dh]. Returns (x, k_cache, v_cache)."""
+    dt = cfg.compute_dtype
+    b, s, _ = x.shape
+    t_max = k_cache.shape[1]
+
+    h = rms_norm(x, lp["ln1"]).astype(dt)
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
+    k_new = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
+    v_new = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
+    positions = length + jnp.arange(s)
+    q = apply_rope(q, cos, sin, positions=positions)
+    k_new = apply_rope(k_new, cos, sin, positions=positions)
+
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (0, length, 0, 0)
+    )
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (0, length, 0, 0)
+    )
+
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32), k_cache.astype(jnp.float32),
+    ) * scale
+    # Global causal mask; it also hides the cache tail past length+S
+    # (those positions are > every query position). mask: [S, Tmax].
+    mask = positions[:, None] >= jnp.arange(t_max)[None, :]
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
+    ).astype(dt)
+    x = x + jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
+
+    # Same MLP as training — one source of truth keeps the token-exact
+    # parity the tests pin.
+    x = x + _dense_mlp(x, lp, cfg, manual=False, constrain=False)
+    return x, k_cache, v_cache
+
+
+def advance(params: dict, cache: dict, tokens: jax.Array,
+            cfg: TransformerConfig):
+    """Feed ``tokens`` [B, S] at the cache's current length; returns
+    (last-position logits [B, V] fp32, updated cache)."""
+    if cfg.n_experts:
+        raise NotImplementedError("KV-cache decode supports the dense trunk")
+    if tokens.shape[1] > cache["k"].shape[2]:
+        # RoPE tables and the cache are both static; overflow would clamp
+        # indices and silently corrupt instead of erroring.
+        raise ValueError(
+            f"{tokens.shape[1]} tokens cannot fit a "
+            f"{cache['k'].shape[2]}-position cache"
+        )
+    dt = cfg.compute_dtype
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                theta=cfg.rope_theta)
+    length = cache["length"]
+    x = params["embed"][tokens].astype(dt)
+
+    def body(carry, layer_in):
+        lp, kc, vc = layer_in
+        x, kc, vc = _layer_decode(carry, lp, kc, vc, length, cfg, cos, sin)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    # Only the last position is ever sampled — slice BEFORE the unembed so
+    # prefill never materializes [B, S, V] logits.
+    x = rms_norm(x[:, -1:], params["final_norm"]).astype(dt)
+    logits = jnp.einsum(
+        "btd,dv->btv", x, params["unembed"].astype(dt)
+    )[:, 0].astype(jnp.float32)
+    new_cache = {
+        "k": k_all, "v": v_all,
+        "length": length + tokens.shape[1],
+    }
+    return logits, new_cache
+
+
+def _sample(logits, temperature, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature")
+)
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive generation: prefill the prompt [B, T0], then decode
+    ``max_new_tokens`` greedily (or by temperature sampling). Returns the
+    generated tokens [B, max_new_tokens]."""
+    b, t0 = prompt.shape
+    if t0 + max_new_tokens > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cfg.max_seq ({cfg.max_seq}) — RoPE positions would clamp and "
+            f"silently repeat"
+        )
+    if temperature != 0.0 and key is None:
+        raise ValueError("temperature sampling needs an explicit PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused in greedy mode
+    cache = init_cache(cfg, b, t0 + max_new_tokens)
+    logits, cache = advance(params, cache, prompt, cfg)
+
+    def step(carry, step_key):
+        cache, logits = carry
+        tok = _sample(logits, temperature, step_key)
+        logits, cache = advance(params, cache, tok[:, None], cfg)
+        return (cache, logits), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), toks = lax.scan(step, (cache, logits), keys)
+    return toks.T  # [B, max_new_tokens]

@@ -192,9 +192,14 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
     return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
-def _dense_mlp(x, lp, cfg, *, manual: bool, mesh: Mesh | None = None):
+def _dense_mlp(
+    x, lp, cfg, *, manual: bool, mesh: Mesh | None = None,
+    constrain: bool = True,
+):
     """SwiGLU. tp splits d_ff columns; manual mode psums the row-parallel
-    down-projection (megatron pattern), GSPMD lets SPMD insert it."""
+    down-projection (megatron pattern), GSPMD lets SPMD insert it.
+    ``constrain=False`` skips the sharding constraint for mesh-free callers
+    (the KV-cache decode path reuses this exact math)."""
     dt = cfg.compute_dtype
     h = rms_norm(x, lp["ln2"]).astype(dt)
     g = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dt))
@@ -203,6 +208,8 @@ def _dense_mlp(x, lp, cfg, *, manual: bool, mesh: Mesh | None = None):
     out = jnp.einsum("btf,fd->btd", act, lp["w_down"].astype(dt))
     if manual:
         return lax.psum(out, "tp")
+    if not constrain:
+        return out
     return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
