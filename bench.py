@@ -95,11 +95,13 @@ def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 30):
         for _ in range(3):
             state, metrics = step_fn(state, tokens)
         float(metrics["loss"])  # host readback = real fence
-        t0 = time.perf_counter()
-        for _ in range(measure):
-            state, metrics = step_fn(state, tokens)
-        float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        dt = float("inf")  # best of 2: the tunneled chip sees transient
+        for _ in range(2):  # contention that can halve a single window
+            t0 = time.perf_counter()
+            for _ in range(measure):
+                state, metrics = step_fn(state, tokens)
+            float(metrics["loss"])
+            dt = min(dt, time.perf_counter() - t0)
 
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     tokens_per_step = batch * seq
@@ -149,11 +151,13 @@ def bench_resnet50(batch: int = 32, size: int = 224, measure: int = 20):
         for _ in range(3):
             state, metrics = step_fn(state, images, labels)
         float(metrics["loss"])  # host readback = real fence
-        t0 = time.perf_counter()
-        for _ in range(measure):
-            state, metrics = step_fn(state, images, labels)
-        float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        dt = float("inf")  # best of 2 (see bench_transformer)
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(measure):
+                state, metrics = step_fn(state, images, labels)
+            float(metrics["loss"])
+            dt = min(dt, time.perf_counter() - t0)
     return {
         "images_per_sec_per_chip": round(batch * measure / dt, 1),
         "batch": batch,

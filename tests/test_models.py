@@ -338,3 +338,14 @@ class TestDecode:
         with pytest.raises(ValueError, match="PRNG key"):
             generate(params, jnp.ones((1, 4), jnp.int32), cfg, 4,
                      temperature=1.0)
+
+    def test_cumulative_cache_overflow_rejected_eagerly(self):
+        from tony_tpu.models import advance, init_cache
+        import pytest
+
+        cfg, params = self._setup()
+        cache = init_cache(cfg, 1, 16)
+        _, cache = advance(params, cache,
+                           jnp.ones((1, 10), jnp.int32), cfg)
+        with pytest.raises(ValueError, match="cannot take"):
+            advance(params, cache, jnp.ones((1, 10), jnp.int32), cfg)

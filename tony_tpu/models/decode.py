@@ -93,13 +93,23 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     (last-position logits [B, V] fp32, updated cache)."""
     if cfg.n_experts:
         raise NotImplementedError("KV-cache decode supports the dense trunk")
-    if tokens.shape[1] > cache["k"].shape[2]:
+    capacity = cache["k"].shape[2]
+    if tokens.shape[1] > capacity:
         # RoPE tables and the cache are both static; overflow would clamp
         # indices and silently corrupt instead of erroring.
         raise ValueError(
-            f"{tokens.shape[1]} tokens cannot fit a "
-            f"{cache['k'].shape[2]}-position cache"
+            f"{tokens.shape[1]} tokens cannot fit a {capacity}-position "
+            f"cache"
         )
+    if not isinstance(cache["length"], jax.core.Tracer):
+        # Eager incremental use (chat-style repeated advance calls): the
+        # cumulative check is only possible with a concrete length — under
+        # jit the caller owns capacity (generate() pre-validates its loop).
+        if int(cache["length"]) + tokens.shape[1] > capacity:
+            raise ValueError(
+                f"cache at length {int(cache['length'])} cannot take "
+                f"{tokens.shape[1]} more tokens (capacity {capacity})"
+            )
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                 theta=cfg.rope_theta)
