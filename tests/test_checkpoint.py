@@ -4,6 +4,7 @@ resume path implies (SURVEY §5.4; session retry is
 TonyApplicationMaster.reset:526-542)."""
 
 import sys
+import time
 from pathlib import Path
 
 import jax
@@ -113,15 +114,28 @@ def test_explicit_step_missing_or_torn_returns_none(tmp_path):
 
 
 def test_gc_reclaims_old_torn_dirs(tmp_path):
-    mgr = CheckpointManager(tmp_path, max_to_keep=2)
+    mgr = CheckpointManager(tmp_path, max_to_keep=2, torn_gc_grace_s=0.0)
     mgr.save(1, _state(1.0), blocking=True)
     # a crash leftover older than the kept window
     (tmp_path / "step_0").mkdir()
     (tmp_path / "step_0" / ".tmp_process_0.npz").write_bytes(b"torn")
+    time.sleep(0.01)  # let the leftover age past the (zero) grace window
     for s in (2, 3):
         mgr.save(s, _state(float(s)), blocking=True)
     assert mgr._complete_steps() == [2, 3]
     assert not (tmp_path / "step_0").exists()
+
+
+def test_gc_spares_recently_written_torn_dirs(tmp_path):
+    """A torn dir still being written (recent mtime) survives GC: process 0
+    must not rmtree a straggler's in-flight older-step write."""
+    mgr = CheckpointManager(tmp_path, max_to_keep=2, torn_gc_grace_s=3600.0)
+    mgr.save(1, _state(1.0), blocking=True)
+    (tmp_path / "step_0").mkdir()
+    (tmp_path / "step_0" / ".tmp_process_1.npz").write_bytes(b"in flight")
+    for s in (2, 3):
+        mgr.save(s, _state(float(s)), blocking=True)
+    assert (tmp_path / "step_0").exists()
 
 
 def test_structure_mismatch_raises(tmp_path):

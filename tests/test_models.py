@@ -349,3 +349,30 @@ class TestDecode:
                            jnp.ones((1, 10), jnp.int32), cfg)
         with pytest.raises(ValueError, match="cannot take"):
             advance(params, cache, jnp.ones((1, 10), jnp.int32), cfg)
+
+    def test_checked_overflow_caught_under_jit(self):
+        """checked=True + checkify turns a traced-length cache overflow into
+        a runtime error instead of a clamped, silently-corrupting update."""
+        from jax.experimental import checkify
+
+        from tony_tpu.models import advance, init_cache
+
+        cfg, params = self._setup()
+
+        @jax.jit
+        def two_steps(params, tokens):
+            cache = init_cache(cfg, 1, 16)
+            err1, (_, cache) = checkify.checkify(
+                lambda: advance(params, cache, tokens, cfg, checked=True)
+            )()
+            err2, _ = checkify.checkify(
+                lambda: advance(params, cache, tokens, cfg, checked=True)
+            )()
+            return err1, err2
+
+        err1, err2 = two_steps(params, jnp.ones((1, 10), jnp.int32))
+        err1.throw()  # 10 <= 16: fine
+        import pytest
+
+        with pytest.raises(Exception, match="KV cache overflow"):
+            err2.throw()  # 20 > 16

@@ -37,6 +37,7 @@ import os
 import re
 import shutil
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
@@ -104,12 +105,17 @@ class CheckpointManager:
         process_id: int = 0,
         num_processes: int = 1,
         max_to_keep: int = 3,
+        torn_gc_grace_s: float = 300.0,
     ) -> None:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.process_id = process_id
         self.num_processes = num_processes
         self.max_to_keep = max_to_keep
+        # Torn (incomplete) dirs are only GC'd once quiescent for this long,
+        # so process 0 can't delete a straggler's in-flight older-step write
+        # out from under it when processes desync.
+        self.torn_gc_grace_s = torn_gc_grace_s
         self._writer: threading.Thread | None = None
         self._writer_exc: BaseException | None = None
 
@@ -286,7 +292,22 @@ class CheckpointManager:
             n = int(m.group(1))
             stale_complete = n in set(complete) - kept
             torn_and_old = (
-                n not in complete and threshold is not None and n < threshold
+                n not in complete
+                and threshold is not None
+                and n < threshold
+                and self._quiescent(child)
             )
             if stale_complete or torn_and_old:
                 shutil.rmtree(child, ignore_errors=True)
+
+    def _quiescent(self, child: Path) -> bool:
+        """True when nothing under ``child`` was modified within the grace
+        window — a straggler still writing an old step keeps its dir alive."""
+        try:
+            newest = max(
+                (p.stat().st_mtime for p in child.rglob("*")),
+                default=child.stat().st_mtime,
+            )
+        except OSError:
+            return False  # files vanishing under us: someone is active
+        return (time.time() - newest) > self.torn_gc_grace_s

@@ -35,8 +35,12 @@ class TonyConfiguration:
 
     def __init__(self, load_defaults: bool = True) -> None:
         self._props: dict[str, Any] = {}
+        # Keys set by any layer above the shipped defaults resource — lets
+        # callers distinguish "the default says X" from "the operator said X"
+        # (e.g. an explicit tony.http.port=disabled must be honored).
+        self._explicit: set[str] = set()
         if load_defaults:
-            self.add_resource(_RESOURCE_DIR / constants.TONY_DEFAULT_CONF)
+            self._add_resource_raw(_RESOURCE_DIR / constants.TONY_DEFAULT_CONF)
             site_dir = os.environ.get(constants.TONY_CONF_DIR_ENV)
             if site_dir:
                 site = Path(site_dir) / constants.TONY_SITE_CONF
@@ -44,16 +48,21 @@ class TonyConfiguration:
                     self.add_resource(site)
 
     # -- layering ----------------------------------------------------------
-    def add_resource(self, path: str | os.PathLike[str]) -> "TonyConfiguration":
+    def _add_resource_raw(self, path: str | os.PathLike[str]) -> dict[str, Any]:
         with open(path, "r", encoding="utf-8") as f:
             data = json.load(f)
         if not isinstance(data, dict):
             raise ValueError(f"config resource {path} must be a JSON object")
         self._props.update(data)
+        return data
+
+    def add_resource(self, path: str | os.PathLike[str]) -> "TonyConfiguration":
+        self._explicit.update(self._add_resource_raw(path))
         return self
 
     def set_all(self, overrides: Mapping[str, Any]) -> "TonyConfiguration":
         self._props.update(overrides)
+        self._explicit.update(overrides)
         return self
 
     def set_kv_list(self, kvs: list[str]) -> "TonyConfiguration":
@@ -63,7 +72,13 @@ class TonyConfiguration:
             if not sep:
                 raise ValueError(f"--conf expects key=value, got {kv!r}")
             self._props[k.strip()] = v.strip()
+            self._explicit.add(k.strip())
         return self
+
+    def is_explicit(self, key: str) -> bool:
+        """True when ``key`` was set by a layer above the shipped defaults
+        (site/job file, overrides, or programmatic ``set``)."""
+        return key in self._explicit
 
     # -- accessors ---------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:
@@ -80,6 +95,7 @@ class TonyConfiguration:
 
     def set(self, key: str, value: Any) -> None:
         self._props[key] = value
+        self._explicit.add(key)
 
     def get_int(self, key: str, default: int = 0) -> int:
         v = self._props.get(key)

@@ -234,9 +234,13 @@ def main(argv: list[str] | None = None) -> int:
     else:
         try:
             server = HistoryServer.from_conf(conf, location, host=args.host)
-        except NothingToServe:
-            # nothing configured: starting the server IS the opt-in, so
-            # fall back to plain http on the reference's default port
+        except NothingToServe as exc:
+            if conf.is_explicit(keys.K_HTTP_PORT):
+                # The operator explicitly disabled http and configured no
+                # cert: honor it — an explicit --port is the only override.
+                p.error(str(exc))
+            # Nothing configured at all: starting the server IS the opt-in,
+            # so fall back to plain http on the reference's default port.
             server = HistoryServer(location, 19886, host=args.host)
     print(f"history server on {server.scheme}://localhost:{server.port}")
     try:

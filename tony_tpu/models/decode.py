@@ -88,9 +88,17 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
 
 
 def advance(params: dict, cache: dict, tokens: jax.Array,
-            cfg: TransformerConfig):
+            cfg: TransformerConfig, *, checked: bool = False):
     """Feed ``tokens`` [B, S] at the cache's current length; returns
-    (last-position logits [B, V] fp32, updated cache)."""
+    (last-position logits [B, V] fp32, updated cache).
+
+    Capacity contract under jit: with a traced ``cache["length"]`` the
+    cumulative bound cannot be checked eagerly, and an overflowing
+    ``dynamic_update_slice`` clamps its start index — wrong-position K/V,
+    silently. Jitted callers must pre-validate their loop the way
+    ``generate()`` does (prompt + max_new_tokens ≤ capacity), or pass
+    ``checked=True`` and wrap the call in ``jax.experimental.checkify``
+    to turn overflow into a checked runtime error."""
     if cfg.n_experts:
         raise NotImplementedError("KV-cache decode supports the dense trunk")
     capacity = cache["k"].shape[2]
@@ -104,12 +112,22 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     if not isinstance(cache["length"], jax.core.Tracer):
         # Eager incremental use (chat-style repeated advance calls): the
         # cumulative check is only possible with a concrete length — under
-        # jit the caller owns capacity (generate() pre-validates its loop).
+        # jit the caller owns capacity (generate() pre-validates its loop,
+        # see the capacity contract in the docstring).
         if int(cache["length"]) + tokens.shape[1] > capacity:
             raise ValueError(
                 f"cache at length {int(cache['length'])} cannot take "
                 f"{tokens.shape[1]} more tokens (capacity {capacity})"
             )
+    elif checked:
+        from jax.experimental import checkify
+
+        checkify.check(
+            cache["length"] + tokens.shape[1] <= capacity,
+            "KV cache overflow: length {l} + {s} new tokens exceeds "
+            "capacity {c}", l=cache["length"],
+            s=jnp.int32(tokens.shape[1]), c=jnp.int32(capacity),
+        )
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                 theta=cfg.rope_theta)

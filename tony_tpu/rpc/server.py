@@ -8,6 +8,7 @@ token (the ClientToAM-token analogue, TonyApplicationMaster.java:401-411).
 
 from __future__ import annotations
 
+import hmac
 import logging
 import random
 import socket
@@ -105,13 +106,27 @@ class ApplicationRpcServer:
         role: str | None = None
         if self._role_tokens is not None:
             auth = req.get("auth")
-            role = (
-                self._role_tokens.get(auth) if isinstance(auth, str) else None
-            )
+            # Constant-time scan over all tokens: no early exit, no dict
+            # lookup, so timing leaks neither a token match nor its prefix.
+            if isinstance(auth, str):
+                # surrogatepass: JSON escapes can smuggle lone surrogates
+                # that a strict encode would raise on mid-dispatch.
+                presented = auth.encode("utf-8", "surrogatepass")
+                for token, token_role in self._role_tokens.items():
+                    if hmac.compare_digest(token.encode(), presented):
+                        role = token_role
             if role is None:
                 return {"ok": False, "error": "authentication failed"}
-        elif self._secret is not None and req.get("auth") != self._secret:
-            return {"ok": False, "error": "authentication failed"}
+        elif self._secret is not None:
+            auth = req.get("auth")
+            if not (
+                isinstance(auth, str)
+                and hmac.compare_digest(
+                    self._secret.encode(),
+                    auth.encode("utf-8", "surrogatepass"),
+                )
+            ):
+                return {"ok": False, "error": "authentication failed"}
         method = req.get("method")
         if method not in RPC_METHODS:
             return {"ok": False, "error": f"unknown method {method!r}"}
