@@ -125,6 +125,98 @@ def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 30):
     }
 
 
+def bench_decode(batch: int = 8, prompt_len: int = 128, new_tokens: int = 128,
+                 n_kv_heads: int = 4, windows: int = 3):
+    """KV-cache greedy decode on the flagship LM with GQA (the decode
+    bandwidth lever — the cache holds n_kv_heads of the 16 query heads).
+    Wall tok/s is best-of-N generate calls (tunnel variance), device-bound
+    ceiling is higher; see BASELINE.md."""
+    from tony_tpu.models import TransformerConfig, generate, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
+        d_ff=4096, max_seq=2048, dtype="bfloat16", remat=False,
+        n_kv_heads=n_kv_heads,
+    )
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                          (batch, prompt_len)),
+        jnp.int32,
+    )
+
+    def timed(n: int) -> float:
+        toks = generate(params, prompt, cfg, max_new_tokens=n)
+        float(jnp.sum(toks))  # compile + fence
+        dt = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            toks = generate(params, prompt, cfg, max_new_tokens=n)
+            float(jnp.sum(toks))
+            dt = min(dt, time.perf_counter() - t0)
+        return dt
+
+    # Two horizons; the difference isolates the marginal decode step from
+    # the prefill + dispatch cost that a single-horizon wall divide would
+    # smear into "step_ms" (it would then move with prompt_len).
+    short = max(8, new_tokens // 4)
+    dt_full = timed(new_tokens)
+    dt_short = timed(short)
+    step_s = max(dt_full - dt_short, 1e-9) / (new_tokens - short)
+    return {
+        "tokens_per_sec_per_chip": round(batch / step_s),
+        "step_ms": round(step_s * 1000, 3),
+        "generate_wall_tokens_per_sec": round(batch * new_tokens / dt_full),
+        "prefill_plus_overhead_ms": round(
+            (dt_short - short * step_s) * 1000, 2
+        ),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "n_kv_heads": n_kv_heads,
+    }
+
+
+def bench_moe(batch: int = 4, seq: int = 2048, measure: int = 8):
+    """MoE trunk train step on one chip (4 experts, top-2, with the Switch
+    balance + router z losses active): tokens/sec/chip."""
+    from tony_tpu.models import TransformerConfig, make_train_step
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
+        d_ff=4096, max_seq=seq, dtype="bfloat16", remat=True,
+        remat_policy="dots", n_experts=4, expert_top_k=2,
+    )
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        jnp.int32,
+    )
+    with jax.sharding.set_mesh(mesh):
+        state = init_fn(jax.random.key(0))
+        for _ in range(2):
+            state, metrics = step_fn(state, tokens)
+        float(metrics["loss"])
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(measure):
+                state, metrics = step_fn(state, tokens)
+            float(metrics["loss"])
+            dt = min(dt, time.perf_counter() - t0)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    return {
+        "tokens_per_sec_per_chip": round(batch * seq * measure / dt),
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": seq,
+        "moe_entropy": round(float(metrics["moe_entropy"]), 3),
+        "moe_drop_rate": round(float(metrics["moe_drop_rate"]), 4),
+    }
+
+
 def bench_resnet50(batch: int = 32, size: int = 224, measure: int = 20):
     """ResNet-50 full train step (fwd+loss+grad+adam), images/sec/chip —
     the BASELINE config-5 workload."""
@@ -214,6 +306,8 @@ def main() -> None:
                 batch=2, seq=8192, measure=8
             ),
             "resnet50": bench_resnet50(),
+            "decode_gqa": bench_decode(),
+            "moe": bench_moe(),
             "flash_attention_2k": bench_flash_attention(seq=2048, batch=4),
             "flash_attention_8k": bench_flash_attention(seq=8192, batch=1),
             "device": jax.devices()[0].device_kind,

@@ -106,6 +106,63 @@ class TestTrainStep:
                 losses.append(float(metrics["loss"]))
         assert all(np.isfinite(losses))
         assert losses[2] < losses[0]
+        # Router metrics ride the step output on the MoE path.
+        for k in ("moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy"):
+            assert np.isfinite(float(metrics[k])), k
+
+    def test_moe_balance_loss_recovers_biased_router(self):
+        """Start from a router collapsed onto expert 0 (shrunk weights plus
+        an expert-0 column aligned with the batch's activation directions):
+        with the Switch balance loss the assignment re-spreads (entropy
+        rises to ~ln E, drop rate goes to 0); with the coefficient at 0 the
+        collapse persists. This is the failure mode the aux loss exists
+        for — dropped tokens silently pass through the residual."""
+
+        def run(balance_coef, steps=40):
+            cfg = TransformerConfig(
+                vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+                head_dim=16, d_ff=64, max_seq=64, n_experts=4,
+                expert_top_k=1, dtype="float32", remat=False,
+                moe_balance_coef=balance_coef,
+            )
+            mesh = build_mesh(MeshSpec(dp=2, ep=2, tp=2))
+            init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-2)
+            rng = np.random.default_rng(1)
+            tokens = jnp.asarray(rng.integers(0, 128, (4, 17)), jnp.int32)
+            with jax.sharding.set_mesh(mesh):
+                state = init_fn(jax.random.key(0))
+                embed = state.params["embed"]
+                used = jnp.unique(tokens)
+                direction = embed[used]
+                direction = (
+                    direction
+                    / jnp.linalg.norm(direction, axis=-1, keepdims=True)
+                ).sum(0)
+                router = state.params["layers"]["router"] * 0.05
+                router = router.at[:, :, 0].add(0.1 * direction)
+                state = state._replace(
+                    params={**state.params,
+                            "layers": {**state.params["layers"],
+                                       "router": router}},
+                )
+                hist = []
+                for _ in range(steps):
+                    state, metrics = step_fn(state, tokens)
+                    hist.append({k: float(v) for k, v in metrics.items()})
+            return hist
+
+        with_aux = run(0.05)
+        without = run(0.0)
+        ln_e = float(np.log(4))
+        # Both start collapsed: entropy well below uniform, heavy overflow.
+        assert with_aux[0]["moe_entropy"] < 0.65 * ln_e
+        assert with_aux[0]["moe_drop_rate"] > 0.3
+        # The balance loss re-spreads routing; CE alone does not (top-1
+        # combine weights are constant 1, so CE gives the router no signal).
+        assert with_aux[-1]["moe_entropy"] > 0.9 * ln_e
+        assert with_aux[-1]["moe_drop_rate"] < 0.05
+        assert without[-1]["moe_entropy"] < 0.7 * ln_e
+        assert without[-1]["moe_drop_rate"] > 0.3
 
     def test_pipeline_step_pp_tp_dp(self):
         mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
@@ -141,6 +198,66 @@ class TestTrainStep:
                 lambda p, t: lm_loss(p, t, CFG, pmesh, pipeline_microbatches=4)
             )(params, tokens))
         np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_interleaved_schedule_matches_gpipe_loss_and_grads(self):
+        """Megatron-style virtual stages (v=2) vs GPipe on the same pp=2
+        mesh: identical loss AND identical gradients — the round-robin
+        chunk placement and wrap-around output collection must be a pure
+        re-scheduling of the same math."""
+        tokens = _tokens()
+        params = jax.jit(lambda k: init_params(k, CFG))(jax.random.key(3))
+        pmesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+
+        def loss_fn(schedule, virtual):
+            def f(p, t):
+                return lm_loss(p, t, CFG, pmesh, pipeline_microbatches=4,
+                               pipeline_schedule=schedule,
+                               pipeline_virtual=virtual)
+            return f
+
+        with jax.sharding.set_mesh(pmesh):
+            lg, gg = jax.jit(jax.value_and_grad(loss_fn("gpipe", 1)))(
+                params, tokens)
+            li, gi = jax.jit(
+                jax.value_and_grad(loss_fn("interleaved", 2))
+            )(params, tokens)
+        np.testing.assert_allclose(float(li), float(lg), rtol=2e-5)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(gg)[0],
+            jax.tree_util.tree_flatten_with_path(gi)[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+                err_msg=str(path),
+            )
+
+    def test_interleaved_schedule_shrinks_bubble(self):
+        """Tick accounting: at v virtual stages the idle bubble per device
+        drops from (pp-1) full-stage ticks to (pp-1) chunk ticks — a ~v
+        fold reduction of idle time (the schedule implementations derive
+        their scan lengths from this same function)."""
+        from tony_tpu.parallel.pipeline import schedule_info
+
+        m, pp, layers = 8, 4, 16
+        v = 2
+        gp = schedule_info("gpipe", m, pp, layers)
+        il = schedule_info("interleaved", m, pp, layers, virtual=v)
+        # Idle time per device, in units of layer executions: GPipe idles
+        # (pp-1) full ticks, interleaved pp chunk-ticks of 1/v the work —
+        # a ((pp-1)/pp)*v-fold shrink (1.5x here).
+        gp_idle = gp.bubble_fraction * gp.ticks * gp.tick_layers
+        il_idle = il.bubble_fraction * il.ticks * il.tick_layers
+        assert gp_idle == pytest.approx((pp - 1) * layers / pp)
+        assert il_idle == pytest.approx(layers / v)
+        assert il_idle < gp_idle / (((pp - 1) / pp) * v * 0.99)
+        # Same useful work either way: m microbatches x all layers / pp —
+        # exact in both schedules (the accounting must conserve work).
+        assert gp.ticks * gp.tick_layers * (1 - gp.bubble_fraction) == (
+            pytest.approx(m * layers / pp)
+        )
+        assert il.ticks * il.tick_layers * (1 - il.bubble_fraction) == (
+            pytest.approx(m * layers / pp)
+        )
 
     def test_moe_requires_gspmd_trunk(self):
         cfg = TransformerConfig(n_experts=4, n_layers=2)
@@ -349,6 +466,61 @@ class TestDecode:
                            jnp.ones((1, 10), jnp.int32), cfg)
         with pytest.raises(ValueError, match="cannot take"):
             advance(params, cache, jnp.ones((1, 10), jnp.int32), cfg)
+
+    def test_gqa_trains_and_decodes_token_exact(self):
+        """GQA config (4 q heads, 2 kv heads): the train step descends and
+        cached greedy decode matches full-recompute argmax token-for-token
+        — same pin as the MHA parity tests, over the shrunken cache."""
+        from tony_tpu.models import (
+            TransformerConfig, forward, generate, make_train_step,
+        )
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, n_kv_heads=2, dtype="float32", remat=False,
+        )
+        mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-2)
+        rng = np.random.default_rng(5)
+        tokens = jnp.asarray(rng.integers(0, 64, (4, 33)), jnp.int32)
+        with jax.sharding.set_mesh(mesh):
+            state = init_fn(jax.random.key(2))
+            losses = []
+            for _ in range(5):
+                state, metrics = step_fn(state, tokens)
+                losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+        params = jax.device_get(state.params)
+        prompt = tokens[:2, :8]
+        got = generate(params, prompt, cfg, max_new_tokens=6)
+        # Reference: argmax over the full training forward, re-fed greedily.
+        ctx = prompt
+        want = []
+        # Trivial 1-device mesh for the reference loop: its growing seq
+        # lengths and batch 2 divide neither the training mesh's sp nor dp.
+        dmesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+        with jax.sharding.set_mesh(dmesh):
+            for _ in range(6):
+                logits = forward(params, ctx, cfg, dmesh)[:, -1]
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                want.append(nxt)
+                ctx = jnp.concatenate([ctx, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.stack(want, axis=1)
+        )
+
+    def test_gqa_cache_is_smaller(self):
+        from tony_tpu.models import TransformerConfig, init_cache
+
+        mha = TransformerConfig(n_heads=8, head_dim=16, d_model=128)
+        gqa = TransformerConfig(
+            n_heads=8, head_dim=16, d_model=128, n_kv_heads=2
+        )
+        c_mha = init_cache(mha, 2, 32)
+        c_gqa = init_cache(gqa, 2, 32)
+        assert c_gqa["k"].size * 4 == c_mha["k"].size
 
     def test_checked_overflow_caught_under_jit(self):
         """checked=True + checkify turns a traced-length cache overflow into

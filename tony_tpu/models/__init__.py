@@ -22,7 +22,7 @@ from tony_tpu.models.transformer import (
     forward_pipeline,
     param_roles,
 )
-from tony_tpu.models.decode import advance, generate, init_cache
+from tony_tpu.models.decode import advance, decode_weights, generate, init_cache
 from tony_tpu.models.mnist import MnistConfig, mnist_init, mnist_apply
 from tony_tpu.models.resnet import ResNetConfig, resnet_init, resnet_apply
 from tony_tpu.models.train import (
@@ -49,6 +49,7 @@ __all__ = [
     "make_image_classifier_step",
     "lm_loss",
     "advance",
+    "decode_weights",
     "generate",
     "init_cache",
 ]

@@ -7,13 +7,18 @@ choices:
 * One jittable ``advance`` handles both prefill (S = prompt length) and
   single-token steps (S = 1): static shapes per call site, so XLA compiles
   exactly two executables for a whole generation loop.
-* The cache is a stacked [L, B, Tmax, H, Dh] pair updated with
-  ``dynamic_update_slice`` at a traced offset; the layer loop stays one
-  ``lax.scan`` over the stacked layer params (same trunk layout as
-  training, so trained checkpoints drop in).
-* Decode attention is a dense matvec against the cache with a global
-  causal position mask (t_q is 1 or the prompt length — flash blocking
-  buys nothing there), fp32 softmax like the training kernels.
+* The cache is a stacked [L, B, Tmax, Hkv, Dh] pair updated with
+  ``dynamic_update_slice`` at a traced offset; Hkv < H under GQA — the
+  n_heads/n_kv_heads cache shrink is the main decode-bandwidth lever. The
+  layer loop stays one ``lax.scan`` over the stacked layer params (same
+  trunk layout as training, so trained checkpoints drop in).
+* Decode attention is a grouped dense matvec against the cache (q regrouped
+  [B, S, Hkv, G, Dh] so the cache is never head-repeated), read in the
+  stored dtype with fp32 MXU accumulation and fp32 softmax (t_q is 1 or
+  the prompt length — flash blocking buys nothing there).
+* ``decode_weights`` re-packs the fp32 training masters once per generate
+  call: downcast to the compute dtype, qkv and gate|up fused — decode at
+  small batch is bandwidth/op-count-bound, so fewer, wider matmuls win.
 
 Dense trunk only (MoE decode needs expert caching; ``generate`` rejects
 ``n_experts > 0`` explicitly). Sampling: greedy at ``temperature=0``,
@@ -28,14 +33,53 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tony_tpu.models.transformer import TransformerConfig, _dense_mlp
+from tony_tpu.models.transformer import TransformerConfig
 from tony_tpu.ops import apply_rope, rms_norm, rope_frequencies
 
 NEG_INF = -1e30
 
 
+def decode_weights(params: dict, cfg: TransformerConfig) -> dict:
+    """Re-pack training params for the decode loop: cast fp32 masters to the
+    compute dtype and fuse the per-layer projections (wq|wk|wv on the head
+    axis, w_gate|w_up on the feature axis) so each decode step runs one
+    matmul where training runs three/two. Decode is bandwidth- and
+    op-count-bound at batch sizes the MXU can't fill; the fusion runs once
+    per ``generate`` call (XLA hoists it out of the token loop).
+
+    ``advance`` accepts either this fused layout or raw training params
+    (fusing on the fly), so eager chat-style callers need not care."""
+    dt = cfg.compute_dtype
+    lp = params["layers"]
+
+    def c(x):
+        return x.astype(dt)
+
+    return {
+        "embed": c(params["embed"]),
+        "final_norm": c(params["final_norm"]),
+        "unembed": c(params["unembed"]),
+        "layers": {
+            "ln1": c(lp["ln1"]),
+            "ln2": c(lp["ln2"]),
+            # [L, d, H + 2*Hkv, Dh]
+            "qkv": jnp.concatenate(
+                [c(lp["wq"]), c(lp["wk"]), c(lp["wv"])], axis=2
+            ),
+            "wo": c(lp["wo"]),
+            # [L, d, 2*F]
+            "gate_up": jnp.concatenate(
+                [c(lp["w_gate"]), c(lp["w_up"])], axis=2
+            ),
+            "w_down": c(lp["w_down"]),
+        },
+    }
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    # kv_heads (not n_heads): GQA caches only the shared K/V heads — an
+    # n_heads/n_kv_heads shrink in both HBM footprint and per-step traffic.
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     dt = cfg.compute_dtype
     return {
         "k": jnp.zeros(shape, dt),
@@ -46,15 +90,18 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
 
 def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
     """One decoder layer over S new tokens at positions [length, length+S).
-    x: [B, S, d]; caches [B, Tmax, H, Dh]. Returns (x, k_cache, v_cache)."""
+    x: [B, S, d]; caches [B, Tmax, Hkv, Dh]; lp in the fused
+    ``decode_weights`` layout. Returns (x, k_cache, v_cache)."""
     dt = cfg.compute_dtype
     b, s, _ = x.shape
     t_max = k_cache.shape[1]
+    n_h, h_kv = cfg.n_heads, k_cache.shape[2]
 
     h = rms_norm(x, lp["ln1"]).astype(dt)
-    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
-    k_new = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
-    v_new = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
+    qkv = jnp.einsum("btd,dhk->bthk", h, lp["qkv"])
+    q = qkv[:, :, :n_h]
+    k_new = qkv[:, :, n_h:n_h + h_kv]
+    v_new = qkv[:, :, n_h + h_kv:]
     positions = length + jnp.arange(s)
     q = apply_rope(q, cos, sin, positions=positions)
     k_new = apply_rope(k_new, cos, sin, positions=positions)
@@ -66,24 +113,36 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
         v_cache, v_new.astype(v_cache.dtype), (0, length, 0, 0)
     )
 
+    # Grouped attention against the cache: q regrouped as [B, S, Hkv, G, Dh]
+    # so each K/V head serves its G query heads without materializing a
+    # repeated cache. The einsums read the cache in its stored dtype
+    # (bfloat16) with fp32 MXU accumulation — no fp32 upcast copy of the
+    # full T_max cache per step — and softmax stays fp32.
+    g = n_h // h_kv
     scale = cfg.head_dim ** -0.5
+    qg = q.reshape(b, s, h_kv, g, cfg.head_dim)
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk",
-        q.astype(jnp.float32), k_cache.astype(jnp.float32),
+        "bqhgd,bkhd->bhgqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
     ) * scale
     # Global causal mask; it also hides the cache tail past length+S
     # (those positions are > every query position). mask: [S, Tmax].
     mask = positions[:, None] >= jnp.arange(t_max)[None, :]
-    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     o = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
-    ).astype(dt)
-    x = x + jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
+        "bhgqk,bkhd->bqhgd", probs.astype(dt), v_cache,
+        preferred_element_type=jnp.float32,
+    ).astype(dt).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    x = x + jnp.einsum("bthk,hkd->btd", o, lp["wo"])
 
-    # Same MLP as training — one source of truth keeps the token-exact
-    # parity the tests pin.
-    x = x + _dense_mlp(x, lp, cfg, manual=False, constrain=False)
+    # SwiGLU with the fused gate|up projection — the same math as
+    # training's _dense_mlp, one matmul instead of two.
+    hn = rms_norm(x, lp["ln2"]).astype(dt)
+    gu = jnp.einsum("btd,df->btf", hn, lp["gate_up"])
+    f = gu.shape[-1] // 2
+    act = jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt) * gu[..., f:]
+    x = x + jnp.einsum("btf,fd->btd", act, lp["w_down"])
     return x, k_cache, v_cache
 
 
@@ -128,6 +187,10 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
             "capacity {c}", l=cache["length"],
             s=jnp.int32(tokens.shape[1]), c=jnp.int32(capacity),
         )
+    if "qkv" not in params["layers"]:
+        # Raw training params from an eager caller: fuse per call (generate
+        # fuses once, outside its token loop).
+        params = decode_weights(params, cfg)
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                 theta=cfg.rope_theta)
@@ -146,7 +209,7 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     # prefill never materializes [B, S, V] logits.
     x = rms_norm(x[:, -1:], params["final_norm"]).astype(dt)
     logits = jnp.einsum(
-        "btd,dv->btv", x, params["unembed"].astype(dt)
+        "btd,dv->btv", x, params["unembed"]
     )[:, 0].astype(jnp.float32)
     new_cache = {
         "k": k_all, "v": v_all,
@@ -163,9 +226,6 @@ def _sample(logits, temperature, key):
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature")
-)
 def generate(
     params: dict,
     prompt: jax.Array,
@@ -177,7 +237,13 @@ def generate(
 ) -> jax.Array:
     """Autoregressive generation: prefill the prompt [B, T0], then decode
     ``max_new_tokens`` greedily (or by temperature sampling). Returns the
-    generated tokens [B, max_new_tokens]."""
+    generated tokens [B, max_new_tokens].
+
+    Two jitted executables: weight fusion (``decode_weights``) runs as its
+    own dispatch, then the prefill+loop runs over the fused params. Fusing
+    inside the loop jit is a trap — XLA sinks the loop-invariant concat
+    into the while body and re-materializes it every token (measured 5
+    extra DMA copies/step), so the split is deliberate."""
     b, t0 = prompt.shape
     if t0 + max_new_tokens > cfg.max_seq:
         raise ValueError(
@@ -189,6 +255,29 @@ def generate(
         raise ValueError("temperature sampling needs an explicit PRNG key")
     if key is None:
         key = jax.random.key(0)  # unused in greedy mode
+    if "qkv" not in params["layers"]:
+        params = _decode_weights_jit(params, cfg)
+    return _generate_loop(params, prompt, cfg, max_new_tokens, temperature,
+                          key)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _decode_weights_jit(params: dict, cfg: TransformerConfig) -> dict:
+    return decode_weights(params, cfg)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature")
+)
+def _generate_loop(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    temperature: float,
+    key: jax.Array,
+) -> jax.Array:
+    b, t0 = prompt.shape
     cache = init_cache(cfg, b, t0 + max_new_tokens)
     logits, cache = advance(params, cache, prompt, cfg)
 

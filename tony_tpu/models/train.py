@@ -79,16 +79,38 @@ def lm_loss(
     mesh: Mesh | None = None,
     *,
     pipeline_microbatches: int | None = None,
-) -> jax.Array:
-    """Next-token cross-entropy. tokens: [B, T+1] int32."""
+    pipeline_schedule: str = "gpipe",
+    pipeline_virtual: int = 1,
+    return_metrics: bool = False,
+):
+    """Next-token cross-entropy, plus the MoE router auxiliary losses when
+    the config has experts (balance keeps routing uniform, z-loss keeps
+    router logits bounded — without them the router can collapse onto few
+    experts and dropped tokens silently stop training). tokens: [B, T+1]
+    int32. With ``return_metrics`` returns ``(total, metrics)`` where
+    metrics includes the raw cross-entropy and per-component router stats.
+    """
     inputs, labels = tokens[:, :-1], tokens[:, 1:]
     if pipeline_microbatches is not None:
         logits = forward_pipeline(
-            params, inputs, cfg, mesh, num_microbatches=pipeline_microbatches
+            params, inputs, cfg, mesh, num_microbatches=pipeline_microbatches,
+            schedule=pipeline_schedule, virtual_stages=pipeline_virtual,
         )
+        aux = {}
     else:
-        logits = forward(params, inputs, cfg, mesh)
-    return softmax_cross_entropy(logits, labels)
+        logits, aux = forward(params, inputs, cfg, mesh, return_aux=True)
+    ce = softmax_cross_entropy(logits, labels)
+    total = ce
+    if aux:
+        total = (
+            total
+            + cfg.moe_balance_coef * aux["moe_balance"]
+            + cfg.moe_zloss_coef * aux["moe_zloss"]
+        )
+    if not return_metrics:
+        return total
+    metrics = {"cross_entropy": ce, **aux}
+    return total, metrics
 
 
 def make_train_step(
@@ -99,6 +121,8 @@ def make_train_step(
     weight_decay: float = 0.1,
     grad_clip: float = 1.0,
     pipeline_microbatches: int | None = None,
+    pipeline_schedule: str = "gpipe",
+    pipeline_virtual: int = 1,
     optimizer: optax.GradientTransformation | None = None,
 ):
     """Returns (init_fn, step_fn), both jitted over ``mesh``.
@@ -132,19 +156,30 @@ def make_train_step(
     jit_init = jax.jit(init_fn, out_shardings=state_sh)
 
     def step_fn(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(lm_loss)(
+        (loss, metrics), grads = jax.value_and_grad(lm_loss, has_aux=True)(
             state.params, tokens, cfg, mesh,
             pipeline_microbatches=pipeline_microbatches,
+            pipeline_schedule=pipeline_schedule,
+            pipeline_virtual=pipeline_virtual,
+            return_metrics=True,
         )
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(state.step + 1, params, opt_state)
-        return new_state, {"loss": loss}
+        return new_state, {"loss": loss, **metrics}
 
+    # Metric structure is config-static: router stats exist only on the
+    # GSPMD MoE path (the pipeline trunk is dense-only).
+    metric_keys = ["loss", "cross_entropy"]
+    if cfg.n_experts and pipeline_microbatches is None:
+        metric_keys += [
+            "moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy",
+        ]
+    metrics_sh = {k: repl for k in metric_keys}
     jit_step = jax.jit(
         step_fn,
         in_shardings=(state_sh, batch_sh),
-        out_shardings=(state_sh, {"loss": repl}),
+        out_shardings=(state_sh, metrics_sh),
         donate_argnums=(0,),
     )
 

@@ -55,11 +55,21 @@ class TransformerConfig:
     d_ff: int = 1408
     max_seq: int = 2048
     rope_theta: float = 10_000.0
+    # GQA (grouped-query attention): number of K/V heads; 0 = n_heads
+    # (MHA). Shrinks the KV cache by n_heads/n_kv_heads — *the* decode
+    # bandwidth lever; training repeats K/V heads (compute-bound anyway).
+    n_kv_heads: int = 0
     # MoE: 0 experts = dense SwiGLU mlp. When > 0, every layer is an MoE
     # layer with top-k routing and capacity_factor token capacity.
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # Router auxiliary losses (Switch Transformer): the balance term keeps
+    # expert assignment near-uniform (its minimum), the z term keeps router
+    # logits small so the fp32 softmax stays well-conditioned. Both are
+    # added to the LM loss by lm_loss(); 0 disables.
+    moe_balance_coef: float = 0.01
+    moe_zloss_coef: float = 1e-3
     dtype: str = "bfloat16"
     remat: bool = True
     # "full": recompute the whole layer in backward (min memory);
@@ -71,6 +81,15 @@ class TransformerConfig:
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads {kv} must divide n_heads {self.n_heads}"
+            )
+        return kv
 
 
 # ---------------------------------------------------------------------------
@@ -84,6 +103,7 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     d, h, dh, f, l = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
     )
+    hkv = cfg.kv_heads
     keys = jax.random.split(key, 10)
 
     def norm(k, shape, scale):
@@ -92,8 +112,8 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     layer = {
         "ln1": jnp.ones((l, d), jnp.float32),
         "wq": norm(keys[1], (l, d, h, dh), d ** -0.5),
-        "wk": norm(keys[2], (l, d, h, dh), d ** -0.5),
-        "wv": norm(keys[3], (l, d, h, dh), d ** -0.5),
+        "wk": norm(keys[2], (l, d, hkv, dh), d ** -0.5),
+        "wv": norm(keys[3], (l, d, hkv, dh), d ** -0.5),
         "wo": norm(keys[4], (l, h, dh, d), (h * dh) ** -0.5),
         "ln2": jnp.ones((l, d), jnp.float32),
     }
@@ -164,6 +184,15 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
     k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
 
+    def expand_kv(arr):
+        """GQA: repeat K/V heads up to q's head count for attention paths
+        that expect matched heads (the repeat is a broadcast XLA folds into
+        the consuming matmul; training is compute-bound regardless — the
+        cache-size win happens in models/decode.py). Uses q's *local* head
+        count so it stays correct under tp-sliced manual mode."""
+        group = q.shape[2] // arr.shape[2]
+        return jnp.repeat(arr, group, axis=2) if group > 1 else arr
+
     if manual:
         sp = lax.axis_size("sp")
         t_local = x.shape[1]
@@ -172,7 +201,7 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
         k = apply_rope(k, cos, sin, positions=positions)
         if sp > 1:
             o = ring_attention_local(
-                q, k, v, axis_name="sp", causal=True,
+                q, expand_kv(k), expand_kv(v), axis_name="sp", causal=True,
                 scale=cfg.head_dim ** -0.5,
             )
         else:
@@ -185,7 +214,7 @@ def _attention(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        o = ring_attention(q, k, v, mesh, causal=True)
+        o = ring_attention(q, expand_kv(k), expand_kv(v), mesh, causal=True)
     else:
         o = flash_attention(q, k, v, causal=True)
     out = jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"].astype(dt))
@@ -220,6 +249,12 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
 
     Tokens beyond an expert's capacity are dropped (residual passes them
     through unchanged) — the standard capacity_factor trade.
+
+    Returns ``(out, aux)``; aux carries the Switch-style load-balance loss,
+    the router z-loss, and diagnostics (drop rate, assignment entropy) for
+    the train loop to surface. Without the balance term the router can
+    collapse onto few experts — dropped tokens then pass silently through
+    the residual and the layer stops training.
     """
     dt = cfg.compute_dtype
     b, t, d = x.shape
@@ -235,6 +270,18 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
     gvals = gvals / jnp.maximum(gvals.sum(-1, keepdims=True), 1e-9)
     onehot_e = jax.nn.one_hot(gidx, e, dtype=jnp.float32)  # [b,t,k,E]
 
+    # Switch balance loss (arXiv 2101.03961 eq. 4, generalized to top-k):
+    # E · Σ_e f_e·P_e where f_e is the fraction of routed (token, choice)
+    # slots assigned to expert e and P_e the mean router probability. f is
+    # one-hot (non-differentiable) — the gradient flows through P; minimum
+    # 1.0 at the uniform assignment. z-loss (PaLM §B): mean logsumexp², a
+    # pull toward small router logits.
+    frac = onehot_e.mean((0, 1, 2))                      # [E], sums to 1
+    pmean = probs.mean((0, 1))                           # [E]
+    balance = e * jnp.sum(frac * pmean)
+    zloss = jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
+    entropy = -jnp.sum(frac * jnp.log(frac + 1e-9))
+
     # Position of each (token, choice) within its expert: flatten in
     # (k-priority, token) order — all first choices queue before any second
     # choice — and cumsum per expert.
@@ -246,6 +293,7 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
     keep = (pos_e < cap).astype(jnp.float32)
     onehot_c = jax.nn.one_hot(pos_e, cap, dtype=jnp.float32)
     onehot_c = onehot_c * keep[..., None]               # [b,t,k,C]
+    drop_rate = 1.0 - keep.mean()
 
     dispatch = jnp.einsum("btke,btkc->btec", onehot_e, onehot_c)
     combine = jnp.einsum("btke,btkc->btec", onehot_e * gvals[..., None], onehot_c)
@@ -258,16 +306,27 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
     out_e = jnp.einsum("ecf,efd->ecd", act, lp["w_down"].astype(dt))
     out_e = with_logical_constraint(out_e, "expert", None, None, mesh=mesh)
     out = jnp.einsum("ecd,btec->btd", out_e, combine.astype(dt))
-    return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
+    out = with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
+    aux = {
+        "moe_balance": balance,
+        "moe_zloss": zloss,
+        "moe_drop_rate": drop_rate,
+        "moe_entropy": entropy,
+    }
+    return out, aux
 
 
 def _decoder_layer(x, lp, cfg, cos, sin, *, manual: bool, mesh: Mesh | None):
+    """Returns ``(x, aux)``; aux is the MoE router loss dict (per layer) on
+    the GSPMD MoE path, else None."""
     x = x + _attention(x, lp, cfg, cos, sin, manual=manual, mesh=mesh)
+    aux = None
     if cfg.n_experts and not manual:
-        x = x + _moe_mlp(x, lp, cfg, mesh)
+        moe_out, aux = _moe_mlp(x, lp, cfg, mesh)
+        x = x + moe_out
     else:
         x = x + _dense_mlp(x, lp, cfg, manual=manual, mesh=mesh)
-    return x
+    return x, aux
 
 
 # ---------------------------------------------------------------------------
@@ -288,10 +347,14 @@ def _remat_policy(cfg: TransformerConfig):
 
 def forward(
     params: dict, tokens: jax.Array, cfg: TransformerConfig,
-    mesh: Mesh | None = None,
-) -> jax.Array:
+    mesh: Mesh | None = None, *, return_aux: bool = False,
+):
     """tokens [B, T] int32 -> logits [B, T, V] (compute dtype). Everything
-    under jit + sharding constraints; call inside ``jax.jit``."""
+    under jit + sharding constraints; call inside ``jax.jit``.
+
+    ``return_aux=True`` additionally returns the layer-averaged MoE router
+    aux dict (balance/z losses + diagnostics; empty dict for dense
+    configs) — the train loss needs it, inference callers don't."""
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
     x = params["embed"][tokens].astype(dt)
@@ -304,12 +367,19 @@ def forward(
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
     def scan_body(carry, lp):
-        return layer_fn(carry, lp), None
+        return layer_fn(carry, lp)
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, aux_layers = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"]).astype(dt)
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
-    return with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)
+    logits = with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)
+    if not return_aux:
+        return logits
+    aux = (
+        {} if aux_layers is None
+        else jax.tree.map(lambda v: v.mean(), aux_layers)
+    )
+    return logits, aux
 
 
 # ---------------------------------------------------------------------------
@@ -340,27 +410,61 @@ def forward_pipeline(
     mesh: Mesh,
     *,
     num_microbatches: int,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
-    """GPipe trunk: embed/unembed stay GSPMD (outside the pipeline — the
-    classic constraint that stages map microbatch -> same-shape microbatch),
-    the layer stack runs as pp stages with manual tp psums and the
-    in-shard_map sp ring. Dense mlp only (MoE is GSPMD-mode)."""
+    """Pipelined trunk: embed/unembed stay GSPMD (outside the pipeline —
+    the classic constraint that stages map microbatch -> same-shape
+    microbatch), the layer stack runs as pp stages with manual tp psums and
+    the in-shard_map sp ring. Dense mlp only (MoE is GSPMD-mode).
+
+    ``schedule="interleaved"`` with ``virtual_stages=v`` assigns each
+    device v round-robin chunks of n_layers/(v·pp) layers (Megatron
+    virtual stages) — the bubble shrinks ~v-fold; see
+    ``parallel.pipeline.schedule_info``."""
     if cfg.n_experts:
         raise ValueError("MoE layers require the GSPMD trunk (pp=1)")
     pp = mesh.shape["pp"]
-    if cfg.n_layers % pp:
-        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp {pp}")
+    v = virtual_stages
+    if schedule != "interleaved" and v != 1:
+        raise ValueError("virtual_stages > 1 requires schedule='interleaved'")
+    if cfg.n_layers % (pp * v):
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pp*virtual {pp * v}"
+        )
+    tp = mesh.shape.get("tp", 1)
+    if cfg.kv_heads % tp:
+        # The stage param specs slice wk/wv head axes over tp; a non-dividing
+        # GQA head count would silently replicate K/V out of step with the
+        # sliced wq.
+        raise ValueError(
+            f"pipeline trunk needs n_kv_heads ({cfg.kv_heads}) divisible by "
+            f"tp ({tp}); use the GSPMD trunk or fewer tp shards"
+        )
     dt = cfg.compute_dtype
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, theta=cfg.rope_theta)
 
     x = params["embed"][tokens].astype(dt)
     x = with_logical_constraint(x, "batch", "seq", "embed", mesh=mesh)
 
-    # [L, ...] -> [pp, L/pp, ...]
-    stage_params = jax.tree.map(
-        lambda p: p.reshape((pp, cfg.n_layers // pp) + p.shape[1:]),
-        params["layers"],
-    )
+    if schedule == "interleaved":
+        # [L, ...] -> [pp, v, L/(v*pp), ...] where [d, c] holds global
+        # virtual stage c*pp + d (round-robin: [v*pp] -> [v, pp] indexes
+        # [c, d], then swap to put the sharded device axis first).
+        lv = cfg.n_layers // (pp * v)
+
+        def to_chunks(p):
+            return (
+                p.reshape((v, pp, lv) + p.shape[1:]).swapaxes(0, 1)
+            )
+
+        stage_params = jax.tree.map(to_chunks, params["layers"])
+    else:
+        # [L, ...] -> [pp, L/pp, ...]
+        stage_params = jax.tree.map(
+            lambda p: p.reshape((pp, cfg.n_layers // pp) + p.shape[1:]),
+            params["layers"],
+        )
 
     def stage_fn(sp_params, xm):
         layer_fn = functools.partial(
@@ -370,11 +474,18 @@ def forward_pipeline(
             layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
         def body(carry, lp):
-            return layer_fn(carry, lp), None
+            out, _aux = layer_fn(carry, lp)  # manual mode: aux is None
+            return out, None
 
         out, _ = lax.scan(body, xm, sp_params)
         return out
 
+    param_specs = _stage_param_specs(cfg)
+    if schedule == "interleaved":
+        # Chunk axis rides unsharded between pp and the weight dims.
+        param_specs = {
+            k: P(spec[0], None, *spec[1:]) for k, spec in param_specs.items()
+        }
     x = pipeline_apply(
         stage_fn,
         stage_params,
@@ -382,7 +493,9 @@ def forward_pipeline(
         mesh=mesh,
         num_microbatches=num_microbatches,
         data_spec=P(None, ("dp", "ep"), "sp", None),
-        param_specs=_stage_param_specs(cfg),
+        param_specs=param_specs,
+        schedule=schedule,
+        virtual=v,
     )
     x = with_logical_constraint(x, "batch", "seq", "embed", mesh=mesh)
     x = rms_norm(x, params["final_norm"]).astype(dt)

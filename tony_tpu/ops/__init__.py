@@ -8,13 +8,14 @@ and a numerically identical blockwise-JAX path elsewhere (which is also the
 recompute used for the backward pass).
 """
 
-from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.attention import flash_attention, flash_attention_lse
 from tony_tpu.ops.norms import rms_norm
 from tony_tpu.ops.rope import apply_rope, rope_frequencies
 from tony_tpu.ops.losses import softmax_cross_entropy
 
 __all__ = [
     "flash_attention",
+    "flash_attention_lse",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

@@ -362,10 +362,15 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_attention_pallas_bwd(
     q, k, v, out, lse, do, *, causal, scale, block_q, block_k,
-    interpret=False,
+    interpret=False, g_lse=None,
 ):
     """Backward for the Pallas forward. All inputs [BH, T, D] (lse/delta
-    [BH, T]); returns (dq, dk, dv)."""
+    [BH, T]); returns (dq, dk, dv).
+
+    ``g_lse`` is the optional cotangent of the forward's lse output (ring
+    attention differentiates through its merge weights): d lse/d s = p, so
+    it folds into the existing kernels as ds = p·(dp - (delta - g_lse)) —
+    delta is simply shifted, no kernel change."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t_q, d = q.shape
@@ -375,6 +380,8 @@ def _flash_attention_pallas_bwd(
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     pad_q = (-t_q) % block_q
     pad_k = (-t_k) % block_k
     if pad_q:
@@ -445,9 +452,11 @@ def _flash_attention_pallas_bwd(
 # Blockwise JAX path (fallback forward + recompute backward)
 # ---------------------------------------------------------------------------
 
-def _blockwise_attention_jax(q, k, v, *, causal, scale, block_k):
+def _blockwise_attention_jax(q, k, v, *, causal, scale, block_k,
+                             return_lse=False):
     """Same online-softmax math as the kernel, as a lax.scan over kv blocks.
-    q,k,v: [BH, T, D]."""
+    q,k,v: [BH, T, D]. With ``return_lse`` also returns the per-row
+    log-sum-exp [BH, T] (same masked-row convention as the kernel)."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     block_k = min(block_k, t_k)
@@ -487,9 +496,13 @@ def _blockwise_attention_jax(q, k, v, *, causal, scale, block_k):
     o0 = jnp.zeros((bh, t_q, d), jnp.float32)
     m0 = jnp.full((bh, t_q), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bh, t_q), jnp.float32)
-    (o, _, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(n_blocks))
+    (o, m, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(n_blocks))
     l = jnp.maximum(l, 1e-30)
-    return (o / l[..., None]).astype(q.dtype)
+    out = (o / l[..., None]).astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jnp.where(m <= NEG_INF / 2, 0.0, m) + jnp.log(l)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -545,6 +558,19 @@ def _flash_core_bwd(causal, scale, block_q, block_k, force_jax, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def _default_blocks(t_q: int, t_k: int,
+                    block_q: int | None, block_k: int | None):
+    """Measured sweet spots on v5e (fwd+bwd, d=64): 512 blocks up to ~4k
+    sequence, 1024 beyond (fewer grid steps amortize the per-block scalar
+    work; 2048-wide K tiles blow the 16M scoped-VMEM budget). Callers can
+    still pin either."""
+    if block_q is None:
+        block_q = 512 if t_q <= 4096 else 1024
+    if block_k is None:
+        block_k = 512 if t_k <= 4096 else 1024
+    return block_q, block_k
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -552,22 +578,124 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     force_jax: bool = False,
 ) -> jax.Array:
     """Memory-efficient exact attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
     K/V may have a different sequence length than Q (cross-attention /
-    decode). ``force_jax=True`` pins the blockwise-JAX path (used by tests
-    and by shard_map'd callers on CPU meshes).
+    decode) and fewer heads than Q (GQA/MQA: H % H_kv == 0; each group of
+    H/H_kv query heads shares one K/V head — the repeat happens here, and
+    autodiff folds the grouped K/V gradients back automatically).
+    ``force_jax=True`` pins the blockwise-JAX path (used by tests and by
+    shard_map'd callers on CPU meshes).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, t_q, h, d = q.shape
+    h_kv = k.shape[2]
+    if h_kv != h:
+        if h % h_kv:
+            raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+        k = jnp.repeat(k, h // h_kv, axis=2)
+        v = jnp.repeat(v, h // h_kv, axis=2)
     t_k = k.shape[1]
+    block_q, block_k = _default_blocks(t_q, t_k, block_q, block_k)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
     out = _flash_core(qf, kf, vf, causal, scale, block_q, block_k, force_jax)
     return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) entry for ring attention
+# ---------------------------------------------------------------------------
+# Ring attention merges per-step partials with softmax statistics, so it
+# needs the per-row log-sum-exp alongside the normalized output — and it
+# differentiates through the merge weights, so lse carries a cotangent.
+# d lse / d s = p folds into the flash backward as a shift of delta (see
+# _flash_attention_pallas_bwd); the kernels are reused unchanged.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse_core(q, k, v, causal, scale, block_q, block_k, mode):
+    out, lse = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                              mode)[0]
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, mode):
+    if mode == "jax":
+        out, lse = _blockwise_attention_jax(
+            q, k, v, causal=causal, scale=scale, block_k=block_k,
+            return_lse=True,
+        )
+        return (out, lse), (q, k, v)
+    out, lse = _flash_attention_pallas(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=(mode == "interpret"), return_lse=True,
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, mode, res, g):
+    g_out, g_lse = g
+    if mode == "jax":
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _blockwise_attention_jax(
+                q, k, v, causal=causal, scale=scale, block_k=block_k,
+                return_lse=True,
+            ),
+            q, k, v,
+        )
+        return vjp((g_out, g_lse))
+    q, k, v, out, lse = res
+    return _flash_attention_pallas_bwd(
+        q, k, v, out, lse, g_out, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=(mode == "interpret"),
+        g_lse=g_lse,
+    )
+
+
+_flash_lse_core.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    mode: str = "auto",
+) -> tuple[jax.Array, jax.Array]:
+    """Flash attention returning ``(out, lse)`` for partial-softmax merging.
+
+    q,k,v: [B, T, H, D] -> out [B, T, H, D] (q dtype), lse [B, H, T] f32
+    (log-sum-exp of the scaled scores per query row; the masked-row
+    convention matches the Pallas kernel). ``mode``: "auto" picks the
+    Pallas kernel on TPU and the blockwise-JAX path elsewhere; "jax" pins
+    the fallback; "interpret" runs the kernel in interpreter mode (CPU
+    tests of the kernel path).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mode == "auto":
+        mode = "pallas" if _on_tpu() else "jax"
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _default_blocks(t_q, t_k, block_q, block_k)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    out, lse = _flash_lse_core(qf, kf, vf, causal, scale, block_q, block_k,
+                               mode)
+    return (
+        out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, t_q),
+    )

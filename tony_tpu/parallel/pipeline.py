@@ -1,9 +1,20 @@
 """Pipeline parallelism as a collective program: layer stages live on the
 ``pp`` mesh axis, activations flow stage-to-stage with ``ppermute`` under a
-GPipe microbatch schedule expressed as one ``lax.scan`` — so the whole
-schedule is a single XLA computation (traced once, no host control flow),
-and ``jax.grad`` differentiates straight through it (backward pipeline for
+microbatch schedule expressed as one ``lax.scan`` — so the whole schedule is
+a single XLA computation (traced once, no host control flow), and
+``jax.grad`` differentiates straight through it (backward pipeline for
 free, reverse ppermutes inserted by AD).
+
+Two schedules:
+
+* ``"gpipe"`` — m + pp - 1 ticks of one full stage each; bubble fraction
+  (pp-1)/(m+pp-1).
+* ``"interleaved"`` — Megatron-style virtual stages: each device holds v
+  round-robin chunks of depth L/(v·pp); v·m + pp ticks of one *chunk*
+  each (1/v the work). Idle per device: pp chunk-ticks vs GPipe's (pp-1)
+  full ticks — idle time shrinks ((pp-1)/pp)·v-fold. The ring ppermute
+  wraps stage pp-1 back to stage 0, which both feeds chunk c+1 and
+  delivers final outputs to stage 0 with no separate transfer.
 
 The reference has no pipeline parallelism (SURVEY.md §2.3 table: PP = No);
 this is new TPU-first capability.
@@ -11,10 +22,50 @@ this is new TPU-first capability.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ScheduleInfo:
+    """Tick accounting for a pipeline schedule (single source of truth —
+    the implementations derive their scan lengths from this, tests assert
+    bubble fractions from it). ``tick_layers`` is the per-tick work in
+    layers; ``bubble_fraction`` is idle time per device / makespan."""
+
+    ticks: int
+    tick_layers: float
+    bubble_fraction: float
+
+
+def schedule_info(
+    schedule: str, num_micro: int, pp: int, n_layers: int,
+    virtual: int = 1,
+) -> ScheduleInfo:
+    if schedule == "gpipe":
+        # Each device is busy num_micro of the ticks: idle = pp - 1.
+        ticks = num_micro + pp - 1
+        return ScheduleInfo(
+            ticks=ticks,
+            tick_layers=n_layers / pp,
+            bubble_fraction=(pp - 1) / ticks,
+        )
+    if schedule == "interleaved":
+        # +pp (not +pp-1): the wrap hop that lands the last microbatch's
+        # final output on stage 0 costs one extra tick. Each device is busy
+        # virtual*num_micro of the ticks: idle = pp ticks — but a tick here
+        # is 1/virtual the work, so idle TIME shrinks ~virtual-fold.
+        ticks = virtual * num_micro + pp
+        return ScheduleInfo(
+            ticks=ticks,
+            tick_layers=n_layers / (virtual * pp),
+            bubble_fraction=pp / ticks,
+        )
+    raise ValueError(f"unknown schedule {schedule!r}")
 
 
 def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
@@ -28,7 +79,7 @@ def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
     num_micro = x_mb.shape[0]
     my_params = jax.tree.map(lambda p: p[0], params)
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    ticks = num_micro + n_stages - 1
+    ticks = schedule_info("gpipe", num_micro, n_stages, 0).ticks
 
     def tick(carry, t):
         state, out = carry
@@ -57,6 +108,79 @@ def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
     return lax.psum(out * mask, axis_name)
 
 
+def _pipeline_interleaved_local(
+    params, x_mb, *, stage_fn, axis_name: str, virtual: int,
+):
+    """Interleaved (virtual-stage) schedule inside shard_map.
+
+    params: this device's chunks, leading axis [virtual, ...] where chunk c
+    is global virtual stage c·pp + stage_idx (round-robin — the bubble win
+    requires consecutive virtual stages on *different* devices).
+    x_mb: [num_micro, mb, ...], num_micro % pp == 0.
+
+    Timeline (local tick u = t - stage_idx, busy window [0, v·m)): group
+    g = u // pp selects block b = g // v of pp microbatches and chunk
+    c = g % v; within the group, microbatch i = b·pp + (u % pp). Chunk 0
+    ticks inject fresh microbatches on stage 0; every other input is the
+    ring-permuted activation from the previous stage — including the wrap
+    pp-1 → 0, which simultaneously feeds chunk c+1 and (when the sender
+    just ran chunk v-1) delivers a FINAL output to stage 0. Stage 0
+    records those arrivals; no separate output transfer exists.
+
+    Chunk weights are selected per tick with a traced dynamic index — a
+    chunk-sized copy per tick that the GPipe path does not pay; at v=2
+    this is model/(2·pp) per tick, amortized against the bubble saving.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    num_micro = x_mb.shape[0]
+    params = jax.tree.map(lambda p: p[0], params)  # strip local pp axis
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    info = schedule_info(
+        "interleaved", num_micro, n_stages, n_layers=0, virtual=virtual
+    )
+
+    def tick(carry, t):
+        state, out = carry
+        u = t - stage_idx
+        g = jnp.clip(u // n_stages, 0, virtual * (num_micro // n_stages) - 1)
+        c = g % virtual
+        i = (g // virtual) * n_stages + u % n_stages
+        i = jnp.clip(i, 0, num_micro - 1)
+        # Stage 0, chunk 0: inject a fresh microbatch; else consume the ring.
+        mb = lax.dynamic_index_in_dim(x_mb, i, axis=0, keepdims=False)
+        inject = (stage_idx == 0) & (c == 0)
+        x_in = jnp.where(inject, mb, state)
+        my_chunk = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, c, axis=0, keepdims=False),
+            params,
+        )
+        y = stage_fn(my_chunk, x_in)
+        # Record final outputs as they arrive on stage 0: the sender (stage
+        # pp-1, one tick ago) emitted chunk v-1 iff its group index had
+        # c_s == v-1.
+        u_s = t - n_stages
+        g_s = u_s // n_stages
+        c_s = g_s % virtual
+        j = (g_s // virtual) * n_stages + u_s % n_stages
+        is_final = (stage_idx == 0) & (u_s >= 0) & (c_s == virtual - 1)
+        j = jnp.clip(j, 0, num_micro - 1)
+        prev = lax.dynamic_index_in_dim(out, j, axis=0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(is_final, state, prev), j, axis=0
+        )
+        state_next = lax.ppermute(y, axis_name, fwd_perm)
+        return (state_next, out), None
+
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(info.ticks))
+    # Outputs live on stage 0 (the ring wrap put them there); the masked
+    # psum replicates them for the caller's replicated loss.
+    mask = (stage_idx == 0).astype(out.dtype)
+    return lax.psum(out * mask, axis_name)
+
+
 def pipeline_apply(
     stage_fn,
     stage_params,
@@ -67,6 +191,8 @@ def pipeline_apply(
     axis_name: str = "pp",
     data_spec: P | None = None,
     param_specs=None,
+    schedule: str = "gpipe",
+    virtual: int = 1,
 ):
     """Apply ``stage_fn`` (params, x) -> y through ``pp`` pipeline stages.
 
@@ -85,11 +211,35 @@ def pipeline_apply(
     caller additionally shard within-stage weight dims (e.g. megatron tp
     slices) so ``stage_fn`` sees only its local slice and reduces with
     explicit psums. Default: sharded over ``axis_name`` only.
+
+    ``schedule="interleaved"`` runs ``virtual`` round-robin chunks per
+    device (Megatron virtual stages): stage_params leaves must then be
+    [pp, virtual, ...] — element [d, c] is global virtual stage c·pp + d,
+    i.e. ``stage_fn`` here maps a microbatch through ONE chunk of depth
+    n_layers/(virtual·pp) — and num_microbatches must divide by pp. The
+    bubble shrinks from (pp-1)/(m+pp-1) to (pp-1)/(virtual·m+pp-1) of the
+    step (see ``schedule_info``).
     """
     if x.shape[0] % num_microbatches:
         raise ValueError(
             f"batch {x.shape[0]} not divisible by {num_microbatches} microbatches"
         )
+    if schedule not in ("gpipe", "interleaved"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "interleaved":
+        pp = mesh.shape[axis_name]
+        if num_microbatches % pp:
+            # The tight interleave needs whole pp-sized microbatch blocks;
+            # a ragged tail block would leave holes the index math reads
+            # as valid slots.
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches "
+                f"({num_microbatches}) divisible by pp ({pp})"
+            )
+        if virtual < 1:
+            raise ValueError(f"virtual must be >= 1, got {virtual}")
+    elif virtual != 1:
+        raise ValueError("virtual > 1 requires schedule='interleaved'")
     mb = x.shape[0] // num_microbatches
     x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
 
@@ -108,8 +258,17 @@ def pipeline_apply(
                 )
     in_spec = data_spec if data_spec is not None else P()
 
-    def body(params, xm):
-        return _pipeline_local(params, xm, stage_fn=stage_fn, axis_name=axis_name)
+    if schedule == "interleaved":
+        def body(params, xm):
+            return _pipeline_interleaved_local(
+                params, xm, stage_fn=stage_fn, axis_name=axis_name,
+                virtual=virtual,
+            )
+    else:
+        def body(params, xm):
+            return _pipeline_local(
+                params, xm, stage_fn=stage_fn, axis_name=axis_name
+            )
 
     out_mb = jax.shard_map(
         body,

@@ -105,15 +105,31 @@ def _merge(o1, m1, l1, o2, m2, l2):
 
 def ring_attention_local(
     q, k, v, *, axis_name: str, causal: bool, scale: float,
-    block_k: int = 512,
+    block_k: int = 512, kernel: str = "auto",
 ):
     """Per-shard body (runs inside shard_map). q,k,v: [B, Tlocal, H, D].
-    Each ring step runs the blockwise inner loop (``block_k`` keys at a
-    time), so the forward never materializes a [Tlocal, Tlocal] score
-    matrix — peak is O(Tlocal · block_k). The backward is remat-bounded:
-    per-block and per-ring-step recompute keeps stored residuals to the
-    (o, m, l) carries plus the rotating K/V blocks, not the score
-    matrices."""
+
+    ``kernel`` selects the per-step chunk attention:
+
+    * ``"auto"`` — the Pallas flash kernel on TPU (via
+      ``ops.flash_attention_lse``), the independent blockwise-JAX
+      implementation elsewhere;
+    * ``"jax"`` — pin the blockwise-JAX path (the cross-check);
+    * ``"pallas"`` / ``"interpret"`` — pin the kernel (interpret = Pallas
+      interpreter mode, for CPU tests of the kernel path).
+
+    Either way the forward never materializes a [Tlocal, Tlocal] score
+    matrix and the backward is remat-bounded: per-ring-step recompute keeps
+    stored residuals to the merge carries plus the rotating K/V blocks."""
+    if kernel == "auto":
+        from tony_tpu.ops.attention import _on_tpu
+
+        kernel = "pallas" if _on_tpu() else "jax"
+    if kernel in ("pallas", "interpret"):
+        return _ring_kernel_local(
+            q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+            block_k=block_k, mode=kernel,
+        )
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
@@ -171,6 +187,85 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
+def _merge_lse(o1, lse1, o2, lse2):
+    """Merge two normalized partials (o [B,T,H,D] f32, lse [B,H,T]) —
+    the (out, lse) form of ``_merge``, matching the kernel's outputs."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    wt1 = (w1 / denom).transpose(0, 2, 1)[..., None]
+    wt2 = (w2 / denom).transpose(0, 2, 1)[..., None]
+    return o1 * wt1 + o2 * wt2, m + jnp.log(denom)
+
+
+def _ring_kernel_local(
+    q, k, v, *, axis_name: str, causal: bool, scale: float,
+    block_k: int, mode: str,
+):
+    """Ring body with the Pallas flash kernel doing each step's chunk
+    attention (ops.flash_attention_lse). The ring structure makes the
+    kernel calls mask-cheap: step 0 is plain causal self-attention (the
+    kernel's fast diagonal path), and every later live step attends a
+    block that is entirely in the past — ``causal=False``, no mask work at
+    all; fully-future blocks are skipped by the lax.cond. Merging uses the
+    kernel's (out, lse) outputs; gradients flow through the merge weights
+    into the kernel's lse (see _flash_attention_pallas_bwd's g_lse)."""
+    from tony_tpu.ops.attention import flash_attention_lse
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_q = q.shape[1]
+    t_k = k.shape[1]
+    fwd_perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def chunk(k_blk, v_blk, *, causal_step):
+        o, lse = flash_attention_lse(
+            q, k_blk, v_blk, causal=causal_step, scale=scale,
+            block_k=block_k, mode=mode,
+        )
+        return o.astype(jnp.float32), lse
+
+    # Step 0: this shard's own K/V — the only step that needs a causal mask.
+    out, lse = chunk(k, v, causal_step=causal)
+    if axis_size == 1:
+        return out.astype(q.dtype)
+    k_blk = lax.ppermute(k, axis_name, fwd_perm)
+    v_blk = lax.ppermute(v, axis_name, fwd_perm)
+
+    def step(carry, s):
+        out, lse, k_blk, v_blk = carry
+        kv_owner = (my_idx - s) % axis_size
+
+        def attend(out, lse):
+            o2, lse2 = chunk(k_blk, v_blk, causal_step=False)
+            return _merge_lse(out, lse, o2, lse2)
+
+        if causal:
+            # Global-position comparison (exact for t_q != t_k): skip iff
+            # the block's first key comes after our last query. Blocks that
+            # straddle the diagonal cannot occur for s >= 1 — each shard
+            # owns a disjoint position range.
+            fully_masked = kv_owner * t_k >= (my_idx + 1) * t_q
+            out, lse = lax.cond(
+                fully_masked, lambda o, l: (o, l), attend, out, lse,
+            )
+        else:
+            out, lse = attend(out, lse)
+        k_nxt = lax.ppermute(k_blk, axis_name, fwd_perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, fwd_perm)
+        return (out, lse, k_nxt, v_nxt), None
+
+    # Remat per ring step (same policy as the JAX path): backward replays
+    # one step's kernels at a time; stored residuals are the merge carries
+    # plus the rotating K/V blocks.
+    (out, lse, _, _), _ = lax.scan(
+        jax.checkpoint(step), (out, lse, k_blk, v_blk),
+        jnp.arange(1, axis_size),
+    )
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -183,20 +278,22 @@ def ring_attention(
     batch_axes=("dp", "ep"),
     head_axis: str = "tp",
     block_k: int = 512,
+    kernel: str = "auto",
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     q, k, v: [batch, seq, heads, head_dim] (global shapes). The sequence axis
     is split over ``sp``, heads over ``tp``, batch over ``dp``/``ep``;
     within each shard the kv scan runs ``block_k`` keys at a time (flash
-    accumulation), so memory stays O(T/sp · block_k).
+    accumulation), so memory stays O(T/sp · block_k). ``kernel`` selects
+    the per-step chunk attention (see ``ring_attention_local``).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal,
-        scale=scale, block_k=block_k,
+        scale=scale, block_k=block_k, kernel=kernel,
     )
     sharded = jax.shard_map(
         body,
