@@ -1,0 +1,38 @@
+"""Asserts the per-slice env contract for multi-slice jobs: the
+coordinator stamps TONY_SLICE_INDEX/TONY_NUM_SLICES at launch, and the JAX
+runtime adds the megascale/DCN variables at rendezvous. Run with 2 workers
+x tpus=8 pinned to v5litepod-8 => 2 slices of 1 host each."""
+import os
+import sys
+
+import tony_tpu.runtime as rt
+
+ctx = rt.task_context()
+plan = rt.slice_topology()
+if plan is None or plan["num_slices"] != 2:
+    print(f"expected a 2-slice plan, got {plan}", file=sys.stderr)
+    sys.exit(2)
+if ctx.num_slices != 2:
+    print(f"ctx.num_slices = {ctx.num_slices}", file=sys.stderr)
+    sys.exit(3)
+# 1 host per slice: worker i is slice i, in-slice process 0.
+if ctx.slice_index != ctx.task_index or ctx.slice_process_id != 0:
+    print(f"slice identity wrong: task {ctx.task_index} -> "
+          f"slice {ctx.slice_index}/{ctx.slice_process_id}", file=sys.stderr)
+    sys.exit(4)
+for var, want in [
+    ("MEGASCALE_NUM_SLICES", "2"),
+    ("MEGASCALE_SLICE_ID", str(ctx.task_index)),
+]:
+    if os.environ.get(var) != want:
+        print(f"{var} = {os.environ.get(var)!r}, want {want!r}",
+              file=sys.stderr)
+        sys.exit(5)
+if not os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+    print("MEGASCALE_COORDINATOR_ADDRESS missing", file=sys.stderr)
+    sys.exit(6)
+# One flat jax.distributed identity across both slices.
+if ctx.num_processes != 2:
+    print(f"num_processes = {ctx.num_processes}", file=sys.stderr)
+    sys.exit(7)
+sys.exit(0)

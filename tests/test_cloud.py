@@ -1,0 +1,544 @@
+"""Cloud control-plane tests — recorded-response (fixture-transport) tests
+for the concrete GCP clients, the analogue of the reference's client really
+talking to its cluster (`TonyClient.createAMContainerSpec` uploads to HDFS
+and submits through a live `YarnClient`, TonyClient.java:369-424, 568-621;
+`ClusterSubmitter.java:48-82` stages the framework jar). No egress exists
+in this environment, so the transports are the seam: every test drives the
+real request-building / response-parsing code against scripted responses
+and asserts the exact wire traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.cloud import (
+    GcpQueuedResourceApi,
+    GcsStorage,
+    is_gs_uri,
+    set_default_storage,
+    split_gs_uri,
+)
+from tony_tpu.cloud.gcs import GcsError
+from tony_tpu.coordinator.backend import SlicePlan, TpuVmBackend
+
+
+class FakeTransport:
+    """Scripted HTTP transport: responses matched by (method, url regex),
+    each consumed in order; every request is recorded for assertions."""
+
+    def __init__(self) -> None:
+        self.scripts: list[tuple[str, str, int, bytes]] = []
+        self.requests: list[tuple[str, str, bytes | None]] = []
+
+    def expect(self, method: str, url_re: str, status: int,
+               body: object = b"") -> "FakeTransport":
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        elif isinstance(body, str):
+            body = body.encode()
+        self.scripts.append((method, url_re, status, body))
+        return self
+
+    def request(self, method, url, body, headers):
+        if hasattr(body, "read"):
+            body = body.read()  # streamed upload: record the real payload
+        self.requests.append((method, url, body))
+        for i, (m, url_re, status, resp) in enumerate(self.scripts):
+            if m == method and re.search(url_re, url):
+                self.scripts.pop(i)
+                return status, resp
+        raise AssertionError(f"unexpected request: {method} {url}")
+
+
+class FakeRunner:
+    """CommandRunner fake: records started commands, lets tests finish
+    them."""
+
+    def __init__(self) -> None:
+        self.started: list[tuple[str, int, str]] = []
+        self._codes: dict[int, int | None] = {}
+        self.killed: list[int] = []
+
+    def start(self, node, worker, command):
+        handle = len(self.started)
+        self.started.append((node, worker, command))
+        self._codes[handle] = None
+        return handle
+
+    def finish(self, handle: int, code: int) -> None:
+        self._codes[handle] = code
+
+    def poll(self, handle):
+        return self._codes[handle]
+
+    def kill(self, handle):
+        self.killed.append(handle)
+        self._codes[handle] = -9
+
+
+class FakeStorage:
+    """In-memory object store with GcsStorage's surface, for code that
+    takes a storage client (staging, bootstrap, history)."""
+
+    def __init__(self) -> None:
+        self.objects: dict[str, bytes] = {}
+
+    def put_bytes(self, uri, data):
+        self.objects[uri] = bytes(data)
+
+    def get_bytes(self, uri):
+        return self.objects[uri]
+
+    def upload_file(self, local, uri):
+        self.put_bytes(uri, Path(local).read_bytes())
+
+    def download_file(self, uri, local):
+        Path(local).parent.mkdir(parents=True, exist_ok=True)
+        Path(local).write_bytes(self.get_bytes(uri))
+
+    def exists(self, uri):
+        return uri in self.objects
+
+    def list_prefix(self, uri):
+        bucket, prefix = split_gs_uri(uri)
+        return [
+            split_gs_uri(u)[1]
+            for u in sorted(self.objects)
+            if u.startswith(f"gs://{bucket}/{prefix}")
+        ]
+
+    def delete(self, uri):
+        self.objects.pop(uri, None)
+
+
+@pytest.fixture
+def fake_storage():
+    store = FakeStorage()
+    set_default_storage(store)  # type: ignore[arg-type]
+    yield store
+    set_default_storage(None)
+
+
+# ---------------------------------------------------------------------------
+# GCS client over recorded responses
+# ---------------------------------------------------------------------------
+
+class TestGcsStorage:
+    def test_uri_helpers(self):
+        assert is_gs_uri("gs://b/k") and not is_gs_uri("/tmp/x")
+        assert split_gs_uri("gs://bucket/a/b.json") == ("bucket", "a/b.json")
+        with pytest.raises(ValueError):
+            split_gs_uri("s3://nope/x")
+
+    def test_put_get_roundtrip_wire_shape(self):
+        t = FakeTransport()
+        t.expect("POST", r"upload/storage/v1/b/bkt/o\?uploadType=media"
+                         r"&name=app%2Fconf\.json", 200, {"name": "app/conf.json"})
+        t.expect("GET", r"storage/v1/b/bkt/o/app%2Fconf\.json\?alt=media",
+                 200, b"hello")
+        store = GcsStorage(t)
+        store.put_bytes("gs://bkt/app/conf.json", b"hello")
+        assert store.get_bytes("gs://bkt/app/conf.json") == b"hello"
+        method, url, body = t.requests[0]
+        assert body == b"hello"
+
+    def test_list_prefix_follows_pages(self):
+        t = FakeTransport()
+        t.expect("GET", r"/o\?prefix=app%2F$", 200,
+                 {"items": [{"name": "app/a"}], "nextPageToken": "p2"})
+        t.expect("GET", r"pageToken=p2", 200, {"items": [{"name": "app/b"}]})
+        assert GcsStorage(t).list_prefix("gs://bkt/app/") == ["app/a", "app/b"]
+
+    def test_exists_and_error_paths(self):
+        t = FakeTransport()
+        t.expect("GET", r"/o/x$", 200, {"name": "x"})
+        t.expect("GET", r"/o/y$", 404, b"not found")
+        t.expect("GET", r"/o/z$", 403, b"denied")
+        store = GcsStorage(t)
+        assert store.exists("gs://b/x") is True
+        assert store.exists("gs://b/y") is False
+        with pytest.raises(GcsError, match="403"):
+            store.exists("gs://b/z")
+
+
+# ---------------------------------------------------------------------------
+# Queued-resources API lifecycle (VERDICT r2 item 1's "Done" list)
+# ---------------------------------------------------------------------------
+
+def _qr_state(state: str) -> dict:
+    return {"state": {"state": state}}
+
+
+class TestGcpQueuedResourceApi:
+    def _api(self, transport, runner=None):
+        return GcpQueuedResourceApi(
+            "proj", "us-central1-a", transport=transport,
+            runner=runner or FakeRunner(),
+        )
+
+    def test_create_ready_start_delete_lifecycle(self):
+        t = FakeTransport()
+        runner = FakeRunner()
+        api = self._api(t, runner)
+        # create: one queued resource, two nodes (multi-slice is atomic)
+        t.expect("POST",
+                 r"projects/proj/locations/us-central1-a/queuedResources"
+                 r"\?queued_resource_id=app1-worker", 200, {"name": "op1"})
+        api.create_slice("app1-worker", "v5litepod-16", 2)
+        method, url, body = t.requests[-1]
+        spec = json.loads(body)
+        nodes = spec["tpu"]["node_spec"]
+        assert [n["node_id"] for n in nodes] == [
+            "app1-worker-s0", "app1-worker-s1"
+        ]
+        assert nodes[0]["node"]["accelerator_type"] == "v5litepod-16"
+        assert nodes[0]["parent"] == "projects/proj/locations/us-central1-a"
+
+        # poll: CREATING (ACCEPTED) -> READY (ACTIVE)
+        t.expect("GET", r"queuedResources/app1-worker$", 200,
+                 _qr_state("ACCEPTED"))
+        t.expect("GET", r"queuedResources/app1-worker$", 200,
+                 _qr_state("ACTIVE"))
+        assert api.slice_state("app1-worker") == "CREATING"
+        assert api.slice_state("app1-worker") == "READY"
+
+        # start: host 3 of 2-host slices -> slice 1, worker 1; env exported,
+        # stage-0 loader fetches the staged app dir
+        h = api.start_executor(
+            "app1-worker", 3,
+            {"JOB_NAME": "worker", "TONY_STAGED_URI": "gs://bkt/app1"},
+        )
+        node, worker, command = runner.started[-1]
+        assert node == "app1-worker-s1" and worker == 1
+        assert "export JOB_NAME=worker;" in command
+        assert "gs://bkt/app1" in command
+        assert "metadata.google.internal" in command  # stage-0 loader inlined
+        assert api.executor_status(h) is None
+        runner.finish(h, 0)
+        assert api.executor_status(h) == 0
+
+        # delete: force, 404 tolerated on retry
+        t.expect("DELETE", r"queuedResources/app1-worker\?force=true", 200)
+        api.delete_slice("app1-worker")
+        t.expect("DELETE", r"queuedResources/app1-worker\?force=true", 404,
+                 b"gone")
+        api.delete_slice("app1-worker")
+
+    def test_failed_provision_maps_to_failed(self):
+        t = FakeTransport()
+        api = self._api(t)
+        for raw, want in [("FAILED", "FAILED"), ("SUSPENDED", "FAILED"),
+                          ("WAITING_FOR_RESOURCES", "CREATING"),
+                          ("PROVISIONING", "CREATING")]:
+            t.expect("GET", r"queuedResources/g$", 200, _qr_state(raw))
+            assert api.slice_state("g") == want
+
+    def test_api_error_raises_with_status(self):
+        t = FakeTransport()
+        t.expect("POST", r"queuedResources", 409, b"already exists")
+        with pytest.raises(Exception, match="409"):
+            self._api(t).create_slice("dup", "v5litepod-8", 1)
+
+    def test_backend_drives_full_lifecycle_through_api(self):
+        """TpuVmBackend + GcpQueuedResourceApi end to end: launch while
+        CREATING, executor starts on READY, exit propagates, stop_all
+        deletes the queued resource — the reference's async
+        allocate->launch->complete flow on the real control-plane client."""
+        from tony_tpu.coordinator.session import TonyTask
+
+        t = FakeTransport()
+        runner = FakeRunner()
+        api = self._api(t, runner)
+        backend = TpuVmBackend(api, "app9")
+        backend.prepare_slices(
+            {"worker": SlicePlan("v5litepod-8", 1, 1, 8)}
+        )
+        t.expect("POST", r"queued_resource_id=app9-worker", 200, {})
+        task = TonyTask(job_name="worker", index=0, session_id=1)
+        h = backend.launch(task, {"TONY_STAGED_URI": "gs://b/app9"})
+
+        t.expect("GET", r"queuedResources/app9-worker$", 200,
+                 _qr_state("CREATING"))
+        assert backend.poll(h) is None          # still provisioning
+        backend._state_cache.clear()
+        t.expect("GET", r"queuedResources/app9-worker$", 200,
+                 _qr_state("ACTIVE"))
+        assert backend.poll(h) is None          # READY -> executor started
+        assert runner.started[-1][0] == "app9-worker-s0"
+        runner.finish(h.remote, 0)
+        assert backend.poll(h) == 0
+
+        t.expect("DELETE", r"queuedResources/app9-worker\?force", 200)
+        backend.stop_all()
+        assert not backend._created
+
+    def test_backend_failed_provision_fails_task(self):
+        t = FakeTransport()
+        api = self._api(t)
+        from tony_tpu.coordinator.session import TonyTask
+
+        backend = TpuVmBackend(api, "app9")
+        backend.prepare_slices({"worker": SlicePlan("v5litepod-8", 1, 1, 8)})
+        t.expect("POST", r"queued_resource_id=app9-worker", 200, {})
+        h = backend.launch(
+            TonyTask(job_name="worker", index=0, session_id=1), {}
+        )
+        t.expect("GET", r"queuedResources/app9-worker$", 200,
+                 _qr_state("FAILED"))
+        assert backend.poll(h) == 1  # fails the session -> retry machinery
+
+
+# ---------------------------------------------------------------------------
+# gs:// staging + localization
+# ---------------------------------------------------------------------------
+
+class TestGsStaging:
+    def test_client_stages_to_gs(self, fake_storage, tmp_path, monkeypatch):
+        """_stage with a gs:// staging location mirrors every artifact
+        (archive, venv, lib.zip, frozen conf) under gs://.../<app_id>/ and
+        rewrites the venv to a bare name remote bootstraps can resolve."""
+        from tony_tpu.client.client import TonyClient
+        from tony_tpu.conf import keys
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "train.py").write_text("print('hi')\n")
+        venv = tmp_path / "venv.zip"
+        venv.write_bytes(b"fake venv zip")
+        lib = tmp_path / "lib"
+        (lib / "tony_tpu").mkdir(parents=True)
+        (lib / "tony_tpu" / "__init__.py").write_text("")
+
+        client = TonyClient().init([
+            "--src_dir", str(src), "--executes", "train.py",
+            "--python_venv", str(venv),
+            "--conf", "tony.staging.location=gs://bkt/staging",
+        ])
+        client.conf.set(keys.K_LIB_PATH, str(lib))
+        client._gcs_store = fake_storage
+        app_dir = client._stage()
+        prefix = f"gs://bkt/staging/{client.app_id}"
+        names = {
+            u[len(prefix) + 1:] for u in fake_storage.objects
+            if u.startswith(prefix)
+        }
+        assert {"tony.zip", "venv.zip", "lib.zip",
+                "tony-final.json"} <= names
+        # venv key rewritten to the bare localized name
+        frozen = json.loads(
+            fake_storage.get_bytes(f"{prefix}/tony-final.json")
+        )
+        assert frozen[keys.K_PYTHON_VENV] == "venv.zip"
+        assert (app_dir / "tony-final.json").is_file()  # local copy stays
+
+    def test_bootstrap_localizes_and_runs_executor(
+        self, fake_storage, tmp_path, monkeypatch
+    ):
+        """Stage 2 of the TPU-VM bootstrap: downloads every staged object,
+        unzips the archive, points TONY_CONF_PATH at the local conf, and
+        hands off to the task executor in the workdir."""
+        from tony_tpu import constants, utils
+        from tony_tpu.cloud import bootstrap
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "train.py").write_text("ok\n")
+        archive = tmp_path / "tony.zip"
+        utils.zip_dir(src, archive)
+        fake_storage.put_bytes("gs://b/app/tony.zip", archive.read_bytes())
+        fake_storage.put_bytes("gs://b/app/tony-final.json", b"{}")
+        fake_storage.put_bytes("gs://b/app/lib.zip", b"skipped")
+
+        ran = {}
+
+        def fake_executor_main():
+            ran["cwd"] = Path.cwd()
+            ran["conf"] = os.environ[constants.TONY_CONF_PATH]
+            return 0
+
+        import os
+
+        import tony_tpu.executor.task_executor as te
+
+        monkeypatch.setattr(te, "main", fake_executor_main)
+        monkeypatch.chdir(tmp_path)
+        rc = bootstrap.main("gs://b/app")
+        assert rc == 0
+        workdir = tmp_path / "tony-workdir"
+        assert ran["cwd"] == workdir
+        assert ran["conf"] == str(workdir / "tony-final.json")
+        assert (workdir / "train.py").is_file()       # archive unzipped
+        assert not (workdir / "lib.zip").exists()     # loader's job, skipped
+
+    def test_history_writer_gs(self, fake_storage):
+        from tony_tpu.conf.configuration import TonyConfiguration
+        from tony_tpu.history.writer import (
+            JobMetadata,
+            create_history_file,
+            setup_job_dir,
+            write_config_file,
+        )
+
+        job_dir = setup_job_dir("gs://b/hist", "application_1_a", 0)
+        assert job_dir.startswith("gs://b/hist/1970/")
+        write_config_file(job_dir, TonyConfiguration())
+        meta = JobMetadata.new("application_1_a", 0, "SUCCEEDED", user="u")
+        uri = create_history_file(job_dir, meta)
+        assert f"{job_dir}/config.json" in fake_storage.objects
+        assert uri.endswith("-SUCCEEDED.jhist")
+        assert uri in fake_storage.objects
+
+
+class TestReviewFixes:
+    def test_upload_file_streams_from_disk(self, tmp_path):
+        """upload_file hands the transport an open file (not a bytes blob)
+        with Content-Length — multi-GB artifacts never land in RAM."""
+        t = FakeTransport()
+        t.expect("POST", r"name=big\.bin", 200, {})
+        big = tmp_path / "big.bin"
+        big.write_bytes(b"x" * 1024)
+        GcsStorage(t).upload_file(big, "gs://b/big.bin")
+        method, url, body = t.requests[0]
+        assert body == b"x" * 1024  # FakeTransport read it from the file
+
+    def test_download_file_uses_stream_when_available(self, tmp_path):
+        import io
+
+        class StreamTransport(FakeTransport):
+            def request_stream(self, method, url):
+                return 200, io.BytesIO(b"streamed!")
+
+        target = tmp_path / "out.bin"
+        GcsStorage(StreamTransport()).download_file("gs://b/k", target)
+        assert target.read_bytes() == b"streamed!"
+
+    def test_bootstrap_exports_pythonpath_for_user_subprocess(
+        self, fake_storage, tmp_path, monkeypatch
+    ):
+        """The user script is a SUBPROCESS of the executor; bootstrap must
+        export PYTHONPATH so `import tony_tpu` works there too (locally
+        LocalProcessBackend does this; the remote path must as well)."""
+        import os
+
+        import tony_tpu
+        import tony_tpu.executor.task_executor as te
+        from tony_tpu.cloud import bootstrap
+
+        fake_storage.put_bytes("gs://b/app/tony-final.json", b"{}")
+        seen = {}
+        monkeypatch.setattr(
+            te, "main", lambda: seen.update(pp=os.environ.get("PYTHONPATH"))
+            or 0,
+        )
+        monkeypatch.delenv("PYTHONPATH", raising=False)
+        monkeypatch.chdir(tmp_path)
+        assert bootstrap.main("gs://b/app") == 0
+        pkg_root = str(Path(tony_tpu.__file__).resolve().parent.parent)
+        assert pkg_root in seen["pp"].split(os.pathsep)
+
+    def test_relearn_without_node_specs_raises_clearly(self):
+        t = FakeTransport()
+        t.expect("GET", r"queuedResources/ghost$", 200, {})
+        api = GcpQueuedResourceApi(
+            "proj", "z", transport=t, runner=FakeRunner()
+        )
+        with pytest.raises(RuntimeError, match="no node specs"):
+            api.start_executor("ghost", 0, {})
+
+    def test_gs_history_read_path(self, fake_storage):
+        """Writers gained gs://; the readers must see the same jobs —
+        list/jhist/config/final all through the object listing."""
+        from tony_tpu.conf.configuration import TonyConfiguration
+        from tony_tpu.history.reader import (
+            job_config,
+            job_final_status,
+            list_jobs,
+        )
+        from tony_tpu.history.writer import (
+            JobMetadata,
+            create_history_file,
+            setup_job_dir,
+            write_config_file,
+            write_final_status,
+        )
+
+        loc = "gs://b/hist"
+        for app, ms, status in [
+            ("application_1_a", 1_000, "SUCCEEDED"),
+            ("application_1_b", 2_000, "FAILED"),
+        ]:
+            job_dir = setup_job_dir(loc, app, ms)
+            conf = TonyConfiguration()
+            conf.set("tony.application.name", f"name-{app}")
+            write_config_file(job_dir, conf)
+            write_final_status(job_dir, {"state": status, "stats": {}})
+            create_history_file(
+                job_dir, JobMetadata.new(app, ms, status, user="u")
+            )
+        jobs = list_jobs(loc)
+        assert [j.app_id for j in jobs] == [
+            "application_1_b", "application_1_a"
+        ]
+        assert job_config(loc, "application_1_a")[
+            "tony.application.name"] == "name-application_1_a"
+        assert job_final_status(loc, "application_1_b")["state"] == "FAILED"
+        assert job_config(loc, "application_9_x") is None
+
+    def test_cluster_submit_gs_staging_uses_tempdir(self, tmp_path,
+                                                    monkeypatch):
+        """A gs:// staging location must not be treated as a local path
+        for the framework lib dir (no literal 'gs:/...' dirs in cwd)."""
+        from tony_tpu.client import cli
+        from tony_tpu.conf import keys as _keys
+
+        captured = {}
+
+        class FakeClient:
+            def __init__(self):
+                from tony_tpu.conf.configuration import TonyConfiguration
+
+                self.conf = TonyConfiguration()
+                self.conf.set(_keys.K_STAGING_LOCATION, "gs://bkt/stage")
+
+            def init(self, argv):
+                return self
+
+            def run(self):
+                captured["lib"] = self.conf.get_str(_keys.K_LIB_PATH)
+                assert Path(captured["lib"]).is_dir()
+                return 0
+
+        monkeypatch.setattr(cli, "TonyClient", FakeClient)
+        monkeypatch.chdir(tmp_path)
+        assert cli.cluster_submit([]) == 0
+        assert not captured["lib"].startswith(str(tmp_path))
+        assert "gs:" not in captured["lib"]
+        assert not list(tmp_path.iterdir())  # nothing littered in cwd
+
+
+class TestBackendSelection:
+    def test_gcp_project_requires_gs_staging(self, tmp_path):
+        """Coordinator main() refuses a GCP backend without gs:// staging —
+        remote bootstraps could never localize the job."""
+        import subprocess
+        import sys
+
+        from tony_tpu import constants
+        from tony_tpu.conf.configuration import TonyConfiguration
+
+        conf = TonyConfiguration()
+        conf.set("tony.gcp.project", "proj")
+        conf.set("tony.worker.instances", 1)
+        conf.write_final(tmp_path / constants.TONY_FINAL_CONF)
+        out = subprocess.run(
+            [sys.executable, "-m", "tony_tpu.coordinator.app_master",
+             "--app-dir", str(tmp_path), "--app-id", "app_x"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode != 0
+        assert "gs://" in out.stderr

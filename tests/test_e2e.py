@@ -82,6 +82,20 @@ def test_slice_topology_reaches_user_script(cluster):
     assert coord.slice_plans["worker"].accelerator_type == "v5litepod-4"
 
 
+def test_multislice_identity_reaches_user_script(cluster):
+    """2 workers x tpus=8 pinned to v5litepod-8 => a 2-slice plan; each
+    executor must see its slice index, in-slice process id, and the
+    megascale/DCN env, while jax.distributed stays one flat process list
+    (VERDICT r2 item 2: multi-slice must be driveable end to end)."""
+    conf = _job(cluster, "check_multislice_env.py", workers=2)
+    conf.set(keys.tpus_key("worker"), 8)
+    conf.set(keys.K_TPU_ACCELERATOR_TYPE, "v5litepod-8")
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED, coord.session.diagnostics
+    plan = coord.slice_plans["worker"]
+    assert plan.num_slices == 2 and plan.hosts_per_slice == 1
+
+
 def test_sharded_reader_handoff_exactly_once(cluster, tmp_path):
     """Data-plane handoff (the py4j analogue): two executor processes each
     build a reader via tony_tpu.runtime.sharded_reader; together their

@@ -220,6 +220,79 @@ class TestRingAttention:
             )
 
 
+class TestMultiSlice:
+    """Multi-slice (DCN-spanning) mesh: dp rows tile slice-by-slice so
+    inner-axis collectives never cross the slice boundary — the VERDICT r2
+    item 2 contract."""
+
+    def test_dp_outermost_tiles_slices(self):
+        devices = jax.devices()[:8]
+        mesh = build_mesh(
+            MeshSpec(dp=2, sp=2, tp=2), devices=devices, num_slices=2
+        )
+        arr = mesh.devices  # [dp, pp, ep, sp, tp]
+        # dp row 0 == slice 0 (devices 0..3), row 1 == slice 1 (4..7).
+        assert {d.id for d in arr[0].flat} == {d.id for d in devices[:4]}
+        assert {d.id for d in arr[1].flat} == {d.id for d in devices[4:]}
+
+    def test_auto_spec_pins_dp_to_slices(self):
+        mesh = build_mesh(devices=jax.devices()[:8], num_slices=2)
+        assert mesh.shape["dp"] == 2
+
+    def test_inner_axis_across_slices_rejected(self):
+        with pytest.raises(ValueError, match="dp.*divisible by"):
+            build_mesh(MeshSpec(dp=1, tp=8), devices=jax.devices()[:8],
+                       num_slices=2)
+        with pytest.raises(ValueError, match="equal slices"):
+            build_mesh(MeshSpec(dp=3, tp=2), devices=jax.devices()[:6],
+                       num_slices=4)
+
+    def test_two_slice_training_dp_across_dcn(self):
+        """The dryrun-style 2-slice case: 2 x 4-device groups, full train
+        step with dp crossing the "DCN" boundary and tp/sp inside each
+        slice — finite, descending loss."""
+        import numpy as np
+
+        from tony_tpu.models import TransformerConfig, make_train_step
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+        )
+        mesh = build_mesh(
+            MeshSpec(dp=2, sp=2, tp=2), devices=jax.devices()[:8],
+            num_slices=2,
+        )
+        init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-2)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (4, 33)), jnp.int32
+        )
+        with jax.sharding.set_mesh(mesh):
+            state = init_fn(jax.random.key(0))
+            losses = []
+            for _ in range(3):
+                state, metrics = step_fn(state, tokens)
+                losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_build_job_mesh_reads_topology_env(self, monkeypatch):
+        import json as _json
+
+        import tony_tpu.runtime as rt
+        from tony_tpu import constants
+
+        monkeypatch.setenv(
+            constants.TONY_SLICE_TOPOLOGY,
+            _json.dumps({
+                "accelerator_type": "v5litepod-4", "num_slices": 2,
+                "hosts_per_slice": 1, "chips_per_slice": 4,
+            }),
+        )
+        mesh = rt.build_job_mesh(devices=jax.devices()[:8])
+        assert mesh.shape["dp"] == 2
+
+
 class TestCollectives:
     def _run(self, mesh, fn, in_specs, out_specs, *args):
         return jax.shard_map(

@@ -44,6 +44,30 @@ def test_jax_env_chief_is_process_zero():
     assert ps_env["JAX_COORDINATOR_ADDRESS"] == "h0:5000"
 
 
+def test_jax_env_multislice_megascale(monkeypatch):
+    """With the coordinator's slice identity in the executor env, the JAX
+    runtime injects the megascale/DCN variables (slice id, slice count,
+    coordinator host) alongside the flat jax.distributed identity —
+    VERDICT r2 item 2's per-slice env contract."""
+    monkeypatch.setenv("TONY_SLICE_INDEX", "1")
+    monkeypatch.setenv("TONY_SLICE_PROCESS_ID", "0")
+    monkeypatch.setenv("TONY_NUM_SLICES", "2")
+    rt = get_runtime("jax")
+    env = rt.build_env(SPEC, "worker", 1, _conf())
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "h0"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["TONY_SLICE_INDEX"] == "1"
+    # jax.distributed still spans all processes with ONE coordinator.
+    assert env["JAX_COORDINATOR_ADDRESS"] == "h0:5000"
+    assert env["TONY_NUM_PROCESSES"] == "3"
+
+
+def test_jax_env_single_slice_has_no_megascale():
+    env = get_runtime("jax").build_env(SPEC, "worker", 0, _conf())
+    assert "MEGASCALE_SLICE_ID" not in env
+
+
 def test_unknown_framework():
     with pytest.raises(ValueError, match="unknown framework"):
         get_runtime("mxnet")

@@ -25,6 +25,7 @@ from pathlib import Path
 
 import tony_tpu
 from tony_tpu import constants
+from tony_tpu.cloud.gcs import is_gs_uri
 from tony_tpu.client.client import TonyClient
 from tony_tpu.conf import keys
 from tony_tpu.proxy import ProxyServer
@@ -38,10 +39,16 @@ def cluster_submit(argv: list[str]) -> int:
     ``--hdfs_classpath``) so remote executors resolve the same framework
     version the client submitted with."""
     client = TonyClient().init(argv)
-    staging_root = Path(
-        client.conf.get_str(keys.K_STAGING_LOCATION)
-        or Path.cwd() / constants.TONY_STAGING_DIR
-    )
+    staging_conf = client.conf.get_str(keys.K_STAGING_LOCATION)
+    if is_gs_uri(staging_conf):
+        # gs:// staging: the framework copy is built in a local tempdir and
+        # rides the app dir to GCS as lib.zip (client._stage); the gs URI
+        # must never be treated as a local path.
+        staging_root = Path(tempfile.mkdtemp(prefix="tony-lib-"))
+    else:
+        staging_root = Path(
+            staging_conf or Path.cwd() / constants.TONY_STAGING_DIR
+        )
     # Per-submission lib dir (the reference stages its jar under
     # .tony/<uuid>, ClusterSubmitter.java:59-63): each submission owns a
     # fresh framework copy and cleans up only its own, so concurrent

@@ -22,6 +22,7 @@ import uuid
 from pathlib import Path
 
 from tony_tpu import constants, utils
+from tony_tpu.cloud.gcs import is_gs_uri
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration, load_job_config
 from tony_tpu.rpc.client import ApplicationRpcClient
@@ -57,6 +58,8 @@ class TonyClient:
         self.coordinator_proc: subprocess.Popen | None = None
         self.rpc: ApplicationRpcClient | None = None
         self._urls_printed = False
+        # Injectable for tests (no egress here); None = real GcsStorage.
+        self._gcs_store = None
 
     # -- init (TonyClient.init:251-340) ------------------------------------
     def init(self, argv: list[str]) -> "TonyClient":
@@ -85,10 +88,21 @@ class TonyClient:
 
     # -- staging (zipArchive + createAMContainerSpec:369-424, 468-491) ------
     def _stage(self) -> Path:
-        staging_root = Path(
-            self.conf.get_str(keys.K_STAGING_LOCATION)
-            or Path.cwd() / constants.TONY_STAGING_DIR
-        )
+        staging_conf = self.conf.get_str(keys.K_STAGING_LOCATION)
+        gs_staging = is_gs_uri(staging_conf)
+        if gs_staging:
+            # Remote staging (the HDFS-upload analogue,
+            # TonyClient.createAMContainerSpec:374-385): build the app dir
+            # locally first — the locally-spawned coordinator reads it from
+            # disk — then mirror every artifact to gs://, where TPU-VM
+            # bootstraps localize from (cloud/bootstrap.py).
+            import tempfile
+
+            staging_root = Path(tempfile.mkdtemp(prefix="tony-staging-"))
+        else:
+            staging_root = Path(
+                staging_conf or Path.cwd() / constants.TONY_STAGING_DIR
+            )
         self.app_id = f"application_{int(time.time() * 1000)}_{uuid.uuid4().hex[:8]}"
         app_dir = staging_root / self.app_id
         app_dir.mkdir(parents=True, exist_ok=True)
@@ -101,8 +115,19 @@ class TonyClient:
             staged = app_dir / Path(venv).name
             shutil.copy2(venv, staged)
             # Executors must unzip the *staged* copy: on a remote deployment
-            # only the staging location is shared, not the client's home dir.
-            self.conf.set(keys.K_PYTHON_VENV, str(staged))
+            # only the staging location is shared, not the client's home
+            # dir. Under gs:// staging the bootstrap localizes every staged
+            # object into the executor cwd, so the bare name resolves.
+            self.conf.set(
+                keys.K_PYTHON_VENV,
+                staged.name if gs_staging else str(staged),
+            )
+        lib_path = self.conf.get_str(keys.K_LIB_PATH)
+        if gs_staging and lib_path:
+            # The ClusterSubmitter framework copy rides the same app dir as
+            # lib.zip; the stage-0 loader on each TPU VM fetches it before
+            # anything else (ClusterSubmitter.java:59-63 stages the fat jar).
+            utils.zip_dir(lib_path, app_dir / "lib.zip")
         # Fresh per-job credentials (TonyClient.getTokens analogue); the
         # frozen conf carries them, so restrict it to the submitting user.
         from tony_tpu import security
@@ -113,6 +138,15 @@ class TonyClient:
             app_dir / constants.TONY_FINAL_CONF,
             mode=0o600 if secure else None,
         )
+        if gs_staging:
+            from tony_tpu.cloud import default_storage
+
+            store = self._gcs_store or default_storage()
+            for f in sorted(app_dir.iterdir()):
+                store.upload_file(f, f"{staging_conf}/{self.app_id}/{f.name}")
+            log.info(
+                "staged %s to %s/%s", self.app_id, staging_conf, self.app_id
+            )
         return app_dir
 
     # -- submit + monitor (TonyClient.run:146-208) --------------------------

@@ -1,0 +1,53 @@
+"""Concrete Google Cloud control-plane clients — the layer the reference
+implements against YARN/HDFS (`TonyClient.createAMContainerSpec` uploads to
+HDFS and submits through a live `YarnClient`, TonyClient.java:369-424,
+568-621; `ClusterSubmitter` stages the framework jar remotely,
+ClusterSubmitter.java:48-82). Here the substrate is GCS for staging
+(`gcs.GcsStorage`) and the Cloud TPU queued-resources API for slice
+provisioning (`gcp.GcpQueuedResourceApi`, implementing
+``coordinator.backend.TpuApi``).
+
+Everything network-facing goes through an injectable ``HttpTransport`` /
+``CommandRunner`` so the full lifecycle is testable with recorded
+responses — this build environment has no egress, so the tests ARE the
+integration surface; the default transports (urllib + gcloud ssh) are the
+production path.
+"""
+
+from tony_tpu.cloud.gcs import GcsStorage, is_gs_uri, split_gs_uri
+from tony_tpu.cloud.gcp import (
+    GcpQueuedResourceApi,
+    GcloudSshRunner,
+    UrllibTransport,
+    default_token_provider,
+)
+
+_default_storage: GcsStorage | None = None
+
+
+def default_storage() -> GcsStorage:
+    """Process-wide GcsStorage used by call sites that cannot take an
+    injected client (history writer, bootstrap). Tests swap it with
+    ``set_default_storage``; production lazily builds the urllib one."""
+    global _default_storage
+    if _default_storage is None:
+        _default_storage = GcsStorage()
+    return _default_storage
+
+
+def set_default_storage(storage: GcsStorage | None) -> None:
+    global _default_storage
+    _default_storage = storage
+
+
+__all__ = [
+    "GcsStorage",
+    "is_gs_uri",
+    "split_gs_uri",
+    "GcpQueuedResourceApi",
+    "GcloudSshRunner",
+    "UrllibTransport",
+    "default_token_provider",
+    "default_storage",
+    "set_default_storage",
+]

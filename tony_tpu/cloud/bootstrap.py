@@ -1,0 +1,120 @@
+"""Remote-host bootstrap: what runs on each TPU-VM worker before the task
+executor — the analogue of YARN container localization (the NM fetching
+``tony.zip`` + ``tony-final.xml`` before `TaskExecutor.main`,
+TonyClient.java:374-385 upload side, TaskExecutor.java:97-99 unpack side).
+
+Two stages:
+
+* ``INLINE_LOADER`` — a self-contained stdlib-only script the ssh command
+  runs as ``python3 -c``: fetches ``lib.zip`` (the staged framework copy,
+  ClusterSubmitter analogue) from the gs:// app dir using the VM's
+  metadata-server token, puts it on sys.path, then hands off to stage 2.
+  If no ``lib.zip`` is staged (framework baked into the VM image), the
+  import must already work.
+* ``main(staged_uri)`` — stage 2, running with tony_tpu importable:
+  download ``tony-final.json`` (+ job archive if present), unzip into a
+  workdir, point ``TONY_CONF_PATH`` at the local conf copy, chdir, and
+  run the normal ``TaskExecutor``. Exit code propagates through ssh to
+  the coordinator's poll loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+# Keep in sync with gcs.py request shapes; stdlib-only on purpose — this
+# string runs on a bare TPU VM before any framework code exists there.
+INLINE_LOADER = r"""
+import io, json, os, sys, urllib.request, urllib.error, zipfile
+uri = sys.argv[1]
+def _tok():
+    rq = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"})
+    return json.loads(urllib.request.urlopen(rq, timeout=5).read())[
+        "access_token"]
+def _get(bucket, key):
+    from urllib.parse import quote
+    rq = urllib.request.Request(
+        "https://storage.googleapis.com/storage/v1/b/%s/o/%s?alt=media"
+        % (quote(bucket), quote(key, safe="")),
+        headers={"Authorization": "Bearer " + _tok()})
+    return urllib.request.urlopen(rq, timeout=300).read()
+bucket, _, prefix = uri[len("gs://"):].partition("/")
+try:
+    lib = _get(bucket, prefix + "/lib.zip")
+    zipfile.ZipFile(io.BytesIO(lib)).extractall("tony_lib")
+    sys.path.insert(0, os.path.abspath("tony_lib"))
+except urllib.error.HTTPError as e:
+    if e.code != 404:
+        raise
+from tony_tpu.cloud.bootstrap import main
+sys.exit(main(uri))
+"""
+
+
+def main(staged_uri: str) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s bootstrap: %(message)s",
+    )
+    from tony_tpu import constants, utils
+    from tony_tpu.cloud import default_storage
+
+    store = default_storage()
+    workdir = Path.cwd() / "tony-workdir"
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    # Localize every staged artifact (conf, job archive, venv zip, ...) —
+    # the frozen conf references venvs by bare name relative to this cwd.
+    # lib.zip was already handled by the stage-0 loader.
+    bucket, _, prefix = staged_uri[len("gs://"):].partition("/")
+    for key in store.list_prefix(staged_uri):
+        name = key[len(prefix):].lstrip("/")
+        if not name or "/" in name or name == "lib.zip":
+            continue
+        store.download_file(f"gs://{bucket}/{key}", workdir / name)
+    conf_path = workdir / constants.TONY_FINAL_CONF
+    if not conf_path.is_file():
+        raise FileNotFoundError(
+            f"no {constants.TONY_FINAL_CONF} under {staged_uri}"
+        )
+    local_zip = workdir / constants.TONY_ARCHIVE
+    if local_zip.is_file():
+        utils.unzip(local_zip, workdir)
+        log.info("localized job archive from %s", staged_uri)
+
+    # The coordinator's TONY_CONF_PATH points at ITS filesystem; override
+    # with the localized copy before the executor reads it.
+    os.environ[constants.TONY_CONF_PATH] = str(conf_path)
+    # The user script runs as a SUBPROCESS of the executor and must import
+    # tony_tpu too (runtime.initialize, sharded_reader, ...): export the
+    # package root — the stage-0 loader set sys.path for THIS process only.
+    # LocalProcessBackend does the same for local runs (backend.py).
+    import tony_tpu
+
+    pkg_root = str(Path(tony_tpu.__file__).resolve().parent.parent)
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        os.environ["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + existing if existing else "")
+        )
+    os.chdir(workdir)
+
+    from tony_tpu.executor.task_executor import main as executor_main
+
+    return executor_main()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2 or not sys.argv[1].startswith("gs://"):
+        print("usage: python -m tony_tpu.cloud.bootstrap gs://bucket/app-dir",
+              file=sys.stderr)
+        raise SystemExit(2)
+    raise SystemExit(main(sys.argv[1]))

@@ -1,0 +1,180 @@
+"""GCS staging client over the JSON/upload REST surface — the HDFS-upload
+analogue (`TonyClient.createAMContainerSpec` puts the job zip + conf on
+HDFS, TonyClient.java:374-385; executors localize them). No SDK
+dependency: plain REST through the injectable ``HttpTransport`` seam so
+recorded-response tests cover the whole surface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.parse
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tony_tpu.cloud.gcp import HttpTransport
+
+log = logging.getLogger(__name__)
+
+_API = "https://storage.googleapis.com"
+
+
+def is_gs_uri(uri: str | Path) -> bool:
+    return str(uri).startswith("gs://")
+
+
+def split_gs_uri(uri: str) -> tuple[str, str]:
+    """gs://bucket/some/key -> ("bucket", "some/key")."""
+    if not is_gs_uri(uri):
+        raise ValueError(f"not a gs:// URI: {uri!r}")
+    rest = str(uri)[len("gs://"):]
+    bucket, _, key = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"gs:// URI missing bucket: {uri!r}")
+    return bucket, key
+
+
+class GcsError(RuntimeError):
+    def __init__(self, status: int, url: str, body: bytes) -> None:
+        super().__init__(
+            f"GCS request failed with HTTP {status} for {url}: "
+            f"{body[:300]!r}"
+        )
+        self.status = status
+
+
+class GcsStorage:
+    """Minimal object store client: put/get/list/delete, bytes and files.
+
+    ``transport`` is any ``gcp.HttpTransport``; the default is the urllib
+    transport with metadata-server / gcloud auth (see
+    ``gcp.UrllibTransport``).
+    """
+
+    def __init__(self, transport: "HttpTransport | None" = None) -> None:
+        if transport is None:
+            from tony_tpu.cloud.gcp import UrllibTransport
+
+            transport = UrllibTransport()
+        self.transport = transport
+
+    # -- bytes --------------------------------------------------------------
+    def put_bytes(self, uri: str, data: bytes) -> None:
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/upload/storage/v1/b/{urllib.parse.quote(bucket)}/o"
+            f"?uploadType=media&name={urllib.parse.quote(key, safe='')}"
+        )
+        status, body = self.transport.request(
+            "POST", url, data, {"Content-Type": "application/octet-stream"}
+        )
+        if status != 200:
+            raise GcsError(status, url, body)
+        log.debug("uploaded %d bytes to %s", len(data), uri)
+
+    def get_bytes(self, uri: str) -> bytes:
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        status, body = self.transport.request("GET", url, None, {})
+        if status != 200:
+            raise GcsError(status, url, body)
+        return body
+
+    # -- files --------------------------------------------------------------
+    def upload_file(self, local: str | Path, uri: str) -> None:
+        """Streamed upload: the request body is the open file object (the
+        transport sends Content-Length from its size), so a multi-GB venv
+        archive never lands in client RAM."""
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/upload/storage/v1/b/{urllib.parse.quote(bucket)}/o"
+            f"?uploadType=media&name={urllib.parse.quote(key, safe='')}"
+        )
+        size = Path(local).stat().st_size
+        with open(local, "rb") as f:
+            status, body = self.transport.request(
+                "POST", url, f,
+                {
+                    "Content-Type": "application/octet-stream",
+                    "Content-Length": str(size),
+                },
+            )
+        if status != 200:
+            raise GcsError(status, url, body)
+        log.debug("uploaded %d bytes to %s", size, uri)
+
+    def download_file(self, uri: str, local: str | Path) -> None:
+        """Streamed when the transport supports it (UrllibTransport does);
+        fake/simple transports fall back to the in-memory path."""
+        path = Path(local)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        stream = getattr(self.transport, "request_stream", None)
+        if stream is None:
+            path.write_bytes(self.get_bytes(uri))
+            return
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        status, resp = stream("GET", url)
+        if status != 200:
+            with resp:
+                raise GcsError(status, url, resp.read()[:300])
+        with resp, open(path, "wb") as out:
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+
+    # -- metadata -----------------------------------------------------------
+    def exists(self, uri: str) -> bool:
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}"
+        )
+        status, body = self.transport.request("GET", url, None, {})
+        if status == 200:
+            return True
+        if status == 404:
+            return False
+        raise GcsError(status, url, body)
+
+    def list_prefix(self, uri: str) -> list[str]:
+        """All object keys under a gs://bucket/prefix (full keys, paging
+        followed)."""
+        bucket, prefix = split_gs_uri(uri)
+        names: list[str] = []
+        page = ""
+        while True:
+            url = (
+                f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o"
+                f"?prefix={urllib.parse.quote(prefix, safe='')}"
+            )
+            if page:
+                url += f"&pageToken={urllib.parse.quote(page)}"
+            status, body = self.transport.request("GET", url, None, {})
+            if status != 200:
+                raise GcsError(status, url, body)
+            doc = json.loads(body)
+            names += [item["name"] for item in doc.get("items", [])]
+            page = doc.get("nextPageToken", "")
+            if not page:
+                return names
+
+    def delete(self, uri: str) -> None:
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}"
+        )
+        status, body = self.transport.request("DELETE", url, None, {})
+        if status not in (200, 204, 404):
+            raise GcsError(status, url, body)

@@ -75,6 +75,14 @@ K_TPU_TOPOLOGY = TPU_PREFIX + "topology"                 # e.g. "v5e-8", "" = au
 K_TPU_ACCELERATOR_TYPE = TPU_PREFIX + "accelerator-type" # e.g. "v5litepod-8"
 K_TPU_SLICE_STRICT = TPU_PREFIX + "strict-slice-shapes"  # reject illegal topologies
 
+# --- GCP control plane (new; the YarnClient-analogue substrate) ------------
+GCP_PREFIX = TONY_PREFIX + "gcp."
+K_GCP_PROJECT = GCP_PREFIX + "project"          # non-empty => TpuVmBackend
+K_GCP_ZONE = GCP_PREFIX + "zone"                # e.g. "us-central1-a"
+K_GCP_RUNTIME_VERSION = GCP_PREFIX + "runtime-version"  # TPU VM image
+K_GCP_NETWORK = GCP_PREFIX + "network"          # "" = project default
+K_AM_ADDRESS_HOST = AM_PREFIX + "address-host"  # reachable AM host for remote executors ("" = auto)
+
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
 # (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
@@ -137,6 +145,11 @@ DEFAULTS: dict[str, object] = {
     K_TPU_TOPOLOGY: "",
     K_TPU_ACCELERATOR_TYPE: "",
     K_TPU_SLICE_STRICT: False,
+    K_GCP_PROJECT: "",
+    K_GCP_ZONE: "",
+    K_GCP_RUNTIME_VERSION: "v2-alpha-tpuv5-lite",
+    K_GCP_NETWORK: "",
+    K_AM_ADDRESS_HOST: "",
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",

@@ -35,6 +35,18 @@ TONY_NUM_PROCESSES = "TONY_NUM_PROCESSES"
 TONY_PROCESS_ID = "TONY_PROCESS_ID"
 JAX_LOCAL_DEVICE_IDS = "JAX_LOCAL_DEVICE_IDS"
 TONY_SLICE_TOPOLOGY = "TONY_SLICE_TOPOLOGY"
+# Per-task slice identity for multi-slice jobs (num_slices > 1): which
+# slice this host belongs to and its index within the slice — set by the
+# coordinator at launch (SlicePlan is per job type, task index tiles
+# hosts_per_slice at a time).
+TONY_SLICE_INDEX = "TONY_SLICE_INDEX"
+TONY_SLICE_PROCESS_ID = "TONY_SLICE_PROCESS_ID"
+TONY_NUM_SLICES = "TONY_NUM_SLICES"
+# Megascale (DCN inter-slice transport) env the JAX runtime injects for
+# multi-slice jobs — libtpu reads these to bring up the cross-slice mesh.
+MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 TONY_MESH_SHAPE = "TONY_MESH_SHAPE"
 
 # ---------------------------------------------------------------------------
@@ -59,11 +71,16 @@ DOCKER_FORWARD_ENV = (
     INIT_METHOD, RANK, WORLD, WORLD_SIZE, MASTER_ADDR, MASTER_PORT,
     JAX_COORDINATOR_ADDRESS, TONY_COORDINATOR_ADDRESS,
     TONY_NUM_PROCESSES, TONY_PROCESS_ID, TONY_SLICE_TOPOLOGY,
+    TONY_SLICE_INDEX, TONY_SLICE_PROCESS_ID, TONY_NUM_SLICES,
+    MEGASCALE_COORDINATOR_ADDRESS, MEGASCALE_NUM_SLICES, MEGASCALE_SLICE_ID,
     TB_PORT, PROFILER_PORT, TONY_LOG_DIR, PREPROCESSING_JOB, TASK_PARAM_KEY,
 )
 
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"
+# gs:// URI of the staged app dir — TPU-VM bootstraps localize from it
+# (cloud/bootstrap.py), the YARN-resource-localization analogue.
+TONY_STAGED_URI = "TONY_STAGED_URI"
 TONY_EXECUTOR_TOKEN = "TONY_EXECUTOR_TOKEN"  # role credential, not the secret
 TONY_TASK_COMMAND = "TONY_TASK_COMMAND"
 TONY_CONF_PATH = "TONY_CONF_PATH"

@@ -23,6 +23,7 @@ from pathlib import Path
 from typing import Any
 
 from tony_tpu import constants, utils
+from tony_tpu.cloud.gcs import is_gs_uri
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
 from tony_tpu.coordinator.backend import (
@@ -34,7 +35,11 @@ from tony_tpu.coordinator.backend import (
 from tony_tpu.coordinator.liveness import LivenessMonitor
 from tony_tpu.coordinator.session import SessionStatus, TonySession, TonyTask
 from tony_tpu.history import JobMetadata, setup_job_dir
-from tony_tpu.history.writer import create_history_file, write_config_file
+from tony_tpu.history.writer import (
+    create_history_file,
+    write_config_file,
+    write_final_status,
+)
 from tony_tpu.rpc.protocol import ApplicationRpc, TaskUrl
 from tony_tpu.rpc.server import ApplicationRpcServer
 
@@ -323,6 +328,26 @@ class TonyCoordinator:
             if isinstance(self.backend, LocalProcessBackend):
                 task.url = self.backend.task_url(task)
 
+    def _am_host(self) -> str:
+        """Address executors dial back to. Local backends use loopback;
+        remote backends (TPU VMs) need a reachable host — configurable via
+        tony.am.address-host, else this host's primary address."""
+        override = self.conf.get_str(keys.K_AM_ADDRESS_HOST)
+        if override:
+            return override
+        if isinstance(self.backend, LocalProcessBackend):
+            return "127.0.0.1"
+        import socket
+
+        try:
+            # UDP connect (no packets sent) picks the outbound interface —
+            # gethostbyname(hostname) often returns 127.0.1.1 on VMs.
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("8.8.8.8", 80))
+                return s.getsockname()[0]
+        except OSError:
+            return socket.gethostbyname(socket.gethostname())
+
     def _task_env(self, task: TonyTask) -> dict[str, str]:
         assert self.session is not None
         n = len(self.session.tasks[task.job_name])
@@ -331,7 +356,8 @@ class TonyCoordinator:
             constants.TASK_INDEX: str(task.index),
             constants.TASK_NUM: str(n),
             constants.SESSION_ID: str(self.session.session_id),
-            constants.TONY_AM_ADDRESS: f"127.0.0.1:{self.rpc_server.port}",
+            constants.TONY_AM_ADDRESS:
+                f"{self._am_host()}:{self.rpc_server.port}",
             constants.TONY_CONF_PATH: str(
                 self.app_dir / (
                     constants.TONY_EXECUTOR_CONF
@@ -350,6 +376,20 @@ class TonyCoordinator:
             # (constants.TONY_SLICE_TOPOLOGY; the TPU analogue of the
             # reference exporting GPU capabilities into the container).
             env[constants.TONY_SLICE_TOPOLOGY] = json.dumps(asdict(plan))
+            if plan.num_slices > 1:
+                # Per-slice identity: host tiling is hosts_per_slice at a
+                # time, so task index i lives on slice i // hosts. The JAX
+                # runtime turns this into megascale/DCN env at rendezvous
+                # (executor/runtimes.py JAXRuntime).
+                s, p = divmod(task.index, plan.hosts_per_slice)
+                env[constants.TONY_SLICE_INDEX] = str(s)
+                env[constants.TONY_SLICE_PROCESS_ID] = str(p)
+                env[constants.TONY_NUM_SLICES] = str(plan.num_slices)
+        staging = self.conf.get_str(keys.K_STAGING_LOCATION)
+        if is_gs_uri(staging):
+            # Remote executors localize the app dir from here
+            # (cloud/bootstrap.py) — the YARN LocalResources analogue.
+            env[constants.TONY_STAGED_URI] = f"{staging}/{self.app_id}"
         return env
 
     # -- rendezvous + fault injection hooks --------------------------------
@@ -433,14 +473,13 @@ class TonyCoordinator:
         """stop (TonyApplicationMaster.java:621-637): write history, publish
         the terminal state, then wait (bounded) for the client's
         finishApplication signal."""
-        hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
-        if hist:
-            job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
-            create_history_file(
-                job_dir, JobMetadata.new(self.app_id, self.started_ms, status.value)
-            )
         final = self.application_status()
         final["state"] = status.value  # unmasked: this IS the terminal record
+        if self.session is not None:
+            final["tasks"] = [
+                {"id": t.id, "exit_code": t.exit_code}
+                for t in self.session.all_tasks()
+            ]
         if self.slice_plans:
             final["slices"] = {j: asdict(p) for j, p in self.slice_plans.items()}
         # Run statistics — the reference declares metrics-core but never
@@ -452,6 +491,16 @@ class TonyCoordinator:
             "heartbeat_missed_tasks": sorted(self._hb_missed),
             "wall_ms": int(time.time() * 1000) - self.started_ms,
         }
+        hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
+        if hist:
+            job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
+            create_history_file(
+                job_dir, JobMetadata.new(self.app_id, self.started_ms, status.value)
+            )
+            # The terminal record also lands in history so the per-job page
+            # can render run stats + slice plans (the reference's per-job
+            # page shows only config, JobConfigPageController.java:25-59).
+            write_final_status(job_dir, final)
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0
@@ -497,7 +546,29 @@ def main(argv: list[str] | None = None) -> int:
     backend = None
     archive = Path(args.app_dir) / constants.TONY_ARCHIVE
     lib_path = conf.get_str(keys.K_LIB_PATH) or None
-    if archive.is_file() or lib_path:
+    gcp_project = conf.get_str(keys.K_GCP_PROJECT)
+    if gcp_project:
+        # Cloud deployment: tasks run on TPU VMs provisioned through the
+        # queued-resources API — the YarnClient-submission analogue
+        # (TonyClient.java:369-424). Requires gs:// staging so remote
+        # bootstraps can localize the app dir.
+        from tony_tpu.cloud import GcpQueuedResourceApi
+        from tony_tpu.coordinator.backend import TpuVmBackend
+
+        if not is_gs_uri(conf.get_str(keys.K_STAGING_LOCATION)):
+            raise SystemExit(
+                f"{keys.K_GCP_PROJECT} is set but {keys.K_STAGING_LOCATION} "
+                f"is not a gs:// URI — TPU-VM executors localize the job "
+                f"from GCS"
+            )
+        api = GcpQueuedResourceApi(
+            gcp_project,
+            conf.get_str(keys.K_GCP_ZONE),
+            runtime_version=conf.get_str(keys.K_GCP_RUNTIME_VERSION),
+            network=conf.get_str(keys.K_GCP_NETWORK),
+        )
+        backend = TpuVmBackend(api, args.app_id)
+    elif archive.is_file() or lib_path:
         workdir = None
         if archive.is_file():
             workdir = Path(args.app_dir) / "workdir"

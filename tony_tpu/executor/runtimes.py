@@ -90,18 +90,30 @@ class PyTorchRuntime(Runtime):
 class JAXRuntime(Runtime):
     """The TPU-native runtime. Process 0 is chief:0 (it hosts the
     jax.distributed coordinator service on its registered port — the port
-    the executor reserved and advertised at rendezvous)."""
+    the executor reserved and advertised at rendezvous).
+
+    Multi-slice (``SlicePlan.num_slices > 1``): jax.distributed still spans
+    ALL processes with one coordinator — that is how JAX multislice works —
+    but the DCN transport needs per-slice identity, so when the coordinator
+    stamped this task with TONY_SLICE_INDEX (app_master._task_env) the env
+    additionally carries the megascale variables libtpu reads
+    (MEGASCALE_COORDINATOR_ADDRESS = chief:0's host, default megascale
+    port; MEGASCALE_NUM_SLICES; MEGASCALE_SLICE_ID). ``build_mesh`` then
+    lays dp outermost across slices so only the gradient psum rides DCN
+    (parallel/mesh.py build_mesh(num_slices=...))."""
 
     name = "jax"
 
     def build_env(self, cluster_spec, job_name, task_index, conf):
+        import os
+
         chief_name = conf.get_str(keys.K_CHIEF_NAME, "worker")
         flat = utils.flatten_cluster_spec(cluster_spec, chief_name)
         coordinator = utils.coordinator_address_from_spec(cluster_spec, chief_name)
         process_id = flat.index(
             (job_name, task_index, cluster_spec[job_name][task_index])
         )
-        return {
+        env = {
             constants.JAX_COORDINATOR_ADDRESS: coordinator,
             constants.TONY_COORDINATOR_ADDRESS: coordinator,
             constants.TONY_NUM_PROCESSES: str(len(flat)),
@@ -110,6 +122,22 @@ class JAXRuntime(Runtime):
                 {k: list(v) for k, v in cluster_spec.items()}
             ),
         }
+        slice_index = os.environ.get(constants.TONY_SLICE_INDEX)
+        num_slices = os.environ.get(constants.TONY_NUM_SLICES)
+        if slice_index is not None and num_slices is not None:
+            chief_host = coordinator.rsplit(":", 1)[0]
+            env[constants.MEGASCALE_COORDINATOR_ADDRESS] = chief_host
+            env[constants.MEGASCALE_NUM_SLICES] = num_slices
+            env[constants.MEGASCALE_SLICE_ID] = slice_index
+            # Forward the tony-side identity too so user code (and
+            # runtime.task_context()) sees it without reaching into the
+            # executor env.
+            env[constants.TONY_SLICE_INDEX] = slice_index
+            env[constants.TONY_NUM_SLICES] = num_slices
+            spid = os.environ.get(constants.TONY_SLICE_PROCESS_ID)
+            if spid is not None:
+                env[constants.TONY_SLICE_PROCESS_ID] = spid
+        return env
 
 
 _RUNTIMES: dict[str, type[Runtime]] = {

@@ -11,15 +11,38 @@ import re
 import time
 from pathlib import Path
 
+from tony_tpu.cloud.gcs import is_gs_uri
 from tony_tpu.history.writer import JobMetadata
 
 _APP_ID_RE = re.compile(r"^application_[\w.]+_[\w.]+$")
 
 
-def find_job_dirs(history_location: str | Path) -> list[Path]:
+def _gs_listing(history_location: str) -> dict[str, list[str]]:
+    """One listing call: gs:// job-dir URI -> file names inside it. The
+    writer lays objects out as <hist>/<y>/<m>/<d>/<app_id>/<file>."""
+    from tony_tpu.cloud import default_storage, split_gs_uri
+
+    location = str(history_location).rstrip("/")
+    _, root_key = split_gs_uri(location)
+    out: dict[str, list[str]] = {}
+    for key in default_storage().list_prefix(location + "/"):
+        rel = key[len(root_key):].lstrip("/") if root_key else key
+        parts = rel.split("/")
+        if len(parts) != 5 or not _APP_ID_RE.match(parts[3]):
+            continue
+        out.setdefault(f"{location}/{'/'.join(parts[:4])}", []).append(
+            parts[4]
+        )
+    return out
+
+
+def find_job_dirs(history_location: str | Path) -> "list[Path | str]":
     """Recursive scan for job folders whose name looks like an app id
     (the reference matches ``^application_\\d+_\\d+$``; ours allows the
-    mini/uuid id forms too)."""
+    mini/uuid id forms too). gs:// history locations scan the object
+    listing instead of the filesystem and return gs:// dir URIs."""
+    if is_gs_uri(history_location):
+        return sorted(_gs_listing(str(history_location)))
     root = Path(history_location)
     if not root.is_dir():
         return []
@@ -28,27 +51,73 @@ def find_job_dirs(history_location: str | Path) -> list[Path]:
     )
 
 
+def _job_files(job_dir: "Path | str") -> list[str]:
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage, split_gs_uri
+
+        prefix = split_gs_uri(str(job_dir))[1]
+        return [
+            key[len(prefix):].lstrip("/")
+            for key in default_storage().list_prefix(str(job_dir) + "/")
+        ]
+    return [p.name for p in Path(job_dir).iterdir()]
+
+
+def _read_job_file(job_dir: "Path | str", name: str) -> str | None:
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage
+
+        uri = f"{job_dir}/{name}"
+        store = default_storage()
+        if not store.exists(uri):
+            return None
+        return store.get_bytes(uri).decode()
+    p = Path(job_dir) / name
+    return p.read_text() if p.is_file() else None
+
+
+def _dir_name(job_dir: "Path | str") -> str:
+    return str(job_dir).rstrip("/").rsplit("/", 1)[-1]
+
+
 def list_jobs(history_location: str | Path) -> list[JobMetadata]:
     """Newest-first job metadata, parsed from .jhist filenames (malformed
     entries are skipped, as the reference's parser does)."""
     jobs = []
     for job_dir in find_job_dirs(history_location):
-        for f in job_dir.glob("*.jhist"):
+        for fname in _job_files(job_dir):
+            if not fname.endswith(".jhist"):
+                continue
             try:
-                jobs.append(JobMetadata.parse_jhist_name(f.name))
+                jobs.append(JobMetadata.parse_jhist_name(fname))
             except ValueError:
                 continue
     return sorted(jobs, key=lambda j: j.started_ms, reverse=True)
 
 
+def _job_json(
+    history_location: str | Path, app_id: str, filename: str
+) -> dict | None:
+    for job_dir in find_job_dirs(history_location):
+        if _dir_name(job_dir) == app_id:
+            raw = _read_job_file(job_dir, filename)
+            if raw is not None:
+                return json.loads(raw)
+    return None
+
+
 def job_config(history_location: str | Path, app_id: str) -> dict | None:
     """The frozen config of one job (JobConfigPageController.java:25-59)."""
-    for job_dir in find_job_dirs(history_location):
-        if job_dir.name == app_id:
-            cfg = job_dir / "config.json"
-            if cfg.is_file():
-                return json.loads(cfg.read_text())
-    return None
+    return _job_json(history_location, app_id, "config.json")
+
+
+def job_final_status(
+    history_location: str | Path, app_id: str
+) -> dict | None:
+    """The coordinator's terminal record for one job (state, per-task
+    table, run stats, slice plans) — written by
+    ``writer.write_final_status`` at job stop."""
+    return _job_json(history_location, app_id, "final-status.json")
 
 
 class TtlCache:

@@ -16,7 +16,12 @@ import logging
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from tony_tpu.history.reader import TtlCache, job_config, list_jobs
+from tony_tpu.history.reader import (
+    TtlCache,
+    job_config,
+    job_final_status,
+    list_jobs,
+)
 from tony_tpu.history.writer import redact_config
 
 log = logging.getLogger(__name__)
@@ -52,12 +57,20 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 self._send_json([j.__dict__ for j in self._jobs()])
             elif self.path.startswith("/config/"):
                 self._config_page(self.path[len("/config/"):])
+            elif self.path.startswith("/job/"):
+                self._job_page(self.path[len("/job/"):])
             elif self.path.startswith("/api/config/"):
                 cfg = self._config(self.path[len("/api/config/"):])
                 if cfg is None:
                     self._send_json({"error": "not found"}, status=404)
                 else:
                     self._send_json(cfg)
+            elif self.path.startswith("/api/job/"):
+                final = self._final(self.path[len("/api/job/"):])
+                if final is None:
+                    self._send_json({"error": "not found"}, status=404)
+                else:
+                    self._send_json(final)
             else:
                 self.send_error(404)
         except Exception as exc:  # pragma: no cover - defensive
@@ -82,20 +95,87 @@ class HistoryHandler(BaseHTTPRequestHandler):
         # secret either.
         return None if cfg is None else redact_config(cfg)
 
+    def _final(self, app_id: str):
+        return self.cache.get_or_load(
+            ("final", app_id),
+            lambda: job_final_status(self.history_location, app_id),
+        )
+
     # -- pages ---------------------------------------------------------------
     def _jobs_page(self) -> str:
         rows = "".join(
-            f"<tr><td><a href='/config/{j.app_id}'>{html.escape(j.app_id)}</a></td>"
+            f"<tr><td><a href='/job/{j.app_id}'>{html.escape(j.app_id)}</a></td>"
             f"<td>{_fmt_ms(j.started_ms)}</td><td>{_fmt_ms(j.completed_ms)}</td>"
             f"<td>{html.escape(j.user)}</td>"
-            f"<td class='{html.escape(j.status)}'>{html.escape(j.status)}</td></tr>"
+            f"<td class='{html.escape(j.status)}'>{html.escape(j.status)}</td>"
+            f"<td><a href='/config/{j.app_id}'>config</a></td></tr>"
             for j in self._jobs()
         )
         body = (
             "<table><tr><th>job</th><th>started</th><th>completed</th>"
-            f"<th>user</th><th>status</th></tr>{rows}</table>"
+            f"<th>user</th><th>status</th><th></th></tr>{rows}</table>"
         )
         return _PAGE.format(title="Jobs", body=body)
+
+    def _job_page(self, app_id: str) -> None:
+        """Per-job run report: terminal state, run statistics, slice plans,
+        per-task exits — the richer sibling of the reference's config-only
+        per-job page (JobConfigPageController.java:25-59)."""
+        final = self._final(app_id)
+        if final is None:
+            self.send_error(404, f"no final status for {app_id}")
+            return
+        esc = lambda v: html.escape(str(v))  # noqa: E731
+        stats = final.get("stats", {})
+        parts = [
+            f"<p>state: <span class='{esc(final.get('state'))}'>"
+            f"{esc(final.get('state'))}</span></p>",
+            "<h3>Run statistics</h3><table>",
+        ]
+        wall = stats.get("wall_ms")
+        stat_rows = [
+            ("sessions run", stats.get("sessions_run")),
+            ("tasks failed", stats.get("tasks_failed")),
+            ("heartbeat-missed tasks",
+             ", ".join(stats.get("heartbeat_missed_tasks", [])) or "none"),
+            ("wall time",
+             f"{wall / 1000.0:.1f} s" if wall is not None else "?"),
+        ]
+        parts += [
+            f"<tr><td>{esc(k)}</td><td>{esc(v)}</td></tr>"
+            for k, v in stat_rows
+        ]
+        parts.append("</table>")
+        slices = final.get("slices")
+        if slices:
+            parts.append("<h3>TPU slices</h3><table><tr><th>job</th>"
+                         "<th>accelerator</th><th>slices</th>"
+                         "<th>hosts/slice</th><th>chips/slice</th></tr>")
+            for job, p in sorted(slices.items()):
+                parts.append(
+                    f"<tr><td>{esc(job)}</td>"
+                    f"<td>{esc(p.get('accelerator_type'))}</td>"
+                    f"<td>{esc(p.get('num_slices'))}</td>"
+                    f"<td>{esc(p.get('hosts_per_slice'))}</td>"
+                    f"<td>{esc(p.get('chips_per_slice'))}</td></tr>"
+                )
+            parts.append("</table>")
+        tasks = final.get("tasks")
+        if tasks:
+            parts.append("<h3>Tasks</h3><table><tr><th>task</th>"
+                         "<th>exit</th></tr>")
+            for t in tasks:
+                if isinstance(t, dict):
+                    parts.append(
+                        f"<tr><td>{esc(t.get('id'))}</td>"
+                        f"<td>{esc(t.get('exit_code'))}</td></tr>"
+                    )
+            parts.append("</table>")
+        parts.append(f"<p><a href='/config/{esc(app_id)}'>frozen config</a>"
+                     f" · <a href='/'>all jobs</a></p>")
+        self._send_html(
+            _PAGE.format(title=esc(app_id), body="".join(parts))
+        )
 
     def _config_page(self, app_id: str) -> None:
         cfg = self._config(app_id)

@@ -20,6 +20,7 @@ import time
 from dataclasses import asdict, dataclass
 from pathlib import Path
 
+from tony_tpu.cloud.gcs import is_gs_uri
 from tony_tpu.conf.configuration import TonyConfiguration
 
 
@@ -63,9 +64,19 @@ class JobMetadata:
         )
 
 
-def setup_job_dir(history_location: str, app_id: str, started_ms: int) -> Path:
+def setup_job_dir(
+    history_location: str, app_id: str, started_ms: int
+) -> "Path | str":
+    """y/m/d/appId job dir under the history location — a local Path, or a
+    gs:// prefix string when the history lives in GCS (objects need no
+    mkdir; the write functions below branch on the scheme)."""
     t = time.localtime(started_ms / 1000)
-    job_dir = Path(history_location) / f"{t.tm_year:04d}" / f"{t.tm_mon:02d}" / f"{t.tm_mday:02d}" / app_id
+    parts = (
+        f"{t.tm_year:04d}", f"{t.tm_mon:02d}", f"{t.tm_mday:02d}", app_id
+    )
+    if is_gs_uri(history_location):
+        return "/".join((str(history_location).rstrip("/"),) + parts)
+    job_dir = Path(history_location).joinpath(*parts)
     job_dir.mkdir(parents=True, exist_ok=True)
     return job_dir
 
@@ -102,24 +113,56 @@ def redact_config(cfg: dict) -> dict:
     return out
 
 
-def write_config_file(job_dir: Path, conf: TonyConfiguration) -> None:
+def write_config_file(job_dir: "Path | str", conf: TonyConfiguration) -> None:
     """The history copy of the job config, with secret-bearing keys
     redacted (the live tony-final.json in the staging dir keeps the real
     values — only executors and the client read that one). Atomic: a
     concurrently-scanning history server must never read a half-written
-    file."""
+    file (GCS object writes are atomic by construction)."""
     import os
 
-    target = job_dir / "config.json"
-    tmp = job_dir / ".config.json.tmp"
-    tmp.write_text(
+    data = (
         json.dumps(redact_config(conf.to_dict()), indent=2, sort_keys=True)
         + "\n"
     )
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage
+
+        default_storage().put_bytes(f"{job_dir}/config.json", data.encode())
+        return
+    target = Path(job_dir) / "config.json"
+    tmp = Path(job_dir) / ".config.json.tmp"
+    tmp.write_text(data)
     os.replace(tmp, target)
 
 
-def create_history_file(job_dir: Path, metadata: JobMetadata) -> Path:
-    p = job_dir / metadata.jhist_name()
-    p.write_text(json.dumps(asdict(metadata), indent=2) + "\n")
+def write_final_status(job_dir: "Path | str", final: dict) -> None:
+    """The coordinator's terminal record (state, per-task table, run stats,
+    slice plans) for the history UI's per-job page. Task URLs may embed
+    local paths only; everything else is already display-safe."""
+    data = json.dumps(final, indent=2, sort_keys=True) + "\n"
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage
+
+        default_storage().put_bytes(
+            f"{job_dir}/final-status.json", data.encode()
+        )
+        return
+    import os
+
+    tmp = Path(job_dir) / ".final-status.json.tmp"
+    tmp.write_text(data)
+    os.replace(tmp, Path(job_dir) / "final-status.json")
+
+
+def create_history_file(job_dir: "Path | str", metadata: JobMetadata) -> "Path | str":
+    data = json.dumps(asdict(metadata), indent=2) + "\n"
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage
+
+        uri = f"{job_dir}/{metadata.jhist_name()}"
+        default_storage().put_bytes(uri, data.encode())
+        return uri
+    p = Path(job_dir) / metadata.jhist_name()
+    p.write_text(data)
     return p

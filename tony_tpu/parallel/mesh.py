@@ -118,19 +118,79 @@ def _largest_factor_at_most(n: int, cap: int) -> int:
 def build_mesh(
     spec: MeshSpec | None = None,
     devices: list | None = None,
+    *,
+    num_slices: int = 1,
 ) -> Mesh:
     """Build a 5-axis Mesh. With no spec, auto-factor over all local devices.
 
     On a real TPU slice `jax.devices()` is already ordered so that adjacent
     ids are ICI neighbours; reshaping in C-order therefore keeps the
     innermost axes (sp, tp) on the shortest hops.
+
+    ``num_slices > 1`` builds a multi-slice (DCN-spanning) mesh: devices
+    are grouped per slice (by their ``slice_index`` attribute on real
+    multi-slice hardware, by contiguous id blocks on virtual meshes) and
+    laid out so the OUTERMOST dp rows tile slice-by-slice — every pp/ep/
+    sp/tp collective stays inside one slice's ICI, and only the dp
+    gradient psum crosses the DCN boundary (the scaling-book recipe for
+    inter-slice parallelism). Shapes that would force an inner axis across
+    slices are rejected.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     if spec is None:
-        spec = MeshSpec.auto(len(devices))
+        # Multi-slice auto: pin dp to the slice count (each slice one dp
+        # row) and let the ICI-hot axes factor within a slice.
+        spec = MeshSpec.auto(len(devices)) if num_slices == 1 else (
+            MeshSpec.auto(len(devices), dp=num_slices)
+        )
     spec.validate(len(devices))
+    if num_slices > 1:
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not split into {num_slices} "
+                f"equal slices"
+            )
+        if spec.dp % num_slices:
+            raise ValueError(
+                f"multi-slice meshes need dp ({spec.dp}) divisible by "
+                f"num_slices ({num_slices}) — dp is the only axis allowed "
+                f"to cross the DCN boundary"
+            )
+        per_slice = len(devices) // num_slices
+        inner = spec.pp * spec.ep * spec.sp * spec.tp
+        if (spec.dp // num_slices) * inner != per_slice:
+            raise ValueError(
+                f"mesh {spec.shape} cannot tile {num_slices} slices of "
+                f"{per_slice} devices with dp outermost: "
+                f"(dp/num_slices) x pp x ep x sp x tp = "
+                f"{(spec.dp // num_slices) * inner} != {per_slice}"
+            )
+        devices = _group_by_slice(devices, num_slices)
     dev_array = np.asarray(devices).reshape(spec.shape)
     return Mesh(dev_array, AXES)
+
+
+def _group_by_slice(devices: list, num_slices: int) -> list:
+    """Order devices slice-major. Real multi-slice devices carry a
+    ``slice_index`` attribute; virtual/CPU meshes fall back to contiguous
+    id blocks (the dryrun convention: devices [0, n/s) are slice 0...)."""
+    indexed = [getattr(d, "slice_index", None) for d in devices]
+    if all(s is not None for s in indexed):
+        groups: dict[int, list] = {}
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+        if len(groups) != num_slices:
+            raise ValueError(
+                f"devices report {len(groups)} distinct slice_index values, "
+                f"expected {num_slices}"
+            )
+        sizes = {len(v) for v in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"uneven slice sizes: { {k: len(v) for k, v in groups.items()} }")
+        return [
+            d for s in sorted(groups) for d in sorted(groups[s], key=lambda d: d.id)
+        ]
+    return devices  # already id-ordered: contiguous blocks are the slices
 
 
 def round_up_to_slice(chips: int, generation: str = "v5e") -> int:

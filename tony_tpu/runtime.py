@@ -30,6 +30,12 @@ class TaskContext:
     process_id: int
     num_processes: int
     coordinator_address: str | None
+    # Multi-slice identity (num_slices > 1 jobs only; see
+    # executor/runtimes.py JAXRuntime): which DCN-connected slice this
+    # process runs on, and its index within the slice.
+    slice_index: int = 0
+    num_slices: int = 1
+    slice_process_id: int = 0
 
     @property
     def is_distributed(self) -> bool:
@@ -46,6 +52,9 @@ def task_context() -> TaskContext:
         process_id=int(env.get(constants.TONY_PROCESS_ID, "0")),
         num_processes=int(env.get(constants.TONY_NUM_PROCESSES, "1")),
         coordinator_address=env.get(constants.TONY_COORDINATOR_ADDRESS),
+        slice_index=int(env.get(constants.TONY_SLICE_INDEX, "0")),
+        num_slices=int(env.get(constants.TONY_NUM_SLICES, "1")),
+        slice_process_id=int(env.get(constants.TONY_SLICE_PROCESS_ID, "0")),
     )
 
 
@@ -108,3 +117,20 @@ def slice_topology() -> dict | None:
     to size a ``jax.sharding.Mesh`` without hardcoding the device count."""
     raw = os.environ.get(constants.TONY_SLICE_TOPOLOGY)
     return json.loads(raw) if raw else None
+
+
+def build_job_mesh(spec=None, devices=None):
+    """Build this job's device mesh from the injected slice topology:
+    single-slice jobs get the plain 5-axis mesh; multi-slice jobs get the
+    dp-outermost DCN-spanning layout (``parallel.mesh.build_mesh``'s
+    ``num_slices``) so only the gradient psum crosses slices. Scripts call
+    this instead of hand-building a Mesh::
+
+        rt.initialize()
+        mesh = rt.build_job_mesh()          # or pass a MeshSpec
+    """
+    from tony_tpu.parallel.mesh import build_mesh
+
+    plan = slice_topology()
+    num_slices = int(plan["num_slices"]) if plan else 1
+    return build_mesh(spec, devices, num_slices=num_slices)
