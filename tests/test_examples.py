@@ -47,6 +47,19 @@ def test_jax_example_two_workers_dp():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def test_lm_example_trains_and_checkpoints():
+    """The flagship-framework showcase: transformer LM (GQA) through
+    runtime.initialize + build_job_mesh + make_train_step +
+    CheckpointManager, submitted exactly as a user would."""
+    proc = _submit(
+        "lm_train.py", "jax", workers=1,
+        extra=["--task_params",
+               "--steps 8 --d-model 32 --n-layers 2 --n-heads 2 "
+               "--n-kv-heads 1 --batch 4 --seq 32 --checkpoint-every 4"],
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
 def test_jax_example_with_ps():
     """BASELINE config 2 shape: 1 ps + 2 workers through the gang barrier
     (all three run the user script, like the reference's shared-script ps

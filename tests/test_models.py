@@ -431,18 +431,40 @@ class TestDecode:
         assert a.shape == b.shape == (1, 8)
         assert not np.array_equal(np.asarray(a), np.asarray(b))
 
-    def test_moe_decode_rejected(self):
-        from tony_tpu.models import TransformerConfig, advance, init_cache, init_params
-        import pytest
+    def test_moe_decode_matches_training_forward(self):
+        """MoE trunk (with GQA): cached greedy decode emits the same tokens
+        as full-recompute argmax. capacity_factor is sized so training's
+        dispatch drops nothing — decode's dense-mixture evaluation never
+        drops (inference serves whatever the router picks), so parity
+        requires a non-dropping training config."""
+        from tony_tpu.models import (
+            TransformerConfig, forward, generate, init_params,
+        )
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
 
         cfg = TransformerConfig(
-            vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
-            d_ff=64, max_seq=32, dtype="float32", n_experts=4,
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+            n_experts=4, expert_top_k=2, capacity_factor=4.0,
+            n_kv_heads=2,
         )
-        params = init_params(jax.random.key(0), cfg)
-        with pytest.raises(NotImplementedError):
-            advance(params, init_cache(cfg, 1, 8),
-                    jnp.ones((1, 4), jnp.int32), cfg)
+        params = init_params(jax.random.key(7), cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, 64, (2, 6)), jnp.int32
+        )
+        got = generate(params, prompt, cfg, max_new_tokens=5)
+        mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+        ctx = prompt
+        want = []
+        with jax.sharding.set_mesh(mesh):
+            for _ in range(5):
+                logits = forward(params, ctx, cfg, mesh)[:, -1]
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                want.append(nxt)
+                ctx = jnp.concatenate([ctx, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.stack(want, axis=1)
+        )
 
     def test_overflow_and_key_guards(self):
         from tony_tpu.models import generate
@@ -521,6 +543,77 @@ class TestDecode:
         c_mha = init_cache(mha, 2, 32)
         c_gqa = init_cache(gqa, 2, 32)
         assert c_gqa["k"].size * 4 == c_mha["k"].size
+
+    def test_top_k_and_top_p_sampling(self):
+        """top_k=1 must equal greedy argmax regardless of temperature; a
+        tight top_p keeps samples inside the nucleus; invalid combos are
+        rejected eagerly."""
+        from tony_tpu.models import generate
+
+        cfg, params = self._setup()
+        prompt = jnp.asarray(
+            np.random.default_rng(4).integers(0, 64, (2, 6)), jnp.int32
+        )
+        greedy = generate(params, prompt, cfg, 6)
+        k1 = generate(params, prompt, cfg, 6, temperature=1.0, top_k=1,
+                      key=jax.random.key(9))
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+        # Tiny top_p: only the argmax survives the nucleus at any step
+        # where one token dominates; with p→0 the threshold keeps exactly
+        # the top token, so this must also equal greedy.
+        p_small = generate(params, prompt, cfg, 6, temperature=1.0,
+                           top_p=1e-6, key=jax.random.key(11))
+        np.testing.assert_array_equal(
+            np.asarray(greedy), np.asarray(p_small)
+        )
+
+        # A permissive nucleus still varies with the key (real sampling).
+        a = generate(params, prompt, cfg, 8, temperature=1.0, top_p=0.95,
+                     key=jax.random.key(1))
+        b = generate(params, prompt, cfg, 8, temperature=1.0, top_p=0.95,
+                     key=jax.random.key(2))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+        with pytest.raises(ValueError, match="set a temperature"):
+            generate(params, prompt, cfg, 4, top_k=5)
+        with pytest.raises(ValueError, match="top_p"):
+            generate(params, prompt, cfg, 4, temperature=1.0, top_p=0.0,
+                     key=jax.random.key(0))
+
+    def test_tensor_parallel_decode_matches_single_device(self):
+        """generate under a tp×dp mesh with sharded params produces the
+        same tokens as the single-device path — multi-chip inference
+        (megatron head/vocab splits) falls out of GSPMD."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tony_tpu.models import (
+            TransformerConfig, decode_weights, generate, init_params,
+            param_roles,
+        )
+        from tony_tpu.models.train import _sharding_for_tree
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+            n_kv_heads=2,
+        )
+        params = init_params(jax.random.key(5), cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(0, 64, (2, 6)), jnp.int32
+        )
+        want = generate(params, prompt, cfg, max_new_tokens=6)
+
+        mesh = build_mesh(MeshSpec(dp=2, tp=4))
+        shardings = _sharding_for_tree(params, param_roles(cfg), mesh)
+        sharded = jax.device_put(params, shardings)
+        # The point of the test: weights really are tp-sharded.
+        wq_spec = sharded["layers"]["wq"].sharding.spec
+        assert wq_spec[2] == "tp", wq_spec  # heads axis megatron-split
+        with jax.sharding.set_mesh(mesh):
+            got = generate(sharded, prompt, cfg, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
     def test_checked_overflow_caught_under_jit(self):
         """checked=True + checkify turns a traced-length cache overflow into

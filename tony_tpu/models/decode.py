@@ -20,9 +20,10 @@ choices:
   call: downcast to the compute dtype, qkv and gate|up fused — decode at
   small batch is bandwidth/op-count-bound, so fewer, wider matmuls win.
 
-Dense trunk only (MoE decode needs expert caching; ``generate`` rejects
-``n_experts > 0`` explicitly). Sampling: greedy at ``temperature=0``,
-else temperature sampling with a caller-provided key.
+MoE trunks decode via dense-mixture expert evaluation (``_moe_mlp_decode``:
+every expert runs on the new token, combined by the normalized top-k
+router weights, no capacity dropping at inference). Sampling: greedy at
+``temperature=0``, else temperature sampling with a caller-provided key.
 """
 
 from __future__ import annotations
@@ -47,6 +48,9 @@ def decode_weights(params: dict, cfg: TransformerConfig) -> dict:
     op-count-bound at batch sizes the MXU can't fill; the fusion runs once
     per ``generate`` call (XLA hoists it out of the token loop).
 
+    MoE configs keep the router and fuse gate|up per expert
+    ([L, E, d, 2F]); see ``_layer_decode``'s mixture evaluation.
+
     ``advance`` accepts either this fused layout or raw training params
     (fusing on the fly), so eager chat-style callers need not care."""
     dt = cfg.compute_dtype
@@ -55,24 +59,27 @@ def decode_weights(params: dict, cfg: TransformerConfig) -> dict:
     def c(x):
         return x.astype(dt)
 
+    layers = {
+        "ln1": c(lp["ln1"]),
+        "ln2": c(lp["ln2"]),
+        # [L, d, H + 2*Hkv, Dh]
+        "qkv": jnp.concatenate(
+            [c(lp["wq"]), c(lp["wk"]), c(lp["wv"])], axis=2
+        ),
+        "wo": c(lp["wo"]),
+        # dense: [L, d, 2F]; MoE: [L, E, d, 2F]
+        "gate_up": jnp.concatenate(
+            [c(lp["w_gate"]), c(lp["w_up"])], axis=-1
+        ),
+        "w_down": c(lp["w_down"]),
+    }
+    if cfg.n_experts:
+        layers["router"] = c(lp["router"])
     return {
         "embed": c(params["embed"]),
         "final_norm": c(params["final_norm"]),
         "unembed": c(params["unembed"]),
-        "layers": {
-            "ln1": c(lp["ln1"]),
-            "ln2": c(lp["ln2"]),
-            # [L, d, H + 2*Hkv, Dh]
-            "qkv": jnp.concatenate(
-                [c(lp["wq"]), c(lp["wk"]), c(lp["wv"])], axis=2
-            ),
-            "wo": c(lp["wo"]),
-            # [L, d, 2*F]
-            "gate_up": jnp.concatenate(
-                [c(lp["w_gate"]), c(lp["w_up"])], axis=2
-            ),
-            "w_down": c(lp["w_down"]),
-        },
+        "layers": layers,
     }
 
 
@@ -136,14 +143,57 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
     ).astype(dt).reshape(b, s, cfg.n_heads, cfg.head_dim)
     x = x + jnp.einsum("bthk,hkd->btd", o, lp["wo"])
 
-    # SwiGLU with the fused gate|up projection — the same math as
-    # training's _dense_mlp, one matmul instead of two.
-    hn = rms_norm(x, lp["ln2"]).astype(dt)
-    gu = jnp.einsum("btd,df->btf", hn, lp["gate_up"])
-    f = gu.shape[-1] // 2
-    act = jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt) * gu[..., f:]
-    x = x + jnp.einsum("btf,fd->btd", act, lp["w_down"])
+    if "router" in lp:
+        x = x + _moe_mlp_decode(x, lp, cfg)
+    else:
+        # SwiGLU with the fused gate|up projection — the same math as
+        # training's _dense_mlp, one matmul instead of two.
+        hn = rms_norm(x, lp["ln2"]).astype(dt)
+        gu = jnp.einsum("btd,df->btf", hn, lp["gate_up"])
+        f = gu.shape[-1] // 2
+        act = (
+            jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt)
+            * gu[..., f:]
+        )
+        x = x + jnp.einsum("btf,fd->btd", act, lp["w_down"])
     return x, k_cache, v_cache
+
+
+def _moe_mlp_decode(x, lp, cfg):
+    """MoE layer at decode time: dense-mixture evaluation — run every
+    expert on the new token(s) and combine with the normalized top-k
+    router weights (non-selected experts get exact weight 0). Equivalent
+    to training's dispatch/combine WITHOUT capacity dropping: inference
+    serves whatever the router picks — token dropping is a training-time
+    throughput trade, not a serving semantic (and a per-step capacity over
+    1..S tokens would diverge from the full-sequence forward anyway).
+    Cost: all E experts' weights stream per step; fine for the modest
+    expert counts a single host serves — sharded expert decode belongs on
+    an ep mesh.
+    """
+    from tony_tpu.models.transformer import _route_tokens
+
+    dt = cfg.compute_dtype
+    e = cfg.n_experts
+    hn = rms_norm(x, lp["ln2"])
+    # Same router gating as training (_route_tokens — shared so parity
+    # cannot drift); [b,t,E] combine weights sum the normalized gvals over
+    # the top-k slots.
+    _, gvals, gidx = _route_tokens(hn, lp["router"], cfg.expert_top_k)
+    weights = (jax.nn.one_hot(gidx, e, dtype=jnp.float32)
+               * gvals[..., None]).sum(2)
+
+    hd = hn.astype(dt)
+    gu = jnp.einsum("btd,edf->btef", hd, lp["gate_up"])
+    f = gu.shape[-1] // 2
+    act = (
+        jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt)
+        * gu[..., f:]
+    )
+    per_expert = jnp.einsum("btef,efd->bted", act, lp["w_down"])
+    return jnp.einsum(
+        "bted,bte->btd", per_expert, weights.astype(dt)
+    )
 
 
 def advance(params: dict, cache: dict, tokens: jax.Array,
@@ -158,8 +208,6 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     ``generate()`` does (prompt + max_new_tokens ≤ capacity), or pass
     ``checked=True`` and wrap the call in ``jax.experimental.checkify``
     to turn overflow into a checked runtime error."""
-    if cfg.n_experts:
-        raise NotImplementedError("KV-cache decode supports the dense trunk")
     capacity = cache["k"].shape[2]
     if tokens.shape[1] > capacity:
         # RoPE tables and the cache are both static; overflow would clamp
@@ -218,12 +266,30 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     return logits, new_cache
 
 
-def _sample(logits, temperature, key):
+def _sample(logits, temperature, top_k, top_p, key):
+    """Greedy at temperature 0; else temperature sampling with optional
+    top-k truncation and/or top-p (nucleus) filtering, both applied to the
+    scaled logits before the categorical draw (the standard order:
+    truncate, then renormalize implicitly via categorical-over-masked)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-        jnp.int32
-    )
+    scaled = logits / temperature
+    if top_k > 0 and top_k < scaled.shape[-1]:
+        kth = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    if top_p < 1.0:
+        # Mask tokens outside the smallest prefix of the sorted
+        # distribution whose cumulative probability reaches top_p (the
+        # first token always survives).
+        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p                   # prefix BEFORE token
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled < threshold, NEG_INF, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -233,10 +299,13 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: jax.Array | None = None,
 ) -> jax.Array:
     """Autoregressive generation: prefill the prompt [B, T0], then decode
-    ``max_new_tokens`` greedily (or by temperature sampling). Returns the
+    ``max_new_tokens`` greedily (temperature 0) or by temperature sampling
+    with optional ``top_k`` / ``top_p`` (nucleus) truncation. Returns the
     generated tokens [B, max_new_tokens].
 
     Two jitted executables: weight fusion (``decode_weights``) runs as its
@@ -253,12 +322,19 @@ def generate(
         )
     if temperature != 0.0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
+    if temperature == 0.0 and (top_k > 0 or top_p < 1.0):
+        raise ValueError(
+            "top_k/top_p truncate a SAMPLING distribution; greedy decoding "
+            "(temperature=0) takes the argmax — set a temperature"
+        )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.key(0)  # unused in greedy mode
     if "qkv" not in params["layers"]:
         params = _decode_weights_jit(params, cfg)
     return _generate_loop(params, prompt, cfg, max_new_tokens, temperature,
-                          key)
+                          top_k, top_p, key)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -267,7 +343,9 @@ def _decode_weights_jit(params: dict, cfg: TransformerConfig) -> dict:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature")
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                     "top_p"),
 )
 def _generate_loop(
     params: dict,
@@ -275,18 +353,24 @@ def _generate_loop(
     cfg: TransformerConfig,
     max_new_tokens: int,
     temperature: float,
+    top_k: int,
+    top_p: float,
     key: jax.Array,
 ) -> jax.Array:
     b, t0 = prompt.shape
     cache = init_cache(cfg, b, t0 + max_new_tokens)
     logits, cache = advance(params, cache, prompt, cfg)
+    keys = jax.random.split(key, max_new_tokens)
+    # Sample token 0 from the prefill logits, then advance-and-sample
+    # max_new_tokens - 1 times: the last sampled token is never fed back,
+    # so no trailing forward pass computes logits nobody reads.
+    tok0 = _sample(logits, temperature, top_k, top_p, keys[0])
 
     def step(carry, step_key):
-        cache, logits = carry
-        tok = _sample(logits, temperature, step_key)
+        cache, tok = carry
         logits, cache = advance(params, cache, tok[:, None], cfg)
-        return (cache, logits), tok
+        nxt = _sample(logits, temperature, top_k, top_p, step_key)
+        return (cache, nxt), nxt
 
-    keys = jax.random.split(key, max_new_tokens)
-    (_, _), toks = lax.scan(step, (cache, logits), keys)
-    return toks.T  # [B, max_new_tokens]
+    (_, _), toks = lax.scan(step, (cache, tok0), keys[1:])
+    return jnp.concatenate([tok0[:, None], toks.T], axis=1)  # [B, N]

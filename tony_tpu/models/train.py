@@ -72,6 +72,24 @@ def _sharding_for_tree(abstract_tree, roles: dict, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(leaf_sharding, abstract_tree)
 
 
+def _to_global_batch(batch, sharding):
+    """Place a host batch for the jitted step. Single-process meshes take
+    the plain device_put re-shard; on a multi-process mesh each process
+    holds only ITS shard of the global batch, and device_put of differing
+    per-process values is wrong API usage (jax's cross-process consistency
+    check rejects it — nondeterministically, depending on which collective
+    notices first). make_array_from_process_local_data assembles the
+    global array from the per-process shards instead; note the jitted
+    step then sees the GLOBAL batch shape (num_processes x local)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(batch, sharding)
+    import numpy as np
+
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(batch)
+    )
+
+
 def lm_loss(
     params,
     tokens: jax.Array,
@@ -185,8 +203,9 @@ def make_train_step(
 
     def step(state, tokens):
         # Re-shard the host batch explicitly: jit rejects (rather than
-        # reshards) committed args whose sharding differs from in_shardings.
-        return jit_step(state, jax.device_put(tokens, batch_sh))
+        # reshards) committed args whose sharding differs from in_shardings
+        # (and multi-process meshes need the local->global assembly).
+        return jit_step(state, _to_global_batch(tokens, batch_sh))
 
     return jit_init, step
 
@@ -259,8 +278,8 @@ def make_image_classifier_step(
     def step(state, images, labels):
         return jit_step(
             state,
-            jax.device_put(images, batch_sh),
-            jax.device_put(labels, batch_sh),
+            _to_global_batch(images, batch_sh),
+            _to_global_batch(labels, batch_sh),
         )
 
     return jit_init, step

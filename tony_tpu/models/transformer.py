@@ -242,6 +242,21 @@ def _dense_mlp(
     return with_logical_constraint(out, "batch", "seq", "embed", mesh=mesh)
 
 
+def _route_tokens(hn, router, top_k: int):
+    """Shared router gating for training AND decode (models/decode.py):
+    fp32 logits + softmax, top-k over probabilities, epsilon-guarded
+    renormalization of the selected weights. One implementation so the
+    decode-vs-training token-exact parity cannot drift. Returns
+    (gate_logits [.., E] f32, gvals [.., k] normalized, gidx [.., k])."""
+    gate_logits = jnp.einsum(
+        "btd,de->bte", hn.astype(jnp.float32), router.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    gvals, gidx = lax.top_k(probs, top_k)
+    gvals = gvals / jnp.maximum(gvals.sum(-1, keepdims=True), 1e-9)
+    return gate_logits, gvals, gidx
+
+
 def _moe_mlp(x, lp, cfg, mesh: Mesh):
     """Capacity-based top-k MoE (Switch/Mesh-TF dispatch-combine einsums —
     fully static shapes, so XLA inserts the ep all-to-alls from the expert
@@ -262,12 +277,8 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
     cap = max(1, int(cfg.capacity_factor * b * t * kk / e))
 
     hn = rms_norm(x, lp["ln2"])
-    gate_logits = jnp.einsum(
-        "btd,de->bte", hn.astype(jnp.float32), lp["router"].astype(jnp.float32)
-    )
+    gate_logits, gvals, gidx = _route_tokens(hn, lp["router"], kk)
     probs = jax.nn.softmax(gate_logits, axis=-1)        # [b,t,E]
-    gvals, gidx = lax.top_k(probs, kk)                  # [b,t,k]
-    gvals = gvals / jnp.maximum(gvals.sum(-1, keepdims=True), 1e-9)
     onehot_e = jax.nn.one_hot(gidx, e, dtype=jnp.float32)  # [b,t,k,E]
 
     # Switch balance loss (arXiv 2101.03961 eq. 4, generalized to top-k):
