@@ -82,7 +82,7 @@ def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 30):
     cfg = TransformerConfig(
         vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
         d_ff=4096, max_seq=seq, dtype="bfloat16", remat=True,
-        remat_policy="dots",
+        remat_policy="dots", layer_scan_unroll=8,
     )
     mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
     init_fn, step_fn = make_train_step(cfg, mesh)
@@ -187,6 +187,7 @@ def bench_moe(batch: int = 4, seq: int = 2048, measure: int = 8):
         vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
         d_ff=4096, max_seq=seq, dtype="bfloat16", remat=True,
         remat_policy="dots", n_experts=4, expert_top_k=2,
+        layer_scan_unroll=8,
     )
     mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
     init_fn, step_fn = make_train_step(cfg, mesh)

@@ -107,6 +107,12 @@ def main(argv=None) -> int:
             print(f"resumed from step {int(state.step)}", flush=True)
         rng = np.random.default_rng(ctx.process_id)
         first = last = None
+        if int(state.step) >= args.steps:
+            # A retried session can resume a checkpoint already at the
+            # target: that is success, not a crash.
+            print(f"already at step {int(state.step)} >= {args.steps}; "
+                  f"nothing to do", flush=True)
+            return 0
         while int(state.step) < args.steps:
             idx = rng.integers(0, len(shard), size=(args.batch,))
             tokens = jnp.asarray(shard[idx])

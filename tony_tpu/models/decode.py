@@ -179,7 +179,7 @@ def _moe_mlp_decode(x, lp, cfg):
     # Same router gating as training (_route_tokens — shared so parity
     # cannot drift); [b,t,E] combine weights sum the normalized gvals over
     # the top-k slots.
-    _, gvals, gidx = _route_tokens(hn, lp["router"], cfg.expert_top_k)
+    _, _, gvals, gidx = _route_tokens(hn, lp["router"], cfg.expert_top_k)
     weights = (jax.nn.one_hot(gidx, e, dtype=jnp.float32)
                * gvals[..., None]).sum(2)
 
@@ -301,12 +301,22 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_token: int | None = None,
+    pad_token: int = 0,
     key: jax.Array | None = None,
 ) -> jax.Array:
     """Autoregressive generation: prefill the prompt [B, T0], then decode
     ``max_new_tokens`` greedily (temperature 0) or by temperature sampling
     with optional ``top_k`` / ``top_p`` (nucleus) truncation. Returns the
     generated tokens [B, max_new_tokens].
+
+    ``eos_token``: positions after a sequence's first EOS come back as
+    ``pad_token``. The masking is post-hoc: the loop still runs the full
+    static horizon (XLA needs static shapes; per-sequence early exit would
+    retrace per length) and finished sequences keep feeding their SAMPLED
+    continuation internally — the mask only guarantees callers never see
+    it. Cache contents past EOS are therefore sampled-token-conditioned,
+    and sampling keys are consumed for masked positions too.
 
     Two jitted executables: weight fusion (``decode_weights``) runs as its
     own dispatch, then the prefill+loop runs over the fused params. Fusing
@@ -333,8 +343,17 @@ def generate(
         key = jax.random.key(0)  # unused in greedy mode
     if "qkv" not in params["layers"]:
         params = _decode_weights_jit(params, cfg)
-    return _generate_loop(params, prompt, cfg, max_new_tokens, temperature,
+    toks = _generate_loop(params, prompt, cfg, max_new_tokens, temperature,
                           top_k, top_p, key)
+    if eos_token is not None:
+        seen = jnp.cumsum(
+            (toks == eos_token).astype(jnp.int32), axis=1
+        )
+        # Keep the EOS itself (first position where the running count
+        # becomes 1), pad everything after it.
+        after = (seen - (toks == eos_token)) > 0
+        toks = jnp.where(after, jnp.int32(pad_token), toks)
+    return toks
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -358,6 +377,8 @@ def _generate_loop(
     key: jax.Array,
 ) -> jax.Array:
     b, t0 = prompt.shape
+    if max_new_tokens == 0:
+        return jnp.zeros((b, 0), jnp.int32)
     cache = init_cache(cfg, b, t0 + max_new_tokens)
     logits, cache = advance(params, cache, prompt, cfg)
     keys = jax.random.split(key, max_new_tokens)

@@ -77,6 +77,13 @@ class TransformerConfig:
     # dots_with_no_batch_dims_saveable) — more memory, fewer recomputed
     # flops, usually the better MFU point when the model fits.
     remat_policy: str = "full"
+    # lax.scan unroll for the layer loop. The rolled scan accumulates
+    # stacked [L, ...] gradients with dynamic-update-slices XLA cannot
+    # alias (measured 18% of a 2k train step in dus copies); full unroll
+    # (= n_layers) turns them into static-index updates that fuse — a
+    # measured ~7% step-time win at L=8 — at the cost of ~L x trunk
+    # compile time. 1 = rolled (default; dryruns and tests stay fast).
+    layer_scan_unroll: int = 1
 
     @property
     def compute_dtype(self):
@@ -247,14 +254,15 @@ def _route_tokens(hn, router, top_k: int):
     fp32 logits + softmax, top-k over probabilities, epsilon-guarded
     renormalization of the selected weights. One implementation so the
     decode-vs-training token-exact parity cannot drift. Returns
-    (gate_logits [.., E] f32, gvals [.., k] normalized, gidx [.., k])."""
+    (gate_logits [.., E] f32, probs [.., E], gvals [.., k] normalized,
+    gidx [.., k])."""
     gate_logits = jnp.einsum(
         "btd,de->bte", hn.astype(jnp.float32), router.astype(jnp.float32)
     )
     probs = jax.nn.softmax(gate_logits, axis=-1)
     gvals, gidx = lax.top_k(probs, top_k)
     gvals = gvals / jnp.maximum(gvals.sum(-1, keepdims=True), 1e-9)
-    return gate_logits, gvals, gidx
+    return gate_logits, probs, gvals, gidx
 
 
 def _moe_mlp(x, lp, cfg, mesh: Mesh):
@@ -277,8 +285,7 @@ def _moe_mlp(x, lp, cfg, mesh: Mesh):
     cap = max(1, int(cfg.capacity_factor * b * t * kk / e))
 
     hn = rms_norm(x, lp["ln2"])
-    gate_logits, gvals, gidx = _route_tokens(hn, lp["router"], kk)
-    probs = jax.nn.softmax(gate_logits, axis=-1)        # [b,t,E]
+    gate_logits, probs, gvals, gidx = _route_tokens(hn, lp["router"], kk)
     onehot_e = jax.nn.one_hot(gidx, e, dtype=jnp.float32)  # [b,t,k,E]
 
     # Switch balance loss (arXiv 2101.03961 eq. 4, generalized to top-k):
@@ -380,7 +387,9 @@ def forward(
     def scan_body(carry, lp):
         return layer_fn(carry, lp)
 
-    x, aux_layers = lax.scan(scan_body, x, params["layers"])
+    x, aux_layers = lax.scan(
+        scan_body, x, params["layers"], unroll=cfg.layer_scan_unroll
+    )
     x = rms_norm(x, params["final_norm"]).astype(dt)
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
     logits = with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)
@@ -488,7 +497,11 @@ def forward_pipeline(
             out, _aux = layer_fn(carry, lp)  # manual mode: aux is None
             return out, None
 
-        out, _ = lax.scan(body, xm, sp_params)
+        n_local = jax.tree.leaves(sp_params)[0].shape[0]
+        out, _ = lax.scan(
+            body, xm, sp_params,
+            unroll=min(cfg.layer_scan_unroll, n_local),
+        )
         return out
 
     param_specs = _stage_param_specs(cfg)
