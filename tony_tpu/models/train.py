@@ -215,6 +215,7 @@ def make_classifier_step(
     mesh: Mesh,
     *,
     learning_rate: float = 1e-3,
+    steps_per_call: int = 1,
 ):
     """Data-parallel supervised step for the MNIST models (see
     make_image_classifier_step)."""
@@ -223,6 +224,7 @@ def make_classifier_step(
         lambda params, images: mnist_apply(params, images, cfg),
         mesh,
         learning_rate=learning_rate,
+        steps_per_call=steps_per_call,
     )
 
 
@@ -232,12 +234,20 @@ def make_image_classifier_step(
     mesh: Mesh,
     *,
     learning_rate: float = 1e-3,
+    steps_per_call: int = 1,
 ):
     """Data-parallel supervised step for any image classifier
     ``(params, images) -> logits``: batch split over (dp, ep); params
     replicated (MB-scale at most — fsdp would be pure overhead; the
     transformer path owns the sharded-weights story). Returns
-    (init_fn, step_fn)."""
+    (init_fn, step_fn).
+
+    ``steps_per_call > 1`` runs that many optimizer steps per dispatch as
+    one on-device ``lax.scan``: ``step_fn(state, images, labels)`` then
+    takes STACKED batches with a leading [steps_per_call] axis and
+    returns the last step's metrics. For small models the per-call
+    dispatch (host round-trip) dominates a ~0.5 ms step — the fused loop
+    measures (and delivers) actual chip throughput."""
     opt = optax.adam(learning_rate)
 
     def init_fn(key):
@@ -248,7 +258,10 @@ def make_image_classifier_step(
     state_sh = jax.tree.map(
         lambda _: repl, jax.eval_shape(init_fn, jax.random.key(0))
     )
-    batch_sh = NamedSharding(mesh, P(("dp", "ep")))
+    n = steps_per_call
+    batch_sh = NamedSharding(
+        mesh, P(("dp", "ep")) if n == 1 else P(None, ("dp", "ep"))
+    )
 
     def loss_fn(params, images, labels):
         logits = apply_fn(params, images)
@@ -256,7 +269,7 @@ def make_image_classifier_step(
         acc = (logits.argmax(-1) == labels).mean()
         return loss, acc
 
-    def step_fn(state, images, labels):
+    def one_step(state, images, labels):
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, labels
         )
@@ -266,6 +279,16 @@ def make_image_classifier_step(
             TrainState(state.step + 1, params, opt_state),
             {"loss": loss, "accuracy": acc},
         )
+
+    if n == 1:
+        step_fn = one_step
+    else:
+        def step_fn(state, images, labels):
+            def body(carry, batch):
+                return one_step(carry, *batch)
+
+            state, metrics = jax.lax.scan(body, state, (images, labels))
+            return state, jax.tree.map(lambda m: m[-1], metrics)
 
     jit_init = jax.jit(init_fn, out_shardings=state_sh)
     jit_step = jax.jit(
