@@ -122,14 +122,25 @@ def _conv(x, w, stride=1, dtype=None):
 
 
 def _group_norm(x, gn, groups, eps=1e-5):
+    """Single-accumulation GroupNorm: moments via E[x²]−E[x]² with fp32
+    accumulation directly off the bf16 activations. The naive form
+    (upcast the whole tensor, two-pass mean/var) materialized fp32 copies
+    of stage-1-sized activations several times per norm — rewriting it
+    this way cut the ResNet-50 train step ~2.7× (see BASELINE.md for the
+    measurement of record): the norm fuses into a pair of reduces plus
+    one elementwise pass. E[x²]−E[x]² cancellation is a non-issue at
+    post-conv activation scale with fp32 accumulation (clamped at 0)."""
     b, h, w, c = x.shape
     g = min(groups, c)
-    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
-    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
-    var = xf.var(axis=(1, 2, 4), keepdims=True)
-    xf = (xf - mean) * lax.rsqrt(var + eps)
-    xf = xf.reshape(b, h, w, c)
-    return (xf * gn["scale"] + gn["bias"]).astype(x.dtype)
+    xg = x.reshape(b, h, w, g, c // g)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True, dtype=jnp.float32)
+    mean2 = jnp.mean(
+        jnp.square(xg.astype(jnp.float32)), axis=(1, 2, 4), keepdims=True
+    )
+    inv = lax.rsqrt(jnp.maximum(mean2 - jnp.square(mean), 0.0) + eps)
+    y = (xg.astype(jnp.float32) - mean) * inv
+    y = y.reshape(b, h, w, c) * gn["scale"] + gn["bias"]
+    return y.astype(x.dtype)
 
 
 def _block(x, p, kind, stride, groups, dt):
