@@ -82,7 +82,7 @@ def bench_mnist() -> float:
     return calls * per_call / best_dt / n_chips
 
 
-def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 30):
+def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 20):
     """Flagship LM full train step (fwd+loss+grad+adamw) on one chip:
     tokens/sec/chip and analytic MFU."""
     from tony_tpu.models import TransformerConfig, make_train_step
@@ -313,7 +313,7 @@ def main() -> None:
         extras = {
             "transformer": bench_transformer(),
             "transformer_long_context": bench_transformer(
-                batch=2, seq=8192, measure=8
+                batch=2, seq=8192, measure=6
             ),
             "resnet50": bench_resnet50(),
             "decode_gqa": bench_decode(),
