@@ -470,7 +470,11 @@ class TestDecode:
         params = init_params(jax.random.key(0), cfg)
         return cfg, params
 
-    def test_prefill_matches_training_forward(self):
+    @pytest.mark.parametrize("prefill", [False, True])
+    def test_prefill_matches_training_forward(self, prefill):
+        """Both the dense-scan path and the flash prefill fast path (what
+        generate() actually runs) must match the training forward at the
+        logits level, not just post-argmax."""
         from tony_tpu.models import advance, forward, init_cache
 
         cfg, params = self._setup()
@@ -478,7 +482,7 @@ class TestDecode:
             np.random.default_rng(0).integers(0, 64, (2, 12)), jnp.int32
         )
         cache = init_cache(cfg, 2, 32)
-        logits, cache = advance(params, cache, tokens, cfg)
+        logits, cache = advance(params, cache, tokens, cfg, prefill=prefill)
         from tony_tpu.parallel.mesh import MeshSpec, build_mesh
 
         mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
@@ -574,6 +578,17 @@ class TestDecode:
         with pytest.raises(ValueError, match="PRNG key"):
             generate(params, jnp.ones((1, 4), jnp.int32), cfg, 4,
                      temperature=1.0)
+
+    def test_prefill_on_nonempty_cache_rejected(self):
+        from tony_tpu.models import advance, init_cache
+
+        cfg, params = self._setup()
+        cache = init_cache(cfg, 1, 32)
+        _, cache = advance(params, cache, jnp.ones((1, 4), jnp.int32), cfg,
+                           prefill=True)
+        with pytest.raises(ValueError, match="empty cache"):
+            advance(params, cache, jnp.ones((1, 4), jnp.int32), cfg,
+                    prefill=True)
 
     def test_cumulative_cache_overflow_rejected_eagerly(self):
         from tony_tpu.models import advance, init_cache

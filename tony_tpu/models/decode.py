@@ -35,7 +35,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from tony_tpu.models.transformer import TransformerConfig
-from tony_tpu.ops import apply_rope, rms_norm, rope_frequencies
+from tony_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    rms_norm,
+    rope_frequencies,
+)
 
 NEG_INF = -1e30
 
@@ -95,10 +100,17 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
     }
 
 
-def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
+def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
+                  prefill=False):
     """One decoder layer over S new tokens at positions [length, length+S).
     x: [B, S, d]; caches [B, Tmax, Hkv, Dh]; lp in the fused
-    ``decode_weights`` layout. Returns (x, k_cache, v_cache)."""
+    ``decode_weights`` layout. Returns (x, k_cache, v_cache).
+
+    ``prefill=True`` (static) promises the cache is empty (length == 0):
+    attention then runs the flash kernel over just the S new tokens
+    instead of the masked dense scan of the full T_max cache — the dense
+    path's [S, T_max] fp32 score tensor is fine for single-token steps
+    but quadratic-memory for long prompts."""
     dt = cfg.compute_dtype
     b, s, _ = x.shape
     t_max = k_cache.shape[1]
@@ -120,28 +132,35 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin):
         v_cache, v_new.astype(v_cache.dtype), (0, length, 0, 0)
     )
 
-    # Grouped attention against the cache: q regrouped as [B, S, Hkv, G, Dh]
-    # so each K/V head serves its G query heads without materializing a
-    # repeated cache. The einsums read the cache in its stored dtype
-    # (bfloat16) with fp32 MXU accumulation — no fp32 upcast copy of the
-    # full T_max cache per step — and softmax stays fp32.
-    g = n_h // h_kv
-    scale = cfg.head_dim ** -0.5
-    qg = q.reshape(b, s, h_kv, g, cfg.head_dim)
-    scores = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qg, k_cache,
-        preferred_element_type=jnp.float32,
-    ) * scale
-    # Global causal mask; it also hides the cache tail past length+S
-    # (those positions are > every query position). mask: [S, Tmax].
-    mask = positions[:, None] >= jnp.arange(t_max)[None, :]
-    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs.astype(dt), v_cache,
-        preferred_element_type=jnp.float32,
-    ).astype(dt).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    x = x + jnp.einsum("bthk,hkd->btd", o, lp["wo"])
+    if prefill and s > 1:
+        # Empty cache: self-attention over the prompt only (flash handles
+        # the GQA head grouping internally).
+        o = flash_attention(q, k_new.astype(dt), v_new.astype(dt),
+                            causal=True)
+    else:
+        # Grouped attention against the cache: q regrouped as
+        # [B, S, Hkv, G, Dh] so each K/V head serves its G query heads
+        # without materializing a repeated cache. The einsums read the
+        # cache in its stored dtype (bfloat16) with fp32 MXU accumulation
+        # — no fp32 upcast copy of the full T_max cache per step — and
+        # softmax stays fp32.
+        g = n_h // h_kv
+        scale = cfg.head_dim ** -0.5
+        qg = q.reshape(b, s, h_kv, g, cfg.head_dim)
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k_cache,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # Global causal mask; it also hides the cache tail past length+S
+        # (those positions are > every query position). mask: [S, Tmax].
+        mask = positions[:, None] >= jnp.arange(t_max)[None, :]
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", probs.astype(dt), v_cache,
+            preferred_element_type=jnp.float32,
+        ).astype(dt).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
 
     if "router" in lp:
         x = x + _moe_mlp_decode(x, lp, cfg)
@@ -197,7 +216,8 @@ def _moe_mlp_decode(x, lp, cfg):
 
 
 def advance(params: dict, cache: dict, tokens: jax.Array,
-            cfg: TransformerConfig, *, checked: bool = False):
+            cfg: TransformerConfig, *, checked: bool = False,
+            prefill: bool = False):
     """Feed ``tokens`` [B, S] at the cache's current length; returns
     (last-position logits [B, V] fp32, updated cache).
 
@@ -207,7 +227,14 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     silently. Jitted callers must pre-validate their loop the way
     ``generate()`` does (prompt + max_new_tokens ≤ capacity), or pass
     ``checked=True`` and wrap the call in ``jax.experimental.checkify``
-    to turn overflow into a checked runtime error."""
+    to turn overflow into a checked runtime error.
+
+    ``prefill=True`` (static) selects the flash-attention fast path for
+    long prompts and PROMISES the cache is empty (length == 0): the flash
+    branch attends only over the new tokens, so on a non-empty cache it
+    would silently ignore all cached context. Checked eagerly for
+    concrete lengths, via checkify with ``checked=True`` for traced
+    ones."""
     capacity = cache["k"].shape[2]
     if tokens.shape[1] > capacity:
         # RoPE tables and the cache are both static; overflow would clamp
@@ -226,6 +253,12 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
                 f"cache at length {int(cache['length'])} cannot take "
                 f"{tokens.shape[1]} more tokens (capacity {capacity})"
             )
+        if prefill and int(cache["length"]) != 0:
+            raise ValueError(
+                f"prefill=True requires an empty cache, got length "
+                f"{int(cache['length'])} — the flash prefill branch would "
+                f"silently ignore the cached context"
+            )
     elif checked:
         from jax.experimental import checkify
 
@@ -235,6 +268,12 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
             "capacity {c}", l=cache["length"],
             s=jnp.int32(tokens.shape[1]), c=jnp.int32(capacity),
         )
+        if prefill:
+            checkify.check(
+                cache["length"] == 0,
+                "prefill=True on a non-empty cache (length {l})",
+                l=cache["length"],
+            )
     if "qkv" not in params["layers"]:
         # Raw training params from an eager caller: fuse per call (generate
         # fuses once, outside its token loop).
@@ -247,7 +286,8 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
 
     def body(carry, layer_in):
         lp, kc, vc = layer_in
-        x, kc, vc = _layer_decode(carry, lp, kc, vc, length, cfg, cos, sin)
+        x, kc, vc = _layer_decode(carry, lp, kc, vc, length, cfg, cos, sin,
+                                  prefill=prefill)
         return x, (kc, vc)
 
     x, (k_all, v_all) = lax.scan(
@@ -380,7 +420,7 @@ def _generate_loop(
     if max_new_tokens == 0:
         return jnp.zeros((b, 0), jnp.int32)
     cache = init_cache(cfg, b, t0 + max_new_tokens)
-    logits, cache = advance(params, cache, prompt, cfg)
+    logits, cache = advance(params, cache, prompt, cfg, prefill=True)
     keys = jax.random.split(key, max_new_tokens)
     # Sample token 0 from the prefill logits, then advance-and-sample
     # max_new_tokens - 1 times: the last sampled token is never fed back,
