@@ -31,9 +31,17 @@ def test_single_host_exact_fit():
 
 
 def test_multi_host_single_slice():
-    # 2 hosts x 8 chips -> v5litepod-16 (2 hosts per slice)
+    # 4 hosts x 4 chips -> v5litepod-16 (multihost v5e = 4-chip hosts)
+    plan = plan_slices(4, 4, "v5e")
+    assert plan == SlicePlan("v5litepod-16", 1, 4, 16)
+
+
+def test_eight_chip_hosts_cannot_tile_multihost_v5e():
+    # 2 hosts x 8 chips: no 2-host v5e slice exists (multihost hosts carry
+    # 4 chips), so the planner falls back to 2 DCN-connected v5litepod-8s
+    # rather than inventing an impossible 16-chip 2-host slice.
     plan = plan_slices(2, 8, "v5e")
-    assert plan == SlicePlan("v5litepod-16", 1, 2, 16)
+    assert plan == SlicePlan("v5litepod-8", 2, 1, 8)
 
 
 def test_every_plan_has_one_host_per_instance():
@@ -54,19 +62,20 @@ def test_strict_rejects_chip_overshoot():
 
 
 def test_strict_accepts_exact_tiling():
-    plan = plan_slices(2, 8, "v5e", strict=True)
-    assert plan == SlicePlan("v5litepod-16", 1, 2, 16)
+    plan = plan_slices(4, 4, "v5e", strict=True)
+    assert plan == SlicePlan("v5litepod-16", 1, 4, 16)
 
 
 def test_strict_accepts_exact_multislice_tiling():
-    # 64 hosts x 8 chips = 512 chips = 2 x v5litepod-256 exactly
-    plan = plan_slices(64, 8, "v5e", strict=True)
-    assert plan == SlicePlan("v5litepod-256", 2, 32, 256)
+    # 128 hosts x 4 chips = 512 chips = 2 x v5litepod-256 (64 hosts each)
+    plan = plan_slices(128, 4, "v5e", strict=True)
+    assert plan == SlicePlan("v5litepod-256", 2, 64, 256)
 
 
 def test_multislice_fallback_beyond_largest_shape():
-    # 64 hosts x 8 chips = 512 chips > v5litepod-256 -> 2 DCN-connected slices
-    plan = plan_slices(64, 8, "v5e")
+    # 128 hosts x 4 chips = 512 chips > v5litepod-256 -> 2 DCN-connected
+    # slices
+    plan = plan_slices(128, 4, "v5e")
     assert plan.num_slices == 2 and plan.chips_per_slice == 256
 
 
@@ -89,7 +98,10 @@ def test_unknown_generation_and_accelerator():
 
 
 def test_v4_shapes():
-    assert plan_slices(1, 8, "v4").accelerator_type == "v4-8"
+    # v4-8 = 4 chips (the name counts TensorCores), one 4-chip host.
+    assert plan_slices(1, 4, "v4").accelerator_type == "v4-8"
+    # Multihost v4: 4 chips per host VM.
+    assert plan_slices(4, 4, "v4") == SlicePlan("v4-32", 1, 4, 16)
 
 
 # ---------------------------------------------------------------------------
@@ -104,8 +116,8 @@ def _conf(**kv):
 
 def test_conf_planning_per_job_type():
     conf = _conf(**{
-        keys.instances_key("worker"): 2,
-        keys.tpus_key("worker"): 8,
+        keys.instances_key("worker"): 4,
+        keys.tpus_key("worker"): 4,
         keys.instances_key("ps"): 1,  # no tpus -> no plan
     })
     plans = plan_slices_from_conf(conf)
@@ -129,12 +141,26 @@ def test_conf_accelerator_type_alone_selects_generation():
     # redundant tony.tpu.topology key.
     conf = _conf(**{
         keys.instances_key("worker"): 4,
-        keys.tpus_key("worker"): 8,
+        keys.tpus_key("worker"): 4,
         keys.K_TPU_ACCELERATOR_TYPE: "v4-32",
         keys.instances_key("ps"): 0,
     })
     plans = plan_slices_from_conf(conf)
-    assert plans["worker"] == SlicePlan("v4-32", 1, 4, 32)
+    assert plans["worker"] == SlicePlan("v4-32", 1, 4, 16)
+
+
+def test_conf_v4_topology_number_means_the_accelerator_name():
+    # "v4-16" is a GCP accelerator name (16 TensorCores = 8 chips, 2
+    # hosts) — the name reading must win over treating 16 as a chip count
+    # (which would silently provision a v4-32).
+    conf = _conf(**{
+        keys.instances_key("worker"): 2,
+        keys.tpus_key("worker"): 4,
+        keys.K_TPU_TOPOLOGY: "v4-16",
+        keys.instances_key("ps"): 0,
+    })
+    plans = plan_slices_from_conf(conf)
+    assert plans["worker"] == SlicePlan("v4-16", 1, 2, 8)
 
 
 def test_conf_bad_topology_raises():
@@ -196,8 +222,9 @@ class FakeTpuApi:
 def _tpu_session(tmp_path, api, **conf_kv):
     cluster = MiniTonyCluster(tmp_path)
     conf = cluster.base_conf()
-    conf.set(keys.instances_key("worker"), 2)
-    conf.set(keys.tpus_key("worker"), 8)
+    # 4 hosts x 4 chips -> one v5litepod-16 (4-chip multihost v5e hosts).
+    conf.set(keys.instances_key("worker"), 4)
+    conf.set(keys.tpus_key("worker"), 4)
     conf.set(keys.instances_key("ps"), 0)
     conf.set(keys.K_EXECUTES, "unused_on_tpu_backend.py")
     for k, v in conf_kv.items():
@@ -221,9 +248,9 @@ def test_tpu_backend_full_session(tmp_path):
     # one slice group created for the worker job, then deleted on teardown
     assert api.created == {"application_tpu_1-worker": ("v5litepod-16", 1)}
     assert api.deleted == ["application_tpu_1-worker"]
-    # both hosts got an executor only after the slice went READY
+    # all four hosts got an executor only after the slice went READY
     assert sorted(api.started) == [
-        ("application_tpu_1-worker", 0), ("application_tpu_1-worker", 1)
+        ("application_tpu_1-worker", i) for i in range(4)
     ]
     assert coordinator.slice_plans["worker"].chips_per_slice == 16
     # final-status.json records the planned slice

@@ -111,7 +111,7 @@ class TestHistoryServer:
             },
             "slices": {"worker": {
                 "accelerator_type": "v5litepod-16", "num_slices": 2,
-                "hosts_per_slice": 2, "chips_per_slice": 16,
+                "hosts_per_slice": 4, "chips_per_slice": 16,
             }},
             "tasks": [
                 {"id": "worker:0", "exit_code": 0},

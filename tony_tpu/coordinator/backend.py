@@ -140,23 +140,37 @@ class LocalProcessBackend:
 # Legal accelerator configs: generation → {chip_count: (accel_type, hosts)}.
 # TPU asks must land on one of these — YARN containers are arbitrary,
 # TPU slices are quantized (SURVEY §7 hard part c).
+#
+# Host counts follow the Cloud TPU VM architecture ("TPU configurations",
+# cloud.google.com/tpu/docs — v5e and v4 pages):
+#
+# * v5e single-host shapes (v5litepod-1/-4/-8) run on one VM with up to 8
+#   chips, but every MULTI-host v5e slice is tiled from 4-chip host VMs
+#   (machine type ct5lp-hightpu-4t): v5litepod-16 = 4 workers, -32 = 8,
+#   -64 = 16, -128 = 32, -256 = 64. (An 8-chip host exists only for the
+#   single-host v5litepod-8.) Getting this wrong halves the executor count
+#   on real multihost slices.
+# * v4 accelerator-type numbers count TensorCores, not chips (v4-8 = 4
+#   chips); every v4 host VM has 4 chips, so a v4 slice of C chips has
+#   C/4 workers. Keys below are CHIP counts (what ``tony.<job>.tpus``
+#   asks for), values carry the GCP accelerator-type name.
 SLICE_SHAPES: dict[str, dict[int, tuple[str, int]]] = {
     "v5e": {
         1: ("v5litepod-1", 1),
         4: ("v5litepod-4", 1),
         8: ("v5litepod-8", 1),
-        16: ("v5litepod-16", 2),
-        32: ("v5litepod-32", 4),
-        64: ("v5litepod-64", 8),
-        128: ("v5litepod-128", 16),
-        256: ("v5litepod-256", 32),
+        16: ("v5litepod-16", 4),
+        32: ("v5litepod-32", 8),
+        64: ("v5litepod-64", 16),
+        128: ("v5litepod-128", 32),
+        256: ("v5litepod-256", 64),
     },
     "v4": {
-        8: ("v4-8", 1),
-        16: ("v4-16", 2),
-        32: ("v4-32", 4),
-        64: ("v4-64", 8),
-        128: ("v4-128", 16),
+        4: ("v4-8", 1),
+        8: ("v4-16", 2),
+        16: ("v4-32", 4),
+        32: ("v4-64", 8),
+        64: ("v4-128", 16),
     },
 }
 
@@ -270,13 +284,23 @@ def plan_slices_from_conf(conf) -> dict[str, SlicePlan]:
             shapes = SLICE_SHAPES.get(generation)
             if shapes is None:
                 raise ValueError(f"unknown TPU generation in topology {topology!r}")
-            try:
-                accelerator_type = shapes[int(chip_str)][0]
-            except (KeyError, ValueError):
-                raise ValueError(
-                    f"topology {topology!r} is not a legal {generation} "
-                    f"shape; legal chip counts: {sorted(shapes)}"
-                ) from None
+            # A topology that IS a GCP accelerator name (e.g. "v4-16",
+            # whose number counts TensorCores, not chips) means that
+            # accelerator — the official name wins over reading the number
+            # as a chip count (for v5e the two readings coincide because
+            # "v5e-8" is not an accelerator name and v5litepod names carry
+            # chip counts).
+            by_name = [a for a, _ in shapes.values() if a == topology]
+            if by_name:
+                accelerator_type = by_name[0]
+            else:
+                try:
+                    accelerator_type = shapes[int(chip_str)][0]
+                except (KeyError, ValueError):
+                    raise ValueError(
+                        f"topology {topology!r} is not a legal {generation} "
+                        f"shape; legal chip counts: {sorted(shapes)}"
+                    ) from None
     plans: dict[str, SlicePlan] = {}
     for job, req in parse_container_requests(conf).items():
         if req.tpus > 0:
