@@ -61,12 +61,14 @@ class FakeRunner:
 
     def __init__(self) -> None:
         self.started: list[tuple[str, int, str]] = []
+        self.stdins: list[bytes | None] = []
         self._codes: dict[int, int | None] = {}
         self.killed: list[int] = []
 
-    def start(self, node, worker, command):
+    def start(self, node, worker, command, stdin_data=None):
         handle = len(self.started)
         self.started.append((node, worker, command))
+        self.stdins.append(stdin_data)
         self._codes[handle] = None
         return handle
 
@@ -167,6 +169,106 @@ class TestGcsStorage:
 
 
 # ---------------------------------------------------------------------------
+# UrllibTransport auth lifecycle (ADVICE r3: honor expires_in; retry on 401)
+# ---------------------------------------------------------------------------
+
+class TestUrllibTransportAuth:
+    def _urlopen_script(self, monkeypatch, responses):
+        """Patch urllib.request.urlopen with a scripted response list;
+        entries are bytes (200 body) or int (HTTPError status)."""
+        import io
+        import urllib.error
+        import urllib.request
+
+        calls = []
+
+        class FakeResp:
+            def __init__(self, data):
+                self.status = 200
+                self._data = data
+
+            def read(self):
+                return self._data
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req)
+            r = responses.pop(0)
+            if isinstance(r, int):
+                raise urllib.error.HTTPError(
+                    req.full_url, r, "err", {}, io.BytesIO(b"denied")
+                )
+            return FakeResp(r)
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        return calls
+
+    def test_token_cached_until_expires_in_minus_margin(self, monkeypatch):
+        from tony_tpu.cloud.gcp import UrllibTransport
+
+        fetches = []
+
+        def provider():
+            fetches.append(1)
+            return f"tok{len(fetches)}", 600.0  # 10-minute token
+
+        tr = UrllibTransport(token_provider=provider)
+        clock = [1000.0]
+        monkeypatch.setattr("tony_tpu.cloud.gcp.time.monotonic",
+                            lambda: clock[0])
+        assert tr._bearer() == "tok1"
+        clock[0] += 299.0  # inside 600 - 300 margin
+        assert tr._bearer() == "tok1" and len(fetches) == 1
+        clock[0] += 2.0  # past the margin-adjusted deadline
+        assert tr._bearer() == "tok2" and len(fetches) == 2
+
+    def test_short_lived_token_not_cached_a_fixed_hour(self, monkeypatch):
+        """The metadata server returns its CACHED token until shortly
+        before expiry — a fetch can see expires_in of a few minutes. The
+        old fixed 3000 s cache would serve it long past death."""
+        from tony_tpu.cloud.gcp import UrllibTransport
+
+        fetches = []
+
+        def provider():
+            fetches.append(1)
+            return f"tok{len(fetches)}", 120.0  # 2 minutes of life left
+
+        tr = UrllibTransport(token_provider=provider)
+        clock = [0.0]
+        monkeypatch.setattr("tony_tpu.cloud.gcp.time.monotonic",
+                            lambda: clock[0])
+        assert tr._bearer() == "tok1"
+        clock[0] += 45.0  # past life-margin floor (30 s), well before 3000
+        assert tr._bearer() == "tok2"
+
+    def test_401_drops_token_and_retries_once(self, monkeypatch):
+        from tony_tpu.cloud.gcp import UrllibTransport
+
+        tokens = iter(["stale", "fresh"])
+        tr = UrllibTransport(token_provider=lambda: (next(tokens), 3600.0))
+        calls = self._urlopen_script(monkeypatch, [401, b"ok"])
+        status, body = tr.request("GET", "https://x/y", None, {})
+        assert (status, body) == (200, b"ok")
+        assert [c.get_header("Authorization") for c in calls] == [
+            "Bearer stale", "Bearer fresh"
+        ]
+
+    def test_persistent_403_is_returned_not_looped(self, monkeypatch):
+        from tony_tpu.cloud.gcp import UrllibTransport
+
+        tr = UrllibTransport(token_provider=lambda: ("t", 3600.0))
+        calls = self._urlopen_script(monkeypatch, [403, 403])
+        status, _ = tr.request("GET", "https://x/y", None, {})
+        assert status == 403 and len(calls) == 2  # one retry, then surface
+
+
+# ---------------------------------------------------------------------------
 # Queued-resources API lifecycle (VERDICT r2 item 1's "Done" list)
 # ---------------------------------------------------------------------------
 
@@ -192,11 +294,15 @@ class TestGcpQueuedResourceApi:
         api.create_slice("app1-worker", "v5litepod-16", 2)
         method, url, body = t.requests[-1]
         spec = json.loads(body)
-        nodes = spec["tpu"]["node_spec"]
-        assert [n["node_id"] for n in nodes] == [
+        # Canonical proto-JSON camelCase on the wire — the same spelling
+        # the API emits in responses, so writes diff cleanly against
+        # recorded GET bodies.
+        nodes = spec["tpu"]["nodeSpec"]
+        assert [n["nodeId"] for n in nodes] == [
             "app1-worker-s0", "app1-worker-s1"
         ]
-        assert nodes[0]["node"]["accelerator_type"] == "v5litepod-16"
+        assert nodes[0]["node"]["acceleratorType"] == "v5litepod-16"
+        assert nodes[0]["node"]["runtimeVersion"] == "v2-alpha-tpuv5-lite"
         assert nodes[0]["parent"] == "projects/proj/locations/us-central1-a"
 
         # poll: CREATING (ACCEPTED) -> READY (ACTIVE)
@@ -249,6 +355,71 @@ class TestGcpQueuedResourceApi:
             ("app2-worker-s1", 0), ("app2-worker-s1", 1),
             ("app2-worker-s1", 2), ("app2-worker-s1", 3),
         ]
+
+    def test_restart_relearns_shape_from_response_fixture(self):
+        """A coordinator restarted mid-flight has an empty _groups map and
+        must re-learn the slice shape from a GET — the fixture mirrors the
+        queuedResources RESOURCE shape (proto-JSON camelCase: state.state,
+        tpu.nodeSpec[].node.acceleratorType), which is also the spelling
+        create_slice now writes."""
+        t = FakeTransport()
+        runner = FakeRunner()
+        api = self._api(t, runner)
+        t.expect("GET", r"queuedResources/lost-worker$", 200, {
+            "name": ("projects/proj/locations/us-central1-a/"
+                     "queuedResources/lost-worker"),
+            "state": {"state": "ACTIVE"},
+            "tpu": {"nodeSpec": [
+                {"parent": "projects/proj/locations/us-central1-a",
+                 "nodeId": "lost-worker-s0",
+                 "node": {"acceleratorType": "v5litepod-16",
+                          "runtimeVersion": "v2-alpha-tpuv5-lite"}},
+                {"parent": "projects/proj/locations/us-central1-a",
+                 "nodeId": "lost-worker-s1",
+                 "node": {"acceleratorType": "v5litepod-16",
+                          "runtimeVersion": "v2-alpha-tpuv5-lite"}},
+            ]},
+        })
+        api.start_executor("lost-worker", 6, {})
+        node, worker, _ = runner.started[-1]
+        assert (node, worker) == ("lost-worker-s1", 2)
+
+    def test_secrets_ride_stdin_not_argv(self):
+        """Credential env (TONY_EXECUTOR_TOKEN etc.) must not appear in the
+        ssh command — argv is visible in process listings on the client
+        host and the TPU VM, and the command prefix is logged at INFO
+        (ADVICE r3). Values travel via the remote shell's stdin; only the
+        variable NAMES may appear in the command."""
+        t = FakeTransport()
+        runner = FakeRunner()
+        api = self._api(t, runner)
+        t.expect("POST", r"queued_resource_id=app3-w", 200, {})
+        api.create_slice("app3-w", "v5litepod-8", 1)
+        api.start_executor("app3-w", 0, {
+            "JOB_NAME": "worker",
+            "TONY_EXECUTOR_TOKEN": "deadbeefcafe",
+            "TONY_JOB_SECRET": "s3cr3t",
+        })
+        node, worker, command = runner.started[-1]
+        assert "deadbeefcafe" not in command and "s3cr3t" not in command
+        assert "export JOB_NAME=worker;" in command  # plain env still argv
+        # stdin carries one value per line in sorted key order, read into
+        # the matching variable before exec
+        assert runner.stdins[-1] == b"deadbeefcafe\ns3cr3t\n"
+        assert "IFS= read -r TONY_EXECUTOR_TOKEN; export TONY_EXECUTOR_TOKEN;" in command
+        assert "IFS= read -r TONY_JOB_SECRET; export TONY_JOB_SECRET;" in command
+
+    def test_newline_in_secret_is_rejected(self):
+        """A secret value with an embedded newline would shift every later
+        line-oriented stdin binding — refuse loudly instead."""
+        t = FakeTransport()
+        api = self._api(t, FakeRunner())
+        t.expect("POST", r"queued_resource_id=app4-w", 200, {})
+        api.create_slice("app4-w", "v5litepod-8", 1)
+        with pytest.raises(ValueError, match="newline"):
+            api.start_executor(
+                "app4-w", 0, {"TONY_EXECUTOR_TOKEN": "bad\nvalue"}
+            )
 
     def test_failed_provision_maps_to_failed(self):
         t = FakeTransport()

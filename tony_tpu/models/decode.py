@@ -79,7 +79,11 @@ def decode_weights(params: dict, cfg: TransformerConfig) -> dict:
         "w_down": c(lp["w_down"]),
     }
     if cfg.n_experts:
-        layers["router"] = c(lp["router"])
+        # Router stays fp32 (it is tiny): training routes from fp32
+        # masters, and a bf16 router could flip near-tie gate logits at
+        # decode — the token-exact-parity guarantee would silently narrow
+        # to fp32 configs (ADVICE r3).
+        layers["router"] = lp["router"].astype(jnp.float32)
     return {
         "embed": c(params["embed"]),
         "final_norm": c(params["final_norm"]),

@@ -3,9 +3,12 @@ materializes the [T, T] score matrix.
 
 Forward on TPU is a Pallas kernel (grid over (batch x heads, q-blocks); K/V
 blocks stream through VMEM; MXU does the two matmuls per block in fp32
-accumulation). Everywhere else — and for the backward pass — a blockwise
-``lax.scan`` computes the same math, so results match to fp tolerance and
-memory stays O(T · block) in both directions.
+accumulation). Backward on TPU is a two-pass Pallas pair
+(``_flash_core_bwd``): a dq kernel over q-blocks and a dk/dv kernel over
+kv-blocks, each recomputing the masked probabilities from the saved
+(out, lse) statistics. Off TPU, a blockwise ``lax.scan`` computes the same
+math in both directions, so results match to fp tolerance and memory stays
+O(T · block) everywhere.
 
 Public layout is [batch, seq, heads, head_dim], the same as
 ``tony_tpu.parallel.ring_attention``. Ring attention carries its own

@@ -217,8 +217,9 @@ def pipeline_apply(
     [pp, virtual, ...] — element [d, c] is global virtual stage c·pp + d,
     i.e. ``stage_fn`` here maps a microbatch through ONE chunk of depth
     n_layers/(virtual·pp) — and num_microbatches must divide by pp. The
-    bubble shrinks from (pp-1)/(m+pp-1) to (pp-1)/(virtual·m+pp-1) of the
-    step (see ``schedule_info``).
+    bubble shrinks from (pp-1)/(m+pp-1) to pp/(virtual·m+pp) of the step
+    (``schedule_info`` is the single source of truth: the interleave pays
+    one extra wrap-hop tick, hence pp rather than pp-1).
     """
     if x.shape[0] % num_microbatches:
         raise ValueError(
