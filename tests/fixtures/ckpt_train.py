@@ -6,8 +6,6 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-from pathlib import Path
-
 import jax.numpy as jnp
 
 from tony_tpu.checkpoint import CheckpointManager
@@ -16,7 +14,9 @@ TOTAL_STEPS = 10
 CRASH_AT = 5
 
 session = os.environ.get("SESSION_ID", "1")
-mgr = CheckpointManager(Path(os.environ["CKPT_DIR"]))
+# NOT wrapped in Path(): gs:// URIs must survive verbatim (Path collapses
+# the double slash).
+mgr = CheckpointManager(os.environ["CKPT_DIR"])
 template = {"step": jnp.zeros((), jnp.int32), "w": jnp.zeros((4,))}
 restored = mgr.restore(template)
 start = int(restored["step"]) if restored is not None else 0

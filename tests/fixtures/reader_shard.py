@@ -9,8 +9,10 @@ import sys
 import tony_tpu.runtime as rt
 
 ctx = rt.task_context()
-data = os.environ["READER_DATA"]
-reader = rt.sharded_reader([data], fmt="jsonl", batch_size=4)
+# ";"-separated so multiple paths (incl. gs:// URIs, which embed ":") fit
+# in one comma-separated shell-env assignment.
+data = os.environ["READER_DATA"].split(";")
+reader = rt.sharded_reader(data, fmt="jsonl", batch_size=4)
 schema = json.loads(reader.schema_json())
 if schema["format"] != "jsonl":
     print(f"bad schema: {schema}", file=sys.stderr)

@@ -273,3 +273,126 @@ class TestNativeDecoder:
                 b = r.next_batch()
                 b *= 2  # consumers mutate in place (e.g. masking)
             monkeypatch.undo()
+
+
+# ---------------------------------------------------------------------------
+# gs:// data plane (VERDICT r3 missing #1): the reader opens remote corpora
+# directly, the way the reference's reader opens HDFS
+# (HdfsAvroFileSplitReader.java:347-416) — no manual staging.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def gcs_emulator(tmp_path):
+    from tony_tpu.cloud import set_default_storage
+    from tony_tpu.cloud.gcs import FileObjectStorage
+
+    store = FileObjectStorage(tmp_path / "objects")
+    set_default_storage(store)
+    yield store
+    set_default_storage(None)
+
+
+class TestGsReader:
+    @pytest.mark.parametrize("num_tasks", [1, 2, 3])
+    def test_jsonl_exactly_once_over_gs(self, gcs_emulator, num_tasks):
+        """Two/three readers over gs:// shards: every record exactly once,
+        including records straddling the byte-range boundaries (the
+        split-brain rule must hold over ranged fetches too)."""
+        uris, n = [], 0
+        for fi, count in enumerate([41, 0, 87]):
+            body = "".join(
+                json.dumps({"id": i, "pad": "y" * (i % 11)}) + "\n"
+                for i in range(n, n + count)
+            ).encode()
+            uri = f"gs://corpus/part-{fi}.jsonl"
+            gcs_emulator.put_bytes(uri, body)
+            uris.append(uri)
+            n += count
+        seen = []
+        for t in range(num_tasks):
+            with ShardedRecordReader(
+                uris, t, num_tasks, fmt="jsonl", batch_size=16
+            ) as r:
+                for batch in r:
+                    seen.extend(rec["id"] for rec in batch)
+        assert sorted(seen) == list(range(n))
+
+    def test_tokens_over_gs_match_local(self, gcs_emulator, tmp_path):
+        rl, n_rec = 8, 103
+        data = np.arange(rl * n_rec, dtype=np.uint16).reshape(n_rec, rl)
+        local = tmp_path / "tokens.bin"
+        data.tofile(local)
+        gcs_emulator.put_bytes("gs://corpus/tokens.bin", local.read_bytes())
+        for t in range(3):
+            with ShardedRecordReader(
+                [str(local)], t, 3, fmt="tokens", record_len=rl,
+                dtype=np.uint16, batch_size=10,
+            ) as lr, ShardedRecordReader(
+                ["gs://corpus/tokens.bin"], t, 3, fmt="tokens",
+                record_len=rl, dtype=np.uint16, batch_size=10,
+            ) as gr:
+                while True:
+                    lb, gb = lr.next_batch(), gr.next_batch()
+                    if lb is None:
+                        assert gb is None
+                        break
+                    np.testing.assert_array_equal(lb, gb)
+
+    def test_gs_token_batches_are_writable(self, gcs_emulator):
+        gcs_emulator.put_bytes(
+            "gs://corpus/w.bin", np.arange(32, dtype=np.uint16).tobytes()
+        )
+        with ShardedRecordReader(
+            ["gs://corpus/w.bin"], fmt="tokens", record_len=8,
+            dtype=np.uint16, batch_size=2,
+        ) as r:
+            b = r.next_batch()
+            b *= 2
+
+    def test_mixed_local_and_gs_paths(self, gcs_emulator, tmp_path):
+        local = tmp_path / "a.jsonl"
+        _write_jsonl(local, range(10))
+        gcs_emulator.put_bytes("gs://corpus/b.jsonl", "".join(
+            json.dumps({"id": i, "pad": ""}) + "\n" for i in range(10, 25)
+        ).encode())
+        seen = []
+        for t in range(2):
+            with ShardedRecordReader(
+                [str(local), "gs://corpus/b.jsonl"], t, 2, fmt="jsonl",
+                batch_size=7,
+            ) as r:
+                for batch in r:
+                    seen.extend(rec["id"] for rec in batch)
+        assert sorted(seen) == list(range(25))
+
+
+class TestRangeLineStream:
+    def test_lines_across_chunk_boundaries(self, gcs_emulator, monkeypatch):
+        from tony_tpu.io.storage import RangeLineStream
+
+        lines = [f"record-{i:04d}-" + "z" * (i % 13) for i in range(300)]
+        body = ("\n".join(lines) + "\n").encode()
+        gcs_emulator.put_bytes("gs://corpus/lines.txt", body)
+        monkeypatch.setattr(RangeLineStream, "CHUNK", 37)  # force many fetches
+        s = RangeLineStream("gs://corpus/lines.txt")
+        got = []
+        while True:
+            line = s.readline()
+            if not line:
+                break
+            got.append(line.decode().rstrip("\n"))
+        assert got == lines
+        assert s.tell() == len(body)
+
+    def test_seek_one_byte_back_boundary_rule(self, gcs_emulator):
+        from tony_tpu.io.storage import RangeLineStream
+
+        body = b"aaaa\nbbbb\ncccc\n"
+        gcs_emulator.put_bytes("gs://corpus/b.txt", body)
+        s = RangeLineStream("gs://corpus/b.txt")
+        # offset 5 is exactly the start of "bbbb": seeking one back and
+        # reading a line must consume only the newline, keeping "bbbb"
+        s.seek(4)
+        assert s.readline() == b"\n"
+        assert s.readline() == b"bbbb\n"
+        assert s.tell() == 10

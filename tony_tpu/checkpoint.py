@@ -27,6 +27,16 @@ module is the training-library half of that contract, built TPU-first:
 * **Dtype-exact**: leaves are stored as raw bytes + a dtype/shape manifest,
   so bfloat16 (and any ml_dtypes type numpy can't round-trip through npz)
   restores exactly.
+* **Object-store native**: a ``gs://`` directory checkpoints straight to
+  GCS — the TPU-VM analogue of the reference's user scripts writing
+  checkpoints to the cluster FS (working_dir in
+  tony-examples/mnist-tensorflow/mnist_distributed.py:46-48). Object PUTs
+  are atomic (an object appears whole or not at all), so the
+  write-tmp→fsync→rename dance collapses into direct PUTs; step-level
+  commit stays reader-side — a step is restorable only when its marker
+  (``metadata.json``) AND all ``num_processes`` shard objects exist, so a
+  partially-written step can never be read back. Torn step prefixes are
+  GC'd from the objects' ``updated`` stamps once quiescent.
 """
 
 from __future__ import annotations
@@ -97,6 +107,122 @@ def _fsync_write(path: Path, tmp: Path, data: bytes) -> None:
     tmp.rename(path)  # atomic: readers never see a torn file
 
 
+class _FsCheckpointStore:
+    """Filesystem step storage: fsync + atomic-rename durability."""
+
+    def __init__(self, directory: str | os.PathLike[str]) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    def put_file(self, step: int, name: str, data: bytes) -> None:
+        step_dir = self.directory / f"step_{step}"
+        step_dir.mkdir(parents=True, exist_ok=True)
+        _fsync_write(step_dir / name, step_dir / f".tmp_{name}", data)
+
+    def get_file(self, step: int, name: str) -> bytes | None:
+        path = self.directory / f"step_{step}" / name
+        try:
+            return path.read_bytes()
+        except OSError:
+            return None
+
+    def step_entries(self) -> dict[int, tuple[set[str], float | None]]:
+        """step -> (visible file names, newest mtime). Names exclude
+        in-flight tmp files; the mtime INCLUDES them — a straggler
+        mid-write must read as active to the GC's quiescence check. mtime
+        None: files vanishing underneath us (someone is active)."""
+        out: dict[int, tuple[set[str], float | None]] = {}
+        if not self.directory.is_dir():
+            return out
+        for child in self.directory.iterdir():
+            m = _STEP_RE.match(child.name)
+            if not (m and child.is_dir()):
+                continue
+            try:
+                names = {
+                    p.name for p in child.iterdir()
+                    if not p.name.startswith(".")
+                }
+                newest: float | None = max(
+                    (p.stat().st_mtime for p in child.rglob("*")),
+                    default=child.stat().st_mtime,
+                )
+            except OSError:
+                names, newest = set(), None
+            out[int(m.group(1))] = (names, newest)
+        return out
+
+    def delete_step(self, step: int) -> None:
+        shutil.rmtree(self.directory / f"step_{step}", ignore_errors=True)
+
+
+class _ObjectCheckpointStore:
+    """Object-store step storage under a gs:// prefix. PUTs are atomic per
+    object, so there are no tmp names; durability is the PUT response."""
+
+    def __init__(self, prefix: str) -> None:
+        self.prefix = str(prefix).rstrip("/")
+
+    def _store(self):
+        from tony_tpu.cloud import default_storage
+
+        return default_storage()
+
+    def put_file(self, step: int, name: str, data: bytes) -> None:
+        self._store().put_bytes(f"{self.prefix}/step_{step}/{name}", data)
+
+    def get_file(self, step: int, name: str) -> bytes | None:
+        from tony_tpu.cloud.gcs import GcsError
+
+        try:
+            return self._store().get_bytes(
+                f"{self.prefix}/step_{step}/{name}"
+            )
+        except GcsError as exc:
+            if exc.status == 404:
+                return None
+            raise
+
+    def _entries(self) -> list[tuple[int, str, float]]:
+        from tony_tpu.cloud.gcs import split_gs_uri
+
+        _, root_key = split_gs_uri(self.prefix)
+        store = self._store()
+        if hasattr(store, "list_prefix_mtimes"):
+            listed = store.list_prefix_mtimes(self.prefix + "/")
+        else:  # minimal fakes: no timestamps -> everything quiescent
+            listed = [(k, 0.0) for k in store.list_prefix(self.prefix + "/")]
+        out = []
+        for key, mtime in listed:
+            rel = key[len(root_key):].lstrip("/") if root_key else key
+            parts = rel.split("/")
+            if len(parts) != 2:
+                continue
+            m = _STEP_RE.match(parts[0])
+            if m:
+                out.append((int(m.group(1)), parts[1], mtime))
+        return out
+
+    def step_entries(self) -> dict[int, tuple[set[str], float | None]]:
+        """One listing pass serves names AND quiescence stamps — a GCS
+        list is a paged network round-trip, so per-step re-listing would
+        multiply control-plane traffic by the torn-step count."""
+        out: dict[int, tuple[set[str], float | None]] = {}
+        for step, name, mtime in self._entries():
+            names, newest = out.get(step, (set(), 0.0))
+            names.add(name)
+            out[step] = (names, max(newest or 0.0, mtime))
+        return out
+
+    def delete_step(self, step: int) -> None:
+        from tony_tpu.cloud.gcs import split_gs_uri
+
+        store = self._store()
+        bucket, _ = split_gs_uri(self.prefix)
+        for key in store.list_prefix(f"{self.prefix}/step_{step}/"):
+            store.delete(f"gs://{bucket}/{key}")
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -107,8 +233,14 @@ class CheckpointManager:
         max_to_keep: int = 3,
         torn_gc_grace_s: float = 300.0,
     ) -> None:
-        self.directory = Path(directory)
-        self.directory.mkdir(parents=True, exist_ok=True)
+        from tony_tpu.cloud.gcs import is_gs_uri
+
+        if is_gs_uri(directory):
+            self._store: Any = _ObjectCheckpointStore(str(directory))
+            self.directory: Any = str(directory)
+        else:
+            self._store = _FsCheckpointStore(directory)
+            self.directory = self._store.directory
         self.process_id = process_id
         self.num_processes = num_processes
         self.max_to_keep = max_to_keep
@@ -136,8 +268,6 @@ class CheckpointManager:
                 blobs[f"{path}#s{i}"] = _encode(piece)
 
         def write() -> None:
-            step_dir = self.directory / f"step_{step}"
-            step_dir.mkdir(parents=True, exist_ok=True)
             import io
 
             buf = io.BytesIO()
@@ -148,21 +278,22 @@ class CheckpointManager:
                     json.dumps(manifest).encode(), dtype=np.uint8
                 )},
             )
-            _fsync_write(
-                step_dir / f"process_{self.process_id}.npz",
-                step_dir / f".tmp_process_{self.process_id}.npz",
-                buf.getvalue(),
+            self._store.put_file(
+                step, f"process_{self.process_id}.npz", buf.getvalue()
             )
             if self.process_id == 0:
-                _fsync_write(
-                    step_dir / "metadata.json",
-                    step_dir / ".tmp_metadata.json",
+                # The commit marker: a step is restorable only once this
+                # AND all num_processes shard files exist (reader-side
+                # completeness — no cross-process coordination needed).
+                self._store.put_file(
+                    step, "metadata.json",
                     json.dumps(
                         {"step": step, "num_processes": self.num_processes}
                     ).encode(),
                 )
             self._gc()
-            log.info("checkpoint step %d written to %s", step, step_dir)
+            log.info("checkpoint step %d written under %s", step,
+                     self.directory)
 
         if blocking:
             write()
@@ -189,23 +320,25 @@ class CheckpointManager:
             raise RuntimeError("async checkpoint write failed") from exc
 
     # -- restore ------------------------------------------------------------
-    def _complete_steps(self) -> list[int]:
+    def _complete_steps(
+        self, entries: dict[int, tuple[set[str], float | None]] | None = None,
+    ) -> list[int]:
+        if entries is None:
+            entries = self._store.step_entries()
         steps = []
-        for child in self.directory.iterdir() if self.directory.is_dir() else []:
-            m = _STEP_RE.match(child.name)
-            if not m:
+        for step, (names, _) in entries.items():
+            if "metadata.json" not in names:
                 continue
-            if not (child / "metadata.json").is_file():
+            raw = self._store.get_file(step, "metadata.json")
+            if raw is None:
                 continue
             try:
-                meta = json.loads((child / "metadata.json").read_text())
-            except (OSError, ValueError):
+                meta = json.loads(raw)
+            except ValueError:
                 continue
             n = int(meta.get("num_processes", self.num_processes))
-            if all(
-                (child / f"process_{p}.npz").is_file() for p in range(n)
-            ):
-                steps.append(int(m.group(1)))
+            if all(f"process_{p}.npz" in names for p in range(n)):
+                steps.append(step)
         return sorted(steps)
 
     def latest_step(self) -> int | None:
@@ -224,8 +357,12 @@ class CheckpointManager:
             step = complete[-1]
         elif step not in complete:
             return None
-        path = self.directory / f"step_{step}" / f"process_{self.process_id}.npz"
-        with np.load(path) as data:
+        import io
+
+        raw = self._store.get_file(step, f"process_{self.process_id}.npz")
+        if raw is None:  # deleted between listing and read
+            return None
+        with np.load(io.BytesIO(raw)) as data:
             manifest = json.loads(bytes(data[_MANIFEST]).decode())
             blobs = {k: data[k] for k in data.files if k != _MANIFEST}
         flat = jax.tree_util.tree_flatten_with_path(state_template)
@@ -282,32 +419,25 @@ class CheckpointManager:
         deletion races."""
         if self.process_id != 0 or not self.max_to_keep:
             return
-        complete = self._complete_steps()
+        entries = self._store.step_entries()  # ONE listing serves all
+        complete = self._complete_steps(entries)
         kept = set(complete[-self.max_to_keep:])
         threshold = min(kept) if kept else None
-        for child in list(self.directory.iterdir()):
-            m = _STEP_RE.match(child.name)
-            if not m:
-                continue
-            n = int(m.group(1))
+        for n, (_, newest) in entries.items():
             stale_complete = n in set(complete) - kept
             torn_and_old = (
                 n not in complete
                 and threshold is not None
                 and n < threshold
-                and self._quiescent(child)
+                and self._quiescent(newest)
             )
             if stale_complete or torn_and_old:
-                shutil.rmtree(child, ignore_errors=True)
+                self._store.delete_step(n)
 
-    def _quiescent(self, child: Path) -> bool:
-        """True when nothing under ``child`` was modified within the grace
-        window — a straggler still writing an old step keeps its dir alive."""
-        try:
-            newest = max(
-                (p.stat().st_mtime for p in child.rglob("*")),
-                default=child.stat().st_mtime,
-            )
-        except OSError:
-            return False  # files vanishing under us: someone is active
+    def _quiescent(self, newest: float | None) -> bool:
+        """True when nothing under the step was modified within the grace
+        window — a straggler still writing an old step keeps its dir
+        alive. None (files vanishing under the listing) reads as active."""
+        if newest is None:
+            return False
         return (time.time() - newest) > self.torn_gc_grace_s

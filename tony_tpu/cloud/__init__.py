@@ -14,7 +14,14 @@ integration surface; the default transports (urllib + gcloud ssh) are the
 production path.
 """
 
-from tony_tpu.cloud.gcs import GcsStorage, is_gs_uri, split_gs_uri
+import os
+
+from tony_tpu.cloud.gcs import (
+    FileObjectStorage,
+    GcsStorage,
+    is_gs_uri,
+    split_gs_uri,
+)
 from tony_tpu.cloud.gcp import (
     GcpQueuedResourceApi,
     GcloudSshRunner,
@@ -26,12 +33,18 @@ _default_storage: GcsStorage | None = None
 
 
 def default_storage() -> GcsStorage:
-    """Process-wide GcsStorage used by call sites that cannot take an
-    injected client (history writer, bootstrap). Tests swap it with
-    ``set_default_storage``; production lazily builds the urllib one."""
+    """Process-wide storage used by call sites that cannot take an
+    injected client (history writer, bootstrap, data-plane reader). Tests
+    swap it with ``set_default_storage``; ``TONY_GCS_EMULATOR_DIR`` (the
+    MiniDFS analogue — inherited by executor subprocesses, so whole e2e
+    jobs can run gs:// paths offline) maps gs:// onto a local directory;
+    production lazily builds the urllib one."""
     global _default_storage
     if _default_storage is None:
-        _default_storage = GcsStorage()
+        emulator = os.environ.get("TONY_GCS_EMULATOR_DIR")
+        _default_storage = (
+            FileObjectStorage(emulator) if emulator else GcsStorage()
+        )
     return _default_storage
 
 
@@ -41,6 +54,7 @@ def set_default_storage(storage: GcsStorage | None) -> None:
 
 
 __all__ = [
+    "FileObjectStorage",
     "GcsStorage",
     "is_gs_uri",
     "split_gs_uri",

@@ -137,26 +137,38 @@ class UrllibTransport:
         self, token_provider: Callable[[], str | tuple[str, float]] | None = None,
         timeout_s: float = 60.0,
     ) -> None:
+        import threading
+
         self._provider = token_provider or default_token_provider
         self._timeout = timeout_s
         self._token: str | None = None
         self._token_expiry = 0.0  # monotonic deadline for the cached token
+        # One transport is shared across threads (default_storage feeds
+        # concurrent reader fetchers); the lock also collapses a refresh
+        # stampede into one provider call.
+        self._token_lock = threading.Lock()
 
     def _bearer(self) -> str:
-        now = time.monotonic()
-        if self._token is None or now >= self._token_expiry:
-            got = self._provider()
-            token, life = got if isinstance(got, tuple) else (got, 3600.0)
-            self._token = token
-            # Margin against clock skew / in-flight requests; even a
-            # nearly-dead token is still cached briefly so a stuck
-            # metadata server cannot be hammered in a poll loop.
-            self._token_expiry = now + max(life - self._EXPIRY_MARGIN_S, 30.0)
-        return self._token
+        with self._token_lock:
+            now = time.monotonic()
+            if self._token is None or now >= self._token_expiry:
+                got = self._provider()
+                token, life = got if isinstance(got, tuple) else (got, 3600.0)
+                self._token = token
+                # Margin against clock skew / in-flight requests; even a
+                # nearly-dead token is still cached briefly so a stuck
+                # metadata server cannot be hammered in a poll loop.
+                self._token_expiry = now + max(
+                    life - self._EXPIRY_MARGIN_S, 30.0
+                )
+            return self._token
 
     def _drop_token(self) -> None:
-        self._token = None
-        self._token_expiry = 0.0
+        # Expire, don't clear: a concurrent _bearer() between the drop and
+        # the refresh must see the old (possibly still valid) token, never
+        # None — its own 401 retry covers the stale case.
+        with self._token_lock:
+            self._token_expiry = 0.0
 
     def request(
         self, method: str, url: str, body,

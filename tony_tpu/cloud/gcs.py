@@ -36,6 +36,22 @@ def split_gs_uri(uri: str) -> tuple[str, str]:
     return bucket, key
 
 
+def _rfc3339_epoch(stamp: str | None) -> float:
+    """GCS ``updated`` stamp ("2026-07-30T12:34:56.789Z") -> epoch
+    seconds; missing/unparseable stamps read as 0 (infinitely old — GC
+    treats the object as quiescent rather than immortal)."""
+    if not stamp:
+        return 0.0
+    try:
+        import datetime
+
+        return datetime.datetime.fromisoformat(
+            stamp.replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
 class GcsError(RuntimeError):
     def __init__(self, status: int, url: str, body: bytes) -> None:
         super().__init__(
@@ -133,7 +149,43 @@ class GcsStorage:
                     break
                 out.write(chunk)
 
+    def get_range(self, uri: str, offset: int, length: int) -> bytes:
+        """``length`` bytes from ``offset`` via an HTTP Range request — the
+        data plane's random-access primitive (the FSDataInputStream.seek
+        analogue, HdfsAvroFileSplitReader.java:379-416). GCS serves ranged
+        object reads natively, so byte-range splits port directly."""
+        if length <= 0:
+            return b""
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        status, body = self.transport.request(
+            "GET", url, None,
+            {"Range": f"bytes={offset}-{offset + length - 1}"},
+        )
+        if status == 206:
+            return body
+        if status == 200:
+            # Server ignored the Range header (tiny objects / proxies):
+            # the body is the whole object.
+            return body[offset:offset + length]
+        raise GcsError(status, url, body)
+
     # -- metadata -----------------------------------------------------------
+    def size(self, uri: str) -> int:
+        """Object size in bytes from metadata (no body transfer)."""
+        bucket, key = split_gs_uri(uri)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}"
+        )
+        status, body = self.transport.request("GET", url, None, {})
+        if status != 200:
+            raise GcsError(status, url, body)
+        return int(json.loads(body)["size"])
+
     def exists(self, uri: str) -> bool:
         bucket, key = split_gs_uri(uri)
         url = (
@@ -150,8 +202,14 @@ class GcsStorage:
     def list_prefix(self, uri: str) -> list[str]:
         """All object keys under a gs://bucket/prefix (full keys, paging
         followed)."""
+        return [name for name, _ in self.list_prefix_mtimes(uri)]
+
+    def list_prefix_mtimes(self, uri: str) -> list[tuple[str, float]]:
+        """(key, last-updated epoch seconds) under a prefix — the
+        quiescence signal the checkpoint GC uses (objects carry an
+        ``updated`` RFC3339 stamp in list metadata)."""
         bucket, prefix = split_gs_uri(uri)
-        names: list[str] = []
+        out: list[tuple[str, float]] = []
         page = ""
         while True:
             url = (
@@ -164,10 +222,11 @@ class GcsStorage:
             if status != 200:
                 raise GcsError(status, url, body)
             doc = json.loads(body)
-            names += [item["name"] for item in doc.get("items", [])]
+            for item in doc.get("items", []):
+                out.append((item["name"], _rfc3339_epoch(item.get("updated"))))
             page = doc.get("nextPageToken", "")
             if not page:
-                return names
+                return out
 
     def delete(self, uri: str) -> None:
         bucket, key = split_gs_uri(uri)
@@ -178,3 +237,81 @@ class GcsStorage:
         status, body = self.transport.request("DELETE", url, None, {})
         if status not in (200, 204, 404):
             raise GcsError(status, url, body)
+
+
+class FileObjectStorage:
+    """The GcsStorage surface over a local directory: ``gs://bucket/key``
+    maps to ``<root>/bucket/key``. This is the dev/test object store — the
+    tony-mini analogue of the reference testing its HDFS paths on a
+    MiniDFSCluster: set ``TONY_GCS_EMULATOR_DIR`` (or call
+    ``set_default_storage``) and every gs:// code path (staging, history,
+    data plane, checkpoints) runs against local files, including in
+    executor subprocesses that inherit the env var."""
+
+    def __init__(self, root: str | Path) -> None:
+        self.root = Path(root)
+
+    def _path(self, uri: str) -> Path:
+        bucket, key = split_gs_uri(uri)
+        return self.root / bucket / key
+
+    def put_bytes(self, uri: str, data: bytes) -> None:
+        p = self._path(uri)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        # Per-object atomicity, like a real object store PUT.
+        tmp = p.with_name(f".{p.name}.tmp")
+        tmp.write_bytes(data)
+        tmp.replace(p)
+
+    def get_bytes(self, uri: str) -> bytes:
+        p = self._path(uri)
+        if not p.is_file():
+            raise GcsError(404, str(p), b"no such object")
+        return p.read_bytes()
+
+    def get_range(self, uri: str, offset: int, length: int) -> bytes:
+        if length <= 0:
+            return b""
+        p = self._path(uri)
+        if not p.is_file():
+            raise GcsError(404, str(p), b"no such object")
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def upload_file(self, local: str | Path, uri: str) -> None:
+        self.put_bytes(uri, Path(local).read_bytes())
+
+    def download_file(self, uri: str, local: str | Path) -> None:
+        path = Path(local)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(self.get_bytes(uri))
+
+    def size(self, uri: str) -> int:
+        p = self._path(uri)
+        if not p.is_file():
+            raise GcsError(404, str(p), b"no such object")
+        return p.stat().st_size
+
+    def exists(self, uri: str) -> bool:
+        return self._path(uri).is_file()
+
+    def list_prefix(self, uri: str) -> list[str]:
+        return [name for name, _ in self.list_prefix_mtimes(uri)]
+
+    def list_prefix_mtimes(self, uri: str) -> list[tuple[str, float]]:
+        bucket, prefix = split_gs_uri(uri)
+        base = self.root / bucket
+        if not base.is_dir():
+            return []
+        return sorted(
+            (str(p.relative_to(base)), p.stat().st_mtime)
+            for p in base.rglob("*")
+            if p.is_file() and not p.name.startswith(".")
+            and str(p.relative_to(base)).startswith(prefix)
+        )
+
+    def delete(self, uri: str) -> None:
+        p = self._path(uri)
+        if p.is_file():
+            p.unlink()

@@ -30,6 +30,7 @@ from typing import Any, Iterator
 import numpy as np
 
 from tony_tpu.io.splits import FileSegment, create_read_info
+from tony_tpu.io.storage import file_size, is_gs_uri, open_lines, read_range
 
 _SENTINEL = object()
 
@@ -62,7 +63,12 @@ class ShardedRecordReader:
         self.shuffle_pool = shuffle_pool
         self._rng = random.Random(seed + task_index)
 
-        files = [(p, os.path.getsize(p)) for p in sorted(paths)]
+        # Local paths and gs:// URIs mix freely — sizes and ranges go
+        # through io.storage, so a TPU-VM job streams its corpus straight
+        # from GCS with no manual staging (the reference reads HDFS the
+        # same way, HdfsAvroFileSplitReader.java:347-416).
+        files = [(str(p), file_size(str(p))) for p in sorted(paths)]
+        self._sizes = dict(files)
         self.segments = create_read_info(files, task_index, num_tasks)
         if fmt == "tokens":
             self.segments = [self._align_tokens(s) for s in self.segments]
@@ -90,8 +96,8 @@ class ShardedRecordReader:
         # which rounds its own end up past it) and the end UP as well.
         start = -(-seg.offset // rb) * rb
         end = -(-(seg.offset + seg.length) // rb) * rb
-        file_size = os.path.getsize(seg.path)
-        end = min(end, file_size - file_size % rb)
+        fsize = self._sizes[seg.path]
+        end = min(end, fsize - fsize % rb)
         return FileSegment(seg.path, start, max(0, end - start))
 
     # -- fetcher thread ------------------------------------------------------
@@ -160,6 +166,28 @@ class ShardedRecordReader:
         kernel (native/tony_io.cc) when built; the Python fallback reads
         the same chunk sizes."""
         rb = self._record_bytes()
+        if is_gs_uri(seg.path):
+            # Ranged object reads: same chunk sizes as the local paths.
+            record_len = rb // self.dtype.itemsize
+            offset, remaining = seg.offset, seg.length // rb
+            while remaining > 0:
+                n = min(self._CHUNK_RECORDS * 4, remaining)
+                data = read_range(seg.path, offset, n * rb)
+                got = len(data) // rb
+                if got == 0:
+                    return
+                # bytearray: consumers get writable rows (frombuffer over
+                # bytes is read-only).
+                rows = np.frombuffer(
+                    bytearray(data[: got * rb]), dtype=self.dtype
+                ).reshape(got, record_len)
+                for lo in range(0, got, self._CHUNK_RECORDS):
+                    yield rows[lo: lo + self._CHUNK_RECORDS]
+                offset += got * rb
+                remaining -= got
+                if got < n:
+                    return
+            return
         from tony_tpu.io import native
 
         if native.available():
@@ -222,7 +250,7 @@ class ShardedRecordReader:
                 yield row.copy()
 
     def _iter_jsonl(self, seg: FileSegment) -> Iterator[Any]:
-        with open(seg.path, "rb") as f:
+        with open_lines(seg.path) as f:
             if seg.offset == 0:
                 f.seek(0)
             else:
