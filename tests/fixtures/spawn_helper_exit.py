@@ -1,0 +1,18 @@
+"""Teardown fixture: the worker spawns a long-lived helper in its process
+group and exits 0 — the helper must NOT survive the job (the executor
+reaps the whole user process group even after a clean script exit, like
+YARN killing the container cgroup)."""
+import json
+import os
+import subprocess
+import sys
+
+helper = subprocess.Popen(
+    [sys.executable, "-c", "import time; time.sleep(3600)"]
+)
+out = os.path.join(
+    os.environ["TONY_LOG_DIR"], f"helper-{os.environ['TASK_INDEX']}.json"
+)
+with open(out, "w") as f:
+    json.dump({"helper": helper.pid}, f)
+sys.exit(0)

@@ -11,6 +11,7 @@ in-process fake cluster" is the reference's key transferable test idea).
 
 from __future__ import annotations
 
+import atexit
 import threading
 from pathlib import Path
 
@@ -23,6 +24,12 @@ from tony_tpu.coordinator.session import SessionStatus
 
 
 class MiniTonyCluster:
+    """Also a context manager: ``__exit__``/interpreter-exit stop any
+    still-running coordinator's executors, so a crashed or interrupted
+    harness cannot strand job subprocesses (the in-process half of the
+    orphan-reaping contract; the executor's own death handlers cover the
+    harness being SIGKILLed)."""
+
     def __init__(self, base_dir: str | Path) -> None:
         self.base_dir = Path(base_dir)
         self.staging_dir = self.base_dir / "staging"
@@ -30,6 +37,25 @@ class MiniTonyCluster:
         for d in (self.staging_dir, self.history_dir):
             d.mkdir(parents=True, exist_ok=True)
         self._app_seq = 0
+        self._live: list[TonyCoordinator] = []
+        atexit.register(self.shutdown)
+
+    def shutdown(self) -> None:
+        """Kill every coordinator this cluster started that is still
+        running (idempotent; called by __exit__ and atexit)."""
+        for coordinator in self._live:
+            try:
+                coordinator.kill()
+                coordinator.backend.stop_all()
+            except Exception:
+                pass
+        self._live.clear()
+
+    def __enter__(self) -> "MiniTonyCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
 
     def base_conf(self) -> TonyConfiguration:
         conf = TonyConfiguration()
@@ -54,11 +80,33 @@ class MiniTonyCluster:
             backend=LocalProcessBackend(app_dir / "logs"),
         )
         result: list[SessionStatus] = []
-        t = threading.Thread(target=lambda: result.append(coordinator.run()))
+        # daemon: a wedged coordinator must not block interpreter shutdown,
+        # or the atexit shutdown() below could never run.
+        t = threading.Thread(
+            target=lambda: result.append(coordinator.run()), daemon=True
+        )
+        self._live.append(coordinator)
         t.start()
-        t.join(timeout=timeout_s)
-        if t.is_alive():
-            coordinator.kill()
-            t.join(timeout=10)
-            raise TimeoutError(f"job {app_id} did not finish within {timeout_s}s")
+        try:
+            t.join(timeout=timeout_s)
+            if t.is_alive():
+                coordinator.kill()
+                t.join(timeout=10)
+                raise TimeoutError(
+                    f"job {app_id} did not finish within {timeout_s}s"
+                )
+        finally:
+            if not t.is_alive():
+                # Thread exit is NOT cleanup-complete: a coordinator that
+                # raised mid-session still holds launched executors.
+                try:
+                    coordinator.backend.stop_all()
+                except Exception:
+                    pass
+                self._live.remove(coordinator)
+        if not result:
+            raise RuntimeError(
+                f"coordinator for {app_id} crashed without a status — "
+                f"see its log output"
+            )
         return result[0], coordinator

@@ -197,10 +197,14 @@ def execute_shell(
     timeout_ms: int = 0,
     extra_env: Mapping[str, str] | None = None,
     cwd: str | None = None,
+    on_start=None,
 ) -> int:
     """Run ``bash -c <command>`` inheriting stdio, with injected env and an
     optional kill-after timeout. Returns the exit code (124 on timeout, like
-    coreutils ``timeout``)."""
+    coreutils ``timeout``). ``on_start(proc)`` fires right after spawn —
+    the executor registers the child there so its own death handlers can
+    reap the user process group (which lives in its own session and is NOT
+    covered by a killpg on the executor's group)."""
     env = dict(os.environ)
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
@@ -209,6 +213,8 @@ def execute_shell(
     proc = subprocess.Popen(
         ["bash", "-c", command], env=env, cwd=cwd, start_new_session=True
     )
+    if on_start is not None:
+        on_start(proc)
     try:
         return proc.wait(timeout=timeout_ms / 1000.0 if timeout_ms else None)
     except subprocess.TimeoutExpired:
