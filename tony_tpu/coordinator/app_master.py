@@ -454,10 +454,11 @@ class TonyCoordinator:
             self._wake.wait(interval_s)
             self._wake.clear()
         # Stop whatever is still running (failed/killed sessions leave
-        # stragglers; succeeded chief leaves ps tasks by design).
-        for task in session.all_tasks():
-            if task.handle is not None and not task.completed():
-                self.backend.kill(task.handle)
+        # stragglers; succeeded chief leaves ps tasks by design) — via
+        # stop_all, which TERMs everyone against ONE shared grace window;
+        # per-task kill() would serialize a full grace period per wedged
+        # executor.
+        self.backend.stop_all()
         return session.status
 
     def _reset(self) -> None:

@@ -141,6 +141,13 @@ class LocalProcessBackend:
             os.killpg(pgid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        # One reap per advertisement: a later teardown path re-reading this
+        # file could SIGKILL a RECYCLED pgid (the executor unlinks it on
+        # clean exit; the backend must do the same on fallback reaps).
+        try:
+            pgid_file.unlink()
+        except OSError:
+            pass
 
     def _term(self, handle: _ProcHandle) -> None:
         try:
@@ -171,6 +178,11 @@ class LocalProcessBackend:
         if handle.proc.poll() is None:
             self._term(handle)
             self._escalate(handle, time.monotonic() + self.KILL_GRACE_S)
+        else:
+            # Executor already gone (kernel OOM kill, operator kill -9):
+            # its death handlers never ran, so its user group may still be
+            # alive — reap from the advertised pgid (no-op when empty).
+            self._reap_user_group(handle)
 
     def stop_all(self) -> None:
         # TERM everyone first, then wait them against ONE shared deadline:
@@ -181,6 +193,11 @@ class LocalProcessBackend:
         deadline = time.monotonic() + self.KILL_GRACE_S
         for h in live:
             self._escalate(h, deadline)
+        for h in self._handles:
+            if h not in live:
+                # Died before we got here (uncleanly, perhaps): make sure
+                # its user group did not outlive it.
+                self._reap_user_group(h)
         self._handles.clear()
 
 

@@ -71,6 +71,15 @@ def _kill_user_process_group() -> None:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        # Retract the advertisement: the backend's unclean-death fallback
+        # reaps from this file, and a stale pgid could be recycled by an
+        # unrelated process long after this clean reap.
+        pgid_file = _user_pgid_file()
+        if pgid_file is not None:
+            try:
+                pgid_file.unlink()
+            except OSError:
+                pass
 
 
 def _install_death_handlers() -> None:

@@ -22,7 +22,13 @@ from tony_tpu.models.transformer import (
     forward_pipeline,
     param_roles,
 )
-from tony_tpu.models.decode import advance, decode_weights, generate, init_cache
+from tony_tpu.models.decode import (
+    DecodeSession,
+    advance,
+    decode_weights,
+    generate,
+    init_cache,
+)
 from tony_tpu.models.mnist import MnistConfig, mnist_init, mnist_apply
 from tony_tpu.models.resnet import ResNetConfig, resnet_init, resnet_apply
 from tony_tpu.models.train import (
@@ -49,6 +55,7 @@ __all__ = [
     "make_image_classifier_step",
     "lm_loss",
     "advance",
+    "DecodeSession",
     "decode_weights",
     "generate",
     "init_cache",

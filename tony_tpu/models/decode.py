@@ -16,13 +16,19 @@ choices:
   [B, S, Hkv, G, Dh] so the cache is never head-repeated), read in the
   stored dtype with fp32 MXU accumulation and fp32 softmax (t_q is 1 or
   the prompt length — flash blocking buys nothing there).
-* ``decode_weights`` re-packs the fp32 training masters once per generate
-  call: downcast to the compute dtype, qkv and gate|up fused — decode at
-  small batch is bandwidth/op-count-bound, so fewer, wider matmuls win.
+* ``decode_weights`` re-packs the fp32 training masters: downcast to the
+  compute dtype, qkv and gate|up fused — decode at small batch is
+  bandwidth/op-count-bound, so fewer, wider matmuls win. ``DecodeSession``
+  holds the fused pack so repeated ``generate`` calls pay fusion once
+  (module-level ``generate`` on raw params re-fuses per call).
 
-MoE trunks decode via dense-mixture expert evaluation (``_moe_mlp_decode``:
-every expert runs on the new token, combined by the normalized top-k
-router weights, no capacity dropping at inference). Sampling: greedy at
+MoE trunks decode via the dense mixture by default (every expert runs,
+unselected get exact weight 0): measured on v5e, streaming the stacked
+expert weights beats per-token top-k weight gathers at every tested
+(B, E) — the gathers are the bandwidth-inefficient path, not the
+streaming. A ``routed`` top-k-only evaluation
+(``_moe_mlp_decode_routed``) stays selectable via
+``cfg.moe_decode_mode`` and is token-exact vs dense. Sampling: greedy at
 ``temperature=0``, else temperature sampling with a caller-provided key.
 """
 
@@ -104,11 +110,17 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
     }
 
 
-def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
+def _layer_decode(x, lp, k_all, v_all, layer, length, cfg, cos, sin,
                   prefill=False):
     """One decoder layer over S new tokens at positions [length, length+S).
-    x: [B, S, d]; caches [B, Tmax, Hkv, Dh]; lp in the fused
-    ``decode_weights`` layout. Returns (x, k_cache, v_cache).
+    x: [B, S, d]; ``k_all``/``v_all`` are the FULL stacked caches
+    [L, B, Tmax, Hkv, Dh] carried through the layer scan — the new K/V
+    rows are written at (layer, :, length) with a small
+    ``dynamic_update_slice`` that XLA aliases in place. Scanning with the
+    caches as scan xs/ys instead re-stacks them every step: a measured
+    0.8+ ms/step of pure ``copy`` (the whole cache, every token) in the
+    device trace. lp is in the fused ``decode_weights`` layout. Returns
+    (x, k_all, v_all).
 
     ``prefill=True`` (static) promises the cache is empty (length == 0):
     attention then runs the flash kernel over just the S new tokens
@@ -117,8 +129,8 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
     but quadratic-memory for long prompts."""
     dt = cfg.compute_dtype
     b, s, _ = x.shape
-    t_max = k_cache.shape[1]
-    n_h, h_kv = cfg.n_heads, k_cache.shape[2]
+    t_max = k_all.shape[2]
+    n_h, h_kv = cfg.n_heads, k_all.shape[3]
 
     h = rms_norm(x, lp["ln1"]).astype(dt)
     qkv = jnp.einsum("btd,dhk->bthk", h, lp["qkv"])
@@ -129,12 +141,14 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
     q = apply_rope(q, cos, sin, positions=positions)
     k_new = apply_rope(k_new, cos, sin, positions=positions)
 
-    k_cache = lax.dynamic_update_slice(
-        k_cache, k_new.astype(k_cache.dtype), (0, length, 0, 0)
+    k_all = lax.dynamic_update_slice(
+        k_all, k_new.astype(k_all.dtype)[None], (layer, 0, length, 0, 0)
     )
-    v_cache = lax.dynamic_update_slice(
-        v_cache, v_new.astype(v_cache.dtype), (0, length, 0, 0)
+    v_all = lax.dynamic_update_slice(
+        v_all, v_new.astype(v_all.dtype)[None], (layer, 0, length, 0, 0)
     )
+    k_cache = lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
+    v_cache = lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
 
     if prefill and s > 1:
         # Empty cache: self-attention over the prompt only (flash handles
@@ -148,6 +162,15 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
         # cache in its stored dtype (bfloat16) with fp32 MXU accumulation
         # — no fp32 upcast copy of the full T_max cache per step — and
         # softmax stays fp32.
+        #
+        # Measured dead end (r4): a flash-decoding-style blocked loop
+        # (dynamic trip count over CACHE_BLOCK chunks, online softmax)
+        # is SLOWER here — 0.99 vs 0.82 ms/step at T_max=2048 — because
+        # generate() sizes the cache to exactly t0+max_new_tokens, so
+        # there is no allocated-but-unfilled slack to skip, and the
+        # while-loop costs ~10us/iteration; at a 7.4k-token context the
+        # two paths tie (~4.4 ms). Revisit only if a serving path with
+        # large preallocated caches at low fill appears.
         g = n_h // h_kv
         scale = cfg.head_dim ** -0.5
         qg = q.reshape(b, s, h_kv, g, cfg.head_dim)
@@ -167,7 +190,19 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
     x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
 
     if "router" in lp:
-        x = x + _moe_mlp_decode(x, lp, cfg)
+        mode = cfg.moe_decode_mode
+        if mode not in ("auto", "routed", "dense"):
+            raise ValueError(f"unknown moe_decode_mode {mode!r}")
+        # auto -> dense: measured on v5e, streaming all experts beats
+        # per-token top-k weight gathers at every tested (B, E) — see
+        # TransformerConfig.moe_decode_mode and BASELINE.md. Routed
+        # applies only to single-token steps even when selected: its
+        # gathered [B, T, K, d, 2f] weight copy scales with T — a
+        # 1024-token prefill would materialize hundreds of GB.
+        if mode == "routed" and s == 1:
+            x = x + _moe_mlp_decode_routed(x, lp, cfg)
+        else:
+            x = x + _moe_mlp_decode(x, lp, cfg)
     else:
         # SwiGLU with the fused gate|up projection — the same math as
         # training's _dense_mlp, one matmul instead of two.
@@ -179,7 +214,7 @@ def _layer_decode(x, lp, k_cache, v_cache, length, cfg, cos, sin,
             * gu[..., f:]
         )
         x = x + jnp.einsum("btf,fd->btd", act, lp["w_down"])
-    return x, k_cache, v_cache
+    return x, k_all, v_all
 
 
 def _moe_mlp_decode(x, lp, cfg):
@@ -217,6 +252,41 @@ def _moe_mlp_decode(x, lp, cfg):
     return jnp.einsum(
         "bted,bte->btd", per_expert, weights.astype(dt)
     )
+
+
+def _moe_mlp_decode_routed(x, lp, cfg):
+    """Top-k-only MoE evaluation: gather each token's K selected experts'
+    weights and run just those — per-step cost is B·K expert matmuls.
+    Same router, same normalized gate weights, no capacity dropping —
+    token-exact vs the dense path up to summation order (distinct top-k
+    indices make the zero-weight terms the dense path adds EXACT zeros,
+    so the two sums agree to fp rounding).
+
+    Measured on v5e (r4) this path LOSES to the dense mixture at every
+    tested point (E=16/B=8: 1.52 vs 1.27 ms/step; E=64/B=4: 3.94 vs
+    1.71): decode MoE is bandwidth-bound, XLA streams the stacked expert
+    weights near roofline, and per-token weight gathers do not — so
+    "auto" resolves to dense and this stays an explicit option for
+    B·K ≪ E regimes on hardware with efficient gathers."""
+    from tony_tpu.models.transformer import _route_tokens
+
+    dt = cfg.compute_dtype
+    hn = rms_norm(x, lp["ln2"])
+    # Same router gating as training/dense decode (_route_tokens — shared
+    # so parity cannot drift). gidx/gvals: [b, t, k].
+    _, _, gvals, gidx = _route_tokens(hn, lp["router"], cfg.expert_top_k)
+
+    hd = hn.astype(dt)
+    w_gu = lp["gate_up"][gidx]          # [b, t, k, d, 2f] gathered
+    w_dn = lp["w_down"][gidx]           # [b, t, k, f, d]
+    gu = jnp.einsum("btd,btkdf->btkf", hd, w_gu)
+    f = gu.shape[-1] // 2
+    act = (
+        jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt)
+        * gu[..., f:]
+    )
+    per_slot = jnp.einsum("btkf,btkfd->btkd", act, w_dn)
+    return jnp.einsum("btkd,btk->btd", per_slot, gvals.astype(dt))
 
 
 def advance(params: dict, cache: dict, tokens: jax.Array,
@@ -288,14 +358,23 @@ def advance(params: dict, cache: dict, tokens: jax.Array,
     length = cache["length"]
     x = params["embed"][tokens].astype(dt)
 
+    # The caches ride the scan CARRY (not xs/ys): as xs/ys the layer scan
+    # slices every layer's cache out and re-stacks it each call — the
+    # device trace showed ~0.8 ms/step of pure copy at modest cache sizes
+    # (the whole cache re-written per token). As carry, the per-layer
+    # update is one small aliased dynamic_update_slice.
     def body(carry, layer_in):
-        lp, kc, vc = layer_in
-        x, kc, vc = _layer_decode(carry, lp, kc, vc, length, cfg, cos, sin,
-                                  prefill=prefill)
-        return x, (kc, vc)
+        x, k_all, v_all = carry
+        lp, layer = layer_in
+        x, k_all, v_all = _layer_decode(
+            x, lp, k_all, v_all, layer, length, cfg, cos, sin,
+            prefill=prefill,
+        )
+        return (x, k_all, v_all), None
 
-    x, (k_all, v_all) = lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+    (x, k_all, v_all), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
     )
     # Only the last position is ever sampled — slice BEFORE the unembed so
     # prefill never materializes [B, S, V] logits.
@@ -403,6 +482,43 @@ def generate(
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _decode_weights_jit(params: dict, cfg: TransformerConfig) -> dict:
     return decode_weights(params, cfg)
+
+
+class DecodeSession:
+    """Persistent serving session: fuse + downcast the weights ONCE and
+    reuse the compiled generate loop across calls.
+
+    ``generate()`` on raw training params re-runs the ``decode_weights``
+    fusion every call — one extra jitted dispatch plus the fusion compute
+    (measured 113 ms of the 186 ms wall for a 128-token batch-8 call on
+    v5e, BENCH_r03: wall 5.5k tok/s vs 14.1k steady-state). A served
+    model pays fusion once; this class is that once. Subsequent calls
+    dispatch only the cached ``_generate_loop`` executable.
+
+        session = DecodeSession(params, cfg)
+        out = session.generate(prompt, max_new_tokens=128)
+
+    Call ``refresh(params)`` after a training step to re-fuse updated
+    weights (e.g. periodic eval generation mid-training)."""
+
+    def __init__(self, params: dict, cfg: TransformerConfig) -> None:
+        self.cfg = cfg
+        self.params: dict = {}
+        self.refresh(params)
+
+    def refresh(self, params: dict) -> None:
+        """Re-fuse from (possibly updated) training params; accepts
+        already-fused layouts as-is."""
+        if "qkv" in params["layers"]:
+            self.params = params
+        else:
+            self.params = _decode_weights_jit(params, self.cfg)
+
+    def generate(self, prompt: jax.Array, max_new_tokens: int, **kwargs):
+        """Same surface as module-level ``generate`` minus params/cfg."""
+        return generate(
+            self.params, prompt, self.cfg, max_new_tokens, **kwargs
+        )
 
 
 @functools.partial(

@@ -87,19 +87,21 @@ def _flash_fwd_kernel(
     live = k_start <= q_off + (qi + 1) * block_q - 1 if causal else True
 
     def _scores():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
+        # Operands stay in the input dtype (bf16): the MXU runs bf16
+        # matmuls at full rate and fp32 at a fraction of it; accumulation
+        # is fp32 via preferred_element_type (the FA2 recipe). The scale
+        # folds in AFTER the dot, in fp32, so no precision is spent on a
+        # bf16 pre-scale.
         return jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k]
 
     def _accumulate(s, *, may_be_masked: bool):
         """Online-softmax update. The unmasked variant drops every
         NEG_INF guard: with only real scores m_new is always finite, and
         alpha = exp(m - m_new) underflows cleanly to 0 on the first live
         block (m = NEG_INF)."""
-        v_blk = v_ref[0].astype(jnp.float32)
         # Lanes of m/l hold identical values; a lane-max reads them back.
         m = jnp.max(m_ref[...], axis=1)
         l = jnp.max(l_ref[...], axis=1)
@@ -115,8 +117,11 @@ def _flash_fwd_kernel(
             p = jnp.exp(s - m_new[:, None])
             alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
+        # p downcast to the V dtype for the MXU (bf16 full rate, fp32
+        # accumulation) — p ∈ [0, 1] so the cast costs ~3 decimal digits
+        # on already-exponentiated values, the standard FA2 trade.
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
@@ -282,12 +287,10 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 accumulation (FA2): upcasting to fp32
+        # before the dots runs the MXU at a fraction of its bf16 rate.
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         p = _bwd_masked_p(
@@ -295,12 +298,12 @@ def _flash_bwd_dq_kernel(
             block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
         )
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(k_ref.dtype)
         acc_ref[...] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -331,29 +334,27 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 accumulation (FA2) — see dq kernel.
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         p = _bwd_masked_p(
             s, lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
             block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
         )
+        p16 = p.astype(do_ref.dtype)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p16, do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(q_ref.dtype)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
