@@ -147,12 +147,14 @@ def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 20):
     need it: flash attention keeps activations O(T·block), so at 200M
     both bench shapes fit HBM without remat and its recompute is pure
     MFU loss (measured: 47.0% -> 51.5% at 2k/b8, 36.2% -> 41.6% at
-    8k/b2); past 8k seq it goes back on."""
+    8k/b2); more total tokens than that force it back on (the fit is a
+    batch*seq property: b=16 @ 2k already blows memory without it)."""
     from tony_tpu.models import TransformerConfig
 
     cfg = TransformerConfig(
         vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
-        d_ff=4096, max_seq=seq, dtype="bfloat16", remat=seq > 8192,
+        d_ff=4096, max_seq=seq, dtype="bfloat16",
+        remat=batch * seq > 16384,
         remat_policy="dots", layer_scan_unroll=8,
     )
     return _bench_lm_train(cfg, batch, seq, measure)

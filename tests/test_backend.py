@@ -104,6 +104,31 @@ def test_v4_shapes():
     assert plan_slices(4, 4, "v4") == SlicePlan("v4-32", 1, 4, 16)
 
 
+def test_v5p_shapes_count_tensorcores():
+    # v5p names count TensorCores like v4: v5p-32 = 16 chips on 4 hosts.
+    assert plan_slices(1, 4, "v5p") == SlicePlan("v5p-8", 1, 1, 4)
+    assert plan_slices(4, 4, "v5p") == SlicePlan("v5p-32", 1, 4, 16)
+    # Topology strings that are accelerator names resolve by NAME.
+    conf = _conf(**{
+        keys.instances_key("worker"): 4,
+        keys.tpus_key("worker"): 4,
+        keys.K_TPU_TOPOLOGY: "v5p-32",
+        keys.instances_key("ps"): 0,
+    })
+    assert plan_slices_from_conf(conf)["worker"] == SlicePlan(
+        "v5p-32", 1, 4, 16
+    )
+
+
+def test_v6e_shapes_follow_v5e_pattern():
+    # Trillium: names count chips, 8-chip single host, 4-chip multihost.
+    assert plan_slices(1, 8, "v6e") == SlicePlan("v6e-8", 1, 1, 8)
+    assert plan_slices(4, 4, "v6e") == SlicePlan("v6e-16", 1, 4, 16)
+    assert plan_slices(128, 4, "v6e", strict=True) == SlicePlan(
+        "v6e-256", 2, 64, 256
+    )
+
+
 # ---------------------------------------------------------------------------
 # plan_slices_from_conf
 # ---------------------------------------------------------------------------

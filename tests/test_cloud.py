@@ -385,6 +385,34 @@ class TestGcpQueuedResourceApi:
             ("app2-worker-s1", 2), ("app2-worker-s1", 3),
         ]
 
+    def test_runtime_version_resolves_per_generation(self):
+        """An unset runtime version must resolve to the provisioned
+        accelerator's family image — a fixed v5e image would make every
+        other generation unprovisionable with defaults."""
+        t = FakeTransport()
+        api = self._api(t)
+        for accel, want in (
+            ("v5litepod-16", "v2-alpha-tpuv5-lite"),
+            ("v6e-16", "v2-alpha-tpuv6e"),
+            ("v5p-32", "v2-alpha-tpuv5"),
+            ("v4-32", "tpu-ubuntu2204-base"),
+        ):
+            t.expect("POST", r"queued_resource_id=", 200, {})
+            api.create_slice(f"j-{accel}", accel, 1)
+            spec = json.loads(t.requests[-1][2])
+            got = spec["tpu"]["nodeSpec"][0]["node"]["runtimeVersion"]
+            assert got == want, (accel, got)
+        # explicit override still wins
+        api2 = GcpQueuedResourceApi(
+            "proj", "z", transport=t, runner=FakeRunner(),
+            runtime_version="my-custom-image",
+        )
+        t.expect("POST", r"queued_resource_id=", 200, {})
+        api2.create_slice("j-x", "v6e-16", 1)
+        spec = json.loads(t.requests[-1][2])
+        assert (spec["tpu"]["nodeSpec"][0]["node"]["runtimeVersion"]
+                == "my-custom-image")
+
     def test_restart_relearns_shape_from_response_fixture(self):
         """A coordinator restarted mid-flight has an empty _groups map and
         must re-learn the slice shape from a GET — the fixture mirrors the

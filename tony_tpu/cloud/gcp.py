@@ -276,6 +276,28 @@ class GcpApiError(RuntimeError):
         self.status = status
 
 
+# Default TPU-VM runtime image per accelerator family (the published
+# Cloud TPU software-version names): an empty runtime_version resolves
+# against the accelerator being provisioned — a fixed v5e image would
+# make every other generation unprovisionable with defaults.
+_RUNTIME_BY_FAMILY = (
+    ("v5litepod", "v2-alpha-tpuv5-lite"),
+    ("v6e", "v2-alpha-tpuv6e"),
+    ("v5p", "v2-alpha-tpuv5"),
+    ("v4", "tpu-ubuntu2204-base"),
+)
+
+
+def default_runtime_version(accelerator_type: str) -> str:
+    for prefix, runtime in _RUNTIME_BY_FAMILY:
+        if accelerator_type.startswith(prefix):
+            return runtime
+    raise ValueError(
+        f"no default runtime version for accelerator "
+        f"{accelerator_type!r} — set tony.gcp.runtime-version"
+    )
+
+
 # queuedResources state -> the backend's 3-state model. Unlisted states
 # (ACCEPTED, PROVISIONING, WAITING_FOR_RESOURCES, CREATING, ...) map to
 # CREATING: still in flight.
@@ -302,7 +324,7 @@ class GcpQueuedResourceApi:
         project: str,
         zone: str,
         *,
-        runtime_version: str = "v2-alpha-tpuv5-lite",
+        runtime_version: str = "",
         transport: HttpTransport | None = None,
         runner: CommandRunner | None = None,
         python: str = "python3",
@@ -361,7 +383,10 @@ class GcpQueuedResourceApi:
         # recorded responses (VERDICT r3 missing #3).
         node = {
             "acceleratorType": accelerator_type,
-            "runtimeVersion": self.runtime_version,
+            "runtimeVersion": (
+                self.runtime_version
+                or default_runtime_version(accelerator_type)
+            ),
         }
         if self.network:
             node["networkConfig"] = {"network": self.network}

@@ -147,7 +147,7 @@ DEFAULTS: dict[str, object] = {
     K_TPU_SLICE_STRICT: False,
     K_GCP_PROJECT: "",
     K_GCP_ZONE: "",
-    K_GCP_RUNTIME_VERSION: "v2-alpha-tpuv5-lite",
+    K_GCP_RUNTIME_VERSION: "",  # empty = per-generation default (cloud.gcp)
     K_GCP_NETWORK: "",
     K_AM_ADDRESS_HOST: "",
     K_STAGING_LOCATION: "",

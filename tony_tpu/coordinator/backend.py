@@ -217,10 +217,14 @@ class LocalProcessBackend:
 #   -64 = 16, -128 = 32, -256 = 64. (An 8-chip host exists only for the
 #   single-host v5litepod-8.) Getting this wrong halves the executor count
 #   on real multihost slices.
-# * v4 accelerator-type numbers count TensorCores, not chips (v4-8 = 4
-#   chips); every v4 host VM has 4 chips, so a v4 slice of C chips has
-#   C/4 workers. Keys below are CHIP counts (what ``tony.<job>.tpus``
-#   asks for), values carry the GCP accelerator-type name.
+# * v4 and v5p accelerator-type numbers count TensorCores, not chips
+#   (v4-8 / v5p-8 = 4 chips); every v4/v5p host VM has 4 chips, so a
+#   slice of C chips has C/4 workers.
+# * v6e (Trillium) follows the v5e pattern: the name counts chips,
+#   single-host shapes up to 8 chips, multihost slices tiled from
+#   4-chip hosts.
+#   Keys below are CHIP counts (what ``tony.<job>.tpus`` asks for),
+#   values carry the GCP accelerator-type name.
 SLICE_SHAPES: dict[str, dict[int, tuple[str, int]]] = {
     "v5e": {
         1: ("v5litepod-1", 1),
@@ -232,12 +236,31 @@ SLICE_SHAPES: dict[str, dict[int, tuple[str, int]]] = {
         128: ("v5litepod-128", 32),
         256: ("v5litepod-256", 64),
     },
+    "v6e": {
+        1: ("v6e-1", 1),
+        4: ("v6e-4", 1),
+        8: ("v6e-8", 1),
+        16: ("v6e-16", 4),
+        32: ("v6e-32", 8),
+        64: ("v6e-64", 16),
+        128: ("v6e-128", 32),
+        256: ("v6e-256", 64),
+    },
     "v4": {
         4: ("v4-8", 1),
         8: ("v4-16", 2),
         16: ("v4-32", 4),
         32: ("v4-64", 8),
         64: ("v4-128", 16),
+    },
+    "v5p": {
+        4: ("v5p-8", 1),
+        8: ("v5p-16", 2),
+        16: ("v5p-32", 4),
+        32: ("v5p-64", 8),
+        64: ("v5p-128", 16),
+        128: ("v5p-256", 32),
+        256: ("v5p-512", 64),
     },
 }
 
