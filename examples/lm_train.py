@@ -38,11 +38,10 @@ from tony_tpu.models import TransformerConfig, make_train_step
 from tony_tpu.parallel.mesh import MeshSpec
 
 
-def parse_args(argv):
-    p = argparse.ArgumentParser(description="tony_tpu flagship LM example")
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--seq", type=int, default=128)
+def add_model_args(p: argparse.ArgumentParser) -> None:
+    """Model flags shared verbatim with lm_generate.py — one definition
+    so a checkpoint trained with defaults always restores with defaults
+    (flag-default drift surfaces as opaque pytree mismatches)."""
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=4)
@@ -51,8 +50,34 @@ def parse_args(argv):
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--dtype", default="float32",
                    help="float32 on CPU, bfloat16 on TPU")
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="tony_tpu flagship LM example")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    add_model_args(p)
     p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--ckpt-dir", default="",
+                   help="checkpoint dir or gs:// prefix (default: the "
+                        "job's TONY_LOG_DIR scratch)")
     return p.parse_args(argv)
+
+
+def model_config_from_args(args, *, max_seq: int) -> TransformerConfig:
+    """The single source of the arg→config derivation: lm_generate.py
+    imports this so a checkpoint written here always restores there —
+    drift in head_dim/d_ff derivation would surface as opaque pytree
+    mismatches at restore time."""
+    return TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads,
+        head_dim=max(8, args.d_model // args.n_heads),
+        d_ff=args.d_model * 4, max_seq=max_seq,
+        n_kv_heads=args.n_kv_heads, n_experts=args.n_experts,
+        dtype=args.dtype, remat=False,
+    )
 
 
 def synthetic_tokens(seed: int, n_docs: int, seq: int, vocab: int):
@@ -79,14 +104,7 @@ def main(argv=None) -> int:
           f"{ctx.num_processes} slice {ctx.slice_index}/{ctx.num_slices} "
           f"mesh {dict(mesh.shape)}", flush=True)
 
-    cfg = TransformerConfig(
-        vocab_size=args.vocab, d_model=args.d_model,
-        n_layers=args.n_layers, n_heads=args.n_heads,
-        head_dim=max(8, args.d_model // args.n_heads),
-        d_ff=args.d_model * 4, max_seq=args.seq + 1,
-        n_kv_heads=args.n_kv_heads, n_experts=args.n_experts,
-        dtype=args.dtype, remat=False,
-    )
+    cfg = model_config_from_args(args, max_seq=args.seq + 1)
     init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-2)
 
     # Per-process shard of the corpus via the framework's exactly-once
@@ -95,8 +113,10 @@ def main(argv=None) -> int:
     shard = corpus[ctx.process_id::max(ctx.num_processes, 1)]
 
     scratch = os.environ.get("TONY_LOG_DIR", ".")
+    # NOT wrapped in Path(): --ckpt-dir may be a gs:// prefix.
+    ckpt_dir = args.ckpt_dir or os.path.join(scratch, "lm-checkpoints")
     mgr = CheckpointManager(
-        os.path.join(scratch, "lm-checkpoints"),
+        ckpt_dir,
         process_id=ctx.process_id, num_processes=ctx.num_processes,
     )
     with jax.sharding.set_mesh(mesh):

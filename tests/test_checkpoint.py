@@ -138,6 +138,21 @@ def test_gc_spares_recently_written_torn_dirs(tmp_path):
     assert (tmp_path / "step_0").exists()
 
 
+def test_cross_topology_restore_raises_not_truncates(tmp_path):
+    """A 1-process restore of a checkpoint whose leaves are per-process
+    SHARDS (different topology) must raise, not silently hand back
+    wrong-shaped arrays (found live: a standalone serving job restoring a
+    2-process training checkpoint got half of every sharded leaf)."""
+    # Simulate a shard file: the saved piece is half the template leaf.
+    half = {"w": jnp.ones((4, 2))}
+    CheckpointManager(tmp_path, process_id=0, num_processes=1).save(
+        1, half, blocking=True
+    )
+    full_template = {"w": jnp.zeros((8, 2))}
+    with pytest.raises(ValueError, match="topology"):
+        CheckpointManager(tmp_path).restore(full_template)
+
+
 def test_structure_mismatch_raises(tmp_path):
     mgr = CheckpointManager(tmp_path)
     mgr.save(1, _state(1.0), blocking=True)

@@ -60,6 +60,30 @@ def test_lm_example_trains_and_checkpoints():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def test_lm_generate_serves_trained_checkpoint(tmp_path):
+    """The inference half: lm_train checkpoints to a shared dir, then
+    lm_generate restores the TrainState through a second CLI job, builds
+    a DecodeSession, and decodes — train-to-serve through the framework
+    end to end (lm_generate exits 2 when no checkpoint is restorable, so
+    rc 0 proves the restore happened)."""
+    model_flags = ("--d-model 32 --n-layers 2 --n-heads 2 --n-kv-heads 1")
+    ckpt = tmp_path / "lm-ckpt"
+    train = _submit(
+        "lm_train.py", "jax", workers=1,
+        extra=["--task_params",
+               f"--steps 8 {model_flags} --batch 4 --seq 32 "
+               f"--checkpoint-every 4 --ckpt-dir {ckpt}"],
+    )
+    assert train.returncode == 0, train.stderr[-2000:]
+    gen = _submit(
+        "lm_generate.py", "jax", workers=1,
+        extra=["--task_params",
+               f"--ckpt {ckpt} {model_flags} --max-new 8 "
+               f"--prompt 1,5,9:7,2"],
+    )
+    assert gen.returncode == 0, gen.stderr[-2000:]
+
+
 def test_jax_example_with_ps():
     """BASELINE config 2 shape: 1 ps + 2 workers through the gang barrier
     (all three run the user script, like the reference's shared-script ps

@@ -406,6 +406,19 @@ class CheckpointManager:
                 tuple(info["shape"]), template.sharding, arrays
             )
         value = pieces[0]
+        if tuple(value.shape) != tuple(getattr(template, "shape", value.shape)):
+            # A fully-addressable template restoring a per-process SHARD
+            # file of some other topology: returning the shard would
+            # silently hand the caller wrong-shaped weights (found live:
+            # a 1-process serving job restoring a 2-process training
+            # checkpoint got half of every sharded leaf).
+            raise ValueError(
+                f"leaf {key!r}: checkpoint piece has shape "
+                f"{tuple(value.shape)} but the template expects "
+                f"{tuple(template.shape)} — the checkpoint was written "
+                f"under a different process/sharding topology; restore "
+                f"with the same num_processes/mesh that saved it"
+            )
         if sharding is not None:
             return jax.device_put(value, sharding)
         return value
