@@ -110,30 +110,6 @@ class TestTrainStep:
         for k in ("moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy"):
             assert np.isfinite(float(metrics[k])), k
 
-    def test_layer_scan_unroll_is_pure_scheduling(self):
-        """layer_scan_unroll must not change the math: same params, same
-        batch, identical loss and grads rolled vs fully unrolled (the
-        unroll exists to kill the rolled scan's unaliasable stacked-grad
-        copies — a measured 7% step-time win on the flagship config)."""
-        import dataclasses
-
-        tokens = _tokens()
-        params = jax.jit(lambda k: init_params(k, CFG))(jax.random.key(3))
-        mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
-        cfg_u = dataclasses.replace(CFG, layer_scan_unroll=CFG.n_layers)
-
-        def loss(cfg):
-            return lambda p, t: lm_loss(p, t, cfg, mesh)
-
-        with jax.sharding.set_mesh(mesh):
-            l1, g1 = jax.jit(jax.value_and_grad(loss(CFG)))(params, tokens)
-            l2, g2 = jax.jit(jax.value_and_grad(loss(cfg_u)))(params, tokens)
-        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
-        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
-            )
-
     def test_moe_balance_loss_recovers_biased_router(self):
         """Start from a router collapsed onto expert 0 (shrunk weights plus
         an expert-0 column aligned with the batch's activation directions):
@@ -202,6 +178,34 @@ class TestTrainStep:
                 losses.append(float(metrics["loss"]))
         assert all(np.isfinite(losses))
         assert losses[2] < losses[0]
+
+    def test_unrolled_layer_loop_matches_scan(self):
+        """layer_scan_unroll >= n_layers takes the static Python-loop
+        path (grads avoid scan's stacked-grad DUS); it must be the same
+        math as the rolled scan — loss AND grads."""
+        import dataclasses
+
+        tokens = _tokens()
+        params = jax.jit(lambda k: init_params(k, CFG))(jax.random.key(3))
+        mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        out = {}
+        for unroll in (1, CFG.n_layers):
+            cfg = dataclasses.replace(CFG, layer_scan_unroll=unroll)
+            with jax.sharding.set_mesh(mesh):
+                loss, grads = jax.jit(jax.value_and_grad(
+                    lambda p, t, c=cfg: lm_loss(p, t, c, mesh)
+                ))(params, tokens)
+            out[unroll] = (float(loss), grads)
+        np.testing.assert_allclose(out[1][0], out[CFG.n_layers][0],
+                                   rtol=1e-6)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(out[1][1])[0],
+            jax.tree_util.tree_flatten_with_path(out[CFG.n_layers][1])[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7,
+                err_msg=str(path),
+            )
 
     def test_pipeline_loss_matches_gspmd(self):
         """Same params, same batch: the pp=2 manual trunk and the GSPMD

@@ -394,12 +394,27 @@ def forward(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
-    def scan_body(carry, lp):
-        return layer_fn(carry, lp)
+    if cfg.layer_scan_unroll >= cfg.n_layers:
+        # Fully unrolled: a static Python loop over static slices beats
+        # scan-with-unroll — even unrolled, scan's stacked-grad updates
+        # lower to dynamic-update-slices XLA cannot fully fuse (measured
+        # +2% step throughput from the static loop at L=8/2k).
+        aux_list = []
+        for layer in range(cfg.n_layers):
+            lp = jax.tree.map(lambda p: p[layer], params["layers"])
+            x, aux_l = layer_fn(x, lp)
+            aux_list.append(aux_l)
+        aux_layers = (
+            None if aux_list[0] is None
+            else jax.tree.map(lambda *xs: jnp.stack(xs), *aux_list)
+        )
+    else:
+        def scan_body(carry, lp):
+            return layer_fn(carry, lp)
 
-    x, aux_layers = lax.scan(
-        scan_body, x, params["layers"], unroll=cfg.layer_scan_unroll
-    )
+        x, aux_layers = lax.scan(
+            scan_body, x, params["layers"], unroll=cfg.layer_scan_unroll
+        )
     x = rms_norm(x, params["final_norm"]).astype(dt)
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(dt))
     logits = with_logical_constraint(logits, "batch", "seq", "vocab", mesh=mesh)
