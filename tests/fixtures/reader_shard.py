@@ -2,9 +2,11 @@
 tony_tpu.runtime.sharded_reader (identity from the injected env) and writes
 the record ids it read to TONY_LOG_DIR; the test asserts the shards form an
 exact cover — every record read exactly once across the job."""
+import glob
 import json
 import os
 import sys
+import time
 
 import tony_tpu.runtime as rt
 
@@ -25,7 +27,20 @@ reader.close()
 
 out = os.path.join(os.environ["TONY_LOG_DIR"],
                    f"reader-shard-{ctx.process_id}.json")
-with open(out, "w") as f:
+tmp = out + ".tmp"
+with open(tmp, "w") as f:
     json.dump(ids, f)
+os.rename(tmp, out)
 print(f"process {ctx.process_id} read {len(ids)} records")
+
+# Chief success ends the SESSION (reference semantics) and teardown then
+# kills stragglers — so every worker waits for the full shard set before
+# exiting, or a slow peer's file could be lost mid-write under load.
+deadline = time.time() + 60
+while len(glob.glob(os.path.join(
+        os.environ["TONY_LOG_DIR"], "reader-shard-*.json"))) < ctx.num_processes:
+    if time.time() > deadline:
+        print("timed out waiting for peer shards", file=sys.stderr)
+        sys.exit(6)
+    time.sleep(0.1)
 sys.exit(0)
