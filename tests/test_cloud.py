@@ -413,6 +413,12 @@ class TestGcpQueuedResourceApi:
         assert (spec["tpu"]["nodeSpec"][0]["node"]["runtimeVersion"]
                 == "my-custom-image")
 
+    def test_unknown_accelerator_runtime_raises_with_guidance(self):
+        from tony_tpu.cloud.gcp import default_runtime_version
+
+        with pytest.raises(ValueError, match="tony.gcp.runtime-version"):
+            default_runtime_version("v99-frobnicator-8")
+
     def test_restart_relearns_shape_from_response_fixture(self):
         """A coordinator restarted mid-flight has an empty _groups map and
         must re-learn the slice shape from a GET — the fixture mirrors the
