@@ -60,10 +60,27 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 _MANIFEST = "__manifest__"
 
 
+def _normalize_index(
+    index: tuple, shape: tuple[int, ...]
+) -> list[list[int]]:
+    """A shard's ``.index`` (tuple of slices) -> [[start, stop], ...] per
+    dim, JSON-able. This is what lets a LATER restore under a different
+    topology paste the piece back into the right region of the global
+    array (the manifest's cross-topology coordinates)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
 def _snapshot_leaf(leaf: Any) -> tuple[list[np.ndarray], dict]:
     """Host copies of this process's pieces of ``leaf`` plus manifest info.
     Fully-addressable arrays (single process, or replicated locally) are one
-    piece; global arrays contribute one piece per addressable shard."""
+    piece; global arrays contribute one piece per addressable shard. Each
+    piece's global-coordinate index rides the manifest so a different
+    topology can reassemble (see ``CheckpointManager.restore``)."""
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
         shards = leaf.addressable_shards
         pieces = [np.asarray(s.data) for s in shards]
@@ -72,6 +89,9 @@ def _snapshot_leaf(leaf: Any) -> tuple[list[np.ndarray], dict]:
             "shape": list(leaf.shape),
             "num_shards": len(pieces),
             "shard_shapes": [list(p.shape) for p in pieces],
+            "shard_indices": [
+                _normalize_index(s.index, leaf.shape) for s in shards
+            ],
         }
     arr = np.asarray(jax.device_get(leaf))
     return [arr], {
@@ -79,6 +99,7 @@ def _snapshot_leaf(leaf: Any) -> tuple[list[np.ndarray], dict]:
         "shape": list(arr.shape),
         "num_shards": 1,
         "shard_shapes": [list(arr.shape)],
+        "shard_indices": [[[0, d] for d in arr.shape]],
     }
 
 
@@ -183,15 +204,15 @@ class _ObjectCheckpointStore:
                 return None
             raise
 
-    def _entries(self) -> list[tuple[int, str, float]]:
+    def _entries(self) -> list[tuple[int, str, float | None]]:
         from tony_tpu.cloud.gcs import split_gs_uri
 
         _, root_key = split_gs_uri(self.prefix)
         store = self._store()
         if hasattr(store, "list_prefix_mtimes"):
             listed = store.list_prefix_mtimes(self.prefix + "/")
-        else:  # minimal fakes: no timestamps -> everything quiescent
-            listed = [(k, 0.0) for k in store.list_prefix(self.prefix + "/")]
+        else:  # minimal fakes: no timestamps -> age unknown = active
+            listed = [(k, None) for k in store.list_prefix(self.prefix + "/")]
         out = []
         for key, mtime in listed:
             rel = key[len(root_key):].lstrip("/") if root_key else key
@@ -206,13 +227,21 @@ class _ObjectCheckpointStore:
     def step_entries(self) -> dict[int, tuple[set[str], float | None]]:
         """One listing pass serves names AND quiescence stamps — a GCS
         list is a paged network round-trip, so per-step re-listing would
-        multiply control-plane traffic by the torn-step count."""
+        multiply control-plane traffic by the torn-step count. Any object
+        with an unknown age makes its whole step read as active (None)."""
         out: dict[int, tuple[set[str], float | None]] = {}
+        seen_none: set[int] = set()
         for step, name, mtime in self._entries():
             names, newest = out.get(step, (set(), 0.0))
-            names.add(name)
-            out[step] = (names, max(newest or 0.0, mtime))
-        return out
+            if mtime is None:
+                seen_none.add(step)
+            else:
+                newest = max(newest or 0.0, mtime)
+            out[step] = (names | {name}, newest)
+        return {
+            step: (names, None if step in seen_none else newest)
+            for step, (names, newest) in out.items()
+        }
 
     def delete_step(self, step: int) -> None:
         from tony_tpu.cloud.gcs import split_gs_uri
@@ -349,7 +378,27 @@ class CheckpointManager:
         """Load the newest complete checkpoint (or ``step``, if complete)
         into the structure — and shardings — of ``state_template``. Returns
         None when nothing restorable exists (including an explicit ``step``
-        that is missing or torn)."""
+        that is missing or torn).
+
+        Topology-portable: when the template's process/sharding topology
+        matches the one that saved, each process reads only its own shard
+        file (fast path, no remote bytes). When they differ — train on a
+        slice, serve on one host, or resume onto a different mesh — the
+        restore reassembles each leaf's GLOBAL value from ALL processes'
+        shard files via the manifest's recorded shard coordinates, then
+        re-shards onto the template's sharding. This matches the
+        topology-independent restore the reference's user scripts got from
+        TF full-tensor checkpoints (tony-examples/mnist-tensorflow/
+        mnist_distributed.py:46-48). The reassembly path keeps each donor
+        shard file's raw bytes but decodes only the CURRENT leaf's blobs
+        (npz members decompress on access), so peak host memory is about
+        the checkpoint's on-disk size plus one assembled leaf — never a
+        fully decoded copy of every file at once.
+
+        Restoring onto MORE processes than saved also works: ranks beyond
+        the saved count have no shard file of their own and assemble
+        every leaf from the donor files (process 0's manifest supplies
+        the structure)."""
         complete = self._complete_steps()
         if step is None:
             if not complete:
@@ -357,71 +406,201 @@ class CheckpointManager:
             step = complete[-1]
         elif step not in complete:
             return None
+
+        saved_n = self._saved_num_processes(step)
+        force_cross = False
+        own_id = self.process_id
+        if self.process_id >= saved_n:
+            # This rank did not exist when the checkpoint was written
+            # (fewer processes saved than now restore): no own shard file
+            # — every leaf reassembles from the donor files; process 0's
+            # manifest describes the structure.
+            own_id, force_cross = 0, True
+        own = self._read_shard_file(step, own_id)
+        if own is None:  # deleted between listing and read
+            return None
+        manifest, blobs = own
+        # Lazily-populated cache of donor shard files — only fetched when
+        # some leaf actually needs cross-topology assembly; closed (raw
+        # bytes released) when the restore finishes.
+        others: dict[int, tuple[dict, Any]] = {own_id: own}
+        try:
+            flat = jax.tree_util.tree_flatten_with_path(state_template)
+            leaves = []
+            for key_path, leaf in flat[0]:
+                key = jax.tree_util.keystr(key_path)
+                info = manifest.get(key)
+                if info is None:
+                    raise ValueError(
+                        f"checkpoint step {step} is missing leaf {key!r} — "
+                        f"model/optimizer structure changed since it was "
+                        f"written"
+                    )
+                if not force_cross and self._fast_path_ok(leaf, info):
+                    pieces = [
+                        _decode(blobs[f"{key}#s{i}"], info["dtype"],
+                                info["shard_shapes"][i])
+                        for i in range(info["num_shards"])
+                    ]
+                    leaves.append(
+                        self._restore_leaf_same_topology(leaf, pieces, info)
+                    )
+                else:
+                    leaves.append(
+                        self._restore_leaf_cross_topology(
+                            leaf, info, key, step, saved_n, others
+                        )
+                    )
+            return jax.tree_util.tree_unflatten(flat[1], leaves)
+        finally:
+            for _, npz in others.values():
+                npz.close()
+
+    def _saved_num_processes(self, step: int) -> int:
+        raw = self._store.get_file(step, "metadata.json")
+        if raw is None:
+            return self.num_processes
+        try:
+            return int(json.loads(raw).get("num_processes", self.num_processes))
+        except ValueError:
+            return self.num_processes
+
+    def _read_shard_file(
+        self, step: int, process_id: int
+    ) -> tuple[dict, Any] | None:
+        """(manifest, open NpzFile). The NpzFile decodes members lazily on
+        access, so holding one costs the file's raw bytes — not a decoded
+        copy of every array; callers close() it when done."""
         import io
 
-        raw = self._store.get_file(step, f"process_{self.process_id}.npz")
-        if raw is None:  # deleted between listing and read
+        raw = self._store.get_file(step, f"process_{process_id}.npz")
+        if raw is None:
             return None
-        with np.load(io.BytesIO(raw)) as data:
-            manifest = json.loads(bytes(data[_MANIFEST]).decode())
-            blobs = {k: data[k] for k in data.files if k != _MANIFEST}
-        flat = jax.tree_util.tree_flatten_with_path(state_template)
-        leaves = []
-        for key_path, leaf in flat[0]:
-            key = jax.tree_util.keystr(key_path)
-            info = manifest.get(key)
-            if info is None:
-                raise ValueError(
-                    f"checkpoint step {step} is missing leaf {key!r} — "
-                    f"model/optimizer structure changed since it was written"
-                )
-            pieces = [
-                _decode(blobs[f"{key}#s{i}"], info["dtype"],
-                        info["shard_shapes"][i])
-                for i in range(info["num_shards"])
-            ]
-            leaves.append(self._restore_leaf(leaf, pieces, info, key))
-        return jax.tree_util.tree_unflatten(flat[1], leaves)
+        data = np.load(io.BytesIO(raw))
+        manifest = json.loads(bytes(data[_MANIFEST]).decode())
+        return manifest, data
 
-    def _restore_leaf(
-        self, template: Any, pieces: list[np.ndarray], info: dict, key: str
+    def _fast_path_ok(self, template: Any, info: dict) -> bool:
+        """True when this process's own shard file lines up exactly with
+        the template's addressable shards — same count, same global shape,
+        and (when the manifest records them) identical shard coordinates
+        in identical order."""
+        if (
+            isinstance(template, jax.Array)
+            and not template.is_fully_addressable
+        ):
+            shards = template.addressable_shards
+            if len(shards) != info["num_shards"]:
+                return False
+            if tuple(template.shape) != tuple(info["shape"]):
+                return False
+            recorded = info.get("shard_indices")
+            if recorded is None:
+                return True  # pre-r5 checkpoint: only the old fast path exists
+            return all(
+                _normalize_index(s.index, template.shape) == recorded[i]
+                for i, s in enumerate(shards)
+            )
+        shape = tuple(getattr(template, "shape", ()))
+        # The single piece must SPAN the global shape — a multi-process
+        # save records the global shape but each file holds only a slab.
+        return (
+            info["num_shards"] == 1
+            and tuple(info["shape"]) == shape
+            and tuple(info["shard_shapes"][0]) == shape
+        )
+
+    def _restore_leaf_same_topology(
+        self, template: Any, pieces: list[np.ndarray], info: dict
     ) -> Any:
         sharding = getattr(template, "sharding", None)
         if (
             isinstance(template, jax.Array)
             and not template.is_fully_addressable
         ):
-            shards = template.addressable_shards
-            if len(shards) != len(pieces):
-                raise ValueError(
-                    f"leaf {key!r}: checkpoint has {len(pieces)} local "
-                    f"shards but the template sharding expects "
-                    f"{len(shards)} — save/restore topologies must match"
-                )
             arrays = [
                 jax.device_put(piece, shard.device)
-                for piece, shard in zip(pieces, shards)
+                for piece, shard in zip(pieces, template.addressable_shards)
             ]
             return jax.make_array_from_single_device_arrays(
                 tuple(info["shape"]), template.sharding, arrays
             )
         value = pieces[0]
-        if tuple(value.shape) != tuple(getattr(template, "shape", value.shape)):
-            # A fully-addressable template restoring a per-process SHARD
-            # file of some other topology: returning the shard would
-            # silently hand the caller wrong-shaped weights (found live:
-            # a 1-process serving job restoring a 2-process training
-            # checkpoint got half of every sharded leaf).
-            raise ValueError(
-                f"leaf {key!r}: checkpoint piece has shape "
-                f"{tuple(value.shape)} but the template expects "
-                f"{tuple(template.shape)} — the checkpoint was written "
-                f"under a different process/sharding topology; restore "
-                f"with the same num_processes/mesh that saved it"
-            )
         if sharding is not None:
             return jax.device_put(value, sharding)
         return value
+
+    def _restore_leaf_cross_topology(
+        self, template: Any, info: dict, key: str, step: int, saved_n: int,
+        others: dict[int, tuple[dict, Any]],
+    ) -> Any:
+        """Reassemble ``key``'s global value from every process's recorded
+        shard coordinates, then place it under the template's sharding."""
+        shape = tuple(info["shape"])
+        t_shape = tuple(getattr(template, "shape", shape))
+        if shape != t_shape:
+            raise ValueError(
+                f"leaf {key!r}: checkpoint global shape {shape} does not "
+                f"match the template's {t_shape} — the model/optimizer "
+                f"definition changed since the checkpoint was written"
+            )
+        if info.get("shard_indices") is None:
+            raise ValueError(
+                f"leaf {key!r}: the checkpoint predates shard-coordinate "
+                f"manifests (pre-r5) and its topology differs from the "
+                f"template's — restore with the same num_processes/mesh "
+                f"that saved it, or re-save under the current format"
+            )
+        out = np.empty(shape, dtype=np.dtype(info["dtype"]))
+        filled = np.zeros(shape, dtype=bool) if shape else None
+        wrote_any = False
+        for p in range(saved_n):
+            entry = others.get(p)
+            if entry is None:
+                entry = self._read_shard_file(step, p)
+                if entry is None:
+                    raise ValueError(
+                        f"checkpoint step {step}: shard file for process "
+                        f"{p} vanished during cross-topology restore"
+                    )
+                others[p] = entry
+            p_manifest, p_blobs = entry
+            p_info = p_manifest.get(key)
+            if p_info is None:
+                raise ValueError(
+                    f"leaf {key!r}: missing from process {p}'s shard file "
+                    f"at step {step} — inconsistent checkpoint"
+                )
+            for i, index in enumerate(p_info["shard_indices"]):
+                piece = _decode(
+                    p_blobs[f"{key}#s{i}"], p_info["dtype"],
+                    p_info["shard_shapes"][i],
+                )
+                region = tuple(slice(a, b) for a, b in index)
+                out[region] = piece
+                wrote_any = True
+                if filled is not None:
+                    filled[region] = True
+            # Replicated leaves are saved full-span by EVERY process —
+            # stop at full coverage instead of redundantly decoding the
+            # same bytes saved_n times (the serve-on-one-host critical
+            # path restores the whole param tree this way).
+            if wrote_any and (filled is None or filled.all()):
+                break
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"leaf {key!r}: the union of all processes' shards does "
+                f"not cover the global array at step {step} — torn or "
+                f"inconsistent checkpoint"
+            )
+        sharding = getattr(template, "sharding", None)
+        if isinstance(template, jax.Array) and sharding is not None:
+            # Covers single-process and multi-process templates alike:
+            # each process materializes only its addressable shards.
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: out[idx]
+            )
+        return out
 
     # -- gc -----------------------------------------------------------------
     def _gc(self) -> None:
@@ -436,21 +615,38 @@ class CheckpointManager:
         complete = self._complete_steps(entries)
         kept = set(complete[-self.max_to_keep:])
         threshold = min(kept) if kept else None
+        now = self._now_reference(entries)
         for n, (_, newest) in entries.items():
             stale_complete = n in set(complete) - kept
             torn_and_old = (
                 n not in complete
                 and threshold is not None
                 and n < threshold
-                and self._quiescent(newest)
+                and self._quiescent(newest, now)
             )
             if stale_complete or torn_and_old:
                 self._store.delete_step(n)
 
-    def _quiescent(self, newest: float | None) -> bool:
+    def _now_reference(
+        self, entries: dict[int, tuple[set[str], float | None]]
+    ) -> float | None:
+        """Clock the quiescence check reads ages against. For object
+        stores the ``updated`` stamps are SERVER time — comparing them to
+        local time.time() would let client clock skew eat into (or
+        inflate) the grace window, so "now" is the newest stamp observed
+        in the same listing (server-clock deltas, NTP-free). FS mtimes
+        come from the local clock, so time.time() is the right reference
+        there. None = no usable stamp observed -> nothing is quiescent."""
+        if isinstance(self._store, _ObjectCheckpointStore):
+            stamps = [t for _, t in entries.values() if t is not None]
+            return max(stamps) if stamps else None
+        return time.time()
+
+    def _quiescent(self, newest: float | None, now: float | None) -> bool:
         """True when nothing under the step was modified within the grace
         window — a straggler still writing an old step keeps its dir
-        alive. None (files vanishing under the listing) reads as active."""
-        if newest is None:
+        alive. None (files vanishing under the listing, or unknown age)
+        reads as active."""
+        if newest is None or now is None:
             return False
-        return (time.time() - newest) > self.torn_gc_grace_s
+        return (now - newest) > self.torn_gc_grace_s
