@@ -79,15 +79,39 @@ def local_submit(argv: list[str]) -> int:
         return status
 
 
+def _notebook_url(rpc) -> str | None:
+    """The notebook TASK's registered http URL (reference parity:
+    NotebookSubmitter polls getTaskUrls for the notebook task and proxies
+    to its host:port), falling back to the application status'
+    tensorboard_url. On a cluster backend the task URL carries the remote
+    executor's address — the notebook-on-a-TPU-VM path."""
+    try:
+        for t in rpc.get_task_urls():
+            if (
+                t.name == constants.NOTEBOOK_JOB_NAME
+                and t.url and t.url.startswith("http")
+            ):
+                return t.url
+        return rpc.get_application_status().get("tensorboard_url")
+    except Exception:
+        return None  # transient: monitor loop owns giving up
+
+
 def notebook_submit(argv: list[str]) -> int:
-    """Single-node notebook with a local proxy tunnel (the reference polls
-    ``getTaskUrls`` for the ``notebook`` task, then proxies to it, :95-117).
+    """Notebook job with a local proxy tunnel (the reference polls
+    ``getTaskUrls`` for the ``notebook`` task, then proxies to it,
+    NotebookSubmitter.java:95-117).
 
     Wiring: the notebook task is made chief, so the executor reserves a
     port, exports it as ``TB_PORT`` (the notebook server must listen there,
     e.g. ``jupyter --port=$TB_PORT``), and registers
-    ``http://host:port`` with the coordinator; the client polls that
-    registered URL from the application status and tunnels to it."""
+    ``http://host:port`` with the coordinator; the client polls the
+    notebook TASK's registered URL (get_task_urls — falling back to the
+    application status' tensorboard_url) and tunnels the gateway browser
+    to that host:port. On a cluster backend the registered host is the
+    remote executor's address — set ``tony.notebook.tpus`` (or the
+    backend's placement conf) and the notebook runs ON the TPU VM, the
+    reference's notebook-in-a-cluster-container flow."""
     client = TonyClient().init(argv)
     conf = client.conf
     # Single-node app: the notebook is the only task (reference submits with
@@ -109,12 +133,7 @@ def notebook_submit(argv: list[str]) -> int:
             if client.rpc is None:
                 time.sleep(0.5)
                 continue
-            try:
-                status = client.rpc.get_application_status()
-            except Exception:
-                time.sleep(1)  # transient: monitor loop owns giving up
-                continue
-            url = status.get("tensorboard_url")
+            url = _notebook_url(client.rpc)
             if url:
                 m = re.match(r"(?:https?://)?([^:/]+):(\d+)", url)
                 if m:
@@ -135,10 +154,78 @@ def notebook_submit(argv: list[str]) -> int:
             p.stop()
 
 
+def _janitor_api(args, api=None):
+    if api is not None:
+        return api
+    from tony_tpu.cloud import GcpQueuedResourceApi
+
+    return GcpQueuedResourceApi(args.project, args.zone)
+
+
+def _janitor_args(argv: list[str], prog: str):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog=f"tony_tpu.client.cli {prog}",
+        description="Cloud-resource janitor: queued TPU resources by the "
+                    "deterministic {app}-{job} name prefix.",
+    )
+    p.add_argument("--project", required=True)
+    p.add_argument("--zone", required=True)
+    p.add_argument("--prefix", default="",
+                   help="resource-id prefix (an app id lists that job's "
+                        "slice groups; empty lists the whole zone)")
+    if prog == "cleanup":
+        p.add_argument("--dry-run", action="store_true",
+                       help="print what would be deleted, delete nothing")
+    return p.parse_args(argv)
+
+
+def list_resources(argv: list[str], *, api=None) -> int:
+    """``cli list``: enumerate queued resources by app prefix — the
+    discovery half of reattaching to (or auditing) a job whose
+    coordinator died. The reference got resource reaping for free from
+    YARN's RM; TPU queued resources outlive a dead coordinator and keep
+    billing, so the listing must be explicit."""
+    args = _janitor_args(argv, "list")
+    found = _janitor_api(args, api).list_queued_resources(args.prefix)
+    for r in found:
+        print(f"{r['name']}\t{r['state']}\t{r['nodes']} node(s)")
+    if not found:
+        log.info("no queued resources matching prefix %r", args.prefix)
+    return 0
+
+
+def cleanup_resources(argv: list[str], *, api=None) -> int:
+    """``cli cleanup``: delete every queued resource matching the app
+    prefix — the janitor for coordinator crashes (OOM, preemption,
+    kill -9) that skipped ``stop_all``'s delete_slice. Requires an
+    explicit non-empty --prefix: a zone-wide delete is never one typo
+    away."""
+    args = _janitor_args(argv, "cleanup")
+    if not args.prefix:
+        print("cleanup requires --prefix (refusing a zone-wide delete)",
+              file=sys.stderr)
+        return 2
+    tpu_api = _janitor_api(args, api)
+    found = tpu_api.list_queued_resources(args.prefix)
+    for r in found:
+        if args.dry_run:
+            print(f"would delete {r['name']} ({r['state']})")
+        else:
+            tpu_api.delete_slice(r["name"])
+            print(f"deleted {r['name']} (was {r['state']})")
+    if not found:
+        log.info("nothing to clean up under prefix %r", args.prefix)
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
     "notebook": notebook_submit,
+    "list": list_resources,
+    "cleanup": cleanup_resources,
 }
 
 

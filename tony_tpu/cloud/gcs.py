@@ -36,12 +36,14 @@ def split_gs_uri(uri: str) -> tuple[str, str]:
     return bucket, key
 
 
-def _rfc3339_epoch(stamp: str | None) -> float:
+def _rfc3339_epoch(stamp: str | None) -> float | None:
     """GCS ``updated`` stamp ("2026-07-30T12:34:56.789Z") -> epoch
-    seconds; missing/unparseable stamps read as 0 (infinitely old — GC
-    treats the object as quiescent rather than immortal)."""
+    seconds; missing/unparseable stamps read as None ("active") — the
+    checkpoint GC must never treat an object whose age it cannot
+    establish as quiescent, or a straggler's in-flight step could be
+    deleted mid-write (same rule as the FS store's OSError path)."""
     if not stamp:
-        return 0.0
+        return None
     try:
         import datetime
 
@@ -49,7 +51,7 @@ def _rfc3339_epoch(stamp: str | None) -> float:
             stamp.replace("Z", "+00:00")
         ).timestamp()
     except ValueError:
-        return 0.0
+        return None
 
 
 class GcsError(RuntimeError):
@@ -204,12 +206,12 @@ class GcsStorage:
         followed)."""
         return [name for name, _ in self.list_prefix_mtimes(uri)]
 
-    def list_prefix_mtimes(self, uri: str) -> list[tuple[str, float]]:
-        """(key, last-updated epoch seconds) under a prefix — the
-        quiescence signal the checkpoint GC uses (objects carry an
-        ``updated`` RFC3339 stamp in list metadata)."""
+    def list_prefix_mtimes(self, uri: str) -> list[tuple[str, float | None]]:
+        """(key, last-updated epoch seconds or None=age unknown) under a
+        prefix — the quiescence signal the checkpoint GC uses (objects
+        carry an ``updated`` RFC3339 stamp in list metadata)."""
         bucket, prefix = split_gs_uri(uri)
-        out: list[tuple[str, float]] = []
+        out: list[tuple[str, float | None]] = []
         page = ""
         while True:
             url = (

@@ -64,6 +64,18 @@ class _RpcForClient(ApplicationRpc):
 
     def register_tensorboard_url(self, spec: str, url: str) -> str | None:
         self._c.tensorboard_url = url
+        # Also pin the URL on the registering TASK, so get_task_urls
+        # serves the live service endpoint — the reference's
+        # NotebookSubmitter polls getTaskUrls for the notebook task and
+        # proxies to ITS host:port (NotebookSubmitter.java:95-117); on a
+        # TPU-VM backend that host is the remote executor's address, not
+        # the coordinator's. Local backends already carry a log-file URL
+        # per task — those stay (the history page links them); only
+        # url-less (remote) tasks gain the service endpoint.
+        if self._c.session is not None:
+            task = self._c.session.get_task_by_id(spec)
+            if task is not None and task.url is None:
+                task.url = url
         log.info("TensorBoard for %s at %s", spec, url)
         return None
 
