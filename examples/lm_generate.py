@@ -62,16 +62,18 @@ def main(argv=None) -> int:
     from lm_train import model_config_from_args
 
     cfg = model_config_from_args(args, max_seq=args.max_seq)
+    mesh = rt.build_job_mesh()
     if not args.ckpt:
         params = init_params(jax.random.key(args.seed), cfg)
     else:
         # lm_train checkpoints the full TrainState (params + optimizer
         # state), so the restore template must have that structure — the
         # serving job keeps only .params. NOT wrapped in Path(): gs://
-        # URIs must survive verbatim.
+        # URIs must survive verbatim. The restore is topology-portable:
+        # a checkpoint written on MORE (or fewer) processes than this
+        # serving job reassembles from all shard files and re-shards.
         from tony_tpu.models import make_train_step
 
-        mesh = rt.build_job_mesh()
         init_fn, _ = make_train_step(cfg, mesh, learning_rate=1e-2)
         mgr = CheckpointManager(
             args.ckpt, process_id=ctx.process_id,
@@ -99,7 +101,12 @@ def main(argv=None) -> int:
         [[0] * (width - len(r)) + r for r in rows], jnp.int32
     )
 
-    session = DecodeSession(params, cfg)
+    # Serve sharded in place when the job mesh is bigger than one device
+    # (fused weights megatron-split over tp, KV cache sharded); a 1-device
+    # mesh serves exactly like the plain session.
+    session = DecodeSession(
+        params, cfg, mesh=mesh if mesh.devices.size > 1 else None
+    )
     out = session.generate(
         prompt, max_new_tokens=args.max_new,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,

@@ -327,11 +327,92 @@ class TestTrainStep:
             pytest.approx(m * layers / pp)
         )
 
-    def test_moe_requires_gspmd_trunk(self):
-        cfg = TransformerConfig(n_experts=4, n_layers=2)
-        mesh = build_mesh(MeshSpec(pp=2, dp=4))
+    def test_moe_pipeline_matches_gspmd_loss_and_grads(self):
+        """MoE through the pipeline trunk (VERDICT r4 weak #1): pp=2×ep=2
+        ×tp=2 manual-collective experts (resident E/ep slabs, all_to_all
+        token exchange) produce the same total loss AND gradients as the
+        GSPMD MoE trunk on a dp=2×ep=2×tp=2 mesh. Capacity factor = E so
+        nothing drops — the two trunks then compute identical math."""
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+            head_dim=16, d_ff=64, max_seq=64, dtype="float32",
+            remat=False, n_experts=4, expert_top_k=2, capacity_factor=4.0,
+        )
+        tokens = _tokens(b=8, t=17, vocab=128)
+        params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(7))
+        gmesh = build_mesh(MeshSpec(dp=2, ep=2, tp=2))
+        pmesh = build_mesh(MeshSpec(pp=2, ep=2, tp=2))
+
+        with jax.sharding.set_mesh(gmesh):
+            lg, gg = jax.jit(jax.value_and_grad(
+                lambda p, t: lm_loss(p, t, cfg, gmesh)
+            ))(params, tokens)
+        with jax.sharding.set_mesh(pmesh):
+            lp_, gp_ = jax.jit(jax.value_and_grad(
+                lambda p, t: lm_loss(p, t, cfg, pmesh,
+                                     pipeline_microbatches=1)
+            ))(params, tokens)
+        np.testing.assert_allclose(float(lp_), float(lg), rtol=2e-5)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(gg)[0],
+            jax.tree_util.tree_flatten_with_path(gp_)[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+                err_msg=str(path),
+            )
+
+    def test_moe_pipeline_microbatched_aux_metrics(self):
+        """Microbatched (m=2) MoE pipeline: aux losses accumulate across
+        microbatches and average — the train step surfaces finite router
+        metrics with zero drops at generous capacity, and the interleaved
+        schedule's loss AND grads match GPipe's (same math, different
+        scheduling — including the per-schedule aux accumulation)."""
+        from tony_tpu.models import make_train_step
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=4, n_heads=2,
+            head_dim=16, d_ff=64, max_seq=64, dtype="float32",
+            remat=False, n_experts=4, expert_top_k=2, capacity_factor=4.0,
+        )
+        tokens = _tokens(b=8, t=17, vocab=128)
+        pmesh = build_mesh(MeshSpec(pp=2, ep=2, tp=2))
+        with jax.sharding.set_mesh(pmesh):
+            init_fn, step_fn = make_train_step(
+                cfg, pmesh, pipeline_microbatches=2
+            )
+            state = init_fn(jax.random.key(0))
+            state, metrics = step_fn(state, tokens)
+            lg, gg = jax.jit(jax.value_and_grad(
+                lambda p, t: lm_loss(p, t, cfg, pmesh,
+                                     pipeline_microbatches=2)
+            ))(state.params, tokens)
+            li, gi = jax.jit(jax.value_and_grad(
+                lambda p, t: lm_loss(p, t, cfg, pmesh,
+                                     pipeline_microbatches=2,
+                                     pipeline_schedule="interleaved",
+                                     pipeline_virtual=2)
+            ))(state.params, tokens)
+        for k in ("moe_balance", "moe_zloss", "moe_drop_rate",
+                  "moe_entropy"):
+            assert np.isfinite(float(metrics[k])), k
+        assert float(metrics["moe_drop_rate"]) == 0.0
+        assert float(metrics["moe_balance"]) >= 1.0 - 1e-5  # Switch minimum
+        np.testing.assert_allclose(float(li), float(lg), rtol=2e-5)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(gg)[0],
+            jax.tree_util.tree_flatten_with_path(gi)[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+                err_msg=str(path),
+            )
+
+    def test_moe_pipeline_rejects_indivisible_experts(self):
+        cfg = TransformerConfig(n_experts=3, n_layers=2)
+        mesh = build_mesh(MeshSpec(pp=2, ep=2, tp=2))
         params = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.key(0))
-        with pytest.raises(ValueError, match="GSPMD"):
+        with pytest.raises(ValueError, match="divisible by ep"):
             from tony_tpu.models.transformer import forward_pipeline
             forward_pipeline(
                 params, jnp.zeros((4, 8), jnp.int32), cfg, mesh,
@@ -819,6 +900,74 @@ class TestDecode:
         with jax.sharding.set_mesh(mesh):
             got = generate(sharded, prompt, cfg, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_decode_session_sharded_serving_parity(self):
+        """DecodeSession(mesh=...) is the serve-in-place API (r4's
+        GSPMD TP-decode parity test promoted to surface): fused weights
+        land tp-sharded, the KV cache shards batch-over-dp and
+        kv-heads-over-tp, and the generated tokens exactly match the
+        single-device session."""
+        from tony_tpu.models import (
+            DecodeSession, TransformerConfig, init_params,
+        )
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+            n_kv_heads=2,
+        )
+        params = init_params(jax.random.key(5), cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(0, 64, (4, 6)), jnp.int32
+        )
+        want = DecodeSession(params, cfg).generate(prompt, max_new_tokens=6)
+
+        mesh = build_mesh(MeshSpec(dp=4, tp=2))
+        session = DecodeSession(params, cfg, mesh=mesh)
+        spec = session.params["layers"]["qkv"].sharding.spec
+        assert spec[2] == "tp", spec          # packed head axis split
+        spec = session.params["layers"]["w_down"].sharding.spec
+        assert spec[1] == "tp", spec          # ff axis split
+        got = session.generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # refresh() keeps the serving shardings
+        session.refresh(params)
+        assert session.params["layers"]["qkv"].sharding.spec[2] == "tp"
+
+    def test_init_cache_sharded_under_mesh(self):
+        """Inside a mesh context the KV cache is born sharded (batch over
+        dp, kv heads over tp) — not left to GSPMD propagation; outside a
+        mesh it is unconstrained. Non-divisible dims fall back to
+        replicated."""
+        from tony_tpu.models import TransformerConfig, init_cache
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+            n_kv_heads=2,
+        )
+        mesh = build_mesh(MeshSpec(dp=4, tp=2))
+        with jax.sharding.set_mesh(mesh):
+            cache = jax.jit(
+                lambda: init_cache(cfg, batch=8, max_len=32)
+            )()
+            assert tuple(cache["k"].sharding.spec)[:4] == (
+                None, "dp", None, "tp"
+            ), cache["k"].sharding.spec
+            # batch=3: dp (4) doesn't divide -> replicated batch axis,
+            # heads still sharded
+            cache3 = jax.jit(
+                lambda: init_cache(cfg, batch=3, max_len=32)
+            )()
+            assert tuple(cache3["k"].sharding.spec)[:4] == (
+                None, None, None, "tp"
+            ), cache3["k"].sharding.spec
+        plain = init_cache(cfg, batch=8, max_len=32)
+        assert plain["k"].sharding.is_fully_replicated or isinstance(
+            plain["k"].sharding, jax.sharding.SingleDeviceSharding
+        )
 
     def test_eos_masks_continuation(self):
         """Tokens after a sequence's first EOS come back as pad; the EOS

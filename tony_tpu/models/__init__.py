@@ -25,6 +25,7 @@ from tony_tpu.models.transformer import (
 from tony_tpu.models.decode import (
     DecodeSession,
     advance,
+    decode_param_specs,
     decode_weights,
     generate,
     init_cache,
@@ -56,6 +57,7 @@ __all__ = [
     "lm_loss",
     "advance",
     "DecodeSession",
+    "decode_param_specs",
     "decode_weights",
     "generate",
     "init_cache",

@@ -98,14 +98,80 @@ def decode_weights(params: dict, cfg: TransformerConfig) -> dict:
     }
 
 
+def decode_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs for the FUSED ``decode_weights`` layout — the
+    serving twin of training's ``param_roles`` (transformer.py): tp
+    megatron-splits the packed head axis of qkv, the head axis of wo, the
+    fused ff axis of gate|up and w_down, and the vocab axis of unembed;
+    MoE experts split over ep. Norms, embed, and the (tiny, fp32) router
+    replicate. ``DecodeSession(mesh=...)`` places weights with these; a
+    dim a mesh axis doesn't divide falls back to replicated at placement
+    time (sharding is an optimization, never a correctness requirement —
+    same rule as train._sharding_for_tree)."""
+    from jax.sharding import PartitionSpec as P
+
+    layers = {
+        "ln1": P(),
+        "ln2": P(),
+        "qkv": P(None, None, "tp", None),     # [L, d, H+2Hkv, Dh]
+        "wo": P(None, "tp", None, None),      # [L, H, Dh, d]
+        "gate_up": (
+            P(None, "ep", None, "tp")          # [L, E, d, 2F]
+            if cfg.n_experts else P(None, None, "tp")  # [L, d, 2F]
+        ),
+        "w_down": (
+            P(None, "ep", "tp", None)          # [L, E, F, d]
+            if cfg.n_experts else P(None, "tp", None)  # [L, F, d]
+        ),
+    }
+    if cfg.n_experts:
+        layers["router"] = P()
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "unembed": P(None, "tp"),
+        "layers": layers,
+    }
+
+
+def _cache_spec(abstract_mesh, batch: int, kv_heads: int):
+    """KV-cache PartitionSpec under the active mesh (None outside one):
+    batch over dp, kv heads over tp — the cache is the decode-bandwidth
+    budget, so it must live sharded next to the qkv weights that feed it.
+    Axes that don't divide the dim replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    if abstract_mesh is None or abstract_mesh.empty:
+        return None
+    sizes = dict(zip(abstract_mesh.axis_names, abstract_mesh.axis_sizes))
+    dp = "dp" if sizes.get("dp", 1) > 1 and batch % sizes["dp"] == 0 else None
+    tp = ("tp" if sizes.get("tp", 1) > 1 and kv_heads % sizes["tp"] == 0
+          else None)
+    if dp is None and tp is None:
+        return None
+    return P(None, dp, None, tp, None)
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
     # kv_heads (not n_heads): GQA caches only the shared K/V heads — an
     # n_heads/n_kv_heads shrink in both HBM footprint and per-step traffic.
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     dt = cfg.compute_dtype
+    k = jnp.zeros(shape, dt)
+    v = jnp.zeros(shape, dt)
+    spec = _cache_spec(
+        jax.sharding.get_abstract_mesh(), batch, cfg.kv_heads
+    )
+    if spec is not None:
+        # Inside a mesh context (DecodeSession(mesh=...) serving): pin the
+        # cache sharding rather than leaving it to GSPMD propagation —
+        # the carry of the token-loop scan is the one place a bad
+        # propagation choice would replicate the whole cache per device.
+        k = lax.with_sharding_constraint(k, spec)
+        v = lax.with_sharding_constraint(v, spec)
     return {
-        "k": jnp.zeros(shape, dt),
-        "v": jnp.zeros(shape, dt),
+        "k": k,
+        "v": v,
         "length": jnp.zeros((), jnp.int32),
     }
 
@@ -499,23 +565,80 @@ class DecodeSession:
         out = session.generate(prompt, max_new_tokens=128)
 
     Call ``refresh(params)`` after a training step to re-fuse updated
-    weights (e.g. periodic eval generation mid-training)."""
+    weights (e.g. periodic eval generation mid-training).
 
-    def __init__(self, params: dict, cfg: TransformerConfig) -> None:
+    **Sharded serving**: pass ``mesh=`` (a ``build_mesh`` result, e.g.
+    ``MeshSpec(tp=4)``) and the fused weights are placed under
+    ``decode_param_specs`` (heads/ff/vocab megatron-split over tp, experts
+    over ep) and every ``generate`` runs inside the mesh context, with the
+    KV cache sharded batch-over-dp / kv-heads-over-tp (``_cache_spec``).
+    This is the serve-in-place path for models too big for one chip — the
+    r4 TP-decode GSPMD parity test promoted to API surface."""
+
+    def __init__(
+        self, params: dict, cfg: TransformerConfig, mesh=None
+    ) -> None:
         self.cfg = cfg
+        self.mesh = mesh
         self.params: dict = {}
         self.refresh(params)
 
     def refresh(self, params: dict) -> None:
         """Re-fuse from (possibly updated) training params; accepts
-        already-fused layouts as-is."""
+        already-fused layouts as-is. Under a mesh, (re-)place the fused
+        weights to their serving shardings."""
         if "qkv" in params["layers"]:
-            self.params = params
+            fused = params
+        elif self.mesh is not None:
+            with jax.sharding.set_mesh(self.mesh):
+                fused = _decode_weights_jit(params, self.cfg)
         else:
-            self.params = _decode_weights_jit(params, self.cfg)
+            fused = _decode_weights_jit(params, self.cfg)
+        if self.mesh is not None:
+            shardings = self._serving_shardings(fused)
+            local = jax.process_index()
+            if all(d.process_index == local
+                   for d in self.mesh.devices.flat):
+                fused = jax.device_put(fused, shardings)
+            else:
+                # Multi-process serving mesh: plain device_put of
+                # differing per-process values is the known-flaky path
+                # (build-state trap: "multihost device_put flaky");
+                # a jitted identity with out_shardings is the blessed
+                # global-array reshard.
+                with jax.sharding.set_mesh(self.mesh):
+                    fused = jax.jit(
+                        lambda x: x, out_shardings=shardings
+                    )(fused)
+        self.params = fused
+
+    def _serving_shardings(self, fused: dict):
+        """NamedShardings from ``decode_param_specs`` with the same
+        divisibility fallback as training placement: any dim its mesh
+        axis doesn't divide replicates instead of erroring."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        specs = decode_param_specs(self.cfg)
+
+        def place(spec, leaf):
+            fixed = [
+                a if a is None or dim % self.mesh.shape[a] == 0 else None
+                for a, dim in zip(spec, leaf.shape)
+            ]
+            return NamedSharding(self.mesh, P(*fixed))
+
+        return jax.tree.map(
+            place, specs, fused,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
 
     def generate(self, prompt: jax.Array, max_new_tokens: int, **kwargs):
         """Same surface as module-level ``generate`` minus params/cfg."""
+        if self.mesh is not None:
+            with jax.sharding.set_mesh(self.mesh):
+                return generate(
+                    self.params, prompt, self.cfg, max_new_tokens, **kwargs
+                )
         return generate(
             self.params, prompt, self.cfg, max_new_tokens, **kwargs
         )

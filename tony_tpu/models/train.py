@@ -110,11 +110,11 @@ def lm_loss(
     """
     inputs, labels = tokens[:, :-1], tokens[:, 1:]
     if pipeline_microbatches is not None:
-        logits = forward_pipeline(
+        logits, aux = forward_pipeline(
             params, inputs, cfg, mesh, num_microbatches=pipeline_microbatches,
             schedule=pipeline_schedule, virtual_stages=pipeline_virtual,
+            return_aux=True,
         )
-        aux = {}
     else:
         logits, aux = forward(params, inputs, cfg, mesh, return_aux=True)
     ce = softmax_cross_entropy(logits, labels)
@@ -186,10 +186,10 @@ def make_train_step(
         new_state = TrainState(state.step + 1, params, opt_state)
         return new_state, {"loss": loss, **metrics}
 
-    # Metric structure is config-static: router stats exist only on the
-    # GSPMD MoE path (the pipeline trunk is dense-only).
+    # Metric structure is config-static: router stats exist for MoE
+    # configs on both trunks (GSPMD and, since r5, the pipeline).
     metric_keys = ["loss", "cross_entropy"]
-    if cfg.n_experts and pipeline_microbatches is None:
+    if cfg.n_experts:
         metric_keys += [
             "moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy",
         ]

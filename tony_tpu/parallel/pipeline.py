@@ -68,11 +68,26 @@ def schedule_info(
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
-def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
+def _aux_zeros(stage_fn, my_params, x0):
+    """Zero-initialized accumulator matching stage_fn's aux structure
+    (trace-time eval_shape — no compute)."""
+    _, aux_shape = jax.eval_shape(stage_fn, my_params, x0)
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), aux_shape
+    )
+
+
+def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str,
+                    stage_aux: bool = False):
     """Runs inside shard_map over ``axis_name``.
 
     params: this stage's params, leading stage axis of local size 1.
     x_mb:   [num_micro, mb, ...] microbatched input (replicated over pp).
+
+    ``stage_aux``: stage_fn returns (y, aux-scalars); valid ticks' aux is
+    accumulated (bubble ticks run on garbage activations, so their aux is
+    masked out) and psum'd over the stage axis — the caller gets
+    Σ over (stage, valid tick) contributions.
     """
     n_stages = lax.axis_size(axis_name)
     stage_idx = lax.axis_index(axis_name)
@@ -82,14 +97,22 @@ def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
     ticks = schedule_info("gpipe", num_micro, n_stages, 0).ticks
 
     def tick(carry, t):
-        state, out = carry
+        state, out, aux_acc = carry
         # Stage 0 injects microbatch t (clamped; garbage ticks are never read
         # back because their outputs fall outside the valid output window).
         mb = lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False
         )
         x_in = jnp.where(stage_idx == 0, mb, state)
-        y = stage_fn(my_params, x_in)
+        if stage_aux:
+            y, aux = stage_fn(my_params, x_in)
+            u = t - stage_idx
+            valid = ((u >= 0) & (u < num_micro)).astype(jnp.float32)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + valid * a, aux_acc, aux
+            )
+        else:
+            y = stage_fn(my_params, x_in)
         # Last stage emits microbatch t-(n_stages-1); earlier ticks write to
         # a clamped slot that later valid writes overwrite in order.
         out_t = t - (n_stages - 1)
@@ -97,19 +120,28 @@ def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str):
             out, y, jnp.clip(out_t, 0, num_micro - 1), axis=0
         )
         state_next = lax.ppermute(y, axis_name, fwd_perm)
-        return (state_next, out), None
+        return (state_next, out, aux_acc), None
 
     state0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
-    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+    aux0 = _aux_zeros(stage_fn, my_params, x_mb[0]) if stage_aux else ()
+    (_, out, aux_acc), _ = lax.scan(
+        tick, (state0, out0, aux0), jnp.arange(ticks)
+    )
     # Only the last stage holds real outputs; masked psum broadcasts them so
     # every stage returns the same array (loss is computed replicated).
     mask = (stage_idx == n_stages - 1).astype(out.dtype)
-    return lax.psum(out * mask, axis_name)
+    out = lax.psum(out * mask, axis_name)
+    if stage_aux:
+        return out, jax.tree.map(
+            lambda a: lax.psum(a, axis_name), aux_acc
+        )
+    return out
 
 
 def _pipeline_interleaved_local(
     params, x_mb, *, stage_fn, axis_name: str, virtual: int,
+    stage_aux: bool = False,
 ):
     """Interleaved (virtual-stage) schedule inside shard_map.
 
@@ -141,7 +173,7 @@ def _pipeline_interleaved_local(
     )
 
     def tick(carry, t):
-        state, out = carry
+        state, out, aux_acc = carry
         u = t - stage_idx
         g = jnp.clip(u // n_stages, 0, virtual * (num_micro // n_stages) - 1)
         c = g % virtual
@@ -155,7 +187,14 @@ def _pipeline_interleaved_local(
             lambda p: lax.dynamic_index_in_dim(p, c, axis=0, keepdims=False),
             params,
         )
-        y = stage_fn(my_chunk, x_in)
+        if stage_aux:
+            y, aux = stage_fn(my_chunk, x_in)
+            valid = ((u >= 0) & (u < virtual * num_micro)).astype(jnp.float32)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + valid * a, aux_acc, aux
+            )
+        else:
+            y = stage_fn(my_chunk, x_in)
         # Record final outputs as they arrive on stage 0: the sender (stage
         # pp-1, one tick ago) emitted chunk v-1 iff its group index had
         # c_s == v-1.
@@ -170,15 +209,33 @@ def _pipeline_interleaved_local(
             out, jnp.where(is_final, state, prev), j, axis=0
         )
         state_next = lax.ppermute(y, axis_name, fwd_perm)
-        return (state_next, out), None
+        return (state_next, out, aux_acc), None
 
     state0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
-    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(info.ticks))
+    aux0 = (
+        _aux_zeros(
+            stage_fn,
+            jax.tree.map(
+                lambda p: lax.index_in_dim(p, 0, axis=0, keepdims=False),
+                params,
+            ),
+            x_mb[0],
+        )
+        if stage_aux else ()
+    )
+    (_, out, aux_acc), _ = lax.scan(
+        tick, (state0, out0, aux0), jnp.arange(info.ticks)
+    )
     # Outputs live on stage 0 (the ring wrap put them there); the masked
     # psum replicates them for the caller's replicated loss.
     mask = (stage_idx == 0).astype(out.dtype)
-    return lax.psum(out * mask, axis_name)
+    out = lax.psum(out * mask, axis_name)
+    if stage_aux:
+        return out, jax.tree.map(
+            lambda a: lax.psum(a, axis_name), aux_acc
+        )
+    return out
 
 
 def pipeline_apply(
@@ -193,6 +250,7 @@ def pipeline_apply(
     param_specs=None,
     schedule: str = "gpipe",
     virtual: int = 1,
+    stage_aux: bool = False,
 ):
     """Apply ``stage_fn`` (params, x) -> y through ``pp`` pipeline stages.
 
@@ -263,19 +321,26 @@ def pipeline_apply(
         def body(params, xm):
             return _pipeline_interleaved_local(
                 params, xm, stage_fn=stage_fn, axis_name=axis_name,
-                virtual=virtual,
+                virtual=virtual, stage_aux=stage_aux,
             )
     else:
         def body(params, xm):
             return _pipeline_local(
-                params, xm, stage_fn=stage_fn, axis_name=axis_name
+                params, xm, stage_fn=stage_fn, axis_name=axis_name,
+                stage_aux=stage_aux,
             )
 
-    out_mb = jax.shard_map(
+    # Aux scalars come back replicated: psum'd over pp inside the body and
+    # (by the stage_fn contract) already identical/pmean'd across the
+    # other axes.
+    out_specs = (in_spec, P()) if stage_aux else in_spec
+    result = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, in_spec),
-        out_specs=in_spec,
+        out_specs=out_specs,
         check_vma=False,
     )(stage_params, x_mb)
-    return out_mb.reshape((num_microbatches * mb,) + out_mb.shape[2:])
+    out_mb, aux = result if stage_aux else (result, None)
+    out = out_mb.reshape((num_microbatches * mb,) + out_mb.shape[2:])
+    return (out, aux) if stage_aux else out
