@@ -11,8 +11,13 @@ dense int arrays, not generic records), and a device-placement step that
 shards each batch over the mesh's (dp, ep) axes.
 """
 
+from tony_tpu.io.blocks import read_header, write_jsonl_blocks
 from tony_tpu.io.splits import compute_read_split, create_read_info, FileSegment
-from tony_tpu.io.reader import ShardedRecordReader, sharded_batches
+from tony_tpu.io.reader import (
+    ShardedRecordReader,
+    device_prefetch,
+    sharded_batches,
+)
 
 __all__ = [
     "compute_read_split",
@@ -20,4 +25,7 @@ __all__ = [
     "FileSegment",
     "ShardedRecordReader",
     "sharded_batches",
+    "device_prefetch",
+    "write_jsonl_blocks",
+    "read_header",
 ]

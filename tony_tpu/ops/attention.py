@@ -248,9 +248,13 @@ def _flash_attention_pallas(
 # p = exp(s - lse) is reconstructed from the saved per-row log-sum-exp, so
 # the backward never materializes [T, T]; dq accumulates over kv blocks and
 # (dk, dv) over q blocks, each as its own kernel with the reduction axis as
-# the innermost sequential grid dimension. All masks (causal, tail padding,
-# padded q rows) are applied unconditionally here — backward cost is
-# dominated by the five matmuls per block, not the wheres.
+# the innermost sequential grid dimension. Masking mirrors the forward's
+# two-branch trick: only blocks that straddle the causal diagonal or hold
+# padded tail rows/keys pay the iota + where VPU work — interior blocks
+# run pure matmul + exp. This is NOT free hygiene: the r5 device-trace
+# sweep measured the always-masked variant at 15.1 ms (dq+dkv, 8k, BH=32)
+# vs the forward's 5.7 — the per-block wheres were costing as much as a
+# matmul; branching recovered most of it (see BASELINE.md r5).
 
 
 def _bwd_masked_p(s, lse_row, *, qi, ki, block_q, block_k, q_off, t_q, t_k,
@@ -266,6 +270,22 @@ def _bwd_masked_p(s, lse_row, *, qi, ki, block_q, block_k, q_off, t_q, t_k,
         valid &= (q_off + q_row) >= k_pos
     p = jnp.exp(s - lse_row[:, None])
     return jnp.where(valid, p, 0.0)
+
+
+def _bwd_needs_mask(*, qi, ki, block_q, block_k, q_off, t_q, t_k, causal):
+    """Traced predicate: does this (q-block, kv-block) need the iota +
+    where masking pass? Interior blocks — fully below the causal diagonal
+    and free of padded tail rows/keys — skip it (see the module note:
+    measured at ~matmul cost per block)."""
+    needs = False
+    if causal:
+        # Straddles the diagonal: some (row, key) pairs are masked.
+        needs = ki * block_k + block_k - 1 > q_off + qi * block_q
+    if t_k % block_k:
+        needs = needs | (ki * block_k + block_k > t_k)
+    if t_q % block_q:
+        needs = needs | (qi * block_q + block_q > t_q)
+    return needs
 
 
 def _flash_bwd_dq_kernel(
@@ -285,18 +305,7 @@ def _flash_bwd_dq_kernel(
 
     live = k_start <= q_off + (qi + 1) * block_q - 1 if causal else True
 
-    @pl.when(live)
-    def _compute():
-        # bf16 MXU operands, fp32 accumulation (FA2): upcasting to fp32
-        # before the dots runs the MXU at a fraction of its bf16 rate.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        p = _bwd_masked_p(
-            s, lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
-            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
-        )
+    def _accumulate(p):
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -306,6 +315,30 @@ def _flash_bwd_dq_kernel(
             ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    def _scores():
+        # bf16 MXU operands, fp32 accumulation (FA2): upcasting to fp32
+        # before the dots runs the MXU at a fraction of its bf16 rate.
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    needs_mask = _bwd_needs_mask(
+        qi=qi, ki=ki, block_q=block_q, block_k=block_k, q_off=q_off,
+        t_q=t_q, t_k=t_k, causal=causal,
+    )
+
+    @pl.when(live & jnp.logical_not(needs_mask))
+    def _compute_fast():
+        _accumulate(jnp.exp(_scores() - lse_ref[0, 0][:, None]))
+
+    @pl.when(live & needs_mask)
+    def _compute_masked():
+        _accumulate(_bwd_masked_p(
+            _scores(), lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
+            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
+        ))
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -332,17 +365,8 @@ def _flash_bwd_dkv_kernel(
     # diagonal contributes nothing to this kv block.
     live = q_off + (qi + 1) * block_q - 1 >= k_start if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _accumulate(p):
         # bf16 MXU operands, fp32 accumulation (FA2) — see dq kernel.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        p = _bwd_masked_p(
-            s, lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
-            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
-        )
         p16 = p.astype(do_ref.dtype)
         dv_acc[...] += jax.lax.dot_general(
             p16, do_ref[0], (((0,), (0,)), ((), ())),
@@ -357,6 +381,28 @@ def _flash_bwd_dkv_kernel(
             ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    def _scores():
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    needs_mask = _bwd_needs_mask(
+        qi=qi, ki=ki, block_q=block_q, block_k=block_k, q_off=q_off,
+        t_q=t_q, t_k=t_k, causal=causal,
+    )
+
+    @pl.when(live & jnp.logical_not(needs_mask))
+    def _compute_fast():
+        _accumulate(jnp.exp(_scores() - lse_ref[0, 0][:, None]))
+
+    @pl.when(live & needs_mask)
+    def _compute_masked():
+        _accumulate(_bwd_masked_p(
+            _scores(), lse_ref[0, 0], qi=qi, ki=ki, block_q=block_q,
+            block_k=block_k, q_off=q_off, t_q=t_q, t_k=t_k, causal=causal,
+        ))
 
     @pl.when(qi == num_q - 1)
     def _finalize():

@@ -1,0 +1,59 @@
+"""Device-trace breakdown of the full 200M train step at a given
+(batch, seq) — names the top-k ops by summed kernel time so MFU work
+targets the measured bottleneck, not a guess."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from tools.profile_flash import device_kernel_times  # noqa: E402
+
+
+def main():
+    from tony_tpu.models import TransformerConfig, make_train_step
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+    cfg = TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
+        head_dim=64, d_ff=4096, max_seq=seq, dtype="bfloat16",
+        remat=batch * seq > 16384, remat_policy="dots",
+        layer_scan_unroll=8,
+    )
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        jnp.int32,
+    )
+    with jax.sharding.set_mesh(mesh):
+        state = init_fn(jax.random.key(0))
+
+        def run(state, tokens):
+            state, m = step_fn(state, tokens)
+            return state, m
+
+        holder = [state]
+
+        def once():
+            s, m = run(holder[0], tokens)
+            holder[0] = s
+            return m
+
+        times = device_kernel_times(lambda: once(), warmup=2, iters=4)
+    total = sum(ms for n, ms in times.items()
+                if not n.startswith("jit_"))
+    print(f"batch={batch} seq={seq} — top ops (ms/step), "
+          f"device total ~{total:.1f}:")
+    for name, ms in list(times.items())[:22]:
+        short = name.split(" = ")[0][:60] if " = " in name else name[:90]
+        print(f"  {ms:8.3f}  {short}")
+
+
+if __name__ == "__main__":
+    main()

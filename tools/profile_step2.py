@@ -1,0 +1,50 @@
+import sys
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from tools.profile_flash import device_kernel_times
+from tony_tpu.models import TransformerConfig, make_train_step
+from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+batch, seq = int(sys.argv[1]), int(sys.argv[2])
+cfg = TransformerConfig(
+    vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
+    d_ff=4096, max_seq=seq, dtype="bfloat16", remat=batch * seq > 16384,
+    remat_policy="dots", layer_scan_unroll=8,
+)
+mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+init_fn, step_fn = make_train_step(cfg, mesh)
+tokens = jnp.asarray(
+    np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+    jnp.int32,
+)
+with jax.sharding.set_mesh(mesh):
+    state = init_fn(jax.random.key(0))
+    holder = [state]
+    def once():
+        s, m = step_fn(holder[0], tokens)
+        holder[0] = s
+        return m
+    times = device_kernel_times(lambda: once(), warmup=2, iters=4)
+
+groups = {}
+for n, ms in times.items():
+    if n.startswith("jit_") or (len(n) <= 2 and n.isdigit()):
+        continue
+    if "custom-call" in n:
+        key = "pallas:" + ("dkv" if " = (bf16" in n else
+                           "fwd" if "f32[" in n else "dq")
+    elif n.startswith("%copy-start") or n.startswith("%copy-done"):
+        key = "async-copy"
+    elif n.startswith("%copy"):
+        key = "copy"
+    elif n.startswith("%fusion") or ".fusion" in n:
+        key = "fusion"
+    else:
+        key = n.split(" = ")[0].lstrip("%").rstrip(".0123456789")
+    groups[key] = groups.get(key, 0.0) + ms
+for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:18]:
+    print(f"  {v:9.2f}  {k}")
+# biggest individual copies with full text
+big = [(ms, n) for n, ms in times.items() if n.startswith("%copy-start")]
+for ms, n in sorted(big, reverse=True)[:3]:
+    print(f"COPY {ms:8.2f}: {n[:400]}")

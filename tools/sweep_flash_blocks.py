@@ -1,0 +1,84 @@
+"""Per-direction flash block sweep, timed by DEVICE-TRACE kernel
+durations (the r4 wall-clock sweep drowned in the tunnel's ~80-90 ms
+dispatch floor; kernel durations are immune). Sweeps (block_q, block_k)
+independently for the fwd kernel and the two backward kernels and prints
+a table; BASELINE.md records the chosen defaults."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from tools.profile_flash import device_kernel_times  # noqa: E402
+
+from tony_tpu.ops.attention import (  # noqa: E402
+    _flash_attention_pallas,
+    _flash_attention_pallas_bwd,
+)
+
+
+def main():
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    bh, d = 32, 64  # bench long-context shape: batch 2 x 16 heads
+    rng = np.random.default_rng(0)
+    q, k, v, do = (
+        jnp.asarray(rng.normal(size=(bh, seq, d)), jnp.bfloat16)
+        for _ in range(4)
+    )
+    scale = d ** -0.5
+
+    fwd_ref = jax.jit(lambda q, k, v: _flash_attention_pallas(
+        q, k, v, causal=True, scale=scale, block_q=512, block_k=512,
+        return_lse=True,
+    ))
+    out, lse = fwd_ref(q, k, v)
+
+    blocks = [256, 512, 1024, 2048]
+    print(f"== fwd, seq={seq} (kernel ms) ==")
+    for bq in blocks:
+        for bk in blocks:
+            try:
+                fn = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                             _flash_attention_pallas(
+                                 q, k, v, causal=True, scale=scale,
+                                 block_q=bq, block_k=bk))
+                times = device_kernel_times(fn, q, k, v, warmup=1, iters=4)
+                kern = sum(ms for n, ms in times.items()
+                           if "custom-call" in n)
+                print(f"  bq={bq:5d} bk={bk:5d}  {kern:7.3f}")
+            except Exception as e:
+                print(f"  bq={bq:5d} bk={bk:5d}  FAIL "
+                      f"{str(e).splitlines()[0][:70]}")
+
+    print(f"== bwd (dq + dkv kernel ms; dq=single-out, dkv=tuple-out) ==")
+    for bq in blocks:
+        for bk in blocks:
+            try:
+                fn = jax.jit(lambda q, k, v, out, lse, do, bq=bq, bk=bk:
+                             _flash_attention_pallas_bwd(
+                                 q, k, v, out, lse, do, causal=True,
+                                 scale=scale, block_q=bq, block_k=bk))
+                times = device_kernel_times(fn, q, k, v, out, lse, do,
+                                            warmup=1, iters=4)
+                dq_ms = sum(
+                    ms for n, ms in times.items()
+                    if "custom-call" in n and not n.startswith("%")
+                    or ("custom-call" in n and " = bf16" in n)
+                )
+                # attribute by output arity: dkv returns a tuple
+                dkv_ms = sum(ms for n, ms in times.items()
+                             if "custom-call" in n and " = (bf16" in n)
+                dq_ms = sum(ms for n, ms in times.items()
+                            if "custom-call" in n) - dkv_ms
+                print(f"  bq={bq:5d} bk={bk:5d}  dq={dq_ms:7.3f}  "
+                      f"dkv={dkv_ms:7.3f}")
+            except Exception as e:
+                print(f"  bq={bq:5d} bk={bk:5d}  FAIL "
+                      f"{str(e).splitlines()[0][:70]}")
+
+
+if __name__ == "__main__":
+    main()
