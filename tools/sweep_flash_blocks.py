@@ -22,7 +22,8 @@ from tony_tpu.ops.attention import (  # noqa: E402
 
 def main():
     seq = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
-    bh, d = 32, 64  # bench long-context shape: batch 2 x 16 heads
+    bh = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    d = int(sys.argv[3]) if len(sys.argv) > 3 else 64
     rng = np.random.default_rng(0)
     q, k, v, do = (
         jnp.asarray(rng.normal(size=(bh, seq, d)), jnp.bfloat16)
