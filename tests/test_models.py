@@ -935,6 +935,32 @@ class TestDecode:
         session.refresh(params)
         assert session.params["layers"]["qkv"].sharding.spec[2] == "tp"
 
+    def test_decode_session_sharded_moe_parity(self):
+        """Sharded serving of an MoE model: expert weights split over ep,
+        ff over tp (decode_param_specs' expert branch) — tokens identical
+        to the single-device session."""
+        from tony_tpu.models import (
+            DecodeSession, TransformerConfig, init_params,
+        )
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+            n_kv_heads=2, n_experts=4, expert_top_k=2,
+        )
+        params = init_params(jax.random.key(3), cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, 64, (4, 5)), jnp.int32
+        )
+        want = DecodeSession(params, cfg).generate(prompt, max_new_tokens=5)
+        mesh = build_mesh(MeshSpec(dp=2, ep=2, tp=2))
+        session = DecodeSession(params, cfg, mesh=mesh)
+        spec = session.params["layers"]["gate_up"].sharding.spec
+        assert tuple(spec)[:2] == (None, "ep"), spec
+        got = session.generate(prompt, max_new_tokens=5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_init_cache_sharded_under_mesh(self):
         """Inside a mesh context the KV cache is born sharded (batch over
         dp, kv heads over tp) — not left to GSPMD propagation; outside a

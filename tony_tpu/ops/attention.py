@@ -610,14 +610,21 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def _default_blocks(t_q: int, t_k: int,
                     block_q: int | None, block_k: int | None):
-    """Measured sweet spots on v5e (fwd+bwd, d=64): 512 blocks up to ~4k
-    sequence, 1024 beyond (fewer grid steps amortize the per-block scalar
-    work; 2048-wide K tiles blow the 16M scoped-VMEM budget). Callers can
-    still pin either."""
+    """Measured sweet spots on v5e via device-trace kernel timing (r5
+    sweeps, fwd/dq/dkv swept independently at seq 2k and 8k for d=64 AND
+    d=128, post mask-branching): 1024×1024 wins or ties every cell —
+    fewer grid steps amortize the per-block scalar+VPU work — so it is
+    the default at every length, clamped here to the sequence (2048-wide
+    tiles fail to compile against the 16M scoped-VMEM budget). The
+    r3-era 512-for-short-seq rule predated the bf16-operand and
+    branch-masked kernels and no longer holds. Caveat: the sweeps
+    covered 2k/8k — a length that is a multiple of 512 but not 1024
+    (1536, 2560, ...) pays a partially-padded tail tile the old default
+    avoided; callers with such lengths can still pin either block."""
     if block_q is None:
-        block_q = 512 if t_q <= 4096 else 1024
+        block_q = min(1024, t_q)
     if block_k is None:
-        block_k = 512 if t_k <= 4096 else 1024
+        block_k = min(1024, t_k)
     return block_q, block_k
 
 
