@@ -87,7 +87,10 @@ def bench_mnist() -> float:
 
         calls = max(1, MEASURE // per_call)
         best_dt = float("inf")
-        for _ in range(3):
+        # Best-of-5 (not 3): this is the headline vs_baseline number and
+        # the tunnel's health swings individual windows by 20-30%; extra
+        # windows cost ~a second each and tighten the recorded best.
+        for _ in range(5):
             t0 = time.perf_counter()
             for _ in range(calls):
                 state, metrics = step_fn(state, images, labels)
@@ -590,35 +593,52 @@ def bench_flash_attention(seq: int, batch: int, heads: int = 8,
     }
 
 
+def _safe(fn, *args, **kwargs):
+    """One extra must not sink the whole bench line: the driver records
+    exactly one JSON object per round, so a transient failure (tunnel
+    hiccup, compile-helper 500, full /tmp) in a single extra degrades to
+    an inline error string instead of losing every other number."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception as exc:  # recorded, never raised
+        return {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+
 def main() -> None:
     steps_per_sec_per_chip = bench_mnist()
     if jax.devices()[0].platform in ("tpu", "axon"):
         extras = {
-            "transformer": bench_transformer(),
-            "transformer_long_context": bench_transformer(
-                batch=2, seq=8192, measure=6
+            "transformer": _safe(bench_transformer),
+            "transformer_long_context": _safe(
+                bench_transformer, batch=2, seq=8192, measure=6
             ),
             # TPU-first flagship long-context shape: head_dim 128 (same
             # d_model/params) fills the 128-deep MXU contraction the d=64
             # rows leave half-empty — see bench_transformer's docstring.
             # The d=64 rows above stay for r1-r4 comparability.
-            "transformer_hd128": bench_transformer(
-                measure=12, n_heads=8, head_dim=128
+            "transformer_hd128": _safe(
+                bench_transformer, measure=12, n_heads=8, head_dim=128
             ),
-            "transformer_long_context_hd128": bench_transformer(
-                batch=2, seq=8192, measure=6, n_heads=8, head_dim=128
+            "transformer_long_context_hd128": _safe(
+                bench_transformer, batch=2, seq=8192, measure=6,
+                n_heads=8, head_dim=128,
             ),
-            "transformer_16k_hd128": bench_transformer(
-                batch=1, seq=16384, measure=5, n_heads=8, head_dim=128
+            "transformer_16k_hd128": _safe(
+                bench_transformer, batch=1, seq=16384, measure=5,
+                n_heads=8, head_dim=128,
             ),
-            "transformer_1b": bench_transformer_1b(),
-            "resnet50": bench_resnet50(),
-            "decode_gqa": bench_decode(),
-            "moe": bench_moe(),
-            "moe_decode_routed": bench_moe_decode(),
-            "input_pipeline": bench_input_pipeline(),
-            "flash_attention_2k": bench_flash_attention(seq=2048, batch=4),
-            "flash_attention_8k": bench_flash_attention(seq=8192, batch=1),
+            "transformer_1b": _safe(bench_transformer_1b),
+            "resnet50": _safe(bench_resnet50),
+            "decode_gqa": _safe(bench_decode),
+            "moe": _safe(bench_moe),
+            "moe_decode_routed": _safe(bench_moe_decode),
+            "input_pipeline": _safe(bench_input_pipeline),
+            "flash_attention_2k": _safe(
+                bench_flash_attention, seq=2048, batch=4
+            ),
+            "flash_attention_8k": _safe(
+                bench_flash_attention, seq=8192, batch=1
+            ),
             "device": jax.devices()[0].device_kind,
         }
     else:
