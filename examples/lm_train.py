@@ -57,6 +57,12 @@ def parse_args(argv):
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--data", default="",
+                   help="corpus path(s, comma-sep): *.jblk = block-"
+                        "compressed jsonl containers with a 'tokens' "
+                        "field per record; anything else = fixed-width "
+                        "uint16 token records of length seq+1. Empty: "
+                        "the synthetic motif corpus.")
     add_model_args(p)
     p.add_argument("--checkpoint-every", type=int, default=10)
     p.add_argument("--ckpt-dir", default="",
@@ -96,6 +102,69 @@ def synthetic_tokens(seed: int, n_docs: int, seq: int, vocab: int):
     return np.stack(docs).astype(np.int32)
 
 
+def corpus_batches(args, ctx):
+    """Endless [batch, seq+1] HOST token batches. With ``--data``,
+    records stream through the framework data plane —
+    ``rt.sharded_reader`` shards byte ranges exactly once across
+    processes (its fetcher thread read-ahead overlaps decode with the
+    running step), and the reader re-opens per epoch. Batches stay on
+    the host deliberately: the train step's ``_to_global_batch`` owns
+    device placement, and it is the only placement that is correct on
+    BOTH single- and multi-process meshes (a pre-committed global array
+    here would hit the documented multihost device_put trap). Without
+    ``--data``, the synthetic motif corpus is sampled (the offline
+    default)."""
+    if not args.data:
+        corpus = synthetic_tokens(0, n_docs=64, seq=args.seq,
+                                  vocab=args.vocab)
+        shard = corpus[ctx.process_id::max(ctx.num_processes, 1)]
+        rng = np.random.default_rng(ctx.process_id)
+        while True:
+            idx = rng.integers(0, len(shard), size=(args.batch,))
+            yield shard[idx]
+        return
+    paths = [p for p in args.data.split(",") if p]
+    if not paths:
+        raise ValueError("--data given but no paths parsed from it")
+    jblk = [p.endswith(".jblk") for p in paths]
+    if any(jblk) and not all(jblk):
+        # A .jblk container fed to the fixed-width reader decodes
+        # compressed bytes as token ids — garbage that trains without
+        # erroring. Refuse the ambiguity.
+        raise ValueError(
+            f"--data mixes .jblk containers with raw token files: {paths}"
+        )
+    while True:  # one reader per epoch; splits re-shard identically
+        yielded = 0
+        if all(jblk):
+            with rt.sharded_reader(
+                paths, fmt="jsonl-blocks", batch_size=args.batch
+            ) as r:
+                for recs in r:
+                    if len(recs) == args.batch:
+                        yielded += 1
+                        yield np.asarray(
+                            [rec["tokens"] for rec in recs], np.int32
+                        )
+        else:
+            with rt.sharded_reader(
+                paths, fmt="tokens", dtype=np.uint16,
+                record_len=args.seq + 1, batch_size=args.batch,
+            ) as r:
+                for b in r:
+                    if b.shape[0] == args.batch:
+                        yielded += 1
+                        yield b
+        if not yielded:
+            # This process's byte-range shard holds less than one full
+            # batch: re-opening forever would hang training silently.
+            raise RuntimeError(
+                f"--data {args.data}: process {ctx.process_id}'s shard "
+                f"yielded no full batch of {args.batch} (corpus too "
+                f"small for this process count / batch size)"
+            )
+
+
 def main(argv=None) -> int:
     args = parse_args(sys.argv[1:] if argv is None else argv)
     ctx = rt.initialize()
@@ -107,10 +176,10 @@ def main(argv=None) -> int:
     cfg = model_config_from_args(args, max_seq=args.seq + 1)
     init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-2)
 
-    # Per-process shard of the corpus via the framework's exactly-once
-    # sharding identity (the py4j-reader analogue).
-    corpus = synthetic_tokens(0, n_docs=64, seq=args.seq, vocab=args.vocab)
-    shard = corpus[ctx.process_id::max(ctx.num_processes, 1)]
+    # Per-process corpus shard via the framework's exactly-once sharding
+    # identity (the py4j-reader analogue) — file-backed with --data,
+    # synthetic otherwise.
+    batches = corpus_batches(args, ctx)
 
     scratch = os.environ.get("TONY_LOG_DIR", ".")
     # NOT wrapped in Path(): --ckpt-dir may be a gs:// prefix.
@@ -125,7 +194,6 @@ def main(argv=None) -> int:
         if restored is not None:
             state = restored
             print(f"resumed from step {int(state.step)}", flush=True)
-        rng = np.random.default_rng(ctx.process_id)
         first = last = None
         if int(state.step) >= args.steps:
             # A retried session can resume a checkpoint already at the
@@ -134,8 +202,7 @@ def main(argv=None) -> int:
                   f"nothing to do", flush=True)
             return 0
         while int(state.step) < args.steps:
-            idx = rng.integers(0, len(shard), size=(args.batch,))
-            tokens = jnp.asarray(shard[idx])
+            tokens = next(batches)
             state, metrics = step_fn(state, tokens)
             loss = float(metrics["loss"])
             first = loss if first is None else first

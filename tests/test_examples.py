@@ -113,6 +113,57 @@ def test_lm_generate_across_topology_change(tmp_path):
     assert gen.returncode == 0, gen.stderr[-2000:]
 
 
+def test_lm_train_streams_tokens_corpus_two_workers(tmp_path):
+    """--data with a fixed-width token corpus on TWO workers: the
+    flagship example trains from the framework data plane — each process
+    reads its exactly-once byte-range shard and the step owns device
+    placement (host batches; a pre-committed per-process device_put is
+    the documented multihost trap)."""
+    import numpy as np
+
+    seq, vocab = 32, 512
+    rows = np.random.default_rng(0).integers(
+        1, vocab, (64, seq + 1)
+    ).astype(np.uint16)
+    path = tmp_path / "corpus.tokens"
+    rows.tofile(path)
+    proc = _submit(
+        "lm_train.py", "jax", workers=2,
+        extra=["--conf", "tony.ps.instances=0",
+               "--task_params",
+               f"--steps 8 --d-model 32 --n-layers 2 --n-heads 2 "
+               f"--n-kv-heads 1 --batch 4 --seq {seq} --data {path}"],
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_lm_train_streams_jsonl_blocks_corpus(tmp_path):
+    """--data with a block-compressed jsonl container: the compressed
+    corpus format feeds the flagship training example end to end."""
+    import numpy as np
+
+    from tony_tpu.io import write_jsonl_blocks
+
+    seq, vocab = 32, 512
+    rng = np.random.default_rng(1)
+    path = tmp_path / "corpus.jblk"
+    write_jsonl_blocks(
+        str(path),
+        ({"tokens": rng.integers(1, vocab, seq + 1).tolist()}
+         for _ in range(64)),
+        codec="zstd", block_records=16,
+        schema={"tokens": f"int[{seq + 1}]"},
+    )
+    proc = _submit(
+        "lm_train.py", "jax", workers=1,
+        extra=["--conf", "tony.ps.instances=0",
+               "--task_params",
+               f"--steps 8 --d-model 32 --n-layers 2 --n-heads 2 "
+               f"--n-kv-heads 1 --batch 4 --seq {seq} --data {path}"],
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
 def test_jax_example_with_ps():
     """BASELINE config 2 shape: 1 ps + 2 workers through the gang barrier
     (all three run the user script, like the reference's shared-script ps
