@@ -191,3 +191,63 @@ def test_tensorflow_example_multiworker():
     pytest.importorskip("tensorflow")
     proc = _submit("mnist_tensorflow.py", "tensorflow", workers=2)
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+class TestCorpusBatchesUnit:
+    """Direct unit coverage of lm_train's corpus_batches guards (the e2e
+    tests cover the happy paths; these pin the refusal/empty-shard
+    behavior without a cluster)."""
+
+    def _args(self, tmp_path, data, batch=4, seq=8):
+        import argparse
+        sys.path.insert(0, str(EXAMPLES))
+        try:
+            import lm_train
+        finally:
+            sys.path.pop(0)
+        ns = argparse.Namespace(
+            data=data, batch=batch, seq=seq, vocab=64, steps=1
+        )
+        return lm_train, ns
+
+    class _Ctx:
+        process_id = 0
+        num_processes = 1
+
+    def test_mixed_suffixes_refused(self, tmp_path):
+        lm_train, args = self._args(tmp_path, "a.jblk,b.tokens")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="mixes"):
+            next(lm_train.corpus_batches(args, self._Ctx()))
+
+    def test_empty_path_list_refused(self, tmp_path):
+        lm_train, args = self._args(tmp_path, ",")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="no paths"):
+            next(lm_train.corpus_batches(args, self._Ctx()))
+
+    def test_undersized_shard_raises_not_hangs(self, tmp_path):
+        import numpy as np
+
+        rows = np.zeros((2, 9), np.uint16)  # 2 records < batch of 4
+        p = tmp_path / "tiny.tokens"
+        rows.tofile(p)
+        lm_train, args = self._args(tmp_path, str(p))
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="no full batch"):
+            next(lm_train.corpus_batches(args, self._Ctx()))
+
+    def test_epoch_wrap_yields_endlessly(self, tmp_path):
+        import numpy as np
+
+        rows = np.arange(8 * 9, dtype=np.uint16).reshape(8, 9)
+        p = tmp_path / "c.tokens"
+        rows.tofile(p)
+        lm_train, args = self._args(tmp_path, str(p))
+        src = lm_train.corpus_batches(args, self._Ctx())
+        got = [np.asarray(next(src)) for _ in range(5)]  # > 1 epoch (2/epoch)
+        assert all(b.shape == (4, 9) for b in got)
+        np.testing.assert_array_equal(got[0], got[2])  # epoch determinism

@@ -592,3 +592,42 @@ class TestJsonlBlocks:
             assert sorted(seen) == list(range(60))
         finally:
             set_default_storage(None)
+
+
+class TestJsonlBlocksEdges:
+    def test_empty_container_reads_cleanly(self, tmp_path):
+        from tony_tpu.io import write_jsonl_blocks
+
+        p = tmp_path / "empty.jblk"
+        assert write_jsonl_blocks(str(p), []) == 0
+        with ShardedRecordReader(
+            [str(p)], fmt="jsonl-blocks", batch_size=4
+        ) as r:
+            assert r.next_batch() is None
+
+    def test_single_record_container(self, tmp_path):
+        from tony_tpu.io import write_jsonl_blocks
+
+        p = tmp_path / "one.jblk"
+        write_jsonl_blocks(str(p), [{"id": 42}])
+        with ShardedRecordReader(
+            [str(p)], fmt="jsonl-blocks", batch_size=4
+        ) as r:
+            assert [rec["id"] for rec in r.next_batch()] == [42]
+
+    def test_reader_more_tasks_than_blocks(self, tmp_path):
+        """8 split readers over a 2-block container: most shards own no
+        block and must come up empty instead of duplicating reads."""
+        from tony_tpu.io import write_jsonl_blocks
+
+        p = tmp_path / "few.jblk"
+        write_jsonl_blocks(
+            str(p), [{"id": i} for i in range(8)], block_records=4
+        )
+        seen = []
+        for t in range(8):
+            with ShardedRecordReader(
+                [str(p)], t, 8, fmt="jsonl-blocks", batch_size=8
+            ) as r:
+                seen.extend(rec["id"] for b in r for rec in b)
+        assert sorted(seen) == list(range(8))
