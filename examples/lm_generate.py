@@ -114,7 +114,18 @@ def main(argv=None) -> int:
         key=(jax.random.key(args.seed)
              if args.temperature > 0 else None),
     )
-    for i, row in enumerate(np.asarray(out)):
+    if ctx.num_processes > 1:
+        # Multi-process job: `out` is a global array whose shards live on
+        # other hosts too — fetching it directly raises. Gather the full
+        # value onto every host first.
+        from jax.experimental import multihost_utils
+
+        out_rows = np.asarray(
+            multihost_utils.process_allgather(out, tiled=True)
+        )
+    else:
+        out_rows = np.asarray(out)
+    for i, row in enumerate(out_rows):
         print(f"generated[{i}]: {','.join(str(int(t)) for t in row)}",
               flush=True)
     return 0

@@ -134,6 +134,7 @@ def corpus_batches(args, ctx):
         raise ValueError(
             f"--data mixes .jblk containers with raw token files: {paths}"
         )
+    checked = False
     while True:  # one reader per epoch; splits re-shard identically
         yielded = 0
         if all(jblk):
@@ -141,6 +142,28 @@ def corpus_batches(args, ctx):
                 paths, fmt="jsonl-blocks", batch_size=args.batch
             ) as r:
                 for recs in r:
+                    if not checked and recs:
+                        # Validate the first record once, up front: a
+                        # missing 'tokens' field or a ragged/wrong-width
+                        # list would otherwise surface as an opaque numpy
+                        # object-array or XLA shape error mid-training.
+                        first = recs[0]
+                        tokens = first.get("tokens") if isinstance(
+                            first, dict) else None
+                        if tokens is None or not hasattr(tokens, "__len__"):
+                            raise ValueError(
+                                f"--data {args.data}: records must carry a "
+                                f"'tokens' list; first record has fields "
+                                f"{sorted(first) if isinstance(first, dict) else type(first).__name__}"
+                            )
+                        if len(tokens) != args.seq + 1:
+                            raise ValueError(
+                                f"--data {args.data}: 'tokens' must be "
+                                f"length seq+1 = {args.seq + 1} "
+                                f"(targets are inputs shifted by one); "
+                                f"first record has {len(tokens)}"
+                            )
+                        checked = True
                     if len(recs) == args.batch:
                         yielded += 1
                         yield np.asarray(

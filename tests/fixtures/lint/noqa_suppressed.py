@@ -1,0 +1,10 @@
+"""Inline suppression fixtures: line 8 suppresses its rule by id, line 9
+suppresses everything on the line, line 10 suppresses the WRONG id and
+must still be flagged."""
+import time
+
+import jax
+
+k1 = jax.random.PRNGKey(int(time.time()))  # tony: noqa[TONY-S101]
+k2 = jax.random.PRNGKey(int(time.time()))  # tony: noqa
+k3 = jax.random.PRNGKey(int(time.time()))  # tony: noqa[TONY-S102]

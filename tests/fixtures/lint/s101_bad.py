@@ -1,0 +1,7 @@
+"""TONY-S101: PRNG key from a host-divergent source (expected line 7)."""
+import time
+
+import jax
+
+seed = 42
+key = jax.random.PRNGKey(int(time.time()))

@@ -1,0 +1,4 @@
+"""Clean twin of s101: constant seed."""
+import jax
+
+key = jax.random.PRNGKey(42)

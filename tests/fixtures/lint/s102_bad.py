@@ -1,0 +1,9 @@
+"""TONY-S102: print inside a jitted function (expected line 8)."""
+import jax
+
+
+@jax.jit
+def step(x):
+    y = x * 2
+    print("step value", y)
+    return y

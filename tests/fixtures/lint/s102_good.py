@@ -1,0 +1,13 @@
+"""Clean twin of s102: side effect outside the jitted function."""
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def run(x):
+    y = step(x)
+    print("step value", y)
+    return y

@@ -1,0 +1,9 @@
+"""TONY-S103: PartitionSpec axis absent from the module's Mesh
+(expected line 9)."""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, -1), ("data", "model"))
+good_spec = P("data", "model")
+bad_spec = P("data", "modle")

@@ -1,0 +1,7 @@
+"""Clean twin of s103: every spec axis exists on the mesh."""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, -1), ("data", "model"))
+spec = P("data", "model")

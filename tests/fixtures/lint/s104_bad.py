@@ -1,0 +1,9 @@
+"""TONY-S104: blocking host sync inside a jitted step (expected line 8)."""
+import jax
+
+
+@jax.jit
+def step(x):
+    y = x * 2
+    jax.device_get(y)
+    return y

@@ -1,0 +1,12 @@
+"""Clean twin of s104: synchronization happens outside the step."""
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def run(x):
+    y = step(x)
+    return jax.device_get(y)

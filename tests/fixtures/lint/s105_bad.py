@@ -1,0 +1,7 @@
+"""TONY-S105: reads TF_CONFIG while importing jax (expected line 7)."""
+import json
+import os
+
+import jax
+
+cluster = json.loads(os.environ.get("TF_CONFIG", "{}"))

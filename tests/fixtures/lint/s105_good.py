@@ -1,0 +1,6 @@
+"""Clean twin of s105: distributed identity via the runtime."""
+import jax
+
+import tony_tpu.runtime as rt
+
+ctx = rt.initialize()

@@ -1,0 +1,10 @@
+"""TONY-S106: multi-worker JAX job with no distributed init (expected
+line 4 — the jax import anchors the whole-file finding)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    x = jnp.ones((8,))
+    return jax.device_count() * x.sum()

@@ -1,0 +1,9 @@
+"""Clean twin of s106: initializes the distributed runtime."""
+import jax
+
+import tony_tpu.runtime as rt
+
+
+def main():
+    ctx = rt.initialize()
+    return jax.device_count()

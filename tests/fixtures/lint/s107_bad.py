@@ -1,0 +1,7 @@
+"""TONY-S107: unsorted directory listing shards data (expected line 6)."""
+import glob
+
+import jax
+
+files = glob.glob("data/*.jsonl")
+shard = files[0]

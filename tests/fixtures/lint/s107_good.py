@@ -1,0 +1,7 @@
+"""Clean twin of s107: deterministic order via sorted()."""
+import glob
+
+import jax
+
+files = sorted(glob.glob("data/*.jsonl"))
+shard = files[0]

@@ -1,0 +1,7 @@
+"""TONY-S108: interactive blocker in a submitted script (expected line 6)."""
+import jax
+
+
+def main():
+    answer = input("continue? ")
+    return answer

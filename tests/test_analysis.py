@@ -1,0 +1,399 @@
+"""Preflight static-analysis tests: the config check, the AST script
+lint (against the hazard fixtures in tests/fixtures/lint/), the protocol
+drift check, and the submit-path gate (tony.preflight.mode=strict must
+refuse a typo'd submission before anything is staged)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.analysis import ERROR, WARNING, run_preflight
+from tony_tpu.analysis.config_check import check_config
+from tony_tpu.analysis.findings import Finding, format_findings, has_errors
+from tony_tpu.analysis.protocol_check import check_protocol
+from tony_tpu.analysis.script_lint import lint_script, lint_source
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+
+REPO = Path(__file__).resolve().parents[1]
+LINT_FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+EXAMPLES = REPO / "examples"
+
+
+# ---------------------------------------------------------------------------
+# Script lint: every rule fires on its bad fixture at the right line and
+# stays silent on the clean twin.
+# ---------------------------------------------------------------------------
+RULE_FIXTURES = [
+    ("TONY-S101", "s101", 7, {}),
+    ("TONY-S102", "s102", 8, {}),
+    ("TONY-S103", "s103", 9, {}),
+    ("TONY-S104", "s104", 8, {}),
+    ("TONY-S105", "s105", 7, {}),
+    ("TONY-S106", "s106", 4, {"multi_process": True}),
+    ("TONY-S107", "s107", 6, {}),
+    ("TONY-S108", "s108", 6, {}),
+]
+
+
+class TestScriptLint:
+    @pytest.mark.parametrize(
+        "rule_id,stem,line,ctx", RULE_FIXTURES,
+        ids=[r[0] for r in RULE_FIXTURES],
+    )
+    def test_bad_fixture_flagged_at_line(self, rule_id, stem, line, ctx):
+        findings = lint_script(str(LINT_FIXTURES / f"{stem}_bad.py"), **ctx)
+        hits = [f for f in findings if f.rule_id == rule_id]
+        assert hits, (
+            f"{rule_id} did not fire on its fixture; got "
+            f"{[f.rule_id for f in findings]}"
+        )
+        assert hits[0].line == line, format_findings(hits)
+
+    @pytest.mark.parametrize(
+        "rule_id,stem,line,ctx", RULE_FIXTURES,
+        ids=[r[0] for r in RULE_FIXTURES],
+    )
+    def test_good_twin_clean(self, rule_id, stem, line, ctx):
+        findings = lint_script(str(LINT_FIXTURES / f"{stem}_good.py"), **ctx)
+        assert not [f for f in findings if f.rule_id == rule_id], (
+            format_findings(findings)
+        )
+
+    def test_noqa_suppression(self):
+        findings = lint_script(str(LINT_FIXTURES / "noqa_suppressed.py"))
+        lines = sorted(f.line for f in findings
+                       if f.rule_id == "TONY-S101")
+        # line 8: suppressed by id; line 9: bare noqa; line 10: suppresses
+        # a DIFFERENT rule id, so S101 must still fire there.
+        assert lines == [10], format_findings(findings)
+
+    def test_s103_skips_non_literal_mesh_axes(self):
+        """A mesh whose axis names live in a variable recovers no literal
+        axes — the rule must stay silent, not flag every PartitionSpec."""
+        src = (
+            "import jax\n"
+            "from jax.sharding import Mesh, PartitionSpec\n"
+            'AXES = ("data", "model")\n'
+            "mesh = Mesh(jax.devices(), AXES)\n"
+            'spec = PartitionSpec("data")\n'
+        )
+        findings = lint_source(src, "x.py")
+        assert not [f for f in findings if f.rule_id == "TONY-S103"], (
+            format_findings(findings)
+        )
+
+    def test_s107_set_does_not_sanction_order(self):
+        """set() iteration order is hash-randomized per process — wrapping
+        a glob in set() must still be flagged."""
+        src = (
+            "import glob\n"
+            'for f in set(glob.glob("x/*.txt")):\n'
+            "    pass\n"
+        )
+        findings = lint_source(src, "x.py")
+        assert [f for f in findings if f.rule_id == "TONY-S107"]
+
+    def test_entry_point_deduped_by_realpath(self):
+        """The config's entry script already present in the explicit path
+        list under a different spelling must not be linted twice."""
+        bad = LINT_FIXTURES / "s101_bad.py"
+        alias = f"{LINT_FIXTURES}/./s101_bad.py"
+        conf = TonyConfiguration()
+        conf.set(keys.K_EXECUTES, str(bad))
+        findings = run_preflight(conf, [alias])
+        assert len([f for f in findings if f.rule_id == "TONY-S101"]) == 1
+
+    def test_syntax_error_reported_not_raised(self):
+        findings = lint_source("def broken(:\n", "x.py")
+        assert [f.rule_id for f in findings] == ["TONY-S100"]
+        assert findings[0].severity == ERROR
+
+    def test_single_process_skips_missing_init(self):
+        findings = lint_script(
+            str(LINT_FIXTURES / "s106_bad.py"), multi_process=False
+        )
+        assert not [f for f in findings if f.rule_id == "TONY-S106"]
+
+    def test_examples_are_lint_clean(self):
+        """Self-dogfooding: every shipped example passes its own lint."""
+        scripts = sorted(EXAMPLES.glob("*.py"))
+        assert len(scripts) == 5
+        for script in scripts:
+            findings = lint_script(str(script))
+            assert not findings, (
+                f"{script.name}:\n{format_findings(findings)}"
+            )
+
+    def test_lint_cli_on_examples_exits_zero(self, capsys):
+        from tony_tpu.client.cli import lint
+
+        assert lint([str(EXAMPLES)]) == 0
+        out = capsys.readouterr().out
+        assert "5 script(s), 0 error(s), 0 warning(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# Config check
+# ---------------------------------------------------------------------------
+class TestConfigCheck:
+    def _conf(self, **props):
+        conf = TonyConfiguration()
+        for k, v in props.items():
+            conf.set(k, v)
+        return conf
+
+    def test_default_conf_is_clean(self):
+        assert check_config(TonyConfiguration()) == []
+
+    def test_unknown_key_suggests_static(self):
+        conf = self._conf(**{"tony.aplication.framework": "jax"})
+        (f,) = [x for x in check_config(conf) if x.rule_id == "TONY-C001"]
+        assert f.severity == ERROR
+        assert "tony.application.framework" in f.suggestion
+
+    def test_unknown_key_suggests_dynamic_family(self):
+        conf = self._conf(**{"tony.worker.instanses": 2})
+        (f,) = [x for x in check_config(conf) if x.rule_id == "TONY-C001"]
+        assert "tony.worker.instances" in f.suggestion
+
+    def test_job_type_typo_warned(self):
+        conf = self._conf(**{"tony.wroker.instances": 2})
+        hits = [x for x in check_config(conf) if x.rule_id == "TONY-C009"]
+        assert hits and "tony.worker.instances" in hits[0].suggestion
+
+    def test_bad_bool_and_int(self):
+        conf = self._conf(**{
+            keys.K_SECURITY_ENABLED: "maybe",
+            keys.K_TASK_HEARTBEAT_INTERVAL_MS: "soon",
+        })
+        ids = [x.rule_id for x in check_config(conf)]
+        assert ids.count("TONY-C002") == 2
+
+    def test_bad_port_range_and_enum(self):
+        conf = self._conf(**{
+            keys.K_AM_RPC_PORT_RANGE: "9000",
+            keys.K_FRAMEWORK: "caffe",
+        })
+        ids = [x.rule_id for x in check_config(conf)]
+        assert ids.count("TONY-C002") == 2
+
+    def test_bad_memory_string(self):
+        conf = self._conf(**{keys.memory_key("worker"): "lots"})
+        assert any(
+            x.rule_id == "TONY-C002" and "memory" in x.message
+            for x in check_config(conf)
+        )
+
+    def test_chief_without_instances(self):
+        conf = self._conf(**{
+            keys.K_CHIEF_NAME: "chief",
+            keys.instances_key("worker"): 2,
+        })
+        assert any(x.rule_id == "TONY-C003" for x in check_config(conf))
+
+    def test_chief_index_out_of_range(self):
+        conf = self._conf(**{
+            keys.K_CHIEF_INDEX: "5",
+            keys.instances_key("worker"): 2,
+        })
+        assert any(x.rule_id == "TONY-C003" for x in check_config(conf))
+
+    def test_notebook_multi_instance(self):
+        conf = self._conf(**{keys.instances_key("notebook"): 2})
+        assert any(x.rule_id == "TONY-C004" for x in check_config(conf))
+
+    def test_tpus_under_non_jax_runtime(self):
+        conf = self._conf(**{
+            keys.K_FRAMEWORK: "pytorch",
+            keys.tpus_key("worker"): 8,
+        })
+        hits = [x for x in check_config(conf) if x.rule_id == "TONY-C005"]
+        assert hits and hits[0].severity == WARNING
+
+    def test_illegal_slice_shape(self):
+        conf = self._conf(**{
+            keys.instances_key("worker"): 3,
+            # 3 hosts x 9 chips: single-host v5e shapes top out at 8
+            # chips and no multi-host shape tiles 3 hosts.
+            keys.tpus_key("worker"): 9,
+        })
+        assert any(x.rule_id == "TONY-C006" for x in check_config(conf))
+
+    def test_illegal_topology_without_tpu_ask(self):
+        conf = self._conf(**{keys.K_TPU_TOPOLOGY: "v5e-3"})
+        assert any(x.rule_id == "TONY-C006" for x in check_config(conf))
+
+    def test_legal_tpu_ask_is_clean(self):
+        conf = self._conf(**{
+            keys.instances_key("worker"): 4,
+            keys.tpus_key("worker"): 4,
+            keys.K_TPU_TOPOLOGY: "v5e-16",
+        })
+        assert check_config(conf) == []
+
+
+# ---------------------------------------------------------------------------
+# Protocol drift
+# ---------------------------------------------------------------------------
+class TestProtocolCheck:
+    def test_live_tables_clean(self):
+        assert check_protocol() == []
+
+    def test_detects_missing_acl_and_extra_acl(self):
+        from tony_tpu.rpc.protocol import RPC_METHODS
+
+        acl = {m: frozenset({"client"}) for m in RPC_METHODS}
+        acl.pop("finish_application")
+        acl["shutdown_everything"] = frozenset({"client"})
+        ids = [f.rule_id for f in check_protocol(acl=acl)]
+        assert ids.count("TONY-P002") == 2
+
+    def test_detects_registry_method_without_handler(self):
+        from tony_tpu import security
+        from tony_tpu.rpc.protocol import RPC_METHODS
+
+        registry = dict(RPC_METHODS)
+        registry["new_call"] = ("arg",)
+        acl = dict(security.METHOD_ACL)
+        acl["new_call"] = frozenset({"client"})
+        findings = check_protocol(rpc_methods=registry, acl=acl)
+        ids = {f.rule_id for f in findings}
+        # missing on the interface, missing client stub, missing handler
+        assert {"TONY-P001", "TONY-P003", "TONY-P004"} <= ids
+
+    def test_detects_stub_arg_drift(self):
+        from tony_tpu.rpc.protocol import RPC_METHODS
+
+        registry = dict(RPC_METHODS)
+        registry["task_executor_heartbeat"] = ("task_id", "extra")
+        findings = check_protocol(rpc_methods=registry)
+        assert any(
+            f.rule_id == "TONY-P003" and "task_executor_heartbeat"
+            in f.message
+            for f in findings
+        )
+
+    def test_empty_role_set_flagged(self):
+        from tony_tpu import security
+
+        acl = dict(security.METHOD_ACL)
+        acl["finish_application"] = frozenset()
+        assert any(
+            f.rule_id == "TONY-P002" and "no role" in f.message
+            for f in check_protocol(acl=acl)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Repo self-drift (tools/lint_self.py) — drift fails tier-1.
+# ---------------------------------------------------------------------------
+def test_repo_self_drift_clean(capsys):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint_self
+    finally:
+        sys.path.pop(0)
+    assert lint_self.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# Submission gate
+# ---------------------------------------------------------------------------
+class TestSubmissionGate:
+    def test_strict_blocks_typo_and_suggests(self, tmp_path, caplog):
+        """Acceptance: strict mode refuses a submission whose config has a
+        typo'd key, names the intended key, and stages NOTHING."""
+        from tony_tpu.client.client import TonyClient
+
+        client = TonyClient().init([
+            "--executes", str(LINT_FIXTURES / "s101_good.py"),
+            "--conf", f"{keys.K_PREFLIGHT_MODE}=strict",
+            "--conf", "tony.worker.instanses=2",
+            "--conf", f"{keys.K_STAGING_LOCATION}={tmp_path}/staging",
+        ])
+        import logging
+
+        with caplog.at_level(logging.ERROR):
+            rc = client.run()
+        assert rc == 1
+        assert client.coordinator_proc is None, "nothing may launch"
+        assert not (tmp_path / "staging").exists(), "nothing may stage"
+        joined = "\n".join(r.message for r in caplog.records)
+        assert "tony.worker.instanses" in joined
+        assert "tony.worker.instances" in joined  # the suggestion
+
+    def test_strict_passes_clean_config_preflight(self, tmp_path):
+        from tony_tpu.analysis.preflight import run_for_submission
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_PREFLIGHT_MODE, "strict")
+        # The default conf schedules worker+ps (2 processes), so the
+        # clean script must be one that initializes the distributed
+        # runtime (s106_good) — s101_good would trip TONY-S106.
+        conf.set(keys.K_EXECUTES, str(LINT_FIXTURES / "s106_good.py"))
+        assert run_for_submission(conf) == 0
+
+    def test_warn_mode_reports_but_proceeds(self, caplog):
+        from tony_tpu.analysis.preflight import run_for_submission
+
+        conf = TonyConfiguration()
+        conf.set("tony.worker.instanses", 2)
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            assert run_for_submission(conf) == 0
+        assert any("TONY-C001" in r.message for r in caplog.records)
+
+    def test_off_mode_runs_nothing(self, caplog):
+        from tony_tpu.analysis.preflight import run_for_submission
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_PREFLIGHT_MODE, "off")
+        conf.set("tony.worker.instanses", 2)
+        assert run_for_submission(conf) == 0
+        assert not any("TONY-C001" in r.message for r in caplog.records)
+
+    def test_unknown_mode_degrades_to_warn(self):
+        from tony_tpu.analysis.preflight import preflight_mode
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_PREFLIGHT_MODE, "paranoid")
+        assert preflight_mode(conf) == constants.PREFLIGHT_WARN
+
+    def test_strict_blocks_hazardous_script(self, tmp_path):
+        """The script-lint layer participates in the strict gate: an
+        error-severity hazard in the entry point refuses submission."""
+        from tony_tpu.analysis.preflight import run_for_submission
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_PREFLIGHT_MODE, "strict")
+        conf.set(keys.K_EXECUTES, str(LINT_FIXTURES / "s101_bad.py"))
+        assert run_for_submission(conf) == 1
+
+    def test_preflight_resolves_entry_point_from_conf(self):
+        conf = TonyConfiguration()
+        conf.set(keys.K_EXECUTES, str(LINT_FIXTURES / "s108_bad.py"))
+        findings = run_preflight(conf)
+        assert any(f.rule_id == "TONY-S108" for f in findings)
+
+    def test_multi_worker_conf_drives_s106(self):
+        conf = TonyConfiguration()
+        conf.set(keys.instances_key("worker"), 2)
+        conf.set(keys.K_EXECUTES, str(LINT_FIXTURES / "s106_bad.py"))
+        findings = run_preflight(conf)
+        assert any(f.rule_id == "TONY-S106" for f in findings)
+
+
+def test_findings_format_orders_errors_first():
+    fs = [
+        Finding("TONY-S107", WARNING, "w", file="a.py", line=3),
+        Finding("TONY-S101", ERROR, "e", file="b.py", line=9),
+    ]
+    text = format_findings(fs)
+    assert text.index("TONY-S101") < text.index("TONY-S107")
+    assert has_errors(fs)

@@ -35,6 +35,26 @@ def test_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 3.0)
 
 
+def test_saved_num_processes_tolerates_corrupt_metadata(tmp_path):
+    """A corrupt metadata.json (unparseable, or parsing to a non-dict,
+    or carrying a non-numeric num_processes) must fall back to the
+    ambient process count, not abort the restore."""
+    mgr = CheckpointManager(tmp_path, num_processes=3)
+    for corrupt in (
+        b"{not json",            # unparseable
+        b"[1, 2]",               # parses to a list
+        b'"just a string"',      # parses to a string
+        b"17",                   # parses to a number
+        b'{"num_processes": "x"}',   # non-numeric value
+        b'{"num_processes": null}',  # null value
+    ):
+        mgr._store.put_file(7, "metadata.json", corrupt)
+        assert mgr._saved_num_processes(7) == 3, corrupt
+    # And an honest file still wins.
+    mgr._store.put_file(7, "metadata.json", b'{"num_processes": 5}')
+    assert mgr._saved_num_processes(7) == 5
+
+
 def test_async_save_is_durable_after_wait(tmp_path):
     mgr = CheckpointManager(tmp_path)
     mgr.save(1, _state(1.0))  # async
@@ -365,6 +385,7 @@ def test_sharded_save_restore_across_processes_e2e(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_resnet_gang_fault_restart_e2e(tmp_path):
     """BASELINE config 5 (CI-scaled): 2 gang-scheduled workers train the
     in-framework ResNet; worker 0 crashes mid-run, the whole session

@@ -9,6 +9,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
 
@@ -47,6 +49,7 @@ def test_jax_example_two_workers_dp():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_lm_example_trains_and_checkpoints():
     """The flagship-framework showcase: transformer LM (GQA) through
     runtime.initialize + build_job_mesh + make_train_step +
@@ -60,6 +63,7 @@ def test_lm_example_trains_and_checkpoints():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_lm_generate_serves_trained_checkpoint(tmp_path):
     """The inference half: lm_train checkpoints to a shared dir, then
     lm_generate restores the TrainState through a second CLI job, builds
@@ -84,6 +88,7 @@ def test_lm_generate_serves_trained_checkpoint(tmp_path):
     assert gen.returncode == 0, gen.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_lm_generate_across_topology_change(tmp_path):
     """The normal TPU lifecycle: train on MORE processes than serve. Two
     dp workers checkpoint a sharded TrainState; a ONE-process serving job
@@ -113,6 +118,7 @@ def test_lm_generate_across_topology_change(tmp_path):
     assert gen.returncode == 0, gen.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_lm_train_streams_tokens_corpus_two_workers(tmp_path):
     """--data with a fixed-width token corpus on TWO workers: the
     flagship example trains from the framework data plane — each process
@@ -147,11 +153,16 @@ def test_lm_train_streams_jsonl_blocks_corpus(tmp_path):
     seq, vocab = 32, 512
     rng = np.random.default_rng(1)
     path = tmp_path / "corpus.jblk"
+    try:
+        import zstandard  # noqa: F401
+        codec = "zstd"
+    except ImportError:  # optional dependency; gzip is always available
+        codec = "gzip"
     write_jsonl_blocks(
         str(path),
         ({"tokens": rng.integers(1, vocab, seq + 1).tolist()}
          for _ in range(64)),
-        codec="zstd", block_records=16,
+        codec=codec, block_records=16,
         schema={"tokens": f"int[{seq + 1}]"},
     )
     proc = _submit(
@@ -164,6 +175,7 @@ def test_lm_train_streams_jsonl_blocks_corpus(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_jax_example_with_ps():
     """BASELINE config 2 shape: 1 ps + 2 workers through the gang barrier
     (all three run the user script, like the reference's shared-script ps
@@ -176,12 +188,14 @@ def test_jax_example_with_ps():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_pytorch_example_ddp():
     """BASELINE config 3: PyTorch DDP-style MNIST, 2 workers over gloo."""
     proc = _submit("mnist_pytorch.py", "pytorch", workers=2)
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_tensorflow_example_multiworker():
     """BASELINE configs 2/4 TF shape: 2 MWMS workers + the default ps task
     serving tf.distribute.Server until the chief finishes, all wired from
@@ -238,6 +252,34 @@ class TestCorpusBatchesUnit:
         import pytest as _pytest
 
         with _pytest.raises(RuntimeError, match="no full batch"):
+            next(lm_train.corpus_batches(args, self._Ctx()))
+
+    def test_jblk_missing_tokens_field_refused(self, tmp_path):
+        """A jsonl-blocks corpus whose records lack 'tokens' must fail
+        with a named-field ValueError, not an opaque numpy/XLA error."""
+        from tony_tpu.io import write_jsonl_blocks
+
+        p = tmp_path / "c.jblk"
+        write_jsonl_blocks(str(p), [{"text": "x"} for _ in range(8)])
+        lm_train, args = self._args(tmp_path, str(p))
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="'tokens'"):
+            next(lm_train.corpus_batches(args, self._Ctx()))
+
+    def test_jblk_wrong_token_width_refused(self, tmp_path):
+        """Records whose 'tokens' length != seq+1 must name the expected
+        width up front instead of failing downstream at stacking."""
+        from tony_tpu.io import write_jsonl_blocks
+
+        p = tmp_path / "c.jblk"
+        write_jsonl_blocks(
+            str(p), [{"tokens": list(range(5))} for _ in range(8)]
+        )
+        lm_train, args = self._args(tmp_path, str(p))  # seq=8 -> wants 9
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="seq"):
             next(lm_train.corpus_batches(args, self._Ctx()))
 
     def test_epoch_wrap_yields_endlessly(self, tmp_path):

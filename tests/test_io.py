@@ -462,6 +462,8 @@ class TestJsonlBlocks:
         from tony_tpu.io import write_jsonl_blocks
 
         recs = [{"id": i, "text": f"record-{i}" * 3} for i in range(n)]
+        if codec == "zstd":
+            pytest.importorskip("zstandard")
         wrote = write_jsonl_blocks(
             str(path), recs, codec=codec, block_records=block,
             schema=schema,
@@ -523,6 +525,22 @@ class TestJsonlBlocks:
             doc = _json.loads(r.schema_json())
         assert doc["fields"] == {"id": "int", "text": "str"}
 
+    def test_schema_found_in_later_container(self, tmp_path):
+        """Schema negotiation must consult EVERY container backing the
+        reader, not just the first: here the first header is empty and
+        only the second embeds a schema."""
+        import json as _json
+
+        p1 = tmp_path / "a.jblk"
+        p2 = tmp_path / "b.jblk"
+        self._write(p1)  # no embedded schema
+        self._write(p2, schema={"id": "long", "text": "string"})
+        with ShardedRecordReader(
+            [str(p1), str(p2)], fmt="jsonl-blocks", batch_size=8
+        ) as r:
+            doc = _json.loads(r.schema_json())
+        assert doc["schema"] == {"id": "long", "text": "string"}
+
     def test_corrupt_sync_candidate_skipped_by_crc(self, tmp_path):
         """Garbage bytes containing a fake SYNC marker (with junk lengths
         and CRC) between two real blocks must be skipped — the CRC +
@@ -582,7 +600,7 @@ class TestJsonlBlocks:
         set_default_storage(FileObjectStorage(tmp_path / "obj"))
         try:
             uri = "gs://corpus/train.jblk"
-            recs = self._write(uri, n=60, codec="zstd", block=7)
+            recs = self._write(uri, n=60, codec="gzip", block=7)
             seen = []
             for t in range(2):
                 with ShardedRecordReader(

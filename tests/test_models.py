@@ -20,6 +20,18 @@ from tony_tpu.models import (
 from tony_tpu.models.train import make_classifier_step
 from tony_tpu.parallel.mesh import MeshSpec, build_mesh
 
+# jax < 0.5: the shard_map grad/transpose path re-runs the out-spec
+# replication check even under check_vma/check_rep=False, and rejects the
+# MoE pipeline's psum-replicated aux scalars with a _SpecError; the
+# router-collapse numerics also differ under the old PRNG. The affected
+# tests run on current jax.
+OLD_JAX = tuple(int(p) for p in jax.__version__.split(".")[:2]) < (0, 5)
+moe_pipeline_old_jax = pytest.mark.skipif(
+    OLD_JAX,
+    reason="jax < 0.5 shard_map transpose cannot express the MoE "
+           "pipeline's replicated aux outputs (_SpecError)",
+)
+
 CFG = TransformerConfig(
     vocab_size=256,
     d_model=64,
@@ -110,6 +122,11 @@ class TestTrainStep:
         for k in ("moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy"):
             assert np.isfinite(float(metrics[k])), k
 
+    @pytest.mark.skipif(
+        OLD_JAX,
+        reason="router-collapse initial entropy differs under the "
+               "pre-0.5 jax PRNG",
+    )
     def test_moe_balance_loss_recovers_biased_router(self):
         """Start from a router collapsed onto expert 0 (shrunk weights plus
         an expert-0 column aligned with the batch's activation directions):
@@ -327,6 +344,7 @@ class TestTrainStep:
             pytest.approx(m * layers / pp)
         )
 
+    @moe_pipeline_old_jax
     def test_moe_pipeline_matches_gspmd_loss_and_grads(self):
         """MoE through the pipeline trunk (VERDICT r4 weak #1): pp=2×ep=2
         ×tp=2 manual-collective experts (resident E/ep slabs, all_to_all
@@ -362,6 +380,7 @@ class TestTrainStep:
                 err_msg=str(path),
             )
 
+    @moe_pipeline_old_jax
     def test_moe_pipeline_microbatched_aux_metrics(self):
         """Microbatched (m=2) MoE pipeline: aux losses accumulate across
         microbatches and average — the train step surfaces finite router

@@ -1,0 +1,75 @@
+"""Compatibility shims for older jax releases (< 0.5).
+
+This codebase is written against the current public API (``jax.shard_map``,
+``jax.sharding.set_mesh`` / ``get_abstract_mesh``); deployment images can
+lag by several releases. Each shim aliases the new name onto its pre-0.5
+equivalent and is a no-op when the real attribute exists — so the same
+tree runs unmodified on both. Imported for its side effects from
+``tony_tpu/__init__.py`` (every entry point — client, coordinator,
+executor, tests — imports ``tony_tpu`` first).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+if not hasattr(jax, "shard_map"):  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        # check_vma is the post-0.5 spelling of check_rep.
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "axis_size"):  # pragma: no cover
+
+    def _axis_size(axis_name):
+        from jax._src import core
+
+        try:
+            sizes = core.get_axis_env().axis_sizes
+            if axis_name in sizes:
+                return sizes[axis_name]
+        except (AttributeError, KeyError, TypeError):
+            pass
+        # Fallback: psum of a unit weight — concrete under shard_map.
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+if not hasattr(jax.sharding, "set_mesh"):  # pragma: no cover
+
+    @contextlib.contextmanager
+    def _set_mesh(mesh):
+        # Pre-0.5 jax: entering the Mesh binds it as the ambient mesh for
+        # pjit/with_sharding_constraint — the closest equivalent of the
+        # explicit set_mesh context.
+        with mesh:
+            yield mesh
+
+    jax.sharding.set_mesh = _set_mesh
+
+if not hasattr(jax.sharding, "get_abstract_mesh"):  # pragma: no cover
+
+    def _get_abstract_mesh():
+        from jax._src.mesh import thread_resources
+
+        return thread_resources.env.physical_mesh
+
+    jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+try:  # pragma: no cover - version-dependent
+    from jax.experimental.pallas import tpu as _pltpu
+
+    if not hasattr(_pltpu, "CompilerParams") and hasattr(
+        _pltpu, "TPUCompilerParams"
+    ):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except ImportError:
+    pass

@@ -1,0 +1,46 @@
+"""Preflight static analysis — ``tony lint``.
+
+The reference validates resource asks before gang-scheduling
+(TonyClient.validate, Utils.parseContainerRequests) but discovers
+everything *inside* the user script at runtime, minutes into a
+provisioned slice. This package moves the most expensive failure
+class to submit time, on the client, for free:
+
+* ``config_check``   — the frozen ``TonyConfiguration`` against the
+  ``conf/keys.py`` registry: unknown keys (with did-you-mean
+  suggestions), type/range checks, cross-key rules, illegal slice
+  shapes vs ``coordinator/backend.py``'s topology table.
+* ``script_lint``    — an ``ast`` rule engine over the submitted
+  training script: distributed-JAX hazards (host-divergent seeding,
+  side effects under ``jit``, unknown ``PartitionSpec`` axes, blocking
+  host syncs in the step function, …), each with a stable rule id and
+  a source span, suppressible with ``# tony: noqa[RULE]``.
+* ``protocol_check`` — the three RPC tables (``rpc/protocol.py``
+  registry, server handlers + ``security.METHOD_ACL``, client stubs)
+  can no longer drift silently.
+
+``preflight.run_preflight`` runs all three; ``tony.preflight.mode``
+(off|warn|strict) wires it into every submission.
+"""
+
+from __future__ import annotations
+
+from tony_tpu.analysis.findings import (
+    ERROR,
+    INFO,
+    WARNING,
+    Finding,
+    format_findings,
+    max_severity,
+)
+from tony_tpu.analysis.preflight import run_preflight
+
+__all__ = [
+    "Finding",
+    "ERROR",
+    "WARNING",
+    "INFO",
+    "format_findings",
+    "max_severity",
+    "run_preflight",
+]

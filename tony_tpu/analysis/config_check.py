@@ -1,0 +1,291 @@
+"""Config preflight: a frozen ``TonyConfiguration`` against the
+``conf/keys.py`` registry.
+
+The reference validated little beyond resource parsing — a typo'd key
+silently fell back to its default and the job ran wrong (or burned a
+slice before failing). Every check here is pure and client-side:
+
+* unknown ``tony.*`` keys, with edit-distance "did you mean" suggestions
+  drawn from the static registry AND the dynamic per-job-type families
+  (``tony.<job>.{instances,memory,vcores,gpus,tpus,resources,env}``);
+* type/range checks derived from the defaults registry (bools must parse,
+  ints must parse and be non-negative, memory strings must parse, the
+  port range must be ``lo-hi``, enums must be legal values);
+* cross-key rules: chief must resolve to a schedulable task, notebooks
+  are single-instance, TPU asks under a non-JAX runtime, and every
+  ``tony.<job>.tpus`` ask must land on a legal slice topology
+  (``coordinator/backend.py``'s table — the same planner the scheduler
+  runs, so preflight and scheduling cannot disagree).
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+
+from tony_tpu import constants
+from tony_tpu.analysis.findings import ERROR, INFO, WARNING, Finding
+from tony_tpu.conf import keys
+
+# Dynamic per-job-type key families (keys.instances_key et al.).
+_FAMILY_SUFFIXES = (
+    "instances", "memory", "vcores", "gpus", "tpus", "resources", "env",
+)
+_FAMILY_RE = re.compile(
+    r"tony\.([a-z][a-z0-9_]*)\.(" + "|".join(_FAMILY_SUFFIXES) + r")$"
+)
+_WELL_KNOWN_JOBS = (
+    constants.WORKER_JOB_NAME, constants.PS_JOB_NAME,
+    constants.CHIEF_JOB_NAME, constants.EVALUATOR_JOB_NAME,
+    constants.NOTEBOOK_JOB_NAME, constants.DRIVER_JOB_NAME,
+)
+
+_FRAMEWORKS = ("jax", "tensorflow", "pytorch")
+_PREFLIGHT_MODES = (
+    constants.PREFLIGHT_OFF, constants.PREFLIGHT_WARN,
+    constants.PREFLIGHT_STRICT,
+)
+
+# Keys whose values are enumerations rather than free strings.
+_ENUM_KEYS: dict[str, tuple[str, ...]] = {
+    keys.K_FRAMEWORK: _FRAMEWORKS,
+    keys.K_PREFLIGHT_MODE: _PREFLIGHT_MODES,
+}
+
+_TRUE_FALSE = frozenset(
+    {"true", "1", "yes", "on", "false", "0", "no", "off"}
+)
+
+
+def _known_static_keys() -> frozenset[str]:
+    return frozenset(keys.DEFAULTS)
+
+
+def _candidate_keys(job_names: set[str]) -> list[str]:
+    """The did-you-mean pool: every static key plus every dynamic family
+    key for both the configured and the well-known job types."""
+    pool = set(keys.DEFAULTS)
+    for job in set(_WELL_KNOWN_JOBS) | job_names:
+        for suffix in _FAMILY_SUFFIXES:
+            pool.add(f"{keys.TONY_PREFIX}{job}.{suffix}")
+    return sorted(pool)
+
+
+def _suggest(key: str, pool: list[str]) -> str:
+    close = difflib.get_close_matches(key, pool, n=1, cutoff=0.75)
+    return f"did you mean `{close[0]}`?" if close else ""
+
+
+def _is_int(value) -> bool:
+    try:
+        int(value)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _check_value(key: str, value, default) -> str | None:
+    """Type/range validation for one known key; returns the complaint or
+    None. Expected types derive from the defaults registry, with the
+    handful of special formats carved out explicitly."""
+    if key in _ENUM_KEYS:
+        if str(value) not in _ENUM_KEYS[key]:
+            return (
+                f"must be one of {', '.join(_ENUM_KEYS[key])}; got {value!r}"
+            )
+        return None
+    if key == keys.K_HTTP_PORT:
+        if str(value) != "disabled" and not _is_int(value):
+            return f"must be an integer port or 'disabled'; got {value!r}"
+        return None
+    if key == keys.K_AM_RPC_PORT_RANGE:
+        m = re.fullmatch(r"\s*(\d+)\s*-\s*(\d+)\s*", str(value))
+        if not m or int(m.group(1)) > int(m.group(2)):
+            return f"must be 'lo-hi' with lo <= hi; got {value!r}"
+        return None
+    if isinstance(default, bool):
+        if not (
+            isinstance(value, bool)
+            or str(value).strip().lower() in _TRUE_FALSE
+        ):
+            return f"must be a boolean; got {value!r}"
+        return None
+    if isinstance(default, int):
+        if value == "" or value is None:
+            return None  # empty = take the default (get_int contract)
+        if not _is_int(value):
+            return f"must be an integer; got {value!r}"
+        if int(value) < 0:
+            return f"must be >= 0; got {value!r}"
+        return None
+    return None
+
+
+def _check_family_value(job: str, suffix: str, value) -> str | None:
+    from tony_tpu.utils import parse_memory_string_mb
+
+    if suffix in ("instances", "vcores", "gpus", "tpus"):
+        if not _is_int(value):
+            return f"must be an integer; got {value!r}"
+        if int(value) < 0:
+            return f"must be >= 0; got {value!r}"
+        return None
+    if suffix == "memory":
+        try:
+            parse_memory_string_mb(value)
+        except (TypeError, ValueError):
+            return f"must be a memory size like '2g' or '512m'; got {value!r}"
+    return None
+
+
+def check_config(conf) -> list[Finding]:
+    """All config-layer findings for a resolved ``TonyConfiguration``."""
+    findings: list[Finding] = []
+    static = _known_static_keys()
+    job_names: set[str] = set(conf.job_types())
+    pool = _candidate_keys(job_names)
+
+    for key in sorted(conf):
+        value = conf.get(key)
+        if not str(key).startswith(keys.TONY_PREFIX):
+            findings.append(Finding(
+                "TONY-C008", INFO,
+                f"key `{key}` is not under the tony.* namespace and is "
+                f"ignored by the framework",
+            ))
+            continue
+        if key in static:
+            complaint = _check_value(key, value, keys.DEFAULTS[key])
+            if complaint:
+                findings.append(Finding(
+                    "TONY-C002", ERROR, f"`{key}` {complaint}",
+                ))
+            continue
+        fam = _FAMILY_RE.fullmatch(key)
+        if fam:
+            job, suffix = fam.group(1), fam.group(2)
+            complaint = _check_family_value(job, suffix, value)
+            if complaint:
+                findings.append(Finding(
+                    "TONY-C002", ERROR, f"`{key}` {complaint}",
+                ))
+            elif job not in _WELL_KNOWN_JOBS:
+                # A near-miss of a well-known job name mints a whole new
+                # job type silently (tony.wroker.instances=2 schedules a
+                # "wroker" gang and leaves worker at its default).
+                close = difflib.get_close_matches(
+                    job, _WELL_KNOWN_JOBS, n=1, cutoff=0.8
+                )
+                if close:
+                    findings.append(Finding(
+                        "TONY-C009", WARNING,
+                        f"job type `{job}` in `{key}` looks like a typo",
+                        suggestion=f"did you mean `tony.{close[0]}.{suffix}`?",
+                    ))
+            continue
+        findings.append(Finding(
+            "TONY-C001", ERROR, f"unknown configuration key `{key}`",
+            suggestion=_suggest(key, pool),
+        ))
+
+    findings.extend(_cross_key_checks(conf, job_names))
+    return findings
+
+
+def _get_int_safe(conf, key: str, default: int) -> int | None:
+    try:
+        return conf.get_int(key, default)
+    except (TypeError, ValueError):
+        return None  # already reported as TONY-C002
+
+
+def _cross_key_checks(conf, job_names: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # Requested instances per job (0-instance families are configured but
+    # schedule nothing).
+    instances: dict[str, int] = {}
+    for job in job_names:
+        n = _get_int_safe(conf, keys.instances_key(job),
+                          keys.default_instances(job))
+        if n is not None:
+            instances[job] = n
+
+    # Chief must resolve to a schedulable task: the rendezvous barrier and
+    # completion accounting both key off it.
+    chief_name = conf.get_str(keys.K_CHIEF_NAME, constants.WORKER_JOB_NAME)
+    chief_idx = _get_int_safe(conf, keys.K_CHIEF_INDEX, 0)
+    scheduled = {j: n for j, n in instances.items() if n > 0}
+    if scheduled:
+        chief_n = instances.get(chief_name, 0)
+        if chief_n == 0:
+            findings.append(Finding(
+                "TONY-C003", ERROR,
+                f"chief job `{chief_name}` (tony.chief.name) has no "
+                f"instances — the job can never complete",
+                suggestion=f"set `{keys.instances_key(chief_name)}` >= 1 "
+                           f"or point tony.chief.name at one of: "
+                           f"{', '.join(sorted(scheduled))}",
+            ))
+        elif chief_idx is not None and chief_idx >= chief_n:
+            findings.append(Finding(
+                "TONY-C003", ERROR,
+                f"tony.chief.index={chief_idx} is out of range for "
+                f"{chief_n} `{chief_name}` instance(s)",
+            ))
+
+    # Notebooks are single-instance by construction (one proxy tunnel).
+    nb = instances.get(constants.NOTEBOOK_JOB_NAME, 0)
+    if nb > 1:
+        findings.append(Finding(
+            "TONY-C004", ERROR,
+            f"tony.notebook.instances={nb}: notebook jobs are "
+            f"single-instance (one task, one proxy tunnel)",
+        ))
+
+    # TPU asks under a non-JAX runtime: the TF/PyTorch runtimes here drive
+    # CPU/GPU env contracts, not TPU slice bring-up.
+    framework = conf.get_str(keys.K_FRAMEWORK, "jax")
+    tpu_jobs = {
+        job: t for job in job_names
+        if (t := _get_int_safe(conf, keys.tpus_key(job), 0)) and t > 0
+        and instances.get(job, 0) > 0
+    }
+    if tpu_jobs and framework in _FRAMEWORKS and framework != "jax":
+        findings.append(Finding(
+            "TONY-C005", WARNING,
+            f"tony.{next(iter(sorted(tpu_jobs)))}.tpus > 0 with "
+            f"tony.application.framework={framework}: only the jax "
+            f"runtime initializes TPU slices",
+        ))
+
+    # Single-node apps with a multi-instance gang contradict themselves.
+    try:
+        single_node = conf.get_bool(keys.K_IS_SINGLE_NODE, False)
+    except ValueError:
+        single_node = False
+    total = sum(scheduled.values())
+    if single_node and total > 1:
+        findings.append(Finding(
+            "TONY-C007", WARNING,
+            f"tony.application.single-node=true but {total} task "
+            f"instances are configured",
+        ))
+
+    # Every TPU ask must land on a legal slice topology — run the real
+    # planner so preflight can never disagree with the scheduler. With no
+    # TPU ask the planner never runs, but an explicitly-set topology /
+    # accelerator-type string is still validated (a bad value would only
+    # explode later, on the first job that DOES ask for chips).
+    topology = conf.get_str(keys.K_TPU_TOPOLOGY, "")
+    accel = conf.get_str(keys.K_TPU_ACCELERATOR_TYPE, "")
+    if tpu_jobs or topology or accel:
+        from tony_tpu.coordinator.backend import plan_slices_from_conf
+
+        try:
+            plan_slices_from_conf(conf)
+        except ValueError as exc:
+            findings.append(Finding(
+                "TONY-C006", ERROR, f"illegal TPU slice request: {exc}",
+            ))
+    return findings

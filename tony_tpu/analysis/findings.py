@@ -1,0 +1,61 @@
+"""The finding model shared by every analysis layer.
+
+One flat record type — rule id, severity, message, optional source
+span — so the CLI, the submit-path preflight gate, and the tests all
+consume the same shape regardless of which layer produced it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Severities, in escalation order. ERROR findings block a strict-mode
+# submission; WARNINGs never do (they print and the job proceeds).
+INFO = "info"
+WARNING = "warning"
+ERROR = "error"
+
+_SEVERITY_ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``rule_id`` is stable API (documented in
+    docs/DEPLOY.md and matched by ``# tony: noqa[RULE]`` suppressions);
+    ``line`` is 1-based, 0 = whole-file/whole-config finding."""
+
+    rule_id: str
+    severity: str
+    message: str
+    file: str = ""
+    line: int = 0
+    suggestion: str = field(default="", compare=False)
+
+    def render(self) -> str:
+        loc = ""
+        if self.file:
+            loc = f"{self.file}:{self.line}: " if self.line else f"{self.file}: "
+        text = f"{loc}{self.severity.upper()} [{self.rule_id}] {self.message}"
+        if self.suggestion:
+            text += f" — {self.suggestion}"
+        return text
+
+
+def max_severity(findings: list[Finding]) -> str | None:
+    """Highest severity present, or None for a clean pass."""
+    if not findings:
+        return None
+    return max((f.severity for f in findings), key=_SEVERITY_ORDER.__getitem__)
+
+
+def has_errors(findings: list[Finding]) -> bool:
+    return any(f.severity == ERROR for f in findings)
+
+
+def format_findings(findings: list[Finding]) -> str:
+    """Stable human-readable report: errors first, then by file/line."""
+    ordered = sorted(
+        findings,
+        key=lambda f: (-_SEVERITY_ORDER[f.severity], f.file, f.line, f.rule_id),
+    )
+    return "\n".join(f.render() for f in ordered)

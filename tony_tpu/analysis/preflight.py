@@ -1,0 +1,157 @@
+"""Preflight orchestration: run all three analysis layers for a
+submission and gate it by ``tony.preflight.mode``.
+
+* ``off``    — never runs.
+* ``warn``   — runs, reports every finding, submits anyway (the default;
+  ``mini_cluster`` also runs every job in this mode).
+* ``strict`` — runs and refuses submission when any ERROR-severity
+  finding exists (typo'd config key, illegal slice shape, hazard rule).
+
+The gate runs before staging: a refused submission costs zero staged
+bytes and zero provisioned hardware — the whole point of the subsystem.
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+from pathlib import Path
+
+from tony_tpu import constants
+from tony_tpu.analysis.findings import (
+    ERROR,
+    Finding,
+    format_findings,
+    has_errors,
+)
+from tony_tpu.conf import keys
+
+log = logging.getLogger(__name__)
+
+
+def resolve_script_path(conf, cwd: str | None = None) -> str | None:
+    """Best-effort local path of the submitted entry point: the first
+    token of ``tony.application.executes`` when it is a readable ``.py``
+    file (relative paths resolve against the client cwd, matching how
+    the executor later resolves them against the unpacked archive)."""
+    executes = conf.get_str(keys.K_EXECUTES, "")
+    if not executes:
+        return None
+    try:
+        tokens = shlex.split(executes)
+    except ValueError:
+        tokens = executes.split()
+    for tok in tokens:
+        if tok.endswith(".py"):
+            p = Path(tok)
+            if not p.is_absolute() and cwd:
+                p = Path(cwd) / p
+            if p.is_file():
+                return str(p)
+            src_dir = conf.get_str(keys.K_SRC_DIR, "")
+            if src_dir:
+                inside = Path(src_dir) / tok
+                if inside.is_file():
+                    return str(inside)
+            return None
+    return None
+
+
+def _script_context(conf) -> dict:
+    """Lint context derived from the job config: framework, and whether
+    the job is multi-process (drives the missing-distributed-init rule)."""
+    from tony_tpu.utils import parse_container_requests
+
+    framework = conf.get_str(keys.K_FRAMEWORK, "jax")
+    try:
+        total = sum(
+            r.num_instances for r in parse_container_requests(conf).values()
+        )
+    except (TypeError, ValueError):
+        total = 0  # malformed resource keys — config_check already flagged
+    return {"framework": framework, "multi_process": total > 1}
+
+
+def run_preflight(
+    conf=None,
+    script_paths: list[str] | None = None,
+    *,
+    check_protocol: bool = True,
+    cwd: str | None = None,
+) -> list[Finding]:
+    """All findings for a submission: config check (when ``conf`` given),
+    protocol drift, and script lint over ``script_paths`` plus the
+    config's own entry point."""
+    findings: list[Finding] = []
+    context = {"framework": "jax", "multi_process": False}
+
+    if conf is not None:
+        from tony_tpu.analysis.config_check import check_config
+
+        findings.extend(check_config(conf))
+        context = _script_context(conf)
+
+    if check_protocol:
+        from tony_tpu.analysis.protocol_check import check_protocol as _cp
+
+        findings.extend(_cp())
+
+    paths = list(script_paths or [])
+    if conf is not None:
+        entry = resolve_script_path(conf, cwd=cwd)
+        # Dedup by realpath: the entry point may already be in the
+        # explicit list under a differently-spelled path, and double
+        # linting would double every finding (and the error count).
+        if entry:
+            import os
+
+            seen = {os.path.realpath(p) for p in paths}
+            if os.path.realpath(entry) not in seen:
+                paths.append(entry)
+    if paths:
+        from tony_tpu.analysis.script_lint import lint_script
+
+        for path in paths:
+            findings.extend(lint_script(path, **context))
+    return findings
+
+
+def preflight_mode(conf) -> str:
+    mode = conf.get_str(
+        keys.K_PREFLIGHT_MODE, constants.PREFLIGHT_WARN
+    ).strip().lower()
+    if mode not in (
+        constants.PREFLIGHT_OFF, constants.PREFLIGHT_WARN,
+        constants.PREFLIGHT_STRICT,
+    ):
+        # An unknown mode must not silently disable the gate.
+        log.warning("unknown tony.preflight.mode %r; treating as warn", mode)
+        return constants.PREFLIGHT_WARN
+    return mode
+
+
+def run_for_submission(conf, cwd: str | None = None) -> int:
+    """The submit-path gate (called by ``TonyClient.run`` before staging).
+    Returns 0 to proceed, non-zero to refuse the submission (strict mode
+    with error findings)."""
+    mode = preflight_mode(conf)
+    if mode == constants.PREFLIGHT_OFF:
+        return 0
+    findings = run_preflight(conf, cwd=cwd)
+    if not findings:
+        log.info("preflight: clean")
+        return 0
+    for line in format_findings(findings).splitlines():
+        if mode == constants.PREFLIGHT_STRICT:
+            log.error("preflight: %s", line)
+        else:
+            log.warning("preflight: %s", line)
+    if mode == constants.PREFLIGHT_STRICT and has_errors(findings):
+        log.error(
+            "preflight: refusing submission (%d error finding(s); "
+            "tony.preflight.mode=strict). Fix the findings or resubmit "
+            "with tony.preflight.mode=warn.",
+            sum(1 for f in findings if f.severity == ERROR),
+        )
+        return 1
+    return 0

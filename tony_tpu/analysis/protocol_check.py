@@ -1,0 +1,137 @@
+"""Protocol drift checks: the RPC surface is defined in four places that
+historically drift apart one edit at a time —
+
+1. the wire registry ``rpc/protocol.py::RPC_METHODS`` (method → arg names),
+2. the abstract interface ``ApplicationRpc`` (what servers must implement),
+3. the ACL table ``security.METHOD_ACL`` (who may call what),
+4. the typed client stubs ``rpc/client.py::ApplicationRpcClient``,
+
+plus the coordinator's concrete handler (``_RpcForClient``). A method
+added to the registry but not the ACL is unreachable under security; an
+ACL entry without a registry row is dead config; a stub whose kwargs
+don't match the registry fails only at call time, deep inside a running
+job. This module cross-checks all of them statically (signature
+introspection — nothing is called) so the drift fails preflight and the
+tier-1 suite (tools/lint_self.py) instead of a live cluster.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from tony_tpu.analysis.findings import ERROR, Finding
+
+
+def _arg_names(func) -> tuple[str, ...]:
+    params = list(inspect.signature(func).parameters.values())
+    return tuple(p.name for p in params if p.name != "self")
+
+
+def check_protocol(
+    rpc_methods: dict[str, tuple[str, ...]] | None = None,
+    interface: type | None = None,
+    acl: dict | None = None,
+    client_cls: type | None = None,
+    server_cls: type | None = None,
+) -> list[Finding]:
+    """Cross-check the five tables. All parameters are injectable so tests
+    can seed synthetic drift; defaults are the live ones."""
+    from tony_tpu import security
+    from tony_tpu.rpc import protocol
+    from tony_tpu.rpc.client import ApplicationRpcClient
+
+    if rpc_methods is None:
+        rpc_methods = protocol.RPC_METHODS
+    if interface is None:
+        interface = protocol.ApplicationRpc
+    if acl is None:
+        acl = security.METHOD_ACL
+    if client_cls is None:
+        client_cls = ApplicationRpcClient
+    if server_cls is None:
+        from tony_tpu.coordinator.app_master import _RpcForClient
+
+        server_cls = _RpcForClient
+
+    findings: list[Finding] = []
+    registry = set(rpc_methods)
+
+    # 1 ⟷ 2: registry vs abstract interface.
+    abstract = {
+        name for name in getattr(interface, "__abstractmethods__", ())
+    }
+    for name in sorted(registry - abstract):
+        if not hasattr(interface, name):
+            findings.append(Finding(
+                "TONY-P001", ERROR,
+                f"RPC method `{name}` is in RPC_METHODS but not declared "
+                f"on {interface.__name__}",
+            ))
+    for name in sorted(abstract - registry):
+        findings.append(Finding(
+            "TONY-P001", ERROR,
+            f"`{interface.__name__}.{name}` is abstract but missing from "
+            f"RPC_METHODS — it can never be dispatched",
+        ))
+    for name in sorted(registry):
+        impl = getattr(interface, name, None)
+        if impl is None:
+            continue
+        declared = _arg_names(impl)
+        if declared != rpc_methods[name]:
+            findings.append(Finding(
+                "TONY-P001", ERROR,
+                f"arg drift for `{name}`: RPC_METHODS says "
+                f"{list(rpc_methods[name])}, interface declares "
+                f"{list(declared)}",
+            ))
+
+    # 1 ⟷ 3: registry vs ACL.
+    for name in sorted(registry - set(acl)):
+        findings.append(Finding(
+            "TONY-P002", ERROR,
+            f"RPC method `{name}` has no METHOD_ACL entry — unreachable "
+            f"when security is enabled",
+        ))
+    for name in sorted(set(acl) - registry):
+        findings.append(Finding(
+            "TONY-P002", ERROR,
+            f"METHOD_ACL entry `{name}` matches no RPC method — dead "
+            f"security config",
+        ))
+    for name in sorted(registry & set(acl)):
+        if not acl[name]:
+            findings.append(Finding(
+                "TONY-P002", ERROR,
+                f"METHOD_ACL for `{name}` allows no role at all",
+            ))
+
+    # 1 ⟷ 4: registry vs typed client stubs.
+    for name in sorted(registry):
+        stub = client_cls.__dict__.get(name)
+        if stub is None:
+            findings.append(Finding(
+                "TONY-P003", ERROR,
+                f"{client_cls.__name__} has no typed stub for `{name}`",
+            ))
+            continue
+        stub_args = _arg_names(stub)
+        if stub_args != rpc_methods[name]:
+            findings.append(Finding(
+                "TONY-P003", ERROR,
+                f"client stub `{name}` takes {list(stub_args)} but "
+                f"RPC_METHODS declares {list(rpc_methods[name])}",
+            ))
+
+    # 1 ⟷ server handler: every method must resolve to a concrete impl.
+    for name in sorted(registry):
+        handler = getattr(server_cls, name, None)
+        if handler is None or getattr(
+            handler, "__isabstractmethod__", False
+        ):
+            findings.append(Finding(
+                "TONY-P004", ERROR,
+                f"{server_cls.__name__} has no concrete handler for "
+                f"`{name}` — the dispatch would 500 at runtime",
+            ))
+    return findings

@@ -1,0 +1,462 @@
+"""AST lint of the submitted training script: distributed-JAX hazards
+that burn a provisioned slice before failing (or worse, train wrong
+without failing).
+
+Each rule carries a stable id (``TONY-S1xx``), a severity, and the
+source span of the offending node. A finding on line L is suppressed by
+an inline ``# tony: noqa`` (all rules) or ``# tony: noqa[TONY-S101]``
+(listed rules) comment on that line.
+
+The linter is import-free: the user's script is parsed, never executed —
+a script with side effects at module scope (most training scripts) must
+not run on the submission client.
+
+Rules:
+
+=========  =======  ======================================================
+TONY-S101  error    host-divergent RNG seeding: ``jax.random.PRNGKey``/
+                    ``key`` fed from ``time.time()``, ``random.*``,
+                    ``np.random.*``, ``os.getpid()``, ``uuid.*`` — every
+                    host derives a different key, silently desyncing
+                    initialization across the slice.
+TONY-S102  warning  ``print``/``open`` inside a ``@jit``/``@pjit``
+                    function: executes once at trace time, not per step
+                    (use ``jax.debug.print`` / ``jax.debug.callback``).
+TONY-S103  error    ``PartitionSpec`` axis name that appears in no
+                    ``Mesh``/``make_mesh`` constructed in the module
+                    (skipped when the module builds no mesh).
+TONY-S104  warning  blocking host sync (``jax.device_get``,
+                    ``.block_until_ready()``) inside a ``@jit`` function:
+                    forces a device round-trip in the step's hot path.
+TONY-S105  warning  reading ``TF_CONFIG`` in a script that imports jax:
+                    the JAX runtime injects ``TONY_*``/
+                    ``JAX_COORDINATOR_ADDRESS``, not ``TF_CONFIG``.
+TONY-S106  error    multi-worker JAX job that never calls
+                    ``jax.distributed.initialize`` or
+                    ``tony_tpu.runtime.initialize`` — each host sees only
+                    local devices and collectives hang or mis-shard.
+TONY-S107  warning  iterating ``glob.glob``/``os.listdir`` without
+                    ``sorted(...)``: filesystem order differs per host,
+                    so data shards silently diverge.
+TONY-S108  error    ``input()``/``breakpoint()``/``pdb.set_trace()`` in a
+                    submitted script: blocks a remote executor forever.
+=========  =======  ======================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tony_tpu import constants
+from tony_tpu.analysis.findings import ERROR, WARNING, Finding
+
+_NOQA_RE = re.compile(
+    re.escape(constants.LINT_NOQA_MARKER) + r"(?:\[([A-Za-z0-9_,\-\s]+)\])?"
+)
+
+# Dotted-call prefixes whose results differ per host (feeding these into a
+# PRNG key desyncs initialization across the slice).
+_DIVERGENT_PREFIXES = (
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "random.", "numpy.random.", "os.getpid", "os.urandom",
+    "uuid.", "secrets.",
+)
+_PRNG_KEY_CALLS = ("jax.random.PRNGKey", "jax.random.key")
+_JIT_DECORATORS = (
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "jit", "pjit",
+)
+_MESH_CALLS = (
+    "jax.sharding.Mesh", "jax.experimental.mesh_utils.Mesh", "Mesh",
+    "jax.make_mesh", "make_mesh",
+)
+_PSPEC_CALLS = ("jax.sharding.PartitionSpec", "PartitionSpec", "P")
+_BLOCKING_CALLS = ("jax.device_get",)
+_INTERACTIVE_CALLS = (
+    "input", "breakpoint", "pdb.set_trace", "ipdb.set_trace",
+    "IPython.embed",
+)
+_ENV_READ_CALLS = ("os.getenv", "os.environ.get")
+_UNSORTED_SOURCES = ("glob.glob", "glob.iglob", "os.listdir", "os.scandir")
+_DISTRIBUTED_INIT_CALLS = (
+    "jax.distributed.initialize",
+    "tony_tpu.runtime.initialize",
+)
+
+
+def _noqa_map(source: str) -> dict[int, set[str] | None]:
+    """line -> None (suppress all) | set of rule ids suppressed there."""
+    out: dict[int, set[str] | None] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        if "#" not in line:
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[lineno] = None
+        else:
+            ids = {part.strip().upper() for part in m.group(1).split(",")}
+            out[lineno] = {i for i in ids if i}
+    return out
+
+
+class _Aliases:
+    """Import alias resolution: maps local names back to canonical dotted
+    module paths so ``import numpy as np; np.random.x`` resolves to
+    ``numpy.random.x`` and ``from jax import random as jr; jr.PRNGKey``
+    to ``jax.random.PRNGKey``."""
+
+    _CANON = {"np": "numpy", "jnp": "jax.numpy"}
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.names: dict[str, str] = {}
+        self.modules: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.modules.add(alias.name)
+                    self.names[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                self.modules.add(node.module)
+                for alias in node.names:
+                    self.names[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    def imports(self, module: str) -> bool:
+        return any(
+            m == module or m.startswith(module + ".") for m in self.modules
+        )
+
+    def resolve(self, node: ast.AST) -> str:
+        """Dotted name of an attribute/name expression with the leading
+        alias expanded (best effort; '' for non-name expressions)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return ""
+        head = self.names.get(node.id, node.id)
+        head = self._CANON.get(head, head)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+def _matches(dotted: str, patterns: tuple[str, ...]) -> bool:
+    for pat in patterns:
+        if pat.endswith("."):
+            if dotted.startswith(pat):
+                return True
+        elif dotted == pat:
+            return True
+    return False
+
+
+def _call_name(node: ast.AST, aliases: _Aliases) -> str:
+    return aliases.resolve(node.func) if isinstance(node, ast.Call) else ""
+
+
+def _string_consts(node: ast.AST) -> list[tuple[str, int]]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append((sub.value, getattr(sub, "lineno", 0)))
+    return out
+
+
+class _ScriptLinter:
+    def __init__(
+        self,
+        source: str,
+        filename: str,
+        *,
+        framework: str = "jax",
+        multi_process: bool = False,
+    ) -> None:
+        self.source = source
+        self.filename = filename
+        self.framework = framework
+        self.multi_process = multi_process
+        self.findings: list[Finding] = []
+
+    def _emit(self, rule_id: str, severity: str, node: ast.AST | None,
+              message: str, suggestion: str = "") -> None:
+        self.findings.append(Finding(
+            rule_id, severity, message, file=self.filename,
+            line=getattr(node, "lineno", 0) if node is not None else 0,
+            suggestion=suggestion,
+        ))
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.filename)
+        except SyntaxError as exc:
+            return [Finding(
+                "TONY-S100", ERROR,
+                f"script does not parse: {exc.msg}",
+                file=self.filename, line=exc.lineno or 0,
+            )]
+        aliases = _Aliases(tree)
+        noqa = _noqa_map(self.source)
+
+        self._check_seeding(tree, aliases)
+        self._check_jit_bodies(tree, aliases)
+        self._check_partition_axes(tree, aliases)
+        self._check_tf_config(tree, aliases)
+        self._check_distributed_init(tree, aliases)
+        self._check_unsorted_listing(tree, aliases)
+        self._check_interactive(tree, aliases)
+
+        kept = []
+        for f in self.findings:
+            rule_filter = noqa.get(f.line, ...)
+            if rule_filter is None:  # bare noqa: everything on the line
+                continue
+            if rule_filter is not ... and f.rule_id.upper() in rule_filter:
+                continue
+            kept.append(f)
+        return kept
+
+    # -- TONY-S101 ---------------------------------------------------------
+    def _check_seeding(self, tree: ast.AST, aliases: _Aliases) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node, aliases) not in _PRNG_KEY_CALLS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call):
+                        src = aliases.resolve(sub.func)
+                        if src and _matches(src, _DIVERGENT_PREFIXES):
+                            self._emit(
+                                "TONY-S101", ERROR, node,
+                                f"PRNG key seeded from host-divergent "
+                                f"source `{src}()` — every process gets a "
+                                f"different key and initialization "
+                                f"desyncs across the slice",
+                                "seed from a constant or from the "
+                                "injected process id "
+                                "(tony_tpu.runtime context)",
+                            )
+
+    # -- TONY-S102 / TONY-S104 --------------------------------------------
+    def _is_jit_decorated(self, fn: ast.AST, aliases: _Aliases) -> bool:
+        for dec in getattr(fn, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = aliases.resolve(target)
+            if _matches(name, _JIT_DECORATORS):
+                return True
+            # functools.partial(jax.jit, ...) / partial(pjit, ...)
+            if isinstance(dec, ast.Call) and name.endswith("partial"):
+                for arg in dec.args:
+                    if _matches(aliases.resolve(arg), _JIT_DECORATORS):
+                        return True
+        return False
+
+    def _check_jit_bodies(self, tree: ast.AST, aliases: _Aliases) -> None:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._is_jit_decorated(fn, aliases):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = aliases.resolve(node.func)
+                if name in ("print", "open"):
+                    self._emit(
+                        "TONY-S102", WARNING, node,
+                        f"`{name}(...)` inside jit-compiled "
+                        f"`{fn.name}` runs once at trace time, not "
+                        f"every step",
+                        "use jax.debug.print / jax.debug.callback, or "
+                        "move the side effect out of the jitted function",
+                    )
+                elif name in _BLOCKING_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                ):
+                    self._emit(
+                        "TONY-S104", WARNING, node,
+                        f"blocking host sync inside jit-compiled "
+                        f"`{fn.name}` stalls the step's hot path",
+                        "synchronize outside the step function",
+                    )
+
+    # -- TONY-S103 ---------------------------------------------------------
+    def _check_partition_axes(self, tree: ast.AST, aliases: _Aliases) -> None:
+        mesh_axes: set[str] = set()
+        mesh_seen = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _matches(aliases.resolve(node.func), _MESH_CALLS):
+                mesh_seen = True
+                for s, _ in _string_consts(node):
+                    mesh_axes.add(s)
+        if not mesh_seen:
+            return  # axes may come from a mesh built elsewhere — can't know
+        if not mesh_axes:
+            # A mesh IS built here but its axis names aren't string
+            # literals in the call (held in a variable/unpacked) — we
+            # recovered nothing to check against, so any comparison would
+            # only produce false positives.
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = aliases.resolve(node.func)
+            if name not in _PSPEC_CALLS or not name:
+                continue
+            # Only trust resolved jax.sharding.PartitionSpec, or a bare
+            # P/PartitionSpec alias imported from jax.
+            if name in ("P", "PartitionSpec") and not (
+                aliases.names.get(name, "").startswith("jax")
+            ):
+                continue
+            for axis, lineno in _string_consts(node):
+                if axis not in mesh_axes:
+                    self._emit(
+                        "TONY-S103", ERROR, node,
+                        f"PartitionSpec axis `{axis}` appears in no Mesh "
+                        f"constructed in this module "
+                        f"(axes: {sorted(mesh_axes) or '—'})",
+                    )
+
+    # -- TONY-S105 ---------------------------------------------------------
+    def _check_tf_config(self, tree: ast.AST, aliases: _Aliases) -> None:
+        if not aliases.imports("jax"):
+            return
+        for node in ast.walk(tree):
+            flagged = False
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                # os.environ["TF_CONFIG"] reads (writes are legitimate —
+                # e.g. configuring a nested TF data pipeline).
+                if aliases.resolve(node.value) == "os.environ":
+                    flagged = any(
+                        s == constants.TF_CONFIG
+                        for s, _ in _string_consts(node.slice)
+                    )
+            elif isinstance(node, ast.Call):
+                if aliases.resolve(node.func) in _ENV_READ_CALLS:
+                    flagged = any(
+                        isinstance(a, ast.Constant)
+                        and a.value == constants.TF_CONFIG
+                        for a in node.args
+                    )
+            if flagged:
+                self._emit(
+                    "TONY-S105", WARNING, node,
+                    "reads TF_CONFIG in a script that imports jax — the "
+                    "jax runtime injects TONY_*/JAX_COORDINATOR_ADDRESS, "
+                    "not TF_CONFIG",
+                    "use tony_tpu.runtime.initialize() for distributed "
+                    "identity",
+                )
+
+    # -- TONY-S106 ---------------------------------------------------------
+    def _check_distributed_init(self, tree: ast.AST, aliases: _Aliases) -> None:
+        if not self.multi_process or self.framework != "jax":
+            return
+        if not aliases.imports("jax"):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = aliases.resolve(node.func)
+                if name in _DISTRIBUTED_INIT_CALLS or name.endswith(
+                    "runtime.initialize"
+                ):
+                    return
+        # Anchor the finding on the jax import line.
+        line_node = None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names] if isinstance(
+                    node, ast.Import
+                ) else [node.module or ""]
+                if any(m == "jax" or m.startswith("jax.") for m in mods):
+                    line_node = node
+                    break
+        self._emit(
+            "TONY-S106", ERROR, line_node,
+            "multi-worker JAX job never calls jax.distributed.initialize "
+            "or tony_tpu.runtime.initialize — each host sees only its "
+            "local devices and collectives hang or mis-shard",
+            "call tony_tpu.runtime.initialize() before touching devices",
+        )
+
+    # -- TONY-S107 ---------------------------------------------------------
+    def _check_unsorted_listing(self, tree: ast.AST, aliases: _Aliases) -> None:
+        # Only sorted(...) sanctions the order. NOT set(): string hashing
+        # is randomized per process, so set iteration order is itself
+        # host-divergent — the exact hazard this rule catches.
+        sorted_args: set[int] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and aliases.resolve(node.func) == "sorted"
+            ):
+                for arg in node.args:
+                    sorted_args.add(id(arg))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = aliases.resolve(node.func)
+            if name in _UNSORTED_SOURCES and id(node) not in sorted_args:
+                self._emit(
+                    "TONY-S107", WARNING, node,
+                    f"`{name}(...)` order is filesystem-dependent and "
+                    f"differs per host — unsorted file lists silently "
+                    f"diverge data shards across processes",
+                    "wrap in sorted(...)",
+                )
+
+    # -- TONY-S108 ---------------------------------------------------------
+    def _check_interactive(self, tree: ast.AST, aliases: _Aliases) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = aliases.resolve(node.func)
+            if name in _INTERACTIVE_CALLS:
+                self._emit(
+                    "TONY-S108", ERROR, node,
+                    f"`{name}(...)` blocks a remote executor forever "
+                    f"(no terminal is attached to a submitted task)",
+                )
+
+
+def lint_source(
+    source: str,
+    filename: str = "<script>",
+    *,
+    framework: str = "jax",
+    multi_process: bool = False,
+) -> list[Finding]:
+    return _ScriptLinter(
+        source, filename, framework=framework, multi_process=multi_process
+    ).run()
+
+
+def lint_script(
+    path: str,
+    *,
+    framework: str = "jax",
+    multi_process: bool = False,
+) -> list[Finding]:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError as exc:
+        return [Finding(
+            "TONY-S100", ERROR, f"cannot read script: {exc}", file=str(path),
+        )]
+    return lint_source(
+        source, str(path), framework=framework, multi_process=multi_process
+    )

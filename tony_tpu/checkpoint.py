@@ -460,9 +460,19 @@ class CheckpointManager:
         raw = self._store.get_file(step, "metadata.json")
         if raw is None:
             return self.num_processes
+        # A corrupt metadata.json must degrade to the ambient process
+        # count, not abort the restore: the JSON may fail to parse, parse
+        # to a non-dict (list/string/number), or carry a non-numeric
+        # num_processes.
         try:
-            return int(json.loads(raw).get("num_processes", self.num_processes))
+            meta = json.loads(raw)
         except ValueError:
+            return self.num_processes
+        if not isinstance(meta, dict):
+            return self.num_processes
+        try:
+            return int(meta.get("num_processes", self.num_processes))
+        except (TypeError, ValueError):
             return self.num_processes
 
     def _read_shard_file(

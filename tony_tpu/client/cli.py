@@ -220,10 +220,77 @@ def cleanup_resources(argv: list[str], *, api=None) -> int:
     return 0
 
 
+def lint(argv: list[str]) -> int:
+    """``cli lint``: the preflight static-analysis pass, standalone — the
+    same three layers (config, script, protocol) that ``client.submit``
+    runs under ``tony.preflight.mode``, surfaced as a red/green check the
+    user (or CI) runs before burning a slice.
+
+    Usage::
+
+        python -m tony_tpu.client.cli lint [paths...]
+            [--conf_file tony.json] [--conf k=v] [--strict]
+
+    Paths are training scripts or directories of them (directories are
+    scanned recursively for ``*.py``). With ``--conf_file``/``--conf``
+    the resolved job config is checked too and its entry point joins the
+    lint set. Exit status: 0 when no findings (or warnings only, without
+    ``--strict``), 1 on error findings (or any finding with ``--strict``).
+    """
+    import argparse
+
+    from tony_tpu.analysis import findings as fmod
+    from tony_tpu.analysis.preflight import run_preflight
+    from tony_tpu.conf.configuration import load_job_config
+
+    p = argparse.ArgumentParser(
+        prog="tony_tpu.client.cli lint",
+        description="Preflight static analysis for tony_tpu jobs.",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="training scripts or directories to lint")
+    p.add_argument("--conf_file", help="job config file to check")
+    p.add_argument("--conf", action="append", default=[],
+                   help="key=value override (repeatable)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on warnings too")
+    args = p.parse_args(argv)
+
+    scripts: list[str] = []
+    for raw in args.paths:
+        path = Path(raw)
+        if path.is_dir():
+            scripts.extend(
+                str(f) for f in sorted(path.rglob("*.py"))
+            )
+        elif path.is_file():
+            scripts.append(str(path))
+        else:
+            print(f"lint: no such file or directory: {raw}", file=sys.stderr)
+            return 2
+
+    conf = None
+    if args.conf_file or args.conf:
+        conf = load_job_config(conf_file=args.conf_file, overrides=args.conf)
+    all_findings = run_preflight(conf, scripts)
+    if all_findings:
+        print(fmod.format_findings(all_findings))
+    errors = sum(1 for f in all_findings if f.severity == fmod.ERROR)
+    warnings = sum(1 for f in all_findings if f.severity == fmod.WARNING)
+    print(
+        f"lint: {len(scripts)} script(s), "
+        f"{errors} error(s), {warnings} warning(s)"
+    )
+    if errors or (args.strict and all_findings):
+        return 1
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
     "notebook": notebook_submit,
+    "lint": lint,
     "list": list_resources,
     "cleanup": cleanup_resources,
 }

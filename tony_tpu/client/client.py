@@ -151,6 +151,13 @@ class TonyClient:
 
     # -- submit + monitor (TonyClient.run:146-208) --------------------------
     def run(self) -> int:
+        # Preflight gate BEFORE staging: a strict-mode refusal costs zero
+        # staged bytes and zero provisioned hardware (analysis/preflight).
+        from tony_tpu.analysis.preflight import run_for_submission
+
+        rc = run_for_submission(self.conf, cwd=os.getcwd())
+        if rc:
+            return rc
         self.app_dir = self._stage()
         log.info("staged application %s at %s", self.app_id, self.app_dir)
 

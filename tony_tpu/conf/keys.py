@@ -104,6 +104,10 @@ K_CLIENT_MONITOR_INTERVAL_MS = TONY_PREFIX + "client.monitor-interval"
 K_PROFILER_ENABLED = TONY_PREFIX + "profiler.enabled"
 K_TENSORBOARD_ENABLED = TONY_PREFIX + "tensorboard.enabled"
 
+# --- preflight static analysis (analysis/) ---------------------------------
+# off | warn | strict — strict refuses submission on any error finding.
+K_PREFLIGHT_MODE = TONY_PREFIX + "preflight.mode"
+
 # --- version info (gradle/version-info.gradle analogue; stamped into the
 # conf at submission by tony_tpu.version.inject_version_info) ---------------
 VERSION_INFO_PREFIX = TONY_PREFIX + "version-info."
@@ -161,6 +165,7 @@ DEFAULTS: dict[str, object] = {
     K_CLIENT_MONITOR_INTERVAL_MS: 1000,
     K_PROFILER_ENABLED: False,
     K_TENSORBOARD_ENABLED: True,
+    K_PREFLIGHT_MODE: "warn",
     K_VERSION_INFO_VERSION: "",
     K_VERSION_INFO_REVISION: "",
     K_VERSION_INFO_BRANCH: "",

@@ -98,6 +98,16 @@ TONY_STAGING_DIR = ".tony"
 TONY_CONF_DIR_ENV = "TONY_CONF_DIR"
 
 # ---------------------------------------------------------------------------
+# Preflight static analysis (tony.preflight.mode; analysis/preflight.py)
+# ---------------------------------------------------------------------------
+PREFLIGHT_OFF = "off"        # never run
+PREFLIGHT_WARN = "warn"      # run, report, submit anyway
+PREFLIGHT_STRICT = "strict"  # run, refuse submission on any error finding
+# Inline suppression marker matched by analysis/script_lint.py:
+#   some_code()  # tony: noqa[TONY-S101]
+LINT_NOQA_MARKER = "tony: noqa"
+
+# ---------------------------------------------------------------------------
 # Job / task names
 # ---------------------------------------------------------------------------
 WORKER_JOB_NAME = "worker"

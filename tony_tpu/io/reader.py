@@ -319,6 +319,10 @@ class ShardedRecordReader:
         if self.fmt == "jsonl-blocks":
             from tony_tpu.io.blocks import read_header
 
+            # Consult EVERY container backing this reader before falling
+            # back to record introspection: the writer may have embedded
+            # the schema in any of them (e.g. an older first container
+            # with an empty header followed by schema-carrying ones).
             for path in self._sizes:
                 codec, schema, _ = read_header(path)
                 if schema:
@@ -326,7 +330,6 @@ class ShardedRecordReader:
                         "format": "jsonl-blocks", "codec": codec,
                         "schema": schema,
                     })
-                break
         iter_one = (
             self._iter_blocks if self.fmt == "jsonl-blocks"
             else self._iter_jsonl

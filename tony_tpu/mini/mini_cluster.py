@@ -12,6 +12,7 @@ in-process fake cluster" is the reference's key transferable test idea).
 from __future__ import annotations
 
 import atexit
+import logging
 import threading
 from pathlib import Path
 
@@ -71,6 +72,17 @@ class MiniTonyCluster:
         RPC server + executor subprocesses are real; only the "RM" container
         allocation is replaced by local process spawning."""
         self._app_seq += 1
+        # Preflight in WARN mode regardless of the conf's own setting:
+        # mini-cluster jobs are dev/test runs, so findings should print
+        # but never block (the strict gate belongs to real submissions).
+        from tony_tpu.analysis.findings import format_findings
+        from tony_tpu.analysis.preflight import run_preflight
+
+        findings = run_preflight(conf)
+        if findings:
+            mlog = logging.getLogger(__name__)
+            for line in format_findings(findings).splitlines():
+                mlog.warning("preflight: %s", line)
         app_id = f"application_mini_{self._app_seq}"
         app_dir = self.staging_dir / app_id
         app_dir.mkdir(parents=True, exist_ok=True)

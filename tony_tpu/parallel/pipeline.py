@@ -107,9 +107,14 @@ def _pipeline_local(params, x_mb, *, stage_fn, axis_name: str,
         if stage_aux:
             y, aux = stage_fn(my_params, x_in)
             u = t - stage_idx
-            valid = ((u >= 0) & (u < num_micro)).astype(jnp.float32)
+            valid = (u >= 0) & (u < num_micro)
+            # where, not multiply-by-mask: bubble ticks run stage_fn on
+            # garbage activations, and 0 * NaN = NaN would poison the
+            # accumulator (the output path is safe via clamped overwrite;
+            # the aux path must mask by selection).
             aux_acc = jax.tree.map(
-                lambda acc, a: acc + valid * a, aux_acc, aux
+                lambda acc, a: acc + jnp.where(valid, a, jnp.zeros_like(a)),
+                aux_acc, aux,
             )
         else:
             y = stage_fn(my_params, x_in)
@@ -189,9 +194,12 @@ def _pipeline_interleaved_local(
         )
         if stage_aux:
             y, aux = stage_fn(my_chunk, x_in)
-            valid = ((u >= 0) & (u < virtual * num_micro)).astype(jnp.float32)
+            valid = (u >= 0) & (u < virtual * num_micro)
+            # Selection, not multiplication: garbage-tick aux may be
+            # non-finite and 0 * NaN = NaN (see the gpipe path above).
             aux_acc = jax.tree.map(
-                lambda acc, a: acc + valid * a, aux_acc, aux
+                lambda acc, a: acc + jnp.where(valid, a, jnp.zeros_like(a)),
+                aux_acc, aux,
             )
         else:
             y = stage_fn(my_chunk, x_in)

@@ -71,6 +71,20 @@ def initialize(**kwargs) -> TaskContext:
     if ctx.is_distributed:
         import jax
 
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # Multi-process collectives on the CPU backend need the gloo
+            # transport enabled explicitly on older jax (newer releases
+            # default to it); without this every cross-process psum fails
+            # with "Multiprocess computations aren't implemented".
+            for opt, val in (
+                ("jax_cpu_collectives_implementation", "gloo"),
+                ("jax_cpu_enable_gloo_collectives", True),
+            ):
+                try:
+                    jax.config.update(opt, val)
+                    break
+                except (AttributeError, ValueError):
+                    continue
         jax.distributed.initialize(
             coordinator_address=ctx.coordinator_address,
             num_processes=ctx.num_processes,

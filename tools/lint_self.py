@@ -1,0 +1,84 @@
+"""Repo self-drift check: run the preflight analyzers over THIS tree.
+
+Two tables that must never drift are checked:
+
+* config registry — every ``K_*`` key in ``conf/keys.py`` must appear in
+  the shipped ``tony-default.json`` with the same default, and vice
+  versa (the per-job-type families ship worker/ps rows);
+* the RPC protocol — registry ⟷ interface ⟷ ACL ⟷ client stubs ⟷
+  coordinator handler (``analysis/protocol_check``).
+
+Invoked from the tier-1 suite (``tests/test_analysis.py``) so drift
+fails CI, and runnable standalone::
+
+    python tools/lint_self.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # standalone `python tools/lint_self.py`
+    sys.path.insert(0, str(REPO))
+
+
+def check_config_drift() -> list[str]:
+    """keys.DEFAULTS ⟷ tony-default.json, both directions, values too."""
+    from tony_tpu import constants
+    from tony_tpu.conf import keys
+
+    shipped = json.loads(
+        (REPO / "tony_tpu" / "conf" / constants.TONY_DEFAULT_CONF)
+        .read_text()
+    )
+    expected = dict(keys.DEFAULTS)
+    for job in ("worker", "ps"):
+        expected[keys.instances_key(job)] = keys.default_instances(job)
+        expected[keys.memory_key(job)] = keys.DEFAULT_MEMORY
+        expected[keys.vcores_key(job)] = keys.DEFAULT_VCORES
+        expected[keys.gpus_key(job)] = keys.DEFAULT_GPUS
+        expected[keys.tpus_key(job)] = keys.DEFAULT_TPUS
+
+    problems = []
+    for key in sorted(set(expected) - set(shipped)):
+        problems.append(
+            f"config drift: `{key}` declared in conf/keys.py but absent "
+            f"from {constants.TONY_DEFAULT_CONF}"
+        )
+    for key in sorted(set(shipped) - set(expected)):
+        problems.append(
+            f"config drift: `{key}` in {constants.TONY_DEFAULT_CONF} but "
+            f"not declared in conf/keys.py"
+        )
+    for key in sorted(set(expected) & set(shipped)):
+        if shipped[key] != expected[key]:
+            problems.append(
+                f"config drift: `{key}` defaults disagree — keys.py says "
+                f"{expected[key]!r}, shipped file says {shipped[key]!r}"
+            )
+    return problems
+
+
+def check_protocol_drift() -> list[str]:
+    from tony_tpu.analysis.protocol_check import check_protocol
+
+    return [f.render() for f in check_protocol()]
+
+
+def main() -> int:
+    problems = check_config_drift() + check_protocol_drift()
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"lint_self: {len(problems)} drift problem(s)",
+              file=sys.stderr)
+        return 1
+    print("lint_self: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
