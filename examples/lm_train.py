@@ -205,15 +205,26 @@ def main(argv=None) -> int:
     batches = corpus_batches(args, ctx)
 
     scratch = os.environ.get("TONY_LOG_DIR", ".")
-    # NOT wrapped in Path(): --ckpt-dir may be a gs:// prefix.
-    ckpt_dir = args.ckpt_dir or os.path.join(scratch, "lm-checkpoints")
+    # NOT wrapped in Path(): --ckpt-dir / TONY_CHECKPOINT_DIR may be a
+    # gs:// prefix. TONY_CHECKPOINT_DIR is the coordinator-probed location
+    # (tony.checkpoint.location) — using it keeps resume-step export and
+    # progress-aware retry budgets working without per-script flags.
+    ckpt_dir = (
+        args.ckpt_dir
+        or os.environ.get("TONY_CHECKPOINT_DIR")
+        or os.path.join(scratch, "lm-checkpoints")
+    )
     mgr = CheckpointManager(
         ckpt_dir,
         process_id=ctx.process_id, num_processes=ctx.num_processes,
     )
     with jax.sharding.set_mesh(mesh):
         state = init_fn(jax.random.key(0))
-        restored = mgr.restore(state)
+        # Checkpoint-aware restart: a retried session is told the newest
+        # step the coordinator saw complete (TONY_RESUME_STEP);
+        # restore_resumable pins every process to that SAME step, falling
+        # back to newest-complete outside a retry.
+        restored = mgr.restore_resumable(state)
         if restored is not None:
             state = restored
             print(f"resumed from step {int(state.step)}", flush=True)

@@ -1,0 +1,62 @@
+"""Deterministic chaos-training fixture for the kill-and-resume e2e.
+
+Trains a tiny numpy "model" through the real CheckpointManager, one
+complete checkpoint per step. In session 1 every task parks (sleeps)
+once it reaches PARK_AT — so the session can only end via the fault
+plan's kill, making the surviving checkpoint step deterministic. A
+retried session must resume from TONY_RESUME_STEP (asserted: resuming
+from 0 or from a step != PARK_AT fails the run) and train to TARGET.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from tony_tpu.checkpoint import CheckpointManager
+
+TARGET = 10
+PARK_AT = 5
+
+
+def main() -> int:
+    ckpt_dir = os.environ["TONY_CHECKPOINT_DIR"]
+    session = int(os.environ.get("SESSION_ID", "1"))
+    process_id = int(os.environ.get("TASK_INDEX", "0"))
+    num = int(os.environ.get("TASK_NUM", "1"))
+    mgr = CheckpointManager(
+        ckpt_dir, process_id=process_id, num_processes=num
+    )
+    state = {"step": np.array(0), "w": np.zeros(4)}
+    resume_env = os.environ.get("TONY_RESUME_STEP")
+    restored = mgr.restore_resumable(state)
+    start = 0
+    if restored is not None:
+        state = restored
+        start = int(state["step"])
+        print(f"resumed from step {start}", flush=True)
+    if session > 1:
+        # The retried session must have been pointed at the parked
+        # checkpoint — recomputing from scratch is the bug this fixture
+        # exists to catch.
+        if resume_env is None:
+            print("retried session got no TONY_RESUME_STEP", file=sys.stderr)
+            return 1
+        if start != int(resume_env):
+            print(f"resumed from {start}, expected {resume_env}",
+                  file=sys.stderr)
+            return 1
+    for step in range(start + 1, TARGET + 1):
+        state = {"step": np.array(step), "w": state["w"] + 1.0}
+        mgr.save(step, state, blocking=True)
+        print(f"step {step}", flush=True)
+        if session == 1 and step >= PARK_AT:
+            # Park: session 1 never finishes on its own; only the fault
+            # plan's kill ends it, always with step PARK_AT complete.
+            time.sleep(300)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,10 @@
 """Fault-injection e2e matrix — the analogue of the reference's env-flag
-fault tests (TestTonyE2E.java:86-117, 201-238): deterministic failures
-injected via env vars read at well-defined points (SURVEY §4)."""
+fault tests (TestTonyE2E.java:86-117, 201-238), grown into a structured
+chaos suite: the legacy ``TEST_*`` env vars still work as deprecated
+aliases, and the ``tony.fault.plan`` tests drive the failure classifier,
+backoff policy, and checkpoint-aware resume end to end (SURVEY §4)."""
 
+import json
 import sys
 from pathlib import Path
 
@@ -92,6 +95,100 @@ def test_retries_exhausted_still_fails(cluster):
     status, coord = cluster.run_job(conf, timeout_s=90)
     assert status is SessionStatus.FAILED
     assert coord.session.session_id == 2
+
+
+def test_user_permanent_fails_fast_without_consuming_retries(cluster):
+    """Chaos: worker:0 exits 1 BEFORE the rendezvous barrier (the fault
+    plan's exit_executor — how a typo'd script path looks). The classifier
+    must read the pre-registration nonzero exit as USER_PERMANENT and fail
+    the job on session 1, with the full retry budget untouched — no slices
+    burned re-running a deterministic user bug."""
+    plan = {"seed": 3, "faults": [
+        {"action": "exit_executor", "target": "worker:0",
+         "at": "pre_register", "code": 1},
+    ]}
+    conf = _job(cluster, "exit_0.py")
+    conf.set(keys.K_FAULT_PLAN, json.dumps(plan))
+    conf.set(keys.K_AM_RETRY_COUNT, 3)
+    status, coord = cluster.run_job(conf, timeout_s=60)
+    assert status is SessionStatus.FAILED
+    stats = json.loads(
+        (coord.app_dir / "final-status.json").read_text()
+    )["stats"]
+    assert stats["sessions_run"] == 1  # fail-fast: no retries consumed
+    (record,) = stats["retries"]
+    assert record["category"] == "USER_PERMANENT"
+    assert record["retried"] is False
+    assert record["backoff_ms"] == 0
+    assert "pre-rendezvous" in record["failure"]
+
+
+def test_transient_exit_consumes_retry_budget(cluster):
+    """Counterpoint: the same exit code AFTER rendezvous is TRANSIENT and
+    does consume retries — the category, not the code, decides."""
+    conf = _job(cluster, "exit_1.py")
+    conf.set(keys.K_AM_RETRY_COUNT, 1)
+    conf.set(keys.K_AM_RETRY_BACKOFF_BASE_MS, 50)
+    status, coord = cluster.run_job(conf, timeout_s=90)
+    assert status is SessionStatus.FAILED
+    stats = json.loads(
+        (coord.app_dir / "final-status.json").read_text()
+    )["stats"]
+    assert stats["sessions_run"] == 2
+    assert [r["category"] for r in stats["retries"]] \
+        == ["TRANSIENT", "TRANSIENT"]
+    assert stats["retries"][0]["retried"] is True
+    assert stats["retries"][0]["backoff_ms"] > 0
+    assert stats["retries"][1]["retried"] is False
+
+
+@pytest.mark.slow
+def test_chaos_kill_worker_resumes_from_checkpoint(cluster, tmp_path):
+    """THE acceptance chaos run: a fault plan SIGKILLs the non-chief worker
+    mid-training (after its 15th heartbeat, by which point both workers
+    have parked on a complete step-5 checkpoint — see
+    fixtures/chaos_train.py). Asserts, deterministically under the plan
+    seed: the session retries with the exact seeded backoff (observable in
+    final-status.json stats), the retried session resumes from step 5
+    rather than step 0, and the job finishes SUCCEEDED."""
+    from tony_tpu.resilience import FailureCategory, RetryPolicy
+
+    ckpt_dir = tmp_path / "chaos-ckpts"
+    plan = {"seed": 7, "faults": [
+        {"action": "kill_task", "target": "worker:1",
+         "after_heartbeats": 15, "session": 1},
+    ]}
+    conf = _job(cluster, "chaos_train.py", workers=2)
+    # No ps task: every task type runs the user command, and a ps would
+    # checkpoint as process 0 of a 1-process job into the same directory —
+    # colliding with worker:0's shards and lying about completeness.
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_FAULT_PLAN, json.dumps(plan))
+    conf.set(keys.K_CHECKPOINT_LOCATION, str(ckpt_dir))
+    conf.set(keys.K_AM_RETRY_COUNT, 2)
+    conf.set(keys.K_AM_RETRY_BACKOFF_BASE_MS, 300)
+    conf.set(keys.K_AM_RETRY_BACKOFF_MAX_MS, 2000)
+    status, coord = cluster.run_job(conf, timeout_s=240)
+    assert status is SessionStatus.SUCCEEDED
+    final = json.loads((coord.app_dir / "final-status.json").read_text())
+    stats = final["stats"]
+    assert stats["sessions_run"] == 2
+    (record,) = stats["retries"]
+    # SIGKILL'd mid-training → INFRA, with the exact deterministic backoff
+    # the plan seed implies (jitter seed inherits the plan seed).
+    assert record["category"] == "INFRA"
+    assert record["retried"] is True
+    assert record["resume_step"] == 5
+    expected = RetryPolicy(
+        budget=2, backoff_base_ms=300, backoff_max_ms=2000, seed=7,
+    ).backoff_ms_for(1, FailureCategory.INFRA)
+    assert record["backoff_ms"] == expected > 0
+    # Training finished at the target, resuming — not recomputing — and
+    # the chief's log proves the step-5 resume (chaos_train.py exits 1 on
+    # any other resume point).
+    assert stats["best_checkpoint_step"] == 10
+    chief_log = (coord.app_dir / "logs" / "worker-0.log").read_text()
+    assert "resumed from step 5" in chief_log
 
 
 def test_final_status_carries_run_stats(cluster, tmp_path):

@@ -1,0 +1,608 @@
+"""Unit tests for the failure-aware retry subsystem (resilience/): the
+classifier's category table, backoff-schedule determinism, the
+progress-aware retry budget, fault-plan parse/validation, the jax-free
+checkpoint probe, liveness expiry + ping fencing, and the hardened
+Heartbeater. All fast — the kill-and-resume chaos e2e lives in
+tests/test_fault_injection.py behind the ``slow`` marker."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.liveness import LivenessMonitor
+from tony_tpu.resilience import (
+    FailureCategory,
+    FailureEvent,
+    FaultPlan,
+    FaultPlanError,
+    RetryPolicy,
+    classify,
+    latest_complete_step,
+)
+from tony_tpu.resilience import classifier as kinds
+from tony_tpu.resilience.faults import (
+    CheckpointFaults,
+    FaultInjector,
+    FaultSpec,
+)
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+class TestClassifier:
+    @pytest.mark.parametrize("event,expected", [
+        # Substrate failures → INFRA.
+        (FailureEvent(kinds.HEARTBEAT_EXPIRY, task_id="worker:1"),
+         FailureCategory.INFRA),
+        (FailureEvent(kinds.PREEMPTION, task_id="worker:0", exit_code=1),
+         FailureCategory.INFRA),
+        # Signal deaths → INFRA, both the subprocess (-9) and shell (137)
+        # spellings, and even pre-rendezvous (a SIGKILL is external).
+        (FailureEvent(kinds.TASK_EXIT, exit_code=-9),
+         FailureCategory.INFRA),
+        (FailureEvent(kinds.TASK_EXIT, exit_code=137),
+         FailureCategory.INFRA),
+        (FailureEvent(kinds.TASK_EXIT, exit_code=143, registered=False),
+         FailureCategory.INFRA),
+        (FailureEvent(kinds.TASK_EXIT,
+                      exit_code=constants.EXIT_CODE_LOST_COORDINATOR),
+         FailureCategory.INFRA),
+        # Deterministic user errors → USER_PERMANENT.
+        (FailureEvent(kinds.TASK_EXIT, exit_code=127),
+         FailureCategory.USER_PERMANENT),
+        (FailureEvent(kinds.TASK_EXIT, exit_code=126),
+         FailureCategory.USER_PERMANENT),
+        (FailureEvent(kinds.TASK_EXIT, exit_code=1, registered=False),
+         FailureCategory.USER_PERMANENT),
+        (FailureEvent(kinds.CONF_ERROR, detail="bad topology"),
+         FailureCategory.USER_PERMANENT),
+        # Could-work-on-rerun → TRANSIENT.
+        (FailureEvent(kinds.TASK_EXIT, exit_code=1, registered=True),
+         FailureCategory.TRANSIENT),
+        (FailureEvent(kinds.TASK_EXIT, exit_code=124, registered=False),
+         FailureCategory.TRANSIENT),  # timeout: ran, overran
+        (FailureEvent(kinds.TASK_EXIT),  # unattributed default
+         FailureCategory.TRANSIENT),
+    ])
+    def test_category_table(self, event, expected):
+        assert classify(event) is expected
+
+    def test_describe_mentions_the_facts(self):
+        e = FailureEvent(kinds.TASK_EXIT, task_id="worker:1", exit_code=9,
+                         registered=False)
+        d = e.describe()
+        assert "worker:1" in d and "exit=9" in d and "pre-rendezvous" in d
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_backoff_deterministic_and_bounded(self):
+        a = RetryPolicy(budget=5, backoff_base_ms=1000, seed=42)
+        b = RetryPolicy(budget=5, backoff_base_ms=1000, seed=42)
+        for attempt in (1, 2, 3, 4):
+            x = a.backoff_ms_for(attempt, FailureCategory.TRANSIENT)
+            assert x == b.backoff_ms_for(attempt, FailureCategory.TRANSIENT)
+            base = 1000 * 2 ** (attempt - 1)
+            assert base <= x < base * 1.5
+
+    def test_backoff_grows_exponentially_and_caps(self):
+        p = RetryPolicy(budget=9, backoff_base_ms=100,
+                        backoff_max_ms=500, seed=1)
+        # attempt 4 raw = 800 → capped at 500; jitter < 1.5 keeps it < 750
+        assert p.backoff_ms_for(4, FailureCategory.TRANSIENT) < 750
+        assert p.backoff_ms_for(4, FailureCategory.TRANSIENT) >= 500
+
+    def test_different_seeds_decorrelate(self):
+        vals = {
+            RetryPolicy(budget=1, seed=s).backoff_ms_for(
+                1, FailureCategory.TRANSIENT
+            )
+            for s in range(20)
+        }
+        assert len(vals) > 1  # retry storms must not stampede in lockstep
+
+    def test_infra_backs_off_half_of_transient(self):
+        p = RetryPolicy(budget=1, backoff_base_ms=1000, seed=7)
+        t = p.backoff_ms_for(1, FailureCategory.TRANSIENT)
+        i = p.backoff_ms_for(1, FailureCategory.INFRA)
+        assert i == int(t * 0.5) or abs(i - t / 2) <= 1
+
+    def test_user_permanent_never_retries(self):
+        p = RetryPolicy(budget=100)
+        d = p.decide(FailureCategory.USER_PERMANENT)
+        assert not d.retry and p.remaining == 100
+
+    def test_budget_consumed_and_exhausted(self):
+        p = RetryPolicy(budget=2, backoff_base_ms=10)
+        assert p.decide(FailureCategory.TRANSIENT).retry
+        assert p.decide(FailureCategory.INFRA).retry
+        d = p.decide(FailureCategory.TRANSIENT)
+        assert not d.retry and "exhausted" in d.reason
+
+    def test_progress_refreshes_budget(self):
+        p = RetryPolicy(budget=1, backoff_base_ms=10)
+        p.observe_progress(100)          # first observation: baseline
+        assert p.decide(FailureCategory.INFRA).retry
+        assert p.remaining == 0
+        assert p.observe_progress(200)   # advanced → refresh
+        assert p.remaining == 1
+        assert p.decide(FailureCategory.INFRA).retry
+
+    def test_no_progress_no_refresh(self):
+        p = RetryPolicy(budget=1, backoff_base_ms=10)
+        p.observe_progress(100)
+        assert p.decide(FailureCategory.INFRA).retry
+        assert not p.observe_progress(100)   # same step: no refresh
+        assert not p.observe_progress(None)  # no checkpoint: no refresh
+        assert not p.decide(FailureCategory.INFRA).retry
+
+
+# ---------------------------------------------------------------------------
+# Fault plan parse/validation
+# ---------------------------------------------------------------------------
+GOOD_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"action": "crash_coordinator", "phase": "schedule", "session": 1},
+        {"action": "kill_task", "target": "worker:1", "at": "rendezvous"},
+        {"action": "kill_task", "target": "any_non_chief",
+         "at": "rendezvous"},
+        {"action": "kill_task", "target": "worker:1", "after_heartbeats": 3},
+        {"action": "kill_task", "target": "worker:1", "after_ms": 1500,
+         "session": 1},
+        {"action": "exit_executor", "target": "worker:0", "code": 1},
+        {"action": "drop_heartbeats", "target": "worker:0", "count": 10},
+        {"action": "delay_heartbeats", "target": "worker:0", "ms": 250,
+         "count": 5},
+        {"action": "blackout_rpc", "after_ms": 2000, "ms": 1500},
+        {"action": "fail_checkpoint_write", "step": 10},
+    ],
+}
+
+
+class TestFaultPlanParse:
+    def test_good_plan_parses(self):
+        plan = FaultPlan.parse(json.dumps(GOOD_PLAN))
+        assert plan.seed == 7
+        assert len(plan.specs) == 10
+        assert plan.specs[5].at == "pre_register"  # exit_executor default
+
+    @pytest.mark.parametrize("mutate,complaint", [
+        (lambda p: p.update(seed="x"), "seed must be an integer"),
+        (lambda p: p.update(extra=1), "unknown top-level field"),
+        (lambda p: p["faults"].append({"action": "explode"}),
+         "unknown action"),
+        (lambda p: p["faults"].append(
+            {"action": "kill_task", "target": "worker:1", "at": "rendezvous",
+             "bogus": 1}), "unknown field 'bogus'"),
+        (lambda p: p["faults"].append({"action": "kill_task"}),
+         "missing required field 'target'"),
+        (lambda p: p["faults"].append(
+            {"action": "kill_task", "target": "worker:1"}),
+         "exactly one trigger"),
+        (lambda p: p["faults"].append(
+            {"action": "kill_task", "target": "worker:1",
+             "at": "rendezvous", "after_ms": 5}), "exactly one trigger"),
+        (lambda p: p["faults"].append(
+            {"action": "kill_task", "target": "nocolon", "after_ms": 5}),
+         "job:index"),
+        (lambda p: p["faults"].append(
+            {"action": "kill_task", "target": "any_non_chief",
+             "after_ms": 5}), "only legal with at='rendezvous'"),
+        (lambda p: p["faults"].append(
+            {"action": "crash_coordinator", "phase": "nope"}),
+         "phase must be one of"),
+        (lambda p: p["faults"].append(
+            {"action": "exit_executor", "target": "any_non_chief"}),
+         "concrete 'job:index'"),
+        (lambda p: p["faults"].append(
+            {"action": "exit_executor", "target": "worker:0", "code": 0}),
+         "must be nonzero"),
+        (lambda p: p["faults"].append(
+            {"action": "delay_heartbeats", "target": "worker:0"}),
+         "missing required field 'ms'"),
+        (lambda p: p["faults"].append(
+            {"action": "fail_checkpoint_write", "step": -1}),
+         "must be >= 0"),
+        (lambda p: p["faults"].append(
+            {"action": "drop_heartbeats", "target": "worker:0", "count": 0}),
+         "must be >= 1"),
+    ])
+    def test_bad_plans_refused_with_pointed_errors(self, mutate, complaint):
+        plan = json.loads(json.dumps(GOOD_PLAN))
+        mutate(plan)
+        with pytest.raises(FaultPlanError) as e:
+            FaultPlan.parse(json.dumps(plan))
+        assert complaint in str(e.value)
+
+    def test_not_json_and_not_object(self):
+        with pytest.raises(FaultPlanError, match="not valid JSON"):
+            FaultPlan.parse("{nope")
+        with pytest.raises(FaultPlanError, match="must be a JSON object"):
+            FaultPlan.parse("[1,2]")
+
+    def test_all_errors_reported_at_once(self):
+        bad = {"faults": [{"action": "kill_task"},
+                          {"action": "explode"}]}
+        with pytest.raises(FaultPlanError) as e:
+            FaultPlan.parse(json.dumps(bad))
+        assert len(e.value.errors) >= 2
+
+    def test_from_conf_inline_file_and_empty(self, tmp_path):
+        conf = TonyConfiguration()
+        assert FaultPlan.from_conf(conf, env={}) is None
+        conf.set(keys.K_FAULT_PLAN, json.dumps(GOOD_PLAN))
+        assert len(FaultPlan.from_conf(conf, env={}).specs) == 10
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(GOOD_PLAN))
+        conf.set(keys.K_FAULT_PLAN, str(path))
+        assert FaultPlan.from_conf(conf, env={}).seed == 7
+        conf.set(keys.K_FAULT_PLAN, str(tmp_path / "missing.json"))
+        with pytest.raises(FaultPlanError, match="cannot read plan file"):
+            FaultPlan.from_conf(conf, env={})
+
+    def test_legacy_env_aliases(self):
+        conf = TonyConfiguration()
+        plan = FaultPlan.from_conf(
+            conf, env={constants.TEST_AM_CRASH: "1",
+                       constants.TEST_WORKER_TERMINATION: "1"},
+        )
+        actions = sorted(s.action for s in plan.specs)
+        assert actions == ["crash_coordinator", "kill_task"]
+
+
+# ---------------------------------------------------------------------------
+# Fault injector (coordinator-side semantics)
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def _injector(self, *specs, seed=7):
+        return FaultInjector(FaultPlan(seed=seed, specs=list(specs)))
+
+    def test_disabled_without_plan(self):
+        inj = FaultInjector(None)
+        assert not inj.enabled
+        assert inj.timed_kills(1, 1e9) == []
+        assert not inj.heartbeat_kill("worker:0", 1)
+
+    def test_concrete_rendezvous_kill_fires_once(self):
+        inj = self._injector(FaultSpec(action="kill_task", target="worker:1",
+                                       at="rendezvous"))
+        assert inj.rendezvous_kills("worker:0", True, 1, ["worker:1"]) == []
+        assert inj.rendezvous_kills("worker:1", False, 1, ["worker:1"]) \
+            == ["worker:1"]
+        # one-shot: re-registration does not re-fire
+        assert inj.rendezvous_kills("worker:1", False, 1, ["worker:1"]) == []
+
+    def test_any_non_chief_victim_is_seeded_deterministic(self):
+        spec = FaultSpec(action="kill_task", target="any_non_chief",
+                         at="rendezvous")
+        pool = ["worker:1", "worker:2", "worker:3"]
+        picks = {
+            self._injector(spec, seed=5).rendezvous_kills(
+                "worker:0", True, 1, pool
+            )[0]
+            for _ in range(5)
+        }
+        assert len(picks) == 1  # same seed → same victim, every run
+        other = {
+            self._injector(spec, seed=s).rendezvous_kills(
+                "worker:0", True, 1, pool
+            )[0]
+            for s in range(10)
+        }
+        assert len(other) > 1   # different seeds spread the choice
+
+    def test_session_scoping(self):
+        inj = self._injector(FaultSpec(action="kill_task", target="worker:1",
+                                       at="rendezvous", session=2))
+        assert inj.rendezvous_kills("worker:1", False, 1, []) == []
+        assert inj.rendezvous_kills("worker:1", False, 2, []) == ["worker:1"]
+
+    def test_heartbeat_kill_counts_per_target(self):
+        inj = self._injector(FaultSpec(action="kill_task", target="worker:1",
+                                       after_heartbeats=3))
+        assert not inj.heartbeat_kill("worker:1", 1)
+        assert not inj.heartbeat_kill("worker:0", 1)  # other task: no count
+        assert not inj.heartbeat_kill("worker:1", 1)
+        assert inj.heartbeat_kill("worker:1", 1)
+        assert not inj.heartbeat_kill("worker:1", 1)  # one-shot
+
+    def test_heartbeat_counters_reset_per_session(self):
+        inj = self._injector(
+            FaultSpec(action="kill_task", target="worker:1",
+                      after_heartbeats=2, count=2),
+        )
+        assert not inj.heartbeat_kill("worker:1", 1)
+        inj.reset_session()
+        assert not inj.heartbeat_kill("worker:1", 2)  # count restarted
+        assert inj.heartbeat_kill("worker:1", 2)
+
+    def test_timed_kills(self):
+        inj = self._injector(FaultSpec(action="kill_task", target="worker:1",
+                                       after_ms=500))
+        assert inj.timed_kills(1, 499.0) == []
+        assert inj.timed_kills(1, 500.0) == ["worker:1"]
+        assert inj.timed_kills(1, 9999.0) == []  # one-shot
+
+    def test_crash_coordinator_calls_exit(self, monkeypatch):
+        import os
+
+        calls = []
+        monkeypatch.setattr(os, "_exit", lambda code: calls.append(code))
+        inj = self._injector(FaultSpec(action="crash_coordinator",
+                                       phase="monitor", session=1, code=3))
+        inj.coordinator_phase("schedule", 1)
+        assert calls == []
+        inj.coordinator_phase("monitor", 2)  # wrong session
+        assert calls == []
+        inj.coordinator_phase("monitor", 1)
+        assert calls == [3]
+
+
+# ---------------------------------------------------------------------------
+# Executor-side faults
+# ---------------------------------------------------------------------------
+class TestExecutorFaults:
+    def test_resolution_scopes_by_task_and_session(self):
+        plan = FaultPlan.parse(json.dumps({
+            "faults": [
+                {"action": "exit_executor", "target": "worker:1",
+                 "session": 1, "code": 9},
+                {"action": "drop_heartbeats", "target": "worker:1",
+                 "count": 4},
+                {"action": "delay_heartbeats", "target": "worker:0",
+                 "ms": 100, "count": 2},
+                {"action": "blackout_rpc", "ms": 500, "after_ms": 100},
+            ],
+        }))
+        w1s1 = plan.for_executor("worker:1", 1)
+        assert w1s1.pre_register_exit == 9
+        assert w1s1.drop_heartbeats == 4
+        assert w1s1.delay_heartbeats is None
+        assert w1s1.rpc_blackout == (100, 500)
+        w1s2 = plan.for_executor("worker:1", 2)
+        assert w1s2.pre_register_exit is None  # session-scoped
+        assert w1s2.drop_heartbeats == 4
+        w0 = plan.for_executor("worker:0", 1)
+        assert w0.pre_register_exit is None
+        assert w0.delay_heartbeats == (2, 100)
+        assert w0.rpc_blackout == (100, 500)  # untargeted: everyone
+
+    def test_blackout_hook_window(self):
+        plan = FaultPlan.parse(json.dumps({
+            "faults": [{"action": "blackout_rpc", "ms": 100,
+                        "after_ms": 50}],
+        }))
+        start = time.monotonic()
+        hook = plan.for_executor("worker:0", 1).blackout_hook(start)
+        hook()  # before the window: fine
+        time.sleep(0.06)
+        with pytest.raises(OSError, match="blackout"):
+            hook()
+        time.sleep(0.12)  # past the window
+        hook()
+
+    def test_checkpoint_faults_fire_counted(self):
+        plan = FaultPlan.parse(json.dumps({
+            "faults": [{"action": "fail_checkpoint_write", "step": 5}],
+        }))
+        cf = CheckpointFaults(plan, "worker:0")
+        cf.maybe_fail_write(4)
+        with pytest.raises(OSError, match="fault injection"):
+            cf.maybe_fail_write(5)
+        cf.maybe_fail_write(5)  # count=1: second write of step 5 succeeds
+
+    def test_checkpoint_faults_respect_session(self):
+        # A fault scoped to session 1 must NOT re-fire in the retried
+        # session (a fresh process with fresh counters — the session id
+        # is the only cross-process scoping there is).
+        plan = FaultPlan.parse(json.dumps({
+            "faults": [{"action": "fail_checkpoint_write", "step": 5,
+                        "session": 1}],
+        }))
+        with pytest.raises(OSError):
+            CheckpointFaults(plan, "worker:0", session=1).maybe_fail_write(5)
+        CheckpointFaults(plan, "worker:0", session=2).maybe_fail_write(5)
+
+    def test_checkpoint_faults_respect_target(self):
+        plan = FaultPlan.parse(json.dumps({
+            "faults": [{"action": "fail_checkpoint_write", "step": 5,
+                        "target": "worker:1"}],
+        }))
+        CheckpointFaults(plan, "worker:0").maybe_fail_write(5)  # not us
+        with pytest.raises(OSError):
+            CheckpointFaults(plan, "worker:1").maybe_fail_write(5)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint progress probe (jax-free)
+# ---------------------------------------------------------------------------
+class TestProgressProbe:
+    def _write_step(self, root, step, n_processes, *, torn=False,
+                    bad_meta=False):
+        d = root / f"step_{step}"
+        d.mkdir(parents=True)
+        for p in range(n_processes - (1 if torn else 0)):
+            (d / f"process_{p}.npz").write_bytes(b"x")
+        meta = (b"{not json" if bad_meta
+                else json.dumps({"step": step,
+                                 "num_processes": n_processes}).encode())
+        (d / "metadata.json").write_bytes(meta)
+
+    def test_missing_and_empty_dirs(self, tmp_path):
+        assert latest_complete_step(tmp_path / "nope") is None
+        assert latest_complete_step(tmp_path) is None
+
+    def test_newest_complete_wins_over_torn(self, tmp_path):
+        self._write_step(tmp_path, 3, 2)
+        self._write_step(tmp_path, 7, 2)
+        self._write_step(tmp_path, 9, 2, torn=True)     # missing a shard
+        self._write_step(tmp_path, 11, 2, bad_meta=True)
+        assert latest_complete_step(tmp_path) == 7
+
+    def test_step_without_metadata_ignored(self, tmp_path):
+        d = tmp_path / "step_4"
+        d.mkdir()
+        (d / "process_0.npz").write_bytes(b"x")
+        assert latest_complete_step(tmp_path) is None
+
+    def test_restore_resumable_pins_env_step(self, tmp_path, monkeypatch):
+        from tony_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path, process_id=0, num_processes=1)
+        template = {"step": np.array(0)}
+        for s in (3, 7):
+            mgr.save(s, {"step": np.array(s)}, blocking=True)
+        # No env: newest complete, like plain restore.
+        monkeypatch.delenv("TONY_RESUME_STEP", raising=False)
+        assert int(mgr.restore_resumable(template)["step"]) == 7
+        # Env pins the exact (older) step — stragglers may have finished
+        # a newer one, but every process must resume the SAME step.
+        monkeypatch.setenv("TONY_RESUME_STEP", "3")
+        assert int(mgr.restore_resumable(template)["step"]) == 3
+        # A vanished step and garbage both fall back to newest-complete.
+        monkeypatch.setenv("TONY_RESUME_STEP", "5")
+        assert int(mgr.restore_resumable(template)["step"]) == 7
+        monkeypatch.setenv("TONY_RESUME_STEP", "junk")
+        assert int(mgr.restore_resumable(template)["step"]) == 7
+
+    def test_probe_agrees_with_checkpoint_manager(self, tmp_path):
+        # The completeness rule's source of truth is CheckpointManager;
+        # this pin keeps the jax-free re-implementation from drifting.
+        from tony_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path, process_id=0, num_processes=1)
+        state = {"step": np.array(3), "w": np.zeros(4)}
+        mgr.save(3, state, blocking=True)
+        mgr.save(7, {"step": np.array(7), "w": np.ones(4)}, blocking=True)
+        assert mgr.latest_step() == 7
+        assert latest_complete_step(tmp_path) == 7
+        (tmp_path / "step_7" / "process_0.npz").unlink()  # tear it
+        assert mgr.latest_step() == 3
+        assert latest_complete_step(tmp_path) == 3
+
+
+# ---------------------------------------------------------------------------
+# Liveness: expiry timing + ping fencing
+# ---------------------------------------------------------------------------
+class TestLiveness:
+    def test_expiry_fires_on_silence_not_on_pings(self):
+        expired = []
+        mon = LivenessMonitor(
+            heartbeat_interval_ms=100, max_missed_heartbeats=3,
+            on_expired=expired.append,
+        )
+        mon.start()
+        try:
+            mon.register("worker:0")
+            # Ping for ~0.6s (well past the 0.3s expiry window): must stay
+            # alive while pings flow.
+            for _ in range(6):
+                time.sleep(0.1)
+                assert mon.receive_ping("worker:0")
+            assert expired == []
+            # Silence: expiry must fire within a generous bound.
+            deadline = time.monotonic() + 5.0
+            while not expired and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert expired == ["worker:0"]
+        finally:
+            mon.stop()
+
+    def test_ping_from_unknown_task_is_fenced(self):
+        mon = LivenessMonitor(100, 3, on_expired=lambda t: None)
+        assert not mon.receive_ping("worker:9")       # never registered
+        assert "worker:9" not in mon._last_seen
+
+    def test_ping_after_expiry_does_not_reregister(self):
+        expired = []
+        mon = LivenessMonitor(
+            heartbeat_interval_ms=50, max_missed_heartbeats=2,
+            on_expired=expired.append,
+        )
+        mon.start()
+        try:
+            mon.register("worker:0")
+            deadline = time.monotonic() + 5.0
+            while not expired and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert expired == ["worker:0"]
+            # The zombie pings again: it must NOT silently re-enter the
+            # failed session's monitor.
+            assert not mon.receive_ping("worker:0")
+            assert "worker:0" not in mon._last_seen
+        finally:
+            mon.stop()
+
+    def test_ping_after_unregister_is_fenced(self):
+        mon = LivenessMonitor(100, 3, on_expired=lambda t: None)
+        mon.register("worker:0")
+        assert mon.receive_ping("worker:0")
+        mon.unregister("worker:0")          # task completed
+        assert not mon.receive_ping("worker:0")
+
+
+# ---------------------------------------------------------------------------
+# Heartbeater hardening
+# ---------------------------------------------------------------------------
+class _FlakyHeartbeatClient:
+    def __init__(self, fail_first=0, fail_forever=False):
+        self.fail_first = fail_first
+        self.fail_forever = fail_forever
+        self.sent = 0
+
+    def task_executor_heartbeat(self, task_id, session_id):
+        if self.fail_forever or self.fail_first > 0:
+            self.fail_first -= 1
+            raise ConnectionError("injected")
+        self.sent += 1
+
+
+class TestHeartbeater:
+    def _beater(self, client, **kw):
+        from tony_tpu.executor.task_executor import Heartbeater
+
+        lost = []
+        hb = Heartbeater(client, "worker:0", "1", interval_ms=10,
+                         on_lost=lambda: lost.append(True), **kw)
+        return hb, lost
+
+    def test_transient_failures_survived(self):
+        client = _FlakyHeartbeatClient(fail_first=3)
+        hb, lost = self._beater(client, max_failures=5)
+        hb.start()
+        deadline = time.monotonic() + 5.0
+        while client.sent < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        hb.stop()
+        hb.join(timeout=2)
+        assert client.sent >= 3      # recovered and kept pinging
+        assert lost == []            # never declared the coordinator dead
+        assert hb.consecutive_failures == 0
+
+    def test_persistent_failure_triggers_on_lost(self):
+        client = _FlakyHeartbeatClient(fail_forever=True)
+        hb, lost = self._beater(client, max_failures=4)
+        hb.start()
+        hb.join(timeout=5)           # on_lost returns → thread exits
+        assert lost == [True]
+        assert hb.consecutive_failures == 4
+
+    def test_drop_pings_fault_swallows_then_resumes(self):
+        client = _FlakyHeartbeatClient()
+        hb, lost = self._beater(client, drop_pings=3)
+        hb.start()
+        deadline = time.monotonic() + 5.0
+        while client.sent < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        hb.stop()
+        hb.join(timeout=2)
+        assert client.sent >= 2 and lost == []

@@ -52,7 +52,7 @@ class FakeApp(ApplicationRpc):
     def finish_application(self):
         self.finished.set()
 
-    def task_executor_heartbeat(self, task_id):
+    def task_executor_heartbeat(self, task_id, session_id):
         self.heartbeats.append(task_id)
 
     def get_application_status(self):
@@ -94,7 +94,7 @@ def test_all_seven_calls(served):
     assert app.tb_url == ("worker:0", "http://tb:6006")
     c.register_execution_result(0, "worker", "0", "s0")
     assert app.results == [(0, "worker", "0", "s0")]
-    c.task_executor_heartbeat("worker:0")
+    c.task_executor_heartbeat("worker:0", "1")
     assert app.heartbeats == ["worker:0"]
     c.finish_application()
     assert app.finished.is_set()
@@ -142,10 +142,10 @@ def test_unknown_method_and_bad_args(served):
 def test_client_reconnects_after_drop(served):
     app, server = served
     c = _client(server, retry_interval_s=0.05)
-    c.task_executor_heartbeat("worker:0")
+    c.task_executor_heartbeat("worker:0", "1")
     # simulate a dropped connection under the client
     c._sock.close()
-    c.task_executor_heartbeat("worker:0")  # must transparently reconnect
+    c.task_executor_heartbeat("worker:0", "1")  # must transparently reconnect
     assert app.heartbeats == ["worker:0", "worker:0"]
 
 
@@ -155,7 +155,7 @@ def test_concurrent_heartbeaters(served):
     def beat(i):
         c = _client(server)
         for _ in range(10):
-            c.task_executor_heartbeat(f"w:{i}")
+            c.task_executor_heartbeat(f"w:{i}", "1")
 
     threads = [threading.Thread(target=beat, args=(i,)) for i in range(4)]
     for t in threads:

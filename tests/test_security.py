@@ -38,7 +38,7 @@ class _Impl(ApplicationRpc):
     def finish_application(self):
         return None
 
-    def task_executor_heartbeat(self, task_id):
+    def task_executor_heartbeat(self, task_id, session_id):
         return None
 
     def get_application_status(self):

@@ -299,6 +299,16 @@ class CheckpointManager:
         def write() -> None:
             import io
 
+            from tony_tpu.resilience.faults import checkpoint_faults_from_env
+
+            # Fault injection (tony.fault.plan fail_checkpoint_write,
+            # forwarded via TONY_FAULT_PLAN): raise exactly where a real
+            # disk/GCS failure would, so the async-writer error path —
+            # surfaced by wait()/next save, never silently dropped — is
+            # provable by a chaos run.
+            faults = checkpoint_faults_from_env()
+            if faults is not None:
+                faults.maybe_fail_write(step)
             buf = io.BytesIO()
             np.savez(
                 buf,
@@ -373,6 +383,31 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         steps = self._complete_steps()
         return steps[-1] if steps else None
+
+    def restore_resumable(self, state_template: Any) -> Any | None:
+        """Coordinator-assisted resume, the one-liner user scripts should
+        call after a ``TonyCoordinator`` retry: when ``TONY_RESUME_STEP``
+        is set (the newest step the coordinator saw complete before
+        retrying), restore that EXACT step first — so every process
+        resumes the SAME step even if a straggler completed a newer
+        checkpoint mid-teardown — and fall back to the newest complete
+        step when it is gone, torn, or unparseable. Behaves like plain
+        ``restore`` outside a retried session."""
+        resume = os.environ.get("TONY_RESUME_STEP")
+        if resume:
+            try:
+                step = int(resume)
+            except ValueError:
+                log.warning("ignoring bad TONY_RESUME_STEP=%r", resume)
+            else:
+                restored = self.restore(state_template, step=step)
+                if restored is not None:
+                    return restored
+                log.warning(
+                    "TONY_RESUME_STEP=%d is not restorable here — "
+                    "falling back to the newest complete step", step,
+                )
+        return self.restore(state_template)
 
     def restore(self, state_template: Any, step: int | None = None) -> Any | None:
         """Load the newest complete checkpoint (or ``step``, if complete)

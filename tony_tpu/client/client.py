@@ -203,8 +203,12 @@ class TonyClient:
             secret = security.role_token(
                 self.conf.get_str(keys.K_SECRET_KEY), security.CLIENT_ROLE
             )
-        return ApplicationRpcClient(host, int(port), secret=secret,
-                                    call_retries=retries)
+        return ApplicationRpcClient(
+            host, int(port), secret=secret, call_retries=retries,
+            call_timeout_s=self.conf.get_int(
+                keys.K_RPC_CALL_TIMEOUT_MS, 60000
+            ) / 1000.0,
+        )
 
     def _print_task_urls_once(self) -> None:
         if self._urls_printed or self.rpc is None:

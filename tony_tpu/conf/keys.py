@@ -49,6 +49,15 @@ K_TASK_HEARTBEAT_INTERVAL_MS = TASK_PREFIX + "heartbeat-interval"
 K_TASK_MAX_MISSED_HEARTBEATS = TASK_PREFIX + "max-missed-heartbeats"
 K_TASK_REGISTRATION_TIMEOUT_MS = TASK_PREFIX + "registration-timeout"
 K_TASK_REGISTRATION_RETRY_MS = TASK_PREFIX + "registration-retry-interval"
+# Consecutive failed heartbeat SENDS after which an executor declares the
+# coordinator lost, reaps its user process group, and exits
+# EXIT_CODE_LOST_COORDINATOR — a partitioned executor must not squat its
+# TPU slice as a zombie.
+K_TASK_MAX_HB_SEND_FAILURES = TASK_PREFIX + "max-heartbeat-send-failures"
+
+# --- RPC transport ---------------------------------------------------------
+RPC_PREFIX = TONY_PREFIX + "rpc."
+K_RPC_CALL_TIMEOUT_MS = RPC_PREFIX + "call-timeout"      # per-call socket timeout
 
 # --- coordinator (AM analogue) --------------------------------------------
 # Descoped from the reference (see README "descoped keys"): tony.am.memory/
@@ -56,6 +65,14 @@ K_TASK_REGISTRATION_RETRY_MS = TASK_PREFIX + "registration-retry-interval"
 # subprocess with no resource caps to request.
 AM_PREFIX = TONY_PREFIX + "am."
 K_AM_RETRY_COUNT = AM_PREFIX + "retry-count"
+# Failure-aware retry policy (resilience/policy.py): the n-th session retry
+# backs off base*2^(n-1) ms (capped at max) times a deterministic jitter in
+# [1, 1.5) drawn from the jitter seed (0 = derive from the app id). The
+# budget refreshes whenever a retry advances the best complete checkpoint
+# step (probed from tony.checkpoint.location).
+K_AM_RETRY_BACKOFF_BASE_MS = AM_PREFIX + "retry-backoff-base"
+K_AM_RETRY_BACKOFF_MAX_MS = AM_PREFIX + "retry-backoff-max"
+K_AM_RETRY_JITTER_SEED = AM_PREFIX + "retry-jitter-seed"
 K_AM_MONITOR_INTERVAL_MS = AM_PREFIX + "monitor-interval"
 K_AM_RPC_PORT_RANGE = AM_PREFIX + "rpc-port-range"       # "10000-15000"
 K_AM_STOP_GRACE_MS = AM_PREFIX + "stop-grace"            # wait for client finish signal
@@ -89,6 +106,17 @@ K_AM_ADDRESS_HOST = AM_PREFIX + "address-host"  # reachable AM host for remote e
 K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
 K_LIB_PATH = TONY_PREFIX + "lib.path"                    # staged framework copy for executors
 K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
+# CheckpointManager directory (dir or gs:// URI). When set, the coordinator
+# probes it between sessions for the newest complete step: retried tasks
+# get TONY_RESUME_STEP/TONY_CHECKPOINT_DIR, and progress refreshes the
+# retry budget. Empty = no probe (user scripts still checkpoint wherever
+# they like; they just resume without coordinator help).
+K_CHECKPOINT_LOCATION = TONY_PREFIX + "checkpoint.location"
+
+# --- fault injection (resilience/faults.py) --------------------------------
+# Inline JSON plan or a path to one; "" = no faults. Replaces the
+# deprecated TEST_AM_CRASH / TEST_WORKER_TERMINATION env flags.
+K_FAULT_PLAN = TONY_PREFIX + "fault.plan"
 
 # --- history server (TonyConfigurationKeys.java:41-63) ---------------------
 K_HTTP_PORT = TONY_PREFIX + "http.port"                  # "disabled" or int
@@ -139,7 +167,12 @@ DEFAULTS: dict[str, object] = {
     K_TASK_MAX_MISSED_HEARTBEATS: 25,
     K_TASK_REGISTRATION_TIMEOUT_MS: 0,
     K_TASK_REGISTRATION_RETRY_MS: 500,
+    K_TASK_MAX_HB_SEND_FAILURES: 5,
+    K_RPC_CALL_TIMEOUT_MS: 60000,
     K_AM_RETRY_COUNT: 0,
+    K_AM_RETRY_BACKOFF_BASE_MS: 1000,
+    K_AM_RETRY_BACKOFF_MAX_MS: 60000,
+    K_AM_RETRY_JITTER_SEED: 0,
     K_AM_MONITOR_INTERVAL_MS: 200,
     K_AM_RPC_PORT_RANGE: "10000-15000",
     K_AM_STOP_GRACE_MS: 30000,
@@ -157,6 +190,8 @@ DEFAULTS: dict[str, object] = {
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",
+    K_CHECKPOINT_LOCATION: "",
+    K_FAULT_PLAN: "",
     K_HTTP_PORT: "disabled",
     K_HTTPS_PORT: 19886,
     K_HTTPS_CERT: "",

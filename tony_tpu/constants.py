@@ -62,6 +62,15 @@ TONY_LOG_DIR = "TONY_LOG_DIR"
 # Preprocess / single-node AM mode (Constants.java:34,48)
 PREPROCESSING_JOB = "PREPROCESSING_JOB"
 TASK_PARAM_KEY = "MODEL_PARAMS"
+# Failure-aware retry env (resilience/): the newest complete checkpoint
+# step the coordinator observed before retrying — retried sessions resume
+# from it instead of recomputing from step 0 — and the checkpoint dir the
+# coordinator probes (exported when tony.checkpoint.location is set).
+TONY_RESUME_STEP = "TONY_RESUME_STEP"
+TONY_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"
+# Raw tony.fault.plan JSON, forwarded into the user process so
+# CheckpointManager can honor fail_checkpoint_write faults.
+TONY_FAULT_PLAN = "TONY_FAULT_PLAN"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -74,7 +83,13 @@ DOCKER_FORWARD_ENV = (
     TONY_SLICE_INDEX, TONY_SLICE_PROCESS_ID, TONY_NUM_SLICES,
     MEGASCALE_COORDINATOR_ADDRESS, MEGASCALE_NUM_SLICES, MEGASCALE_SLICE_ID,
     TB_PORT, PROFILER_PORT, TONY_LOG_DIR, PREPROCESSING_JOB, TASK_PARAM_KEY,
+    TONY_RESUME_STEP, TONY_CHECKPOINT_DIR, TONY_FAULT_PLAN,
 )
+
+# The executor's self-termination code after losing the coordinator (N
+# consecutive failed heartbeat sends): distinct from user-script codes so
+# the failure classifier reads it as INFRA, not a program bug.
+EXIT_CODE_LOST_COORDINATOR = 87
 
 # Executor launch env (analogue of TonyApplicationMaster.java:1053-1055).
 TONY_AM_ADDRESS = "TONY_AM_ADDRESS"

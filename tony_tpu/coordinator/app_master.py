@@ -33,13 +33,28 @@ from tony_tpu.coordinator.backend import (
     plan_slices_from_conf,
 )
 from tony_tpu.coordinator.liveness import LivenessMonitor
-from tony_tpu.coordinator.session import SessionStatus, TonySession, TonyTask
+from tony_tpu.coordinator.session import (
+    SessionStatus,
+    TaskStatus,
+    TonySession,
+    TonyTask,
+)
 from tony_tpu.history import JobMetadata, setup_job_dir
 from tony_tpu.history.writer import (
     create_history_file,
     write_config_file,
     write_final_status,
 )
+from tony_tpu.resilience import (
+    FailureEvent,
+    FaultPlan,
+    RetryDecision,
+    RetryPolicy,
+    classify,
+    latest_complete_step,
+)
+from tony_tpu.resilience import classifier as failure_kinds
+from tony_tpu.resilience.faults import FaultInjector
 from tony_tpu.rpc.protocol import ApplicationRpc, TaskUrl
 from tony_tpu.rpc.server import ApplicationRpcServer
 
@@ -92,8 +107,8 @@ class _RpcForClient(ApplicationRpc):
     def finish_application(self) -> None:
         self._c.client_signal_to_finish.set()
 
-    def task_executor_heartbeat(self, task_id: str) -> None:
-        self._c.liveness.receive_ping(task_id)
+    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
+        self._c.on_heartbeat(task_id, session_id)
 
     def get_application_status(self) -> dict[str, Any]:
         return self._c.application_status()
@@ -124,6 +139,17 @@ class TonyCoordinator:
         self.started_ms = int(time.time() * 1000)
         self._session_seq = 0
         self._hb_missed: set[str] = set()
+        # Failure-aware retry state (resilience/): the first failure seen in
+        # the current session (cascades are noise), the step retried tasks
+        # resume from, and one record per retry decision for final-status.
+        self._session_failure: FailureEvent | None = None
+        self._resume_step: int | None = None
+        self._retry_log: list[dict[str, Any]] = []
+        self._retry_policy: RetryPolicy | None = None
+        # Structured fault injection (tony.fault.plan + deprecated TEST_*
+        # aliases). An invalid plan is a conf error and refuses startup —
+        # a chaos run with a typo'd plan must not silently test nothing.
+        self._faults = FaultInjector(FaultPlan.from_conf(conf))
         # Terminal state is masked from the status RPC until stop() has
         # persisted history + final-status — a client that reacts to the
         # terminal state (and, say, reads history) must never win a race
@@ -160,10 +186,14 @@ class TonyCoordinator:
     def prepare(self) -> None:
         """prepare (TonyApplicationMaster.java:379-428): start RPC + liveness,
         advertise the RPC address for the client, write history config."""
+        self._faults.coordinator_phase("prepare", self._session_seq + 1)
         self.rpc_server.start()
         self.liveness.start()
+        # The advertised address must be reachable by the CLIENT too, not
+        # just executors: on a remote (TPU-VM) backend a loopback address
+        # here would have clients dialing themselves.
         (self.app_dir / "coordinator.addr").write_text(
-            f"127.0.0.1:{self.rpc_server.port}\n"
+            f"{self._am_host()}:{self.rpc_server.port}\n"
         )
         if self._executor_token is not None:
             # Executor-audience conf: everything but the job secret. Tasks
@@ -180,19 +210,41 @@ class TonyCoordinator:
             write_config_file(job_dir, self.conf)
 
     def run(self) -> SessionStatus:
-        """Retry loop (TonyApplicationMaster.java:340-365): run sessions until
-        one succeeds or retries are exhausted."""
+        """Failure-aware retry loop (grown from the reference's blind
+        countdown, TonyApplicationMaster.java:340-365): each failed session
+        is classified (TRANSIENT / INFRA / USER_PERMANENT), the per-category
+        policy decides whether to retry and how long to back off, and the
+        retry budget refreshes whenever a retry advanced the best complete
+        checkpoint step — preempted-but-progressing jobs run forever,
+        deterministic user bugs fail fast."""
         self.prepare()
-        retries_left = self.conf.get_int(keys.K_AM_RETRY_COUNT, 0)
+        self._retry_policy = self._build_retry_policy()
         try:
             while True:
                 status = self._run_one_session()
                 if status is SessionStatus.SUCCEEDED or self._killed.is_set():
                     break
-                if retries_left <= 0 or self._fatal:
+                decision = self._decide_retry()
+                if not decision.retry:
                     break
-                retries_left -= 1
-                log.warning("session failed; retrying (%d retries left)", retries_left)
+                # Backoff between sessions, interruptible by kill(): a
+                # retry storm across preempted jobs must not stampede the
+                # provisioning API, and colliding restarts are exactly what
+                # the deterministic jitter decorrelates.
+                if decision.backoff_ms and self._killed.wait(
+                    decision.backoff_ms / 1000.0
+                ):
+                    # Operator kill landed during the backoff: the retry
+                    # was granted but never ran — the trace must not claim
+                    # it did, and the terminal state is KILLED, not the
+                    # dead session's FAILED.
+                    self._retry_log[-1]["retried"] = False
+                    self._retry_log[-1]["reason"] = "killed during backoff"
+                    if self.session is not None:
+                        self.session.status = SessionStatus.KILLED
+                        self.session.diagnostics = "killed by client"
+                    status = SessionStatus.KILLED
+                    break
                 self._reset()
             return self.stop(status)
         finally:
@@ -200,11 +252,86 @@ class TonyCoordinator:
             self.liveness.stop()
             self.rpc_server.stop()
 
+    def _build_retry_policy(self) -> RetryPolicy:
+        # Jitter seed precedence: explicit conf key, then the fault plan's
+        # seed (chaos runs replay bit-identically), then the app id (every
+        # real app decorrelates from every other).
+        seed = self.conf.get_int(keys.K_AM_RETRY_JITTER_SEED, 0)
+        if not seed and self._faults.plan is not None:
+            seed = self._faults.plan.seed
+        if not seed:
+            import zlib
+
+            seed = zlib.crc32(self.app_id.encode())
+        return RetryPolicy(
+            budget=self.conf.get_int(keys.K_AM_RETRY_COUNT, 0),
+            backoff_base_ms=self.conf.get_int(
+                keys.K_AM_RETRY_BACKOFF_BASE_MS, 1000
+            ),
+            backoff_max_ms=self.conf.get_int(
+                keys.K_AM_RETRY_BACKOFF_MAX_MS, 60000
+            ),
+            seed=seed,
+        )
+
+    def _decide_retry(self) -> RetryDecision:
+        """Classify the session's first failure, fold in checkpoint
+        progress, ask the policy, and record the decision for
+        final-status.json."""
+        assert self._retry_policy is not None
+        event = self._session_failure or FailureEvent(
+            kind=failure_kinds.TASK_EXIT,
+            detail="unattributed session failure",
+        )
+        category = classify(event)
+        best = self._probe_checkpoint_step()
+        self._retry_policy.observe_progress(best)
+        if self._fatal:
+            # Conf-shaped failures (slice planning, scheduling, single-node
+            # mode) predate classification and never retry.
+            decision = RetryDecision(
+                False, category, 0, "conf-shaped failure: never retried"
+            )
+        else:
+            decision = self._retry_policy.decide(category)
+        self._retry_log.append({
+            "session": self._session_seq,
+            "failure": event.describe(),
+            "category": category.value,
+            "retried": decision.retry,
+            "backoff_ms": decision.backoff_ms,
+            "resume_step": best,
+            "reason": decision.reason,
+        })
+        if decision.retry:
+            self._resume_step = best
+            log.warning(
+                "session %d failed [%s: %s] — %s",
+                self._session_seq, category.value, event.describe(),
+                decision.reason,
+            )
+        else:
+            log.error(
+                "session %d failed [%s: %s] — not retrying: %s",
+                self._session_seq, category.value, event.describe(),
+                decision.reason,
+            )
+        return decision
+
+    def _probe_checkpoint_step(self) -> int | None:
+        loc = self.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+        return latest_complete_step(loc) if loc else None
+
+    def _record_failure(self, event: FailureEvent) -> None:
+        """First failure wins: a killed slice takes every collective down
+        with it, and the cascade must not re-classify the root cause."""
+        if self._session_failure is None:
+            self._session_failure = event
+
     def _run_one_session(self) -> SessionStatus:
-        if os.environ.get(constants.TEST_AM_CRASH):
-            # Fault injection: AM dies on purpose (reference :341-346).
-            log.error("TEST_AM_CRASH set — coordinator crashing")
-            os._exit(1)
+        # Fault injection: AM dies on purpose entering the schedule phase
+        # (reference :341-346; TEST_AM_CRASH maps to this via FaultPlan).
+        self._faults.coordinator_phase("schedule", self._session_seq + 1)
         self._session_seq += 1
         self.session = TonySession(self.conf, session_id=self._session_seq)
         self.session.status = SessionStatus.RUNNING
@@ -224,11 +351,23 @@ class TonyCoordinator:
                     self.session.status = SessionStatus.SUCCEEDED
                     self.session.diagnostics = "single node job succeeded"
                 else:
+                    self._record_failure(FailureEvent(
+                        kind=failure_kinds.TASK_EXIT, task_id="single-node",
+                        exit_code=exit_code,
+                    ))
                     self.session.fail(
                         f"single node job exited with {exit_code}"
                     )
                 return self.session.status
             if exit_code != 0:
+                # registered=True deliberately: a preprocess script ran real
+                # user code (data fetch, feature prep) whose failure may be
+                # environmental — classified like a post-rendezvous task
+                # exit, not as a setup failure.
+                self._record_failure(FailureEvent(
+                    kind=failure_kinds.TASK_EXIT, task_id="preprocess",
+                    exit_code=exit_code,
+                ))
                 self.session.fail(f"preprocess job exited with {exit_code}")
                 return self.session.status
         # TPU resource model: turn tony.<job>.tpus + tony.tpu.* into slice
@@ -241,6 +380,9 @@ class TonyCoordinator:
         except ValueError as exc:
             # Conf-derived and deterministic: retrying cannot help.
             self._fatal = True
+            self._record_failure(FailureEvent(
+                kind=failure_kinds.CONF_ERROR, detail=str(exc)
+            ))
             self.session.fail(f"TPU slice planning failed: {exc}")
             return self.session.status
         if self.slice_plans:
@@ -254,6 +396,9 @@ class TonyCoordinator:
             # also conf-shaped; fail the session so stop() still publishes
             # a terminal status + history.
             self._fatal = True
+            self._record_failure(FailureEvent(
+                kind=failure_kinds.CONF_ERROR, detail=str(exc)
+            ))
             self.session.fail(f"task scheduling failed: {exc}")
             return self.session.status
         return self._monitor()
@@ -382,6 +527,14 @@ class TonyCoordinator:
             env[constants.TONY_EXECUTOR_TOKEN] = self._executor_token
         if self._model_params is not None:
             env[constants.TASK_PARAM_KEY] = self._model_params
+        # Checkpoint-aware restart: retried sessions learn the newest
+        # complete step so user scripts resume instead of recomputing
+        # (examples/lm_train.py honors both).
+        if self._resume_step is not None:
+            env[constants.TONY_RESUME_STEP] = str(self._resume_step)
+        ckpt_loc = self.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+        if ckpt_loc:
+            env[constants.TONY_CHECKPOINT_DIR] = ckpt_loc
         plan = self.slice_plans.get(task.job_name)
         if plan is not None:
             # The slice topology env the runtime reads to build its Mesh
@@ -413,29 +566,75 @@ class TonyCoordinator:
             self.liveness.register(worker)
             log.info("registered %s at %s", worker, spec)
         task = session.get_task_by_id(worker)
-        if (
-            task is not None
-            and session.is_chief(task.job_name, task.index)
-            and os.environ.get(constants.TEST_WORKER_TERMINATION)
-        ):
-            # Fault injection: kill a non-chief worker as soon as the chief
-            # registers (reference :1108-1119) — simulates preemption.
-            self._kill_one_non_chief()
+        if task is not None and self._faults.enabled:
+            # Fault injection: kill tasks at the rendezvous barrier — a
+            # concrete target dies when IT registers; any_non_chief picks a
+            # seeded victim when the chief registers (the reference's
+            # preemption simulation, :1108-1119, now plan-driven).
+            non_chief = [
+                t.id for t in session.all_tasks()
+                if not session.is_chief(t.job_name, t.index)
+                and t.handle is not None
+            ]
+            for victim in self._faults.rendezvous_kills(
+                worker, session.is_chief(task.job_name, task.index),
+                session.session_id, non_chief,
+            ):
+                self._fault_kill(victim)
         return session.cluster_spec()
 
-    def _kill_one_non_chief(self) -> None:
-        assert self.session is not None
-        for t in self.session.all_tasks():
-            if not self.session.is_chief(t.job_name, t.index) and t.handle is not None:
-                log.warning("TEST_WORKER_TERMINATION: killing %s", t.id)
-                self.backend.kill(t.handle)
-                return
+    def _fault_kill(self, task_id: str) -> None:
+        """Kill a task's container the way preemption would: SIGKILL, no
+        grace — the executor must not get to clean up or deregister."""
+        if self.session is None:
+            return
+        task = self.session.get_task_by_id(task_id)
+        if task is None or task.handle is None or task.completed():
+            return
+        log.warning("fault injection: killing %s", task_id)
+        kill_hard = getattr(self.backend, "kill_hard", None)
+        if kill_hard is not None:
+            kill_hard(task.handle)
+        else:
+            self.backend.kill(task.handle)
+
+    def on_heartbeat(self, task_id: str, session_id: str) -> None:
+        """Heartbeat RPC entry: fence stale pings, then feed liveness.
+
+        Two fences, both required for retried sessions to be trustworthy:
+        a ping carrying a PREVIOUS session id (an executor the backend is
+        still tearing down) must not touch the new session's monitor, and
+        a ping from a task the monitor already expired or unregistered
+        must not silently re-register it into a failed session."""
+        session = self.session
+        if session is None or str(session.session_id) != str(session_id):
+            log.warning(
+                "dropping heartbeat from %s: session %s is not current (%s)",
+                task_id, session_id,
+                session.session_id if session else "none",
+            )
+            return
+        if not self.liveness.receive_ping(task_id):
+            # debug, not warning: executors begin pinging before their
+            # registration RPC lands, so a few fenced pings are routine.
+            log.debug(
+                "dropping heartbeat from %s: not monitored (expired, "
+                "completed, or not yet registered)", task_id,
+            )
+            return
+        if self._faults.enabled and self._faults.heartbeat_kill(
+            task_id, session.session_id
+        ):
+            self._fault_kill(task_id)
 
     def _on_task_deemed_dead(self, task_id: str) -> None:
         """onTaskDeemedDead (TonyApplicationMaster.java:1094-1104). On a TPU
         slice a hung host wedges everyone's collectives, so the whole session
         fails (and retries slice-wide) rather than killing one task."""
         self._hb_missed.add(task_id)
+        self._record_failure(FailureEvent(
+            kind=failure_kinds.HEARTBEAT_EXPIRY, task_id=task_id,
+        ))
         if self.session is not None:
             self.session.fail(f"task {task_id} missed too many heartbeats")
         self._wake.set()
@@ -444,9 +643,11 @@ class TonyCoordinator:
     def _monitor(self) -> SessionStatus:
         assert self.session is not None
         session = self.session
+        self._faults.coordinator_phase("monitor", session.session_id)
         interval_s = self.conf.get_int(keys.K_AM_MONITOR_INTERVAL_MS, 200) / 1000.0
         timeout_ms = self.conf.get_int(keys.K_APPLICATION_TIMEOUT, 0)
-        deadline = time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
+        started = time.monotonic()
+        deadline = started + timeout_ms / 1000.0 if timeout_ms else None
         while not session.training_finished():
             if self._killed.is_set():
                 session.kill("killed by client")
@@ -454,6 +655,14 @@ class TonyCoordinator:
             if deadline is not None and time.monotonic() > deadline:
                 session.fail(f"application timed out after {timeout_ms}ms")
                 break
+            if self._faults.enabled:
+                # Timed kills (kill_task after_ms): preemption T ms into
+                # the session, clocked from the monitor loop's start.
+                elapsed_ms = (time.monotonic() - started) * 1000.0
+                for victim in self._faults.timed_kills(
+                    session.session_id, elapsed_ms
+                ):
+                    self._fault_kill(victim)
             for task in session.all_tasks():
                 if task.handle is None or task.completed():
                     continue
@@ -462,6 +671,7 @@ class TonyCoordinator:
                     self.liveness.unregister(task.id)
                     if code != 0:
                         self._tasks_failed += 1
+                        self._record_failure(self._task_exit_event(task, code))
                     session.on_task_completed(task.job_name, task.index, code)
             self._wake.wait(interval_s)
             self._wake.clear()
@@ -473,6 +683,24 @@ class TonyCoordinator:
         self.backend.stop_all()
         return session.status
 
+    def _task_exit_event(self, task: TonyTask, code: int) -> FailureEvent:
+        """Build the classification event for a nonzero task exit, asking
+        the backend whether it knows better (TpuVmBackend reports slice
+        preemption/provisioning failure — INFRA however the exit code
+        reads)."""
+        reason_fn = getattr(self.backend, "exit_reason", None)
+        reason = reason_fn(task.handle) if reason_fn is not None else None
+        if reason == "preempted":
+            return FailureEvent(
+                kind=failure_kinds.PREEMPTION, task_id=task.id,
+                exit_code=code, detail="backend-reported preemption",
+            )
+        return FailureEvent(
+            kind=failure_kinds.TASK_EXIT, task_id=task.id, exit_code=code,
+            registered=task.status is not TaskStatus.SCHEDULED
+            and task.status is not TaskStatus.NEW,
+        )
+
     def _reset(self) -> None:
         """reset (TonyApplicationMaster.java:526-542): stop all containers,
         drop liveness state; the next _run_one_session builds a fresh session
@@ -480,6 +708,8 @@ class TonyCoordinator:
         self.backend.stop_all()
         self.liveness.reset()
         self._hb_missed.clear()
+        self._session_failure = None
+        self._faults.reset_session()
         self.client_signal_to_finish.clear()
 
     def stop(self, status: SessionStatus) -> SessionStatus:
@@ -498,11 +728,19 @@ class TonyCoordinator:
         # Run statistics — the reference declares metrics-core but never
         # uses it (SURVEY 5.5); these counters make the terminal record
         # self-describing for tooling and the history UI.
+        best_step = self._probe_checkpoint_step()
+        if best_step is None and self._retry_policy is not None:
+            best_step = self._retry_policy.best_step
         final["stats"] = {
             "sessions_run": self._session_seq,
             "tasks_failed": self._tasks_failed,
             "heartbeat_missed_tasks": sorted(self._hb_missed),
             "wall_ms": int(time.time() * 1000) - self.started_ms,
+            # One record per retry decision: {session, failure, category,
+            # retried, backoff_ms, resume_step, reason} — the observable
+            # trace the chaos suite asserts against.
+            "retries": self._retry_log,
+            "best_checkpoint_step": best_step,
         }
         hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
         if hist:

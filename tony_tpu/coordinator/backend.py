@@ -184,6 +184,18 @@ class LocalProcessBackend:
             # alive — reap from the advertised pgid (no-op when empty).
             self._reap_user_group(handle)
 
+    def kill_hard(self, handle: _ProcHandle) -> None:
+        """SIGKILL with no grace — how preemption looks from inside the
+        container, used by fault injection so the executor cannot clean up
+        or deregister. Its user process group (a separate session SIGKILL
+        leaves behind) is reaped from the advertised pgid file."""
+        try:
+            os.killpg(handle.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        handle.proc.wait()
+        self._reap_user_group(handle)
+
     def stop_all(self) -> None:
         # TERM everyone first, then wait them against ONE shared deadline:
         # N wedged executors cost one grace window, not N.
@@ -439,6 +451,10 @@ class _TpuHandle:
     env: dict[str, str]
     remote: object | None = None  # None until the slice is READY
     exit_code: int | None = None
+    # Why the backend thinks the task died, when it knows better than the
+    # exit code ("preempted" for slice PREEMPTED/FAILED states): consumed
+    # by the coordinator's failure classifier as an INFRA signal.
+    reason: str | None = None
 
 
 class TpuVmBackend:
@@ -512,9 +528,11 @@ class TpuVmBackend:
             return handle.exit_code
         if handle.remote is None:
             state = self._slice_state(handle.slice_name)
-            if state == "FAILED":
-                log.error("slice %s failed to provision", handle.slice_name)
+            if state in ("FAILED", "PREEMPTED"):
+                log.error("slice %s %s before provisioning completed",
+                          handle.slice_name, state.lower())
                 handle.exit_code = 1
+                handle.reason = "preempted"
                 return 1
             if state != "READY":
                 return None
@@ -525,11 +543,27 @@ class TpuVmBackend:
                      handle.slice_name, handle.task_id)
             return None
         handle.exit_code = self.api.executor_status(handle.remote)
+        if handle.exit_code is not None and handle.exit_code != 0:
+            # The executor died nonzero — ask the control plane whether the
+            # slice went away underneath it (queued-resources preemption):
+            # that reclassifies the death as INFRA however the code reads.
+            state = self._slice_state(handle.slice_name)
+            if state in ("FAILED", "PREEMPTED", "SUSPENDED"):
+                handle.reason = "preempted"
         return handle.exit_code
+
+    def exit_reason(self, handle: _TpuHandle) -> str | None:
+        """Backend-reported cause for a nonzero exit ("preempted"), or None
+        when the exit code is all the backend knows."""
+        return handle.reason
 
     def kill(self, handle: _TpuHandle) -> None:
         if handle.remote is not None and handle.exit_code is None:
             self.api.kill_executor(handle.remote)
+
+    # Remote containers have no TERM-then-KILL distinction this API can
+    # express; a fault-injection hard kill is the same control-plane call.
+    kill_hard = kill
 
     def stop_all(self) -> None:
         for h in self._handles:

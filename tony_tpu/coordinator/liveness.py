@@ -54,11 +54,19 @@ class LivenessMonitor:
         with self._lock:
             self._last_seen.pop(task_id, None)
 
-    def receive_ping(self, task_id: str) -> None:
+    def receive_ping(self, task_id: str) -> bool:
+        """Record a ping for a MONITORED task; returns False for anything
+        else. Fenced deliberately: a late ping from a task this monitor
+        already expired (or that completed and was unregistered, or that
+        never registered at all) must not silently re-register it — the
+        session-level failure decision was already made on its silence,
+        and a zombie re-appearing in a failed session's monitor would mask
+        the very partition that failed it."""
         with self._lock:
-            # Only tasks that registered are monitored; a ping from an
-            # unknown task re-registers it (covers coordinator restart).
+            if task_id not in self._last_seen:
+                return False
             self._last_seen[task_id] = time.monotonic()
+            return True
 
     def reset(self) -> None:
         """Drop all monitored tasks (session retry re-registers everyone)."""

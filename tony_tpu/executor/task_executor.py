@@ -21,11 +21,14 @@ from pathlib import Path
 from tony_tpu import constants, utils
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.resilience.faults import ExecutorFaults, FaultPlan
 from tony_tpu.rpc.client import ApplicationRpcClient
 
 log = logging.getLogger(__name__)
 
-MAX_CONSECUTIVE_HB_FAILURES = 5  # TaskExecutor.Heartbeater:234-273
+# Default for tony.task.max-heartbeat-send-failures (TaskExecutor.
+# Heartbeater:234-273).
+MAX_CONSECUTIVE_HB_FAILURES = 5
 
 # The in-flight user process (its own session via execute_shell's
 # start_new_session): every executor death path must reap ITS process
@@ -96,42 +99,85 @@ def _install_death_handlers() -> None:
     signal.signal(signal.SIGINT, die)
 
 
-class Heartbeater(threading.Thread):
-    """1 Hz pings to the coordinator; the executor dies hard after 5
-    consecutive send failures (a dead coordinator means the session is being
-    torn down or retried — lingering would leave a zombie holding the TPU).
-    The user process group dies with it — a heartbeat-loss exit must not
-    orphan a ps server blocked in join().
-    TEST_TASK_EXECUTOR_NUM_HB_MISS skips the first N pings (fault injection,
-    TaskExecutor.java:238-248)."""
+def _die_lost_coordinator() -> None:
+    """The executor's lost-coordinator exit: reap the user process group
+    (a partitioned executor must not squat its TPU slice as a zombie — a
+    ps server blocked in join() would hold the chips forever) and exit
+    with the dedicated code the failure classifier reads as INFRA."""
+    _kill_user_process_group()
+    os._exit(constants.EXIT_CODE_LOST_COORDINATOR)
 
-    def __init__(self, client: ApplicationRpcClient, task_id: str, interval_ms: int):
+
+class Heartbeater(threading.Thread):
+    """1 Hz pings to the coordinator. Transient RPC errors are survivable —
+    one failed send only bumps a consecutive-failure counter that any
+    successful ping resets — but after ``max_failures`` consecutive
+    failures the coordinator is presumed gone (session being torn down or
+    retried, or a hard partition) and ``on_lost`` fires: by default the
+    user process group is reaped and the executor exits
+    EXIT_CODE_LOST_COORDINATOR.
+
+    Fault injection: ``drop_pings`` swallows the next N pings and
+    ``delay_spec`` (count, ms) sleeps before each of the next N — the
+    plan-driven replacements for TEST_TASK_EXECUTOR_NUM_HB_MISS, which
+    still works as a deprecated alias."""
+
+    def __init__(
+        self,
+        client: ApplicationRpcClient,
+        task_id: str,
+        session_id: str,
+        interval_ms: int,
+        max_failures: int = MAX_CONSECUTIVE_HB_FAILURES,
+        drop_pings: int = 0,
+        delay_spec: tuple[int, int] | None = None,
+        on_lost=_die_lost_coordinator,
+    ):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
+        self._session_id = session_id
         self._interval_s = interval_ms / 1000.0
+        self._max_failures = max(max_failures, 1)
         self._skip = int(os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
-        self._stop = threading.Event()
+        self._drop = drop_pings
+        self._delay_count, self._delay_ms = delay_spec or (0, 0)
+        self._on_lost = on_lost
+        self.consecutive_failures = 0
+        # NOT named _stop: threading.Thread has a private _stop METHOD that
+        # join() calls when the thread finishes; shadowing it with an Event
+        # makes join() blow up with "'Event' object is not callable".
+        self._stopped = threading.Event()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stopped.set()
 
     def run(self) -> None:
-        failures = 0
-        while not self._stop.wait(self._interval_s):
+        while not self._stopped.wait(self._interval_s):
             if self._skip > 0:
                 self._skip -= 1
                 continue
+            if self._drop > 0:
+                self._drop -= 1
+                log.info("fault injection: dropping heartbeat (%d left)",
+                         self._drop)
+                continue
+            if self._delay_count > 0:
+                self._delay_count -= 1
+                time.sleep(self._delay_ms / 1000.0)
             try:
-                self._client.task_executor_heartbeat(self._task_id)
-                failures = 0
+                self._client.task_executor_heartbeat(
+                    self._task_id, self._session_id
+                )
+                self.consecutive_failures = 0
             except Exception:
-                failures += 1
-                log.warning("heartbeat failed (%d consecutive)", failures)
-                if failures >= MAX_CONSECUTIVE_HB_FAILURES:
+                self.consecutive_failures += 1
+                log.warning("heartbeat failed (%d consecutive)",
+                            self.consecutive_failures)
+                if self.consecutive_failures >= self._max_failures:
                     log.error("lost the coordinator — exiting")
-                    _kill_user_process_group()
-                    os._exit(1)
+                    self._on_lost()
+                    return
 
 
 class TaskExecutor:
@@ -144,11 +190,33 @@ class TaskExecutor:
         self.am_host, _, am_port = env[constants.TONY_AM_ADDRESS].rpartition(":")
         self.am_port = int(am_port)
         self.conf = TonyConfiguration.from_final(env[constants.TONY_CONF_PATH])
+        self._started_monotonic = time.monotonic()
+        # Fault plan (tony.fault.plan rides the frozen conf): resolve this
+        # task's slice of it. A plan the coordinator validated but this
+        # host cannot read (file path on a remote VM) degrades to no
+        # faults rather than failing real work.
+        self._fault_plan: FaultPlan | None = None
+        self._faults = ExecutorFaults()
+        try:
+            self._fault_plan = FaultPlan.from_conf(self.conf)
+        except Exception:
+            log.warning("ignoring unreadable fault plan", exc_info=True)
+        if self._fault_plan is not None:
+            self._faults = self._fault_plan.for_executor(
+                self.task_id, int(self.session_id)
+            )
         # The coordinator hands executors their role credential directly —
         # the conf they can read is secret-stripped, so they cannot derive
         # any other role's token (privilege separation, security.py).
         secret = env.get(constants.TONY_EXECUTOR_TOKEN)
-        self.client = ApplicationRpcClient(self.am_host, self.am_port, secret=secret)
+        self._call_timeout_s = (
+            self.conf.get_int(keys.K_RPC_CALL_TIMEOUT_MS, 60000) / 1000.0
+        )
+        self.client = ApplicationRpcClient(
+            self.am_host, self.am_port, secret=secret,
+            call_timeout_s=self._call_timeout_s,
+            fault_hook=self._faults.blackout_hook(self._started_monotonic),
+        )
         # The rendezvous port: what this task advertises as host:port. Under
         # the JAX runtime, chief:0's port becomes the jax.distributed
         # coordinator service port (TaskExecutor.java:70-82 reserves the
@@ -169,11 +237,34 @@ class TaskExecutor:
 
     # -- rendezvous (TaskExecutor.registerAndGetClusterSpec:196-213) --------
     def register_and_get_cluster_spec(self) -> dict[str, list[str]]:
+        # The heartbeat client retries nothing per-call (call_retries=0)
+        # and runs on a short leash — connect AND per-call timeouts scale
+        # with the interval, NOT the shared tony.rpc.call-timeout: each
+        # failed send must count against the consecutive-failure threshold
+        # within about one interval, or "max failures × interval" stops
+        # bounding how long a partitioned executor squats its slice (a
+        # silent partition leaves the TCP connection up, so a 60s recv
+        # timeout would stretch detection to max_failures × 60s).
+        interval_ms = self.conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS,
+                                        1000)
         self.heartbeater = Heartbeater(
-            ApplicationRpcClient(self.am_host, self.am_port,
-                                 secret=self.client._secret),
+            ApplicationRpcClient(
+                self.am_host, self.am_port, secret=self.client._secret,
+                connect_timeout_s=2.0, call_retries=0,
+                call_timeout_s=max(2 * interval_ms / 1000.0, 2.0),
+                fault_hook=self._faults.blackout_hook(
+                    self._started_monotonic
+                ),
+            ),
             self.task_id,
-            self.conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000),
+            self.session_id,
+            interval_ms,
+            max_failures=self.conf.get_int(
+                keys.K_TASK_MAX_HB_SEND_FAILURES,
+                MAX_CONSECUTIVE_HB_FAILURES,
+            ),
+            drop_pings=self._faults.drop_heartbeats,
+            delay_spec=self._faults.delay_heartbeats,
         )
         self.heartbeater.start()
         retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
@@ -211,6 +302,12 @@ class TaskExecutor:
             env[constants.PROFILER_PORT] = str(self.profiler_port)
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
+        if self._fault_plan is not None and self._fault_plan.raw and any(
+            s.action == "fail_checkpoint_write" for s in self._fault_plan.specs
+        ):
+            # CheckpointManager runs in the USER process and honors
+            # fail_checkpoint_write faults from this env.
+            env[constants.TONY_FAULT_PLAN] = self._fault_plan.raw
         return env
 
     def build_task_command(self) -> str:
@@ -251,6 +348,14 @@ class TaskExecutor:
             log.error("TEST_TASK_EXECUTOR_HANG set — hanging")
             time.sleep(20)
             return 1
+        if self._faults.pre_register_exit is not None:
+            # Fault injection (exit_executor at pre_register): die before
+            # the rendezvous barrier — how a typo'd script path or broken
+            # localization looks to the coordinator, whose classifier must
+            # read a pre-registration nonzero exit as USER_PERMANENT.
+            log.error("fault injection: exiting %d before registration",
+                      self._faults.pre_register_exit)
+            return self._faults.pre_register_exit
         self._maybe_sleep_for_skew()
         cluster_spec = self.register_and_get_cluster_spec()
         log.info("barrier released; cluster spec: %s", cluster_spec)

@@ -1,0 +1,52 @@
+"""Failure-aware session retry — the policy engine behind the coordinator's
+retry loop.
+
+The reference restarts blindly: any session failure burns one unit of
+``tony.am.retry-count`` and the rerun recomputes from step 0
+(TonyApplicationMaster.java:340-365, 526-542). On preemption-heavy TPU
+fleets that conflates three very different situations — a preempted slice
+(retry immediately, it will work), a flaky disk or partition (retry with
+backoff), and a typo in the user script (never retry, stop wasting slices).
+This package separates them:
+
+* ``classifier``  — maps task exit codes, signals, heartbeat expiry, and
+  backend-reported preemption into TRANSIENT / INFRA / USER_PERMANENT.
+* ``policy``      — per-category retry decisions: exponential backoff with
+  deterministic jitter, and a progress-aware budget that refreshes whenever
+  a retry advances past the previous best checkpoint step (the Bamboo /
+  Pathways insight: a job that keeps making progress should keep running).
+* ``progress``    — a jax-free probe for the newest *complete*
+  ``CheckpointManager`` step, so retried sessions resume via
+  ``TONY_RESUME_STEP`` instead of recomputing.
+* ``faults``      — a structured, seedable fault-injection plan
+  (``tony.fault.plan``) replacing the ad-hoc ``TEST_*`` env flags; every
+  robustness claim in this package is provable by a deterministic chaos run.
+
+Deliberately jax-free: the coordinator control plane imports this package
+at startup and must not pay (or depend on) an accelerator runtime import.
+"""
+
+from tony_tpu.resilience.classifier import (
+    FailureCategory,
+    FailureEvent,
+    classify,
+)
+from tony_tpu.resilience.faults import (
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+)
+from tony_tpu.resilience.policy import RetryDecision, RetryPolicy
+from tony_tpu.resilience.progress import latest_complete_step
+
+__all__ = [
+    "FailureCategory",
+    "FailureEvent",
+    "classify",
+    "FaultPlan",
+    "FaultPlanError",
+    "FaultSpec",
+    "RetryDecision",
+    "RetryPolicy",
+    "latest_complete_step",
+]

@@ -1,0 +1,103 @@
+"""Failure classification: one observed failure → one retry category.
+
+The coordinator records the FIRST failure of each session as a
+``FailureEvent`` (later failures are cascade noise — a killed slice takes
+every collective down with it) and asks ``classify`` which of three
+categories it falls into:
+
+* ``TRANSIENT``       — could plausibly succeed on an identical rerun
+  (generic nonzero exit from a task that made it through rendezvous,
+  timeouts). Retried with full exponential backoff.
+* ``INFRA``           — the substrate failed underneath a healthy program:
+  signal deaths (SIGKILL/SIGTERM are how preemption looks from inside),
+  heartbeat expiry (partition or wedged host), backend-reported slice
+  preemption/provisioning failure, an executor that lost the coordinator.
+  Retried promptly — the program was fine.
+* ``USER_PERMANENT``  — deterministic user error: command not found /
+  not executable (126, 127), or a task that died nonzero before ever
+  reaching the rendezvous barrier (typo'd script path, import error,
+  broken interpreter — setup failures rerun identically). Never retried;
+  the session fails fast without consuming retry budget.
+
+The table is intentionally small and auditable — every row is covered by
+``tests/test_resilience.py::TestClassifier``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from tony_tpu import constants
+
+
+class FailureCategory(enum.Enum):
+    TRANSIENT = "TRANSIENT"
+    INFRA = "INFRA"
+    USER_PERMANENT = "USER_PERMANENT"
+
+
+# Event kinds — each is produced at exactly one coordinator code path.
+TASK_EXIT = "task_exit"              # backend.poll returned nonzero
+HEARTBEAT_EXPIRY = "heartbeat_expiry"  # LivenessMonitor expired the task
+PREEMPTION = "preemption"            # backend reported the slice preempted
+CONF_ERROR = "conf_error"            # slice planning / scheduling rejected
+
+
+@dataclass(frozen=True)
+class FailureEvent:
+    """One observed session failure, with everything classification needs."""
+
+    kind: str                 # TASK_EXIT | HEARTBEAT_EXPIRY | PREEMPTION | CONF_ERROR
+    task_id: str | None = None
+    exit_code: int | None = None
+    registered: bool = True   # did the task reach the rendezvous barrier?
+    detail: str = ""
+
+    def describe(self) -> str:
+        bits = [self.kind]
+        if self.task_id:
+            bits.append(self.task_id)
+        if self.exit_code is not None:
+            bits.append(f"exit={self.exit_code}")
+        if not self.registered:
+            bits.append("pre-rendezvous")
+        if self.detail:
+            bits.append(self.detail)
+        return " ".join(bits)
+
+
+# Exit codes with a deterministic-user-error meaning (POSIX shell):
+# 126 = found but not executable, 127 = command not found. Both rerun
+# identically however many slices get burned on them.
+_USER_EXIT_CODES = frozenset({126, 127})
+
+
+def classify(event: FailureEvent) -> FailureCategory:
+    """The category table. Signal deaths dominate: a SIGKILL'd task is an
+    external kill (preemption, OOM reaper, operator) whatever phase it died
+    in, so the signal rows are checked before the pre-rendezvous row."""
+    if event.kind in (HEARTBEAT_EXPIRY, PREEMPTION):
+        return FailureCategory.INFRA
+    if event.kind == CONF_ERROR:
+        return FailureCategory.USER_PERMANENT
+    code = event.exit_code if event.exit_code is not None else 1
+    # subprocess.poll reports signal deaths as -signum; a shell reports the
+    # same death as 128+signum. Accept both spellings.
+    if code < 0 or code > 128:
+        return FailureCategory.INFRA
+    if code == constants.EXIT_CODE_LOST_COORDINATOR:
+        # The executor self-terminated after losing the coordinator — a
+        # partition/teardown artifact, not a program property.
+        return FailureCategory.INFRA
+    if code in _USER_EXIT_CODES:
+        return FailureCategory.USER_PERMANENT
+    if code == 124:
+        # execute_shell's timeout convention (coreutils `timeout`): the
+        # program ran but overran — plausibly data/size dependent.
+        return FailureCategory.TRANSIENT
+    if not event.registered:
+        # Died nonzero before rendezvous: setup is deterministic (script
+        # path, imports, interpreter), so a rerun fails the same way.
+        return FailureCategory.USER_PERMANENT
+    return FailureCategory.TRANSIENT

@@ -1,0 +1,549 @@
+"""Structured, seedable fault injection — ``tony.fault.plan``.
+
+The reference's chaos surface was two ad-hoc env vars read at hardcoded
+points (``TEST_AM_CRASH``, ``TEST_WORKER_TERMINATION``,
+Constants.java:69-74). This module replaces them with a declarative plan
+that ships in the job conf, validates up front, and fires deterministically
+under a fixed seed — so every robustness claim (classification, backoff,
+checkpoint resume) is provable by a replayable chaos run.
+
+Plan shape (inline JSON in the conf value, or a path to a JSON file)::
+
+    {
+      "seed": 7,
+      "faults": [
+        {"action": "crash_coordinator", "phase": "schedule", "session": 1},
+        {"action": "kill_task", "target": "worker:1", "at": "rendezvous"},
+        {"action": "kill_task", "target": "any_non_chief", "after_heartbeats": 3},
+        {"action": "kill_task", "target": "worker:1", "after_ms": 1500, "session": 1},
+        {"action": "exit_executor", "target": "worker:0", "at": "pre_register", "code": 1},
+        {"action": "drop_heartbeats", "target": "worker:0", "count": 10},
+        {"action": "delay_heartbeats", "target": "worker:0", "ms": 250, "count": 5},
+        {"action": "blackout_rpc", "target": "worker:0", "after_ms": 2000, "ms": 1500},
+        {"action": "fail_checkpoint_write", "step": 10, "count": 1}
+      ]
+    }
+
+Every fault may carry ``"session": n`` (fire only in session ``n``;
+default: any session) and ``"count": k`` (fire at most ``k`` times;
+default 1). ``seed`` drives every random choice (victim selection for
+``any_non_chief``) and the retry policy's jitter inherits the same plan
+seed when set, so a whole chaos run replays bit-identically.
+
+Where each action fires:
+
+=====================  =====================================================
+action                 injection point
+=====================  =====================================================
+crash_coordinator      coordinator, entering phase ``prepare`` / ``schedule``
+                       / ``monitor`` (``os._exit``; the AM-death test)
+kill_task              coordinator kills the task's container: when the
+                       target (or, for ``any_non_chief``, the chief)
+                       registers; after the target's N-th heartbeat; or
+                       T ms into the session's monitor loop
+exit_executor          the executor itself exits ``code`` before
+                       registering (``at: pre_register``) — a deterministic
+                       setup failure, the USER_PERMANENT probe
+drop_heartbeats        the executor's Heartbeater swallows its next
+                       ``count`` pings (partition simulation)
+delay_heartbeats       Heartbeater sleeps ``ms`` before each of the next
+                       ``count`` pings (slow network simulation)
+blackout_rpc           every RPC from the target executor raises for the
+                       window [after_ms, after_ms+ms) of its lifetime
+fail_checkpoint_write  ``CheckpointManager.save`` raises at ``step``
+                       (reads the plan from ``TONY_FAULT_PLAN`` in the
+                       user process)
+=====================  =====================================================
+
+The legacy ``TEST_AM_CRASH`` / ``TEST_WORKER_TERMINATION`` env vars remain
+as deprecated aliases: ``FaultPlan.from_conf`` synthesizes the equivalent
+plan entries when they are set.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Mapping, Sequence
+
+log = logging.getLogger(__name__)
+
+ANY_NON_CHIEF = "any_non_chief"
+
+CRASH_COORDINATOR = "crash_coordinator"
+KILL_TASK = "kill_task"
+EXIT_EXECUTOR = "exit_executor"
+DROP_HEARTBEATS = "drop_heartbeats"
+DELAY_HEARTBEATS = "delay_heartbeats"
+BLACKOUT_RPC = "blackout_rpc"
+FAIL_CHECKPOINT_WRITE = "fail_checkpoint_write"
+
+COORDINATOR_PHASES = ("prepare", "schedule", "monitor")
+
+# action → (required fields, optional fields). "session" and "count" are
+# legal everywhere; everything else must be declared here — unknown fields
+# are validation errors, not silent no-ops (a typo'd field name must not
+# turn a chaos test into a pass-by-accident).
+_FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
+    CRASH_COORDINATOR: (frozenset({"phase"}), frozenset({"code"})),
+    KILL_TASK: (
+        frozenset({"target"}),
+        frozenset({"at", "after_heartbeats", "after_ms"}),
+    ),
+    EXIT_EXECUTOR: (frozenset({"target"}), frozenset({"at", "code"})),
+    DROP_HEARTBEATS: (frozenset({"target"}), frozenset()),
+    DELAY_HEARTBEATS: (frozenset({"target", "ms"}), frozenset()),
+    BLACKOUT_RPC: (frozenset({"ms"}), frozenset({"target", "after_ms"})),
+    FAIL_CHECKPOINT_WRITE: (frozenset({"step"}), frozenset({"target"})),
+}
+_COMMON_FIELDS = frozenset({"action", "session", "count"})
+
+
+class FaultPlanError(ValueError):
+    """The plan failed validation; ``errors`` carries every complaint."""
+
+    def __init__(self, errors: Sequence[str]) -> None:
+        self.errors = list(errors)
+        super().__init__(
+            "invalid tony.fault.plan: " + "; ".join(self.errors)
+        )
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    action: str
+    target: str | None = None
+    at: str | None = None
+    phase: str | None = None
+    session: int | None = None
+    count: int = 1
+    code: int = 1
+    ms: int = 0
+    after_ms: int | None = None
+    after_heartbeats: int | None = None
+    step: int | None = None
+
+    def in_session(self, session: int) -> bool:
+        return self.session is None or self.session == session
+
+    def matches_task(self, task_id: str) -> bool:
+        return self.target is None or self.target == task_id
+
+
+def _positive_int(raw: object, what: str, errors: list[str],
+                  minimum: int = 0) -> int:
+    if isinstance(raw, bool) or not isinstance(raw, int):
+        errors.append(f"{what} must be an integer, got {raw!r}")
+        return minimum
+    if raw < minimum:
+        errors.append(f"{what} must be >= {minimum}, got {raw}")
+        return minimum
+    return raw
+
+
+def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
+    where = f"faults[{i}]"
+    if not isinstance(obj, dict):
+        errors.append(f"{where} must be an object, got {type(obj).__name__}")
+        return None
+    action = obj.get("action")
+    if action not in _FIELDS:
+        errors.append(
+            f"{where}: unknown action {action!r}; legal: "
+            f"{sorted(_FIELDS)}"
+        )
+        return None
+    required, optional = _FIELDS[action]
+    legal = required | optional | _COMMON_FIELDS
+    for f in sorted(set(obj) - legal):
+        errors.append(f"{where} ({action}): unknown field {f!r}")
+    for f in sorted(required - set(obj)):
+        errors.append(f"{where} ({action}): missing required field {f!r}")
+
+    session = obj.get("session")
+    if session is not None:
+        session = _positive_int(session, f"{where}.session", errors, 1)
+    count = _positive_int(obj.get("count", 1), f"{where}.count", errors, 1)
+    code = _positive_int(obj.get("code", 1), f"{where}.code", errors, 0)
+    ms = _positive_int(obj.get("ms", 0), f"{where}.ms", errors, 0)
+    after_ms = obj.get("after_ms")
+    if after_ms is not None:
+        after_ms = _positive_int(after_ms, f"{where}.after_ms", errors, 0)
+    after_hb = obj.get("after_heartbeats")
+    if after_hb is not None:
+        after_hb = _positive_int(
+            after_hb, f"{where}.after_heartbeats", errors, 1
+        )
+    step = obj.get("step")
+    if step is not None:
+        step = _positive_int(step, f"{where}.step", errors, 0)
+
+    target = obj.get("target")
+    if target is not None:
+        if not isinstance(target, str) or not target:
+            errors.append(f"{where}.target must be a non-empty string")
+            target = None
+        elif target != ANY_NON_CHIEF and ":" not in target:
+            errors.append(
+                f"{where}.target must be 'job:index' or "
+                f"{ANY_NON_CHIEF!r}, got {target!r}"
+            )
+    at = obj.get("at")
+    phase = obj.get("phase")
+
+    if action == CRASH_COORDINATOR and phase not in COORDINATOR_PHASES:
+        errors.append(
+            f"{where}.phase must be one of {list(COORDINATOR_PHASES)}, "
+            f"got {phase!r}"
+        )
+    if action == KILL_TASK:
+        triggers = [
+            t for t in (at, after_hb, after_ms) if t is not None
+        ]
+        if len(triggers) != 1:
+            errors.append(
+                f"{where} (kill_task): exactly one trigger required — "
+                f"at='rendezvous', after_heartbeats, or after_ms"
+            )
+        if at is not None and at != "rendezvous":
+            errors.append(
+                f"{where}.at must be 'rendezvous' for kill_task, got {at!r}"
+            )
+        if target == ANY_NON_CHIEF and at is None:
+            errors.append(
+                f"{where}: target {ANY_NON_CHIEF!r} is only legal with "
+                f"at='rendezvous' (timed/heartbeat kills need a concrete "
+                f"task)"
+            )
+    if action == EXIT_EXECUTOR:
+        if at is None:
+            at = "pre_register"
+        if at != "pre_register":
+            errors.append(
+                f"{where}.at must be 'pre_register' for exit_executor, "
+                f"got {at!r}"
+            )
+        if code == 0:
+            # Exit 0 pre-registration injects no failure — it marks the
+            # task COMPLETED-successfully and leaves the rest of the gang
+            # blocked at the barrier forever. A plan must not silently
+            # test nothing (or hang).
+            errors.append(
+                f"{where}.code must be nonzero for exit_executor"
+            )
+        if target == ANY_NON_CHIEF:
+            errors.append(
+                f"{where}: exit_executor needs a concrete 'job:index' "
+                f"target"
+            )
+    if action in (DROP_HEARTBEATS, DELAY_HEARTBEATS, FAIL_CHECKPOINT_WRITE):
+        if target == ANY_NON_CHIEF:
+            errors.append(
+                f"{where}: {action} needs a concrete 'job:index' target"
+            )
+
+    return FaultSpec(
+        action=action, target=target, at=at, phase=phase, session=session,
+        count=count, code=code, ms=ms, after_ms=after_ms,
+        after_heartbeats=after_hb, step=step,
+    )
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    specs: list[FaultSpec] = field(default_factory=list)
+    raw: str = ""   # the JSON text, for re-export into the user process env
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        errors: list[str] = []
+        try:
+            data = json.loads(text)
+        except ValueError as exc:
+            raise FaultPlanError([f"not valid JSON: {exc}"]) from None
+        if not isinstance(data, dict):
+            raise FaultPlanError(
+                [f"plan must be a JSON object, got {type(data).__name__}"]
+            )
+        for f in sorted(set(data) - {"seed", "faults"}):
+            errors.append(f"unknown top-level field {f!r}")
+        seed = data.get("seed", 0)
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            errors.append(f"seed must be an integer, got {seed!r}")
+            seed = 0
+        faults = data.get("faults", [])
+        if not isinstance(faults, list):
+            errors.append("faults must be a list")
+            faults = []
+        specs = []
+        for i, obj in enumerate(faults):
+            spec = _parse_spec(i, obj, errors)
+            if spec is not None:
+                specs.append(spec)
+        if errors:
+            raise FaultPlanError(errors)
+        return cls(seed=seed, specs=specs, raw=text)
+
+    @classmethod
+    def from_conf(cls, conf, env: Mapping[str, str] | None = None,
+                  ) -> "FaultPlan | None":
+        """Load from ``tony.fault.plan`` (inline JSON or a file path) and
+        fold in the deprecated ``TEST_*`` env aliases. Returns None when no
+        faults are configured — the common case pays one conf lookup."""
+        import os
+
+        from tony_tpu import constants
+        from tony_tpu.conf import keys
+
+        env = os.environ if env is None else env
+        value = conf.get_str(keys.K_FAULT_PLAN, "").strip()
+        if value and not value.lstrip().startswith("{"):
+            try:
+                value = Path(value).read_text()
+            except OSError as exc:
+                raise FaultPlanError(
+                    [f"cannot read plan file {value!r}: {exc}"]
+                ) from None
+        plan = cls.parse(value) if value else None
+        legacy: list[FaultSpec] = []
+        if env.get(constants.TEST_AM_CRASH):
+            log.warning("%s is deprecated — use tony.fault.plan "
+                        "crash_coordinator", constants.TEST_AM_CRASH)
+            legacy.append(FaultSpec(action=CRASH_COORDINATOR,
+                                    phase="schedule"))
+        if env.get(constants.TEST_WORKER_TERMINATION):
+            log.warning("%s is deprecated — use tony.fault.plan kill_task "
+                        "at rendezvous", constants.TEST_WORKER_TERMINATION)
+            # Unbounded count: the legacy env var killed a non-chief in
+            # EVERY session, so a retried session must get killed again —
+            # the alias must not silently let retries succeed.
+            legacy.append(FaultSpec(action=KILL_TASK, target=ANY_NON_CHIEF,
+                                    at="rendezvous", count=10**9))
+        if plan is None and not legacy:
+            return None
+        if plan is None:
+            plan = cls()
+        plan.specs.extend(legacy)
+        return plan
+
+    # -- executor-side view -------------------------------------------------
+    def for_executor(self, task_id: str, session: int) -> "ExecutorFaults":
+        """The slice of the plan one executor enforces on itself. Session
+        scoping substitutes for cross-process fire counting: a retried
+        executor is a fresh process, so in-memory counters cannot span
+        sessions — but the session id can."""
+        ex = ExecutorFaults()
+        for spec in self.specs:
+            if not (spec.in_session(session) and spec.matches_task(task_id)):
+                continue
+            if spec.action == EXIT_EXECUTOR and spec.target == task_id:
+                ex.pre_register_exit = spec.code
+            elif spec.action == DROP_HEARTBEATS and spec.target == task_id:
+                ex.drop_heartbeats += spec.count
+            elif spec.action == DELAY_HEARTBEATS and spec.target == task_id:
+                ex.delay_heartbeats = (spec.count, spec.ms)
+            elif spec.action == BLACKOUT_RPC:
+                ex.rpc_blackout = (spec.after_ms or 0, spec.ms)
+        return ex
+
+
+@dataclass
+class ExecutorFaults:
+    """Executor-side faults, resolved for one (task, session)."""
+
+    pre_register_exit: int | None = None
+    drop_heartbeats: int = 0
+    delay_heartbeats: tuple[int, int] | None = None  # (count, ms)
+    rpc_blackout: tuple[int, int] | None = None      # (after_ms, ms)
+
+    def any(self) -> bool:
+        return (
+            self.pre_register_exit is not None
+            or self.drop_heartbeats > 0
+            or self.delay_heartbeats is not None
+            or self.rpc_blackout is not None
+        )
+
+    def blackout_hook(self, started_monotonic: float):
+        """A callable for ``ApplicationRpcClient(fault_hook=...)``: raises
+        OSError inside the blackout window, measured from executor start."""
+        if self.rpc_blackout is None:
+            return None
+        after_ms, ms = self.rpc_blackout
+
+        def hook() -> None:
+            elapsed_ms = (time.monotonic() - started_monotonic) * 1000.0
+            if after_ms <= elapsed_ms < after_ms + ms:
+                raise OSError(
+                    f"fault injection: RPC blackout "
+                    f"[{after_ms},{after_ms + ms})ms"
+                )
+
+        return hook
+
+
+class FaultInjector:
+    """Coordinator-side enforcement: holds the plan plus fire/counter state
+    (one-shot faults stay fired across session retries; heartbeat counters
+    reset per session)."""
+
+    def __init__(self, plan: FaultPlan | None) -> None:
+        self.plan = plan
+        self._fired: dict[int, int] = {}
+        self._hb_counts: dict[tuple[int, str], int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.plan is not None and bool(self.plan.specs)
+
+    def reset_session(self) -> None:
+        self._hb_counts.clear()
+
+    def _take(self, idx: int, spec: FaultSpec) -> bool:
+        fired = self._fired.get(idx, 0)
+        if fired >= spec.count:
+            return False
+        self._fired[idx] = fired + 1
+        return True
+
+    def _active(self, action: str, session: int):
+        if self.plan is None:
+            return
+        for idx, spec in enumerate(self.plan.specs):
+            if spec.action == action and spec.in_session(session):
+                yield idx, spec
+
+    # -- coordinator injection points ---------------------------------------
+    def coordinator_phase(self, phase: str, session: int) -> None:
+        """Crash the coordinator on entering ``phase`` if the plan says so
+        (the AM-death chaos path — ``os._exit`` so no cleanup runs, exactly
+        like a SIGKILL'd AM)."""
+        import os
+
+        for idx, spec in self._active(CRASH_COORDINATOR, session):
+            if spec.phase == phase and self._take(idx, spec):
+                log.error("fault injection: crashing coordinator at %s "
+                          "(session %d)", phase, session)
+                os._exit(spec.code or 1)
+
+    def rendezvous_kills(
+        self,
+        registered_task_id: str,
+        registered_is_chief: bool,
+        session: int,
+        non_chief_ids: Sequence[str],
+    ) -> list[str]:
+        """Task ids to kill now that ``registered_task_id`` has registered.
+        A concrete target fires when IT registers; ``any_non_chief`` fires
+        when the CHIEF registers (the reference's preemption simulation,
+        TonyApplicationMaster.java:1108-1119) and picks its victim from the
+        seeded PRNG — deterministic per (seed, session)."""
+        victims: list[str] = []
+        for idx, spec in self._active(KILL_TASK, session):
+            if spec.at != "rendezvous":
+                continue
+            if spec.target == ANY_NON_CHIEF:
+                if registered_is_chief and non_chief_ids \
+                        and self._take(idx, spec):
+                    rng = random.Random(
+                        f"{self.plan.seed}:victim:{session}:{idx}"
+                    )
+                    victims.append(rng.choice(sorted(non_chief_ids)))
+            elif spec.target == registered_task_id and self._take(idx, spec):
+                victims.append(registered_task_id)
+        return victims
+
+    def heartbeat_kill(self, task_id: str, session: int) -> bool:
+        """Count the target's pings; True when one crosses its threshold."""
+        for idx, spec in self._active(KILL_TASK, session):
+            if spec.after_heartbeats is None or spec.target != task_id:
+                continue
+            key = (idx, task_id)
+            n = self._hb_counts.get(key, 0) + 1
+            self._hb_counts[key] = n
+            if n >= spec.after_heartbeats and self._take(idx, spec):
+                return True
+        return False
+
+    def timed_kills(self, session: int, elapsed_ms: float) -> list[str]:
+        """Targets whose ``after_ms`` deadline has passed this session."""
+        victims = []
+        for idx, spec in self._active(KILL_TASK, session):
+            if spec.after_ms is None:
+                continue
+            if elapsed_ms >= spec.after_ms and self._take(idx, spec):
+                victims.append(spec.target)
+        return victims
+
+
+# ---------------------------------------------------------------------------
+# User-process (checkpoint) faults — read from TONY_FAULT_PLAN, which the
+# executor exports when the plan carries fail_checkpoint_write entries.
+# ---------------------------------------------------------------------------
+_ckpt_faults: "CheckpointFaults | None | bool" = False  # False = not loaded
+
+
+class CheckpointFaults:
+    def __init__(self, plan: FaultPlan, task_id: str | None,
+                 session: int = 1) -> None:
+        # Session scoping filters here, like every executor-side fault: a
+        # retried session is a fresh process, so the _fired counter cannot
+        # span sessions — the session id is what makes "fail once, then
+        # recover on retry" expressible.
+        self._specs = [
+            (i, s) for i, s in enumerate(plan.specs)
+            if s.action == FAIL_CHECKPOINT_WRITE
+            and (s.target is None or s.target == task_id)
+            and s.in_session(session)
+        ]
+        self._fired: dict[int, int] = {}
+
+    def maybe_fail_write(self, step: int) -> None:
+        for idx, spec in self._specs:
+            if spec.step != step:
+                continue
+            if self._fired.get(idx, 0) >= spec.count:
+                continue
+            self._fired[idx] = self._fired.get(idx, 0) + 1
+            raise OSError(
+                f"fault injection: checkpoint write failed at step {step}"
+            )
+
+
+def checkpoint_faults_from_env() -> CheckpointFaults | None:
+    """Lazy singleton over ``TONY_FAULT_PLAN`` — called from
+    ``CheckpointManager.save`` on every write, so the env parse happens
+    once per process."""
+    global _ckpt_faults
+    if _ckpt_faults is not False:
+        return _ckpt_faults
+    import os
+
+    from tony_tpu import constants
+
+    raw = os.environ.get(constants.TONY_FAULT_PLAN)
+    if not raw:
+        _ckpt_faults = None
+        return None
+    task_id = None
+    if constants.JOB_NAME in os.environ and constants.TASK_INDEX in os.environ:
+        task_id = (f"{os.environ[constants.JOB_NAME]}:"
+                   f"{os.environ[constants.TASK_INDEX]}")
+    try:
+        session = int(os.environ.get(constants.SESSION_ID, "1"))
+    except ValueError:
+        session = 1
+    try:
+        _ckpt_faults = CheckpointFaults(FaultPlan.parse(raw), task_id,
+                                        session)
+    except FaultPlanError:
+        log.warning("ignoring unparseable %s", constants.TONY_FAULT_PLAN,
+                    exc_info=True)
+        _ckpt_faults = None
+    return _ckpt_faults

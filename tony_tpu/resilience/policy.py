@@ -1,0 +1,118 @@
+"""Per-category retry policy: backoff schedule + progress-aware budget.
+
+Replaces the reference's bare countdown (``retries_left -= 1`` on any
+failure, TonyApplicationMaster.java:340-365) with three rules:
+
+1. **USER_PERMANENT never retries.** A typo fails the job on the first
+   session however much budget is configured.
+2. **Exponential backoff with deterministic jitter.** The n-th retry waits
+   ``base * 2^(n-1)`` capped at ``max``, stretched by a jitter factor in
+   [1, 1.5) drawn from a seeded PRNG — deterministic for a given
+   ``(seed, attempt)`` so chaos tests can assert exact schedules, while
+   distinct seeds (per app) decorrelate retry storms when a zone-wide
+   preemption kills many jobs at once. INFRA failures wait half the
+   TRANSIENT schedule: preempted capacity usually returns quickly and the
+   program itself was healthy.
+3. **Progress refreshes the budget.** When a retried session advances the
+   best complete checkpoint step past the previous best, the remaining
+   budget resets to the full configured count. A job repeatedly preempted
+   at step 10k, 20k, 30k keeps running forever; a job that dies at step 0
+   every time exhausts the budget and stops — exactly the distinction a
+   fixed countdown cannot express (the Bamboo/Pathways behavior the ISSUE
+   names).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+
+from tony_tpu.resilience.classifier import FailureCategory
+
+log = logging.getLogger(__name__)
+
+# INFRA restarts at half the TRANSIENT backoff — the program was healthy,
+# only the substrate blinked.
+_CATEGORY_BACKOFF_SCALE = {
+    FailureCategory.TRANSIENT: 1.0,
+    FailureCategory.INFRA: 0.5,
+}
+
+
+@dataclass(frozen=True)
+class RetryDecision:
+    retry: bool
+    category: FailureCategory
+    backoff_ms: int
+    reason: str
+
+
+@dataclass
+class RetryPolicy:
+    budget: int                 # full per-run retry allowance (refreshable)
+    backoff_base_ms: int = 1000
+    backoff_max_ms: int = 60000
+    seed: int = 0
+    remaining: int = field(init=False)
+    attempt: int = field(init=False, default=0)   # retries granted so far
+    best_step: int | None = field(init=False, default=None)
+
+    def __post_init__(self) -> None:
+        self.remaining = self.budget
+
+    # -- progress-aware budget --------------------------------------------
+    def observe_progress(self, step: int | None) -> bool:
+        """Feed the newest complete checkpoint step observed after a
+        session ended. Returns True when it advanced past the previous
+        best — in which case the remaining budget refreshes to the full
+        allowance (the session earned its keep)."""
+        if step is None:
+            return False
+        if self.best_step is not None and step <= self.best_step:
+            return False
+        advanced = self.best_step is not None
+        self.best_step = step
+        if advanced and self.remaining < self.budget:
+            log.info(
+                "checkpoint advanced to step %d — refreshing retry budget "
+                "to %d", step, self.budget,
+            )
+        if advanced:
+            self.remaining = self.budget
+        return advanced
+
+    # -- backoff schedule ---------------------------------------------------
+    def backoff_ms_for(self, attempt: int, category: FailureCategory) -> int:
+        """Deterministic: same (seed, attempt, category) → same delay.
+        ``attempt`` is 1-based (the first retry is attempt 1)."""
+        raw = self.backoff_base_ms * (2 ** max(attempt - 1, 0))
+        capped = min(raw, self.backoff_max_ms)
+        # Jitter from a PRNG seeded by (seed, attempt): replayable, yet
+        # distinct apps (distinct seeds) spread their restarts.
+        jitter = random.Random(f"{self.seed}:{attempt}").uniform(1.0, 1.5)
+        scale = _CATEGORY_BACKOFF_SCALE.get(category, 1.0)
+        return int(capped * jitter * scale)
+
+    # -- decisions ----------------------------------------------------------
+    def decide(self, category: FailureCategory) -> RetryDecision:
+        """One session failed with ``category`` — retry it? Consumes one
+        unit of budget when the answer is yes."""
+        if category is FailureCategory.USER_PERMANENT:
+            return RetryDecision(
+                False, category, 0,
+                "user-permanent failure: retrying cannot help",
+            )
+        if self.remaining <= 0:
+            return RetryDecision(
+                False, category, 0,
+                f"retry budget exhausted ({self.budget} configured)",
+            )
+        self.remaining -= 1
+        self.attempt += 1
+        backoff = self.backoff_ms_for(self.attempt, category)
+        return RetryDecision(
+            True, category, backoff,
+            f"retry {self.attempt} ({self.remaining} budget left), "
+            f"backoff {backoff}ms",
+        )

@@ -10,12 +10,14 @@ import logging
 import socket
 import threading
 import time
-from typing import Any
+from typing import Any, Callable
 
 from tony_tpu.rpc import wire
 from tony_tpu.rpc.protocol import ApplicationRpc, RpcError, TaskUrl
 
 log = logging.getLogger(__name__)
+
+DEFAULT_CALL_TIMEOUT_S = 60.0  # tony.rpc.call-timeout overrides
 
 
 class ApplicationRpcClient(ApplicationRpc):
@@ -27,6 +29,8 @@ class ApplicationRpcClient(ApplicationRpc):
         connect_timeout_s: float = 5.0,
         call_retries: int = 3,
         retry_interval_s: float = 0.5,
+        call_timeout_s: float = DEFAULT_CALL_TIMEOUT_S,
+        fault_hook: Callable[[], None] | None = None,
     ) -> None:
         self.host = host
         self.port = port
@@ -34,6 +38,15 @@ class ApplicationRpcClient(ApplicationRpc):
         self._connect_timeout_s = connect_timeout_s
         self._call_retries = call_retries
         self._retry_interval_s = retry_interval_s
+        # Per-call socket deadline (tony.rpc.call-timeout). Callers with a
+        # liveness contract tighter than the 60s default — heartbeaters
+        # must notice a dead coordinator within a few intervals — pass
+        # their own.
+        self._call_timeout_s = call_timeout_s
+        # Fault injection seam (resilience/faults.py blackout_rpc): invoked
+        # before every attempt; raising OSError simulates a partition and
+        # follows the normal transport-failure path (reconnect + retry).
+        self._fault_hook = fault_hook
         self._sock: socket.socket | None = None
         # One in-flight call at a time per client (executor threads share it).
         self._lock = threading.Lock()
@@ -44,7 +57,7 @@ class ApplicationRpcClient(ApplicationRpc):
             s = socket.create_connection(
                 (self.host, self.port), timeout=self._connect_timeout_s
             )
-            s.settimeout(60.0)
+            s.settimeout(self._call_timeout_s)
             self._sock = s
         return self._sock
 
@@ -64,6 +77,8 @@ class ApplicationRpcClient(ApplicationRpc):
         with self._lock:
             for attempt in range(self._call_retries + 1):
                 try:
+                    if self._fault_hook is not None:
+                        self._fault_hook()
                     sock = self._connect()
                     wire.send_msg(sock, req)
                     resp = wire.recv_msg(sock)
@@ -111,8 +126,10 @@ class ApplicationRpcClient(ApplicationRpc):
     def finish_application(self) -> None:
         return self._call("finish_application")
 
-    def task_executor_heartbeat(self, task_id: str) -> None:
-        return self._call("task_executor_heartbeat", task_id=task_id)
+    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
+        return self._call(
+            "task_executor_heartbeat", task_id=task_id, session_id=session_id
+        )
 
     def get_application_status(self) -> dict[str, Any]:
         return self._call("get_application_status")

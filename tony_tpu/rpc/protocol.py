@@ -73,8 +73,10 @@ class ApplicationRpc(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str) -> None:
-        ...
+    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
+        """``session_id`` fences stale pings: an executor from a previous
+        (failed, being-torn-down) session must not feed the retried
+        session's liveness monitor."""
 
     @abc.abstractmethod
     def get_application_status(self) -> dict[str, Any]:
@@ -95,6 +97,6 @@ RPC_METHODS: dict[str, tuple[str, ...]] = {
     "register_tensorboard_url": ("spec", "url"),
     "register_execution_result": ("exit_code", "job_name", "job_index", "session_id"),
     "finish_application": (),
-    "task_executor_heartbeat": ("task_id",),
+    "task_executor_heartbeat": ("task_id", "session_id"),
     "get_application_status": (),
 }
