@@ -27,12 +27,14 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import tony_tpu.runtime as rt
+from tony_tpu import observability
 from tony_tpu.checkpoint import CheckpointManager
 from tony_tpu.models import TransformerConfig, make_train_step
 from tony_tpu.parallel.mesh import MeshSpec
@@ -237,11 +239,22 @@ def main(argv=None) -> int:
             return 0
         while int(state.step) < args.steps:
             tokens = next(batches)
+            t0 = time.perf_counter()
             state, metrics = step_fn(state, tokens)
             loss = float(metrics["loss"])
+            # The float() above is the readback fence, so this wall time
+            # covers the whole step. report() publishes the snapshot to
+            # TONY_METRICS_FILE (when tony launched us), where the
+            # executor piggybacks it on its heartbeat — live loss and
+            # throughput on the coordinator's /metrics, no extra RPCs.
+            dt = time.perf_counter() - t0
             first = loss if first is None else first
             last = loss
             step = int(state.step)
+            observability.report(
+                step=step, loss=loss, step_time_ms=dt * 1000.0,
+                tokens_per_sec=args.batch * args.seq / dt if dt else 0.0,
+            )
             if step % 5 == 0 or step == args.steps:
                 print(f"step {step}: loss {loss:.4f}", flush=True)
             if step % args.checkpoint_every == 0:

@@ -1,0 +1,35 @@
+"""Jax-free telemetry fixture: reports a few train-loop metrics through
+``observability.report`` (auto-published to TONY_METRICS_FILE, where the
+executor piggybacks them on its heartbeat), opens a user-process span
+that joins the job trace, and lingers long enough for several heartbeats
+to carry the snapshot."""
+import os
+import sys
+import time
+
+from tony_tpu import observability
+
+if not os.environ.get("TONY_METRICS_FILE"):
+    print("TONY_METRICS_FILE not exported", file=sys.stderr)
+    sys.exit(4)
+if not os.environ.get("TONY_TRACE_ID"):
+    print("TONY_TRACE_ID not exported", file=sys.stderr)
+    sys.exit(5)
+
+# Force every report to publish: the e2e asserts on what rides the very
+# next heartbeat, so the default write throttle would only add latency.
+registry = observability.default_registry()
+registry._publish_min_interval_s = 0.0
+
+with observability.span("fixture_train"):
+    for step in range(1, 6):
+        registry.report(
+            step=step, loss=1.0 / step, step_time_ms=5.0,
+            tokens_per_sec=1000.0,
+        )
+        time.sleep(0.05)
+
+# Linger so heartbeats (interval set tight by the test) carry the final
+# snapshot before this task exits.
+time.sleep(float(os.environ.get("LINGER_S", "2.0")))
+sys.exit(0)

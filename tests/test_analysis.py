@@ -288,6 +288,101 @@ class TestProtocolCheck:
             for f in check_protocol(acl=acl)
         )
 
+    def test_optional_arg_must_be_trailing(self):
+        """An optional arg that is not the trailing registry arg could
+        never be omitted wire-side — flagged as P001."""
+        from tony_tpu.rpc.protocol import RPC_METHODS
+
+        optional = {"register_worker_spec": ("worker",)}  # 'worker' leads
+        findings = check_protocol(optional_args=optional)
+        assert any(
+            f.rule_id == "TONY-P001" and "trailing" in f.message
+            for f in findings
+        )
+
+    def test_optional_arg_without_default_flagged(self):
+        """Declaring an arg optional in the registry but required on the
+        interface/stub silently breaks omission — both sides flagged."""
+        optional = {"register_worker_spec": ("spec",)}
+        findings = check_protocol(optional_args=optional)
+        assert any(
+            f.rule_id == "TONY-P001" and "no default" in f.message
+            for f in findings
+        )
+        assert any(
+            f.rule_id == "TONY-P003" and "no default" in f.message
+            for f in findings
+        )
+
+    def test_optional_entry_for_unknown_method_flagged(self):
+        findings = check_protocol(optional_args={"no_such_call": ("x",)})
+        assert any(
+            f.rule_id == "TONY-P001" and "no_such_call" in f.message
+            for f in findings
+        )
+
+
+# ---------------------------------------------------------------------------
+# Metric-name lint (TONY-M001)
+# ---------------------------------------------------------------------------
+class TestMetricsLint:
+    def _lint(self, tmp_path, source: str):
+        from tony_tpu.analysis.metrics_lint import check_metric_names
+
+        script = tmp_path / "script.py"
+        script.write_text(source)
+        return check_metric_names([script])
+
+    def test_clean_registrations(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "reg.counter('requests_total')\n"
+            "reg.gauge('loss')\n"
+            "reg.histogram('step_seconds')\n"
+            "observability.report(step=1, loss=0.5, step_time_ms=4.0)\n"
+        ))
+        assert findings == []
+
+    def test_bad_names_flagged(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "reg.counter('CamelCase')\n"        # not snake_case
+            "reg.counter('requests')\n"         # counter without _total
+            "reg.gauge('step_time')\n"          # time without unit
+            "reg.gauge('memory_used')\n"        # size without unit
+        ))
+        assert len(findings) == 4
+        assert all(f.rule_id == "TONY-M001" for f in findings)
+        assert findings[0].line == 1 and findings[3].line == 4
+
+    def test_report_kwargs_linted_step_exempt(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "observability.report(step=3, queue_latency=1.0)\n"
+        ))
+        assert len(findings) == 1 and "queue_latency" in findings[0].message
+
+    def test_kind_conflict_across_files(self, tmp_path):
+        from tony_tpu.analysis.metrics_lint import check_metric_names
+
+        (tmp_path / "a.py").write_text("reg.counter('widgets_total')\n")
+        (tmp_path / "b.py").write_text("reg.gauge('widgets_total')\n")
+        findings = check_metric_names([tmp_path])
+        assert len(findings) == 1
+        assert "one name, one kind" in findings[0].message
+
+    def test_unparseable_file_skipped(self, tmp_path):
+        findings = self._lint(tmp_path, "def broken(:\n")
+        assert findings == []
+
+    def test_repo_tree_is_clean(self):
+        """The lint this PR ships must hold for the metrics this PR
+        ships (also enforced via lint_self in tier-1)."""
+        from tony_tpu.analysis.metrics_lint import check_metric_names
+
+        findings = check_metric_names([
+            REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+            REPO / "bench.py",
+        ])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
 
 # ---------------------------------------------------------------------------
 # Repo self-drift (tools/lint_self.py) — drift fails tier-1.

@@ -239,9 +239,12 @@ class TestClusterNotebookUrl:
         class Coord:
             pass
 
+        from tony_tpu.observability.events import EventLog
+
         coord = Coord()
         coord.session = session
         coord.tensorboard_url = None
+        coord.events = EventLog()
         handlers = _RpcForClient(coord)
         local = session.get_task("worker", 0)
         local.url = "file:///worker-0.log"

@@ -47,6 +47,63 @@ class TestReadPath:
         assert cfg["tony.application.name"] == "name-of-application_1_0003"
         assert job_config(tmp_path, "application_9_9999") is None
 
+    def test_malformed_jhist_variants_skipped(self, tmp_path):
+        """Satellite coverage: every malformed-.jhist shape seen in the
+        wild must be skipped, never raise — non-int timestamps, too few
+        fields, empty stems, a .jhist that is a directory."""
+        now = int(time.time() * 1000)
+        _make_job(tmp_path, "application_1_0001", now)
+        day = tmp_path / "2021" / "02" / "03"
+        bad = day / "application_2_0001"
+        bad.mkdir(parents=True)
+        (bad / "application_2_0001-notanint-0-u-FAILED.jhist").write_text("")
+        (bad / "too-few.jhist").write_text("")
+        (bad / ".jhist").write_text("")
+        (bad / "application_2_0001-1-2-u-OK.jhist.d").mkdir()
+        jobs = list_jobs(tmp_path)
+        assert [j.app_id for j in jobs] == ["application_1_0001"]
+
+    def test_empty_day_directories_listed_clean(self, tmp_path):
+        """Empty year/month/day trees (history locations are pre-created
+        by provisioning) must list as zero jobs."""
+        (tmp_path / "2024" / "01" / "01").mkdir(parents=True)
+        (tmp_path / "2024" / "01" / "02").mkdir(parents=True)
+        assert list_jobs(tmp_path) == []
+        # an empty JOB dir (crashed before any write) is also clean
+        (tmp_path / "2024" / "01" / "02" / "application_7_0001").mkdir()
+        assert list_jobs(tmp_path) == []
+
+    def test_config_without_final_status_lists_and_serves(self, tmp_path):
+        """A job with config.json + .jhist but no final-status (crashed
+        coordinator, or pre-observability writer) must list, serve its
+        config, and 404 — not 500 — on the run-report views."""
+        now = int(time.time() * 1000)
+        _make_job(tmp_path, "application_5_0001", now, status="RUNNING")
+        jobs = list_jobs(tmp_path)
+        assert [j.app_id for j in jobs] == ["application_5_0001"]
+        assert job_config(tmp_path, "application_5_0001") is not None
+        from tony_tpu.history.reader import (
+            job_events,
+            job_final_status,
+            job_trace,
+        )
+
+        assert job_final_status(tmp_path, "application_5_0001") is None
+        assert job_events(tmp_path, "application_5_0001") is None
+        assert job_trace(tmp_path, "application_5_0001") is None
+        server = HistoryServer(str(tmp_path), port=0)
+        port = server.serve_background()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://localhost:{port}/job/application_5_0001"
+                )
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
     def test_ttl_cache(self):
         clock = [0.0]
         cache = TtlCache(ttl_s=10.0, clock=lambda: clock[0])
@@ -142,6 +199,85 @@ class TestHistoryServer:
                 assert False, "expected 404"
             except urllib.error.HTTPError as e:
                 assert e.code == 404
+        finally:
+            server.stop()
+
+    def test_job_page_timeline_metrics_and_tensorboard(self, tmp_path):
+        """The observability additions to the per-job page: the lifecycle
+        timeline from events.jsonl, the final aggregated metric summary,
+        and the persisted TensorBoard link (previously the URL lived only
+        in coordinator memory); /api/events serves the raw timeline."""
+        from tony_tpu.history.writer import (
+            write_events_file,
+            write_final_status,
+        )
+
+        now = int(time.time() * 1000)
+        job_dir = _make_job(tmp_path, "application_6_0001", now)
+        write_final_status(job_dir, {
+            "state": "SUCCEEDED",
+            "stats": {"sessions_run": 1, "tasks_failed": 0, "wall_ms": 100},
+            "tensorboard_url": "http://tb-host:6006",
+            "metrics": {
+                "heartbeats": {"worker:0": 9},
+                "tasks": {"worker:0": {
+                    "counters": {"train_steps_total": 5},
+                    "gauges": {"loss": 0.25},
+                }},
+            },
+        })
+        write_events_file(job_dir, [
+            {"ts_ms": now, "kind": "task_registered", "task": "worker:0"},
+            {"ts_ms": now + 10, "kind": "rendezvous_released", "tasks": 1},
+            {"ts_ms": now + 20, "kind": "final_status",
+             "state": "SUCCEEDED"},
+        ])
+        server = HistoryServer(str(tmp_path), port=0)
+        port = server.serve_background()
+        try:
+            base = f"http://localhost:{port}"
+            page = urllib.request.urlopen(
+                f"{base}/job/application_6_0001"
+            ).read().decode()
+            for needle in ("Timeline", "rendezvous_released",
+                           "Final metrics", "train_steps_total",
+                           "http://tb-host:6006"):
+                assert needle in page, needle
+            api = json.loads(urllib.request.urlopen(
+                f"{base}/api/events/application_6_0001"
+            ).read())
+            assert [e["kind"] for e in api] == [
+                "task_registered", "rendezvous_released", "final_status",
+            ]
+            try:
+                urllib.request.urlopen(f"{base}/api/events/application_9_9")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
+    def test_job_supplied_tensorboard_url_scheme_gated(self, tmp_path):
+        """register_tensorboard_url is job-controlled: a javascript: URL
+        must render as text, never as a clickable link in the history
+        server's origin."""
+        from tony_tpu.history.writer import write_final_status
+
+        now = int(time.time() * 1000)
+        job_dir = _make_job(tmp_path, "application_6_0002", now)
+        write_final_status(job_dir, {
+            "state": "SUCCEEDED",
+            "tensorboard_url": "javascript:alert(1)",
+        })
+        server = HistoryServer(str(tmp_path), port=0)
+        port = server.serve_background()
+        try:
+            page = urllib.request.urlopen(
+                f"http://localhost:{port}/job/application_6_0002"
+            ).read().decode()
+            assert "javascript:alert(1)" in page  # visible as text
+            assert "href='javascript" not in page and \
+                   'href="javascript' not in page
         finally:
             server.stop()
 

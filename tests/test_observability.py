@@ -1,0 +1,529 @@
+"""Observability subsystem tests: the metrics registry + Prometheus
+rendering, the structured event log, trace spans + the per-job Chrome
+trace merge, the coordinator-side aggregator, the heartbeat metrics
+piggyback over real RPC, and the mini-cluster e2e that drives the whole
+telemetry plane through a 2-task job (jax-free fixture)."""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.coordinator.app_master import TonyCoordinator
+from tony_tpu.coordinator.backend import LocalProcessBackend
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.observability import events as obs_events
+from tony_tpu.observability import metrics as obs_metrics
+from tony_tpu.observability import trace as obs_trace
+from tony_tpu.observability.aggregator import (
+    MetricsAggregator,
+    ObservabilityHttpServer,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+# ---------------------------------------------------------------------------
+# metrics.py
+# ---------------------------------------------------------------------------
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_roundtrip(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("requests_total").inc()
+        reg.counter("requests_total").inc(2)
+        reg.gauge("loss").set(0.5)
+        h = reg.histogram("step_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        snap = reg.snapshot()
+        assert snap["counters"]["requests_total"] == 3
+        assert snap["gauges"]["loss"] == 0.5
+        hist = snap["histograms"]["step_seconds"]
+        assert hist["count"] == 3 and hist["sum"] == pytest.approx(5.55)
+        assert hist["buckets"] == [[0.1, 1], [1.0, 2]]  # cumulative
+
+    def test_name_validation(self):
+        reg = obs_metrics.MetricsRegistry()
+        with pytest.raises(ValueError, match="snake_case"):
+            reg.counter("Bad-Name")
+        with pytest.raises(ValueError, match="_total"):
+            reg.counter("requests")
+        with pytest.raises(ValueError, match="unit suffix"):
+            reg.gauge("step_time")  # time without _ms/_seconds
+        with pytest.raises(ValueError, match="unit suffix"):
+            reg.gauge("memory_used")
+        reg.gauge("step_time_ms")  # legal
+        reg.counter("ticks_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("ticks_total")  # kind conflict
+
+    def test_counter_cannot_decrease(self):
+        reg = obs_metrics.MetricsRegistry()
+        with pytest.raises(ValueError, match="decrease"):
+            reg.counter("ticks_total").inc(-1)
+
+    def test_report_drives_step_counter_by_delta(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.report(step=3, loss=1.0)
+        reg.report(step=5, loss=0.5)
+        reg.report(step=5, loss=0.4)  # no progress: counter holds
+        snap = reg.snapshot()
+        assert snap["counters"]["train_steps_total"] == 5
+        assert snap["gauges"]["train_step"] == 5
+        assert snap["gauges"]["loss"] == 0.4
+
+    def test_publish_and_load_snapshot(self, tmp_path):
+        path = tmp_path / "m.json"
+        reg = obs_metrics.MetricsRegistry(
+            publish_path=path, publish_min_interval_s=0.0
+        )
+        reg.report(step=1, loss=2.0)
+        snap = obs_metrics.load_snapshot_file(path)
+        assert snap is not None and snap["gauges"]["loss"] == 2.0
+        # corrupt file -> None, never raises (heartbeats must not fail)
+        path.write_text("{not json")
+        assert obs_metrics.load_snapshot_file(path) is None
+        assert obs_metrics.load_snapshot_file(tmp_path / "nope") is None
+
+    def test_prometheus_rendering(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("requests_total").inc(7)
+        reg.gauge("loss").set(1.5)
+        text = reg.to_prometheus()
+        assert "# TYPE requests_total counter" in text
+        assert "requests_total 7" in text
+        assert "loss 1.5" in text
+        labeled = obs_metrics.render_prometheus(
+            reg.snapshot(), labels={"task": 'work"er'}
+        )
+        assert 'requests_total{task="work\\"er"} 7' in labeled
+
+    def test_sanitize_metric_name(self):
+        assert obs_metrics.sanitize_metric_name("%fusion.1") == "fusion_1"
+        assert obs_metrics.sanitize_metric_name("") == "unnamed"
+
+
+# ---------------------------------------------------------------------------
+# events.py
+# ---------------------------------------------------------------------------
+class TestEventLog:
+    def test_emit_order_and_sink(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        log = obs_events.EventLog(sink=obs_events.jsonl_file_sink(path))
+        log.emit(obs_events.TASK_REGISTERED, task="worker:0", session=1)
+        log.emit(obs_events.RENDEZVOUS_RELEASED, session=1, tasks=2)
+        assert log.kinds() == ["task_registered", "rendezvous_released"]
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+        assert json.loads(lines[0])["task"] == "worker:0"
+
+    def test_sink_failure_never_raises(self):
+        def explode(event):
+            raise OSError("disk gone")
+
+        log = obs_events.EventLog(sink=explode)
+        log.emit("task_finished")  # must not raise
+        assert log.kinds() == ["task_finished"]
+
+    def test_parse_jsonl_skips_torn_lines(self):
+        text = '{"kind": "a"}\n{"kind": "b"\nnot json\n{"kind": "c"}\n'
+        events = obs_events.parse_jsonl(text)
+        assert [e["kind"] for e in events] == ["a", "c"]
+
+
+# ---------------------------------------------------------------------------
+# trace.py
+# ---------------------------------------------------------------------------
+class TestTrace:
+    def test_span_exports_chrome_events(self):
+        tracer = obs_trace.Tracer(trace_id="abc123", proc="coordinator")
+        with tracer.span("prepare", session=1):
+            pass
+        events = tracer.to_chrome_events()
+        # metadata row + the span
+        assert events[0]["ph"] == "M"
+        span = events[-1]
+        assert span["ph"] == "X" and span["name"] == "prepare"
+        assert span["args"]["trace_id"] == "abc123"
+        assert span["args"]["proc"] == "coordinator"
+        assert span["dur"] >= 1
+
+    def test_span_end_idempotent_and_attrs(self):
+        tracer = obs_trace.Tracer()
+        span = tracer.begin("monitor")
+        span.set(status="SUCCEEDED")
+        span.end()
+        span.end()
+        spans = [e for e in tracer.to_chrome_events() if e["ph"] == "X"]
+        assert len(spans) == 1
+        assert spans[0]["args"]["status"] == "SUCCEEDED"
+
+    def test_merge_job_trace_includes_executor_files(self, tmp_path):
+        coord = obs_trace.Tracer(trace_id="t1", proc="coordinator")
+        with coord.span("session"):
+            pass
+        ex = obs_trace.Tracer(trace_id="t1", proc="executor:worker:0")
+        with ex.span("user_process"):
+            pass
+        ex.write_jsonl(tmp_path / "trace-worker-0.jsonl")
+        # a torn tail must not hide the rest
+        (tmp_path / "trace-broken.jsonl").write_text('{"name": "x"\n')
+        doc = obs_trace.merge_job_trace(coord, tmp_path)
+        procs = {
+            e["args"]["proc"] for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+        }
+        assert procs == {"coordinator", "executor:worker:0"}
+        assert doc["otherData"]["trace_id"] == "t1"
+
+    def test_ambient_trace_id_env(self, monkeypatch):
+        monkeypatch.setenv(constants.TONY_TRACE_ID, "feedbeef")
+        assert obs_trace.Tracer().trace_id == "feedbeef"
+        monkeypatch.delenv(constants.TONY_TRACE_ID)
+        assert obs_trace.Tracer().trace_id != ""
+
+
+# ---------------------------------------------------------------------------
+# aggregator.py
+# ---------------------------------------------------------------------------
+def _snap(loss, step=1, ts=None):
+    return {
+        "ts_ms": ts or int(time.time() * 1000),
+        "counters": {"train_steps_total": step},
+        "gauges": {"loss": loss},
+        "histograms": {},
+    }
+
+
+class TestAggregator:
+    def test_ingest_and_prometheus(self):
+        agg = MetricsAggregator()
+        agg.registry.counter("sessions_started_total").inc()
+        agg.ingest("worker:0", _snap(0.5, ts=1))
+        agg.ingest("worker:1", None)  # plain liveness ping
+        text = agg.prometheus_text()
+        assert "sessions_started_total 1" in text
+        assert 'tony_task_heartbeats_total{task="worker:0"} 1' in text
+        assert 'tony_task_heartbeats_total{task="worker:1"} 1' in text
+        assert 'loss{task="worker:0"} 0.5' in text
+        assert 'train_steps_total{task="worker:0"} 1' in text
+        # TYPE headers are emitted once however many tasks share a name
+        assert text.count("# TYPE tony_task_heartbeats_total counter") == 1
+
+    def test_series_bounded_and_keyed(self):
+        agg = MetricsAggregator(series_limit=3)
+        for i in range(5):
+            agg.ingest("worker:0", _snap(float(i), ts=i + 1))
+        series = agg.to_json()["series"]["worker:0:loss"]
+        assert [v for _, v in series] == [2.0, 3.0, 4.0]  # bounded
+
+    def test_reset_tasks_keeps_heartbeat_totals(self):
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", _snap(0.5))
+        agg.reset_tasks()
+        agg.ingest("worker:0", None)
+        text = agg.prometheus_text()
+        assert 'tony_task_heartbeats_total{task="worker:0"} 2' in text
+        assert "loss{" not in text  # dead session's gauges dropped
+
+    def test_summary_compact(self):
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", _snap(0.25, step=4))
+        summary = agg.summary()
+        assert summary["tasks"]["worker:0"]["gauges"]["loss"] == 0.25
+        assert summary["heartbeats"]["worker:0"] == 1
+
+    def test_malformed_snapshot_families_normalized(self):
+        """The snapshot crosses a trust boundary (user-writable file →
+        executor → RPC): null/garbage families must not crash summary()
+        in stop() (losing the terminal record) or the /metrics render."""
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", {
+            "ts_ms": "yesterday",
+            "counters": None,
+            "gauges": {"loss": "not-a-number", "ok_ratio": 0.5},
+            "histograms": {"h_seconds": None,
+                           "g_seconds": {"count": 1, "sum": 2.0,
+                                         "buckets": [[1.0, 1], "junk"]}},
+        })
+        summary = agg.summary()
+        assert summary["tasks"]["worker:0"]["counters"] == {}
+        assert summary["tasks"]["worker:0"]["gauges"] == {"ok_ratio": 0.5}
+        text = agg.prometheus_text()
+        assert 'ok_ratio{task="worker:0"} 0.5' in text
+        assert 'g_seconds_count{task="worker:0"} 1' in text
+
+    def test_nan_loss_stays_valid_json(self):
+        """A diverged loop reporting loss=nan is exactly when operators
+        read these views: the JSON surfaces must stay strictly parseable
+        (null, not the bare NaN token), while Prometheus keeps NaN."""
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", _snap(float("nan")))
+        summary = agg.summary()
+        assert summary["tasks"]["worker:0"]["gauges"]["loss"] is None
+        assert "NaN" not in json.dumps(summary)
+        assert 'loss{task="worker:0"} NaN' in agg.prometheus_text()
+        server = ObservabilityHttpServer(agg, host="127.0.0.1")
+        port = server.serve_background()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/metrics"
+            ).read().decode()
+            assert "NaN" not in body
+            json.loads(body)  # strictly parseable
+        finally:
+            server.stop()
+
+    def test_http_endpoints(self):
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", _snap(0.5))
+        events = obs_events.EventLog()
+        events.emit(obs_events.TASK_REGISTERED, task="worker:0")
+        tracer = obs_trace.Tracer(trace_id="t9", proc="coordinator")
+        with tracer.span("prepare"):
+            pass
+        server = ObservabilityHttpServer(
+            agg, events=events, tracer=tracer, host="127.0.0.1"
+        )
+        port = server.serve_background()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert 'loss{task="worker:0"} 0.5' in text
+            api = json.loads(
+                urllib.request.urlopen(f"{base}/api/metrics").read()
+            )
+            assert api["tasks"]["worker:0"]["gauges"]["loss"] == 0.5
+            ev = json.loads(
+                urllib.request.urlopen(f"{base}/api/events").read()
+            )
+            assert ev[0]["kind"] == "task_registered"
+            tr = json.loads(
+                urllib.request.urlopen(f"{base}/api/trace").read()
+            )
+            assert tr["otherData"]["trace_id"] == "t9"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat piggyback over real RPC
+# ---------------------------------------------------------------------------
+class _HbApp:
+    """Heartbeat-only impl mirroring the coordinator's optional-metrics
+    signature."""
+
+    def __init__(self):
+        self.pings = []
+
+    def task_executor_heartbeat(self, task_id, session_id, metrics=None):
+        self.pings.append((task_id, session_id, metrics))
+
+
+class TestHeartbeatMetricsRpc:
+    @pytest.fixture()
+    def served(self):
+        from tony_tpu.rpc.server import ApplicationRpcServer
+
+        app = _HbApp()
+        server = ApplicationRpcServer(
+            app, host="127.0.0.1", port_range=(20000, 25000)
+        )
+        server.start()
+        yield app, server
+        server.stop()
+
+    def test_metrics_ride_the_heartbeat(self, served):
+        from tony_tpu.rpc.client import ApplicationRpcClient
+
+        app, server = served
+        c = ApplicationRpcClient("127.0.0.1", server.port)
+        c.task_executor_heartbeat("worker:0", "1")
+        c.task_executor_heartbeat("worker:0", "1", metrics=_snap(0.5))
+        assert app.pings[0][2] is None  # optional arg stays off the wire
+        assert app.pings[1][2]["gauges"]["loss"] == 0.5
+
+    def test_dispatch_accepts_omitted_optional_arg(self, served):
+        _, server = served
+        ok = server.dispatch({
+            "method": "task_executor_heartbeat",
+            "args": {"task_id": "w:0", "session_id": "1"},
+        })
+        assert ok["ok"] is True
+        bad = server.dispatch({
+            "method": "task_executor_heartbeat",
+            "args": {"metrics": {}},  # required args missing
+        })
+        assert bad["ok"] is False and "expects args" in bad["error"]
+
+    def test_trace_metadata_reaches_handler(self, served):
+        from tony_tpu.rpc.client import ApplicationRpcClient
+
+        app, server = served
+        seen = []
+        orig = app.task_executor_heartbeat
+
+        def spy(task_id, session_id, metrics=None):
+            seen.append(obs_trace.current_rpc_trace())
+            return orig(task_id, session_id, metrics)
+
+        app.task_executor_heartbeat = spy
+        c = ApplicationRpcClient(
+            "127.0.0.1", server.port, trace_id="cafe01"
+        )
+        c.task_executor_heartbeat("worker:0", "1")
+        assert seen == ["cafe01"]
+
+
+# ---------------------------------------------------------------------------
+# mini-cluster e2e: the acceptance scenario
+# ---------------------------------------------------------------------------
+def test_mini_cluster_observability_e2e(tmp_path):
+    """2-task jax-free job: the coordinator's /metrics endpoint serves
+    Prometheus text with per-task heartbeat and step counters WHILE the
+    job runs; events.jsonl lands in history with the ordered lifecycle
+    sequence; and the exported Chrome trace contains spans from the
+    coordinator, an executor, and the user process sharing one trace
+    id."""
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "report_metrics.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 2)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 150)
+    conf.set(keys.K_SHELL_ENV, "LINGER_S=4.0")
+
+    app_id = "application_mini_obs1"
+    app_dir = cluster.staging_dir / app_id
+    app_dir.mkdir(parents=True)
+    conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+    coordinator = TonyCoordinator(
+        conf, app_dir, app_id=app_id,
+        backend=LocalProcessBackend(app_dir / "logs"),
+    )
+    result: list[SessionStatus] = []
+    t = threading.Thread(
+        target=lambda: result.append(coordinator.run()), daemon=True
+    )
+    cluster._live.append(coordinator)
+    t.start()
+    try:
+        # -- live: scrape /metrics while the workers linger ---------------
+        deadline = time.monotonic() + 60
+        addr_file = app_dir / "coordinator.http"
+        while not addr_file.is_file() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert addr_file.is_file(), "coordinator.http never advertised"
+        addr = addr_file.read_text().strip()
+        text = ""
+        wanted = (
+            'tony_task_heartbeats_total{task="worker:0"}',
+            'tony_task_heartbeats_total{task="worker:1"}',
+            'train_steps_total{task="worker:0"}',
+            'train_steps_total{task="worker:1"}',
+            'loss{task="worker:0"}',
+        )
+        while time.monotonic() < deadline:
+            try:
+                text = urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5
+                ).read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            if all(n in text for n in wanted):
+                break
+            time.sleep(0.1)
+        for needle in wanted:
+            assert needle in text, f"{needle!r} never appeared in /metrics"
+        assert "# TYPE train_steps_total counter" in text
+    finally:
+        t.join(timeout=120)
+    assert result and result[0] is SessionStatus.SUCCEEDED, (
+        coordinator.session.diagnostics if coordinator.session else "no run"
+    )
+
+    # -- events.jsonl in history: the ordered lifecycle sequence ----------
+    event_files = list(cluster.history_dir.rglob("events.jsonl"))
+    assert len(event_files) == 1
+    events = obs_events.parse_jsonl(event_files[0].read_text())
+    kinds = [e["kind"] for e in events]
+    for kind in ("job_submitted", "session_started", "task_scheduled"):
+        assert kind in kinds
+    order = [
+        kinds.index("task_registered"),
+        kinds.index("rendezvous_released"),
+        kinds.index("task_finished"),
+        kinds.index("final_status"),
+    ]
+    assert order == sorted(order) and len(set(order)) == 4
+    # RPC metadata propagation: the registration event carries the same
+    # trace id the coordinator minted.
+    reg_event = events[kinds.index("task_registered")]
+    assert reg_event["trace_id"] == coordinator.tracer.trace_id
+
+    # -- Chrome trace: coordinator + executor + user spans, one trace id --
+    trace_files = list(cluster.history_dir.rglob("trace.json"))
+    assert len(trace_files) == 1
+    doc = json.loads(trace_files[0].read_text())
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_ids = {s["args"]["trace_id"] for s in spans}
+    assert trace_ids == {coordinator.tracer.trace_id}
+    procs = {s["args"]["proc"] for s in spans}
+    assert "coordinator" in procs
+    assert any(p.startswith("executor:worker:") for p in procs)
+    assert any(p.startswith("user:worker:") for p in procs)
+    names = {s["name"] for s in spans}
+    for name in ("prepare", "schedule_tasks", "rendezvous_wait",
+                 "rendezvous", "user_process", "fixture_train"):
+        assert name in names, f"span {name!r} missing from job trace"
+
+    # -- final-status carries the aggregated metric summary ---------------
+    final = json.loads((app_dir / "final-status.json").read_text())
+    assert final["trace_id"] == coordinator.tracer.trace_id
+    tasks = final["metrics"]["tasks"]
+    assert tasks["worker:0"]["gauges"]["loss"] == pytest.approx(0.2)
+    assert final["metrics"]["heartbeats"]["worker:0"] >= 1
+
+    # -- CLI: tony events / tony metrics over the same artifacts ----------
+    from tony_tpu.client import cli
+
+    rc = cli.main([
+        "events", app_id, "--staging-location", str(cluster.staging_dir),
+        "--history-location", str(cluster.history_dir),
+    ])
+    assert rc == 0
+    rc = cli.main([
+        "metrics", app_id, "--staging-location", str(cluster.staging_dir),
+        "--history-location", str(cluster.history_dir),
+    ])
+    assert rc == 0
+
+
+def test_observability_port_can_be_disabled(tmp_path):
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "exit_0.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_AM_HTTP_PORT, "disabled")
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED
+    assert coord.http_server is None
+    assert not (coord.app_dir / "coordinator.http").exists()
+    # The rest of the telemetry plane still runs: events + trace persist.
+    assert (coord.app_dir / "events.jsonl").is_file()
+    assert (coord.app_dir / "trace.json").is_file()

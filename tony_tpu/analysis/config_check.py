@@ -94,7 +94,7 @@ def _check_value(key: str, value, default) -> str | None:
                 f"must be one of {', '.join(_ENUM_KEYS[key])}; got {value!r}"
             )
         return None
-    if key == keys.K_HTTP_PORT:
+    if key in (keys.K_HTTP_PORT, keys.K_AM_HTTP_PORT):
         if str(value) != "disabled" and not _is_int(value):
             return f"must be an integer port or 'disabled'; got {value!r}"
         return None

@@ -1,0 +1,98 @@
+"""TONY-M001: metric-name lint.
+
+The observability registry validates names at registration time
+(``observability.metrics.validate_metric_name``), but only on the code
+path that actually runs; this lint finds every *statically visible*
+registration in a source tree — ``registry.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` calls and the keyword names of
+``observability.report(...)`` — and applies the same rules before
+anything executes:
+
+* names are snake_case;
+* counters end ``_total``;
+* names implying a dimension carry its unit (``*_time*`` → ``_ms`` /
+  ``_seconds`` / ``_us``; ``*_memory*``/``*_size*`` → ``_bytes`` /
+  ``_mb`` / ``_gb``);
+* one name, one kind: the same literal registered as (say) a counter in
+  one module and a gauge in another is flagged — the aggregated
+  ``/metrics`` page cannot serve both.
+
+Run from ``tools/lint_self.py`` over this repo (tier-1), and available
+to ``run_preflight`` consumers as a plain findings producer.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tony_tpu.analysis.findings import ERROR, Finding
+from tony_tpu.observability.metrics import validate_metric_name
+
+RULE = "TONY-M001"
+
+_REGISTER_ATTRS = {"counter": "counter", "gauge": "gauge",
+                   "histogram": "histogram"}
+# report() keywords become gauges, minus the step driver.
+_REPORT_SKIP_KWARGS = {"step"}
+
+
+def _iter_registrations(tree: ast.AST, file: str):
+    """Yield (name, kind, file, line) for every statically-visible
+    registration in one parsed module."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        attr = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if attr in _REGISTER_ATTRS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                yield (node.args[0].value, _REGISTER_ATTRS[attr], file,
+                       node.lineno)
+        elif attr == "report":
+            for kw in node.keywords:
+                if kw.arg and kw.arg not in _REPORT_SKIP_KWARGS:
+                    yield (kw.arg, "gauge", file, node.lineno)
+
+
+def check_metric_names(paths: "list[str | Path]") -> list[Finding]:
+    """Lint every registration across ``paths`` (files or directories,
+    scanned recursively for ``*.py``)."""
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+
+    findings: list[Finding] = []
+    # name -> (kind, file, line) of the first registration seen.
+    seen: dict[str, tuple[str, str, int]] = {}
+    for path in files:
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except (SyntaxError, ValueError, OSError):
+            continue  # script_lint owns reporting unparseable sources
+        for name, kind, file, line in _iter_registrations(tree, str(path)):
+            complaint = validate_metric_name(name, kind)
+            if complaint:
+                findings.append(Finding(
+                    RULE, ERROR, complaint, file=file, line=line,
+                ))
+                continue
+            prior = seen.get(name)
+            if prior is None:
+                seen[name] = (kind, file, line)
+            elif prior[0] != kind:
+                findings.append(Finding(
+                    RULE, ERROR,
+                    f"metric {name!r} registered as {kind} here but as "
+                    f"{prior[0]} at {prior[1]}:{prior[2]} — one name, "
+                    f"one kind",
+                    file=file, line=line,
+                ))
+    return findings

@@ -27,12 +27,23 @@ def _arg_names(func) -> tuple[str, ...]:
     return tuple(p.name for p in params if p.name != "self")
 
 
+def _defaulted_args(func) -> tuple[str, ...]:
+    """Names of parameters that carry a default — the callable's notion
+    of which args are optional."""
+    params = inspect.signature(func).parameters.values()
+    return tuple(
+        p.name for p in params
+        if p.name != "self" and p.default is not inspect.Parameter.empty
+    )
+
+
 def check_protocol(
     rpc_methods: dict[str, tuple[str, ...]] | None = None,
     interface: type | None = None,
     acl: dict | None = None,
     client_cls: type | None = None,
     server_cls: type | None = None,
+    optional_args: dict[str, tuple[str, ...]] | None = None,
 ) -> list[Finding]:
     """Cross-check the five tables. All parameters are injectable so tests
     can seed synthetic drift; defaults are the live ones."""
@@ -52,6 +63,8 @@ def check_protocol(
         from tony_tpu.coordinator.app_master import _RpcForClient
 
         server_cls = _RpcForClient
+    if optional_args is None:
+        optional_args = protocol.RPC_OPTIONAL_ARGS
 
     findings: list[Finding] = []
     registry = set(rpc_methods)
@@ -84,6 +97,44 @@ def check_protocol(
                 f"arg drift for `{name}`: RPC_METHODS says "
                 f"{list(rpc_methods[name])}, interface declares "
                 f"{list(declared)}",
+            ))
+
+    # Optional-arg table: RPC_OPTIONAL_ARGS entries must be a trailing
+    # subset of the method's registry row (the server fills omissions by
+    # keyword, but a required arg after an optional one could never be
+    # omitted wire-side), and both the interface and the client stub must
+    # declare a default for each — otherwise "optional" silently becomes
+    # required in one of the four tables.
+    for name in sorted(optional_args):
+        opts = tuple(optional_args[name])
+        if name not in registry:
+            findings.append(Finding(
+                "TONY-P001", ERROR,
+                f"RPC_OPTIONAL_ARGS entry `{name}` matches no RPC method",
+            ))
+            continue
+        row = rpc_methods[name]
+        if opts and tuple(row[-len(opts):]) != opts:
+            findings.append(Finding(
+                "TONY-P001", ERROR,
+                f"optional args {list(opts)} for `{name}` must be the "
+                f"trailing args of its RPC_METHODS row {list(row)}",
+            ))
+        impl = getattr(interface, name, None)
+        if impl is not None and set(opts) - set(_defaulted_args(impl)):
+            findings.append(Finding(
+                "TONY-P001", ERROR,
+                f"`{interface.__name__}.{name}` declares no default for "
+                f"optional arg(s) "
+                f"{sorted(set(opts) - set(_defaulted_args(impl)))} — the "
+                f"server could not fill an omitted arg",
+            ))
+        stub = client_cls.__dict__.get(name)
+        if stub is not None and set(opts) - set(_defaulted_args(stub)):
+            findings.append(Finding(
+                "TONY-P003", ERROR,
+                f"client stub `{name}` declares no default for optional "
+                f"arg(s) {sorted(set(opts) - set(_defaulted_args(stub)))}",
             ))
 
     # 1 ⟷ 3: registry vs ACL.

@@ -286,6 +286,146 @@ def lint(argv: list[str]) -> int:
     return 0
 
 
+def _obs_args(argv: list[str], prog: str):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog=f"tony_tpu.client.cli {prog}",
+        description=f"Job observability: {prog} for one application, from "
+                    f"the live coordinator when it is still running, else "
+                    f"from job history.",
+    )
+    p.add_argument("app_id", help="application id (see `tony list` or the "
+                                  "history server's job table)")
+    p.add_argument("--conf_file", default=None,
+                   help="job config supplying tony.staging/history "
+                        "locations")
+    p.add_argument("--staging-location", default=None,
+                   help="override tony.staging.location (live lookup)")
+    p.add_argument("--history-location", default=None,
+                   help="override tony.history.location (finished jobs)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print raw JSON instead of a table")
+    return p.parse_args(argv)
+
+
+def _obs_locations(args) -> tuple[Path, str]:
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(conf_file=args.conf_file)
+    staging = Path(
+        args.staging_location
+        or conf.get_str(keys.K_STAGING_LOCATION)
+        or Path.cwd() / constants.TONY_STAGING_DIR
+    )
+    history = (
+        args.history_location or conf.get_str(keys.K_HISTORY_LOCATION) or ""
+    )
+    return staging, history
+
+
+def _live_coordinator_get(staging: Path, app_id: str, path: str):
+    """Fetch a JSON view from a still-running coordinator's observability
+    port (advertised in <app_dir>/coordinator.http); None when the job is
+    not live (no file, or the port no longer answers)."""
+    import json as _json
+    import urllib.request
+
+    addr_file = staging / app_id / "coordinator.http"
+    if not addr_file.is_file():
+        return None
+    try:
+        addr = addr_file.read_text().strip()
+        with urllib.request.urlopen(
+            f"http://{addr}{path}", timeout=5
+        ) as resp:
+            return _json.loads(resp.read())
+    except (OSError, ValueError):
+        return None
+
+
+def events_cmd(argv: list[str]) -> int:
+    """``cli events <app_id>``: the job's structured lifecycle timeline —
+    live from the coordinator's /api/events, else events.jsonl from the
+    staging app dir, else job history."""
+    import json as _json
+
+    from tony_tpu.history.reader import job_events
+    from tony_tpu.observability.events import parse_jsonl
+
+    args = _obs_args(argv, "events")
+    staging, history = _obs_locations(args)
+    events = _live_coordinator_get(staging, args.app_id, "/api/events")
+    if events is None:
+        # A dead-but-unarchived coordinator still left the incremental
+        # events.jsonl in its app dir.
+        local = staging / args.app_id / "events.jsonl"
+        if local.is_file():
+            events = parse_jsonl(local.read_text())
+    if events is None and history:
+        events = job_events(history, args.app_id)
+    if events is None:
+        print(f"no events found for {args.app_id}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps(events, indent=2))
+        return 0
+    for e in events:
+        ts = time.strftime(
+            "%H:%M:%S", time.localtime(e.get("ts_ms", 0) / 1000)
+        )
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(e.items())
+            if k not in ("ts_ms", "kind", "task")
+        )
+        task = e.get("task", "")
+        print(f"{ts}  {e.get('kind', '?'):22s} {task:14s} {detail}")
+    return 0
+
+
+def metrics_cmd(argv: list[str]) -> int:
+    """``cli metrics <app_id>``: the aggregated metric state — live from
+    the coordinator's /api/metrics, else the final snapshot persisted in
+    the job's terminal record."""
+    import json as _json
+
+    from tony_tpu.history.reader import job_final_status
+
+    args = _obs_args(argv, "metrics")
+    staging, history = _obs_locations(args)
+    data = _live_coordinator_get(staging, args.app_id, "/api/metrics")
+    source = "live"
+    if data is None:
+        final = None
+        local = staging / args.app_id / "final-status.json"
+        if local.is_file():
+            try:
+                final = _json.loads(local.read_text())
+            except ValueError:
+                final = None
+        if final is None and history:
+            final = job_final_status(history, args.app_id)
+        if final is not None:
+            data = final.get("metrics")
+            source = "final"
+    if data is None:
+        print(f"no metrics found for {args.app_id}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps(data, indent=2))
+        return 0
+    print(f"# {args.app_id} ({source})")
+    for task_id in sorted(data.get("heartbeats", {})):
+        print(f"{task_id:16s} heartbeats_received "
+              f"{data['heartbeats'][task_id]}")
+    for task_id in sorted(data.get("tasks", {})):
+        snap = data["tasks"][task_id] or {}
+        for family in ("counters", "gauges"):
+            for name in sorted(snap.get(family) or {}):
+                print(f"{task_id:16s} {name} {snap[family][name]}")
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
@@ -293,6 +433,8 @@ SUBMITTERS = {
     "lint": lint,
     "list": list_resources,
     "cleanup": cleanup_resources,
+    "events": events_cmd,
+    "metrics": metrics_cmd,
 }
 
 

@@ -32,8 +32,6 @@ import urllib.error
 import urllib.request
 from typing import Callable, Mapping, Protocol
 
-from tony_tpu.coordinator.backend import SLICE_SHAPES
-
 log = logging.getLogger(__name__)
 
 # Env keys matching any of these never ride the ssh argv (visible in
@@ -389,6 +387,12 @@ class GcpQueuedResourceApi:
 
     @staticmethod
     def _hosts_per_slice(accelerator_type: str) -> int:
+        # Deferred: a module-level import here closes the cycle
+        # history -> writer -> cloud -> gcp -> coordinator -> history,
+        # breaking any entry point that imports tony_tpu.history first
+        # (e.g. ``python -m tony_tpu.history.server``).
+        from tony_tpu.coordinator.backend import SLICE_SHAPES
+
         for shapes in SLICE_SHAPES.values():
             for accel, hosts in shapes.values():
                 if accel == accelerator_type:

@@ -76,6 +76,10 @@ K_AM_RETRY_JITTER_SEED = AM_PREFIX + "retry-jitter-seed"
 K_AM_MONITOR_INTERVAL_MS = AM_PREFIX + "monitor-interval"
 K_AM_RPC_PORT_RANGE = AM_PREFIX + "rpc-port-range"       # "10000-15000"
 K_AM_STOP_GRACE_MS = AM_PREFIX + "stop-grace"            # wait for client finish signal
+# Observability HTTP port on the coordinator (/metrics Prometheus text,
+# /api/metrics, /api/events, /api/trace): an int ("0" = ephemeral, the
+# bound port is advertised in <app_dir>/coordinator.http) or "disabled".
+K_AM_HTTP_PORT = AM_PREFIX + "http-port"
 
 # --- chief semantics (TonyConfigurationKeys.java:159-163) ------------------
 CHIEF_PREFIX = TONY_PREFIX + "chief."
@@ -176,6 +180,7 @@ DEFAULTS: dict[str, object] = {
     K_AM_MONITOR_INTERVAL_MS: 200,
     K_AM_RPC_PORT_RANGE: "10000-15000",
     K_AM_STOP_GRACE_MS: 30000,
+    K_AM_HTTP_PORT: "0",
     K_CHIEF_NAME: "worker",
     K_CHIEF_INDEX: "0",
     K_WORKER_TIMEOUT: 0,

@@ -71,6 +71,13 @@ TONY_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"
 # Raw tony.fault.plan JSON, forwarded into the user process so
 # CheckpointManager can honor fail_checkpoint_write faults.
 TONY_FAULT_PLAN = "TONY_FAULT_PLAN"
+# Observability env (observability/): the job's trace id, minted by the
+# coordinator and propagated coordinator -> executor -> user process so
+# every span lands in one distributed trace; and the file the user
+# process publishes its metrics snapshot to (the executor reads it and
+# piggybacks the snapshot on its heartbeat).
+TONY_TRACE_ID = "TONY_TRACE_ID"
+TONY_METRICS_FILE = "TONY_METRICS_FILE"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -84,6 +91,7 @@ DOCKER_FORWARD_ENV = (
     MEGASCALE_COORDINATOR_ADDRESS, MEGASCALE_NUM_SLICES, MEGASCALE_SLICE_ID,
     TB_PORT, PROFILER_PORT, TONY_LOG_DIR, PREPROCESSING_JOB, TASK_PARAM_KEY,
     TONY_RESUME_STEP, TONY_CHECKPOINT_DIR, TONY_FAULT_PLAN,
+    TONY_TRACE_ID, TONY_METRICS_FILE,
 )
 
 # The executor's self-termination code after losing the coordinator (N

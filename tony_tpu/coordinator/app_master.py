@@ -43,8 +43,17 @@ from tony_tpu.history import JobMetadata, setup_job_dir
 from tony_tpu.history.writer import (
     create_history_file,
     write_config_file,
+    write_events_file,
     write_final_status,
+    write_trace_file,
 )
+from tony_tpu.observability import events as obs_events
+from tony_tpu.observability import trace as obs_trace
+from tony_tpu.observability.aggregator import (
+    MetricsAggregator,
+    ObservabilityHttpServer,
+)
+from tony_tpu.observability.metrics import MetricsRegistry
 from tony_tpu.resilience import (
     FailureEvent,
     FaultPlan,
@@ -79,6 +88,8 @@ class _RpcForClient(ApplicationRpc):
 
     def register_tensorboard_url(self, spec: str, url: str) -> str | None:
         self._c.tensorboard_url = url
+        self._c.events.emit(obs_events.TENSORBOARD_REGISTERED,
+                            task=spec, url=url)
         # Also pin the URL on the registering TASK, so get_task_urls
         # serves the live service endpoint — the reference's
         # NotebookSubmitter polls getTaskUrls for the notebook task and
@@ -107,8 +118,11 @@ class _RpcForClient(ApplicationRpc):
     def finish_application(self) -> None:
         self._c.client_signal_to_finish.set()
 
-    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
-        self._c.on_heartbeat(task_id, session_id)
+    def task_executor_heartbeat(
+        self, task_id: str, session_id: str,
+        metrics: dict[str, Any] | None = None,
+    ) -> None:
+        self._c.on_heartbeat(task_id, session_id, metrics)
 
     def get_application_status(self) -> dict[str, Any]:
         return self._c.application_status()
@@ -155,6 +169,21 @@ class TonyCoordinator:
         # terminal state (and, say, reads history) must never win a race
         # against the files being written.
         self._final_published = threading.Event()
+        # Observability plane: the coordinator's own metrics registry,
+        # the per-task aggregator fed by heartbeat piggybacks, the
+        # structured lifecycle log (appended live to events.jsonl so a
+        # crashed coordinator still leaves the timeline), and the job's
+        # distributed trace (its id rides TONY_TRACE_ID + RPC metadata).
+        self.metrics = MetricsRegistry()
+        self.aggregator = MetricsAggregator(registry=self.metrics)
+        self.events = obs_events.EventLog(
+            sink=obs_events.jsonl_file_sink(self.app_dir / "events.jsonl")
+        )
+        self.tracer = obs_trace.Tracer(proc="coordinator")
+        self.http_server: ObservabilityHttpServer | None = None
+        self._rendezvous_released = False
+        self._rendezvous_span: obs_trace.Span | None = None
+        self._session_span: obs_trace.Span | None = None
 
         tokens = None
         self._executor_token: str | None = None
@@ -187,6 +216,8 @@ class TonyCoordinator:
         """prepare (TonyApplicationMaster.java:379-428): start RPC + liveness,
         advertise the RPC address for the client, write history config."""
         self._faults.coordinator_phase("prepare", self._session_seq + 1)
+        self.events.emit(obs_events.JOB_SUBMITTED, app_id=self.app_id,
+                         trace_id=self.tracer.trace_id)
         self.rpc_server.start()
         self.liveness.start()
         # The advertised address must be reachable by the CLIENT too, not
@@ -195,6 +226,28 @@ class TonyCoordinator:
         (self.app_dir / "coordinator.addr").write_text(
             f"{self._am_host()}:{self.rpc_server.port}\n"
         )
+        # The observability port ("disabled" opts out; 0 = ephemeral,
+        # advertised in coordinator.http for the CLI and scrapers).
+        # Best-effort by contract: a bound port or typo'd value must not
+        # kill a working training job over an optional metrics endpoint.
+        http_port = self.conf.get_str(keys.K_AM_HTTP_PORT, "0")
+        if http_port != "disabled":
+            try:
+                self.http_server = ObservabilityHttpServer(
+                    self.aggregator, events=self.events, tracer=self.tracer,
+                    logs_dir=self.app_dir / "logs", port=int(http_port),
+                )
+                self.http_server.serve_background()
+                (self.app_dir / "coordinator.http").write_text(
+                    f"{self._am_host()}:{self.http_server.port}\n"
+                )
+            except (OSError, ValueError) as exc:
+                self.http_server = None
+                log.warning(
+                    "observability http port unavailable (%s=%r): %s — "
+                    "continuing without /metrics",
+                    keys.K_AM_HTTP_PORT, http_port, exc,
+                )
         if self._executor_token is not None:
             # Executor-audience conf: everything but the job secret. Tasks
             # get pointed at this copy (plus TONY_EXECUTOR_TOKEN), the way
@@ -208,6 +261,7 @@ class TonyCoordinator:
         if hist:
             job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
             write_config_file(job_dir, self.conf)
+        self.events.emit(obs_events.JOB_STAGED, app_dir=str(self.app_dir))
 
     def run(self) -> SessionStatus:
         """Failure-aware retry loop (grown from the reference's blind
@@ -217,11 +271,19 @@ class TonyCoordinator:
         retry budget refreshes whenever a retry advanced the best complete
         checkpoint step — preempted-but-progressing jobs run forever,
         deterministic user bugs fail fast."""
-        self.prepare()
+        with self.tracer.span("prepare"):
+            self.prepare()
         self._retry_policy = self._build_retry_policy()
         try:
             while True:
                 status = self._run_one_session()
+                if self._session_span is not None:
+                    self._session_span.set(status=status.value)
+                    self._session_span.end()
+                self.events.emit(
+                    obs_events.SESSION_FINISHED,
+                    session=self._session_seq, status=status.value,
+                )
                 if status is SessionStatus.SUCCEEDED or self._killed.is_set():
                     break
                 decision = self._decide_retry()
@@ -251,6 +313,8 @@ class TonyCoordinator:
             self.backend.stop_all()
             self.liveness.stop()
             self.rpc_server.stop()
+            if self.http_server is not None:
+                self.http_server.stop()
 
     def _build_retry_policy(self) -> RetryPolicy:
         # Jitter seed precedence: explicit conf key, then the fault plan's
@@ -303,6 +367,16 @@ class TonyCoordinator:
             "resume_step": best,
             "reason": decision.reason,
         })
+        if best is not None:
+            self.events.emit(obs_events.CHECKPOINT_PROGRESS,
+                             session=self._session_seq, best_step=best)
+        self.events.emit(
+            obs_events.RETRY_DECISION, session=self._session_seq,
+            failure=event.describe(), category=category.value,
+            retried=decision.retry, backoff_ms=decision.backoff_ms,
+            reason=decision.reason,
+        )
+        self.metrics.counter("retry_decisions_total").inc()
         if decision.retry:
             self._resume_step = best
             log.warning(
@@ -335,6 +409,12 @@ class TonyCoordinator:
         self._session_seq += 1
         self.session = TonySession(self.conf, session_id=self._session_seq)
         self.session.status = SessionStatus.RUNNING
+        self._session_span = self.tracer.begin(
+            "session", session=self._session_seq
+        )
+        self.metrics.counter("sessions_started_total").inc()
+        self.events.emit(obs_events.SESSION_STARTED,
+                         session=self._session_seq)
         # Preprocess / single-node AM mode (doPreprocessingJob,
         # TonyApplicationMaster.java:483-497, 640-703): run the user command
         # inside the coordinator. Single-node jobs end here (no containers,
@@ -479,11 +559,21 @@ class TonyCoordinator:
         """scheduleTasks (TonyApplicationMaster.java:507-524) + the
         ContainerLauncher env contract (:1017-1092)."""
         assert self.session is not None
-        for task in self.session.all_tasks():
-            env = self._task_env(task)
-            task.handle = self.backend.launch(task, env)
-            if isinstance(self.backend, LocalProcessBackend):
-                task.url = self.backend.task_url(task)
+        with self.tracer.span("schedule_tasks",
+                              session=self.session.session_id):
+            for task in self.session.all_tasks():
+                env = self._task_env(task)
+                task.handle = self.backend.launch(task, env)
+                if isinstance(self.backend, LocalProcessBackend):
+                    task.url = self.backend.task_url(task)
+                self.metrics.counter("tasks_launched_total").inc()
+                self.events.emit(obs_events.TASK_SCHEDULED, task=task.id,
+                                 session=self.session.session_id)
+        # The gang barrier opens now; its wait is the span users look for
+        # first in the waterfall (staging -> rendezvous -> first step).
+        self._rendezvous_span = self.tracer.begin(
+            "rendezvous_wait", session=self.session.session_id
+        )
 
     def _am_host(self) -> str:
         """Address executors dial back to. Local backends use loopback;
@@ -532,6 +622,9 @@ class TonyCoordinator:
         # (examples/lm_train.py honors both).
         if self._resume_step is not None:
             env[constants.TONY_RESUME_STEP] = str(self._resume_step)
+        # One trace id per job: executors (and through them the user
+        # processes) join the coordinator's distributed trace.
+        env[constants.TONY_TRACE_ID] = self.tracer.trace_id
         ckpt_loc = self.conf.get_str(keys.K_CHECKPOINT_LOCATION)
         if ckpt_loc:
             env[constants.TONY_CHECKPOINT_DIR] = ckpt_loc
@@ -565,6 +658,13 @@ class TonyCoordinator:
         if session.register_task(worker, spec):
             self.liveness.register(worker)
             log.info("registered %s at %s", worker, spec)
+            # The RPC metadata trace id confirms env->executor propagation
+            # (it should equal this job's id; a mismatch is worth seeing).
+            self.events.emit(
+                obs_events.TASK_REGISTERED, task=worker,
+                session=session.session_id, addr=spec,
+                trace_id=obs_trace.current_rpc_trace(),
+            )
         task = session.get_task_by_id(worker)
         if task is not None and self._faults.enabled:
             # Fault injection: kill tasks at the rendezvous barrier — a
@@ -581,7 +681,16 @@ class TonyCoordinator:
                 session.session_id, non_chief,
             ):
                 self._fault_kill(victim)
-        return session.cluster_spec()
+        spec_out = session.cluster_spec()
+        if spec_out is not None and not self._rendezvous_released:
+            self._rendezvous_released = True
+            if self._rendezvous_span is not None:
+                self._rendezvous_span.end()
+                self._rendezvous_span = None
+            self.events.emit(obs_events.RENDEZVOUS_RELEASED,
+                             session=session.session_id,
+                             tasks=len(session.all_tasks()))
+        return spec_out
 
     def _fault_kill(self, task_id: str) -> None:
         """Kill a task's container the way preemption would: SIGKILL, no
@@ -598,14 +707,20 @@ class TonyCoordinator:
         else:
             self.backend.kill(task.handle)
 
-    def on_heartbeat(self, task_id: str, session_id: str) -> None:
-        """Heartbeat RPC entry: fence stale pings, then feed liveness.
+    def on_heartbeat(
+        self, task_id: str, session_id: str,
+        metrics: dict[str, Any] | None = None,
+    ) -> None:
+        """Heartbeat RPC entry: fence stale pings, then feed liveness and
+        the metrics aggregator (the piggybacked snapshot).
 
         Two fences, both required for retried sessions to be trustworthy:
         a ping carrying a PREVIOUS session id (an executor the backend is
         still tearing down) must not touch the new session's monitor, and
         a ping from a task the monitor already expired or unregistered
-        must not silently re-register it into a failed session."""
+        must not silently re-register it into a failed session. The same
+        fences guard the aggregator — a dead session's executor must not
+        keep updating the live job's gauges."""
         session = self.session
         if session is None or str(session.session_id) != str(session_id):
             log.warning(
@@ -622,6 +737,8 @@ class TonyCoordinator:
                 "completed, or not yet registered)", task_id,
             )
             return
+        self.metrics.counter("heartbeats_received_total").inc()
+        self.aggregator.ingest(task_id, metrics)
         if self._faults.enabled and self._faults.heartbeat_kill(
             task_id, session.session_id
         ):
@@ -632,6 +749,10 @@ class TonyCoordinator:
         slice a hung host wedges everyone's collectives, so the whole session
         fails (and retries slice-wide) rather than killing one task."""
         self._hb_missed.add(task_id)
+        self.events.emit(
+            obs_events.HEARTBEAT_MISSED, task=task_id,
+            session=self.session.session_id if self.session else None,
+        )
         self._record_failure(FailureEvent(
             kind=failure_kinds.HEARTBEAT_EXPIRY, task_id=task_id,
         ))
@@ -644,6 +765,8 @@ class TonyCoordinator:
         assert self.session is not None
         session = self.session
         self._faults.coordinator_phase("monitor", session.session_id)
+        monitor_span = self.tracer.begin("monitor",
+                                         session=session.session_id)
         interval_s = self.conf.get_int(keys.K_AM_MONITOR_INTERVAL_MS, 200) / 1000.0
         timeout_ms = self.conf.get_int(keys.K_APPLICATION_TIMEOUT, 0)
         started = time.monotonic()
@@ -671,7 +794,12 @@ class TonyCoordinator:
                     self.liveness.unregister(task.id)
                     if code != 0:
                         self._tasks_failed += 1
+                        self.metrics.counter("tasks_failed_total").inc()
                         self._record_failure(self._task_exit_event(task, code))
+                    self.events.emit(
+                        obs_events.TASK_FINISHED, task=task.id,
+                        session=session.session_id, exit_code=code,
+                    )
                     session.on_task_completed(task.job_name, task.index, code)
             self._wake.wait(interval_s)
             self._wake.clear()
@@ -681,6 +809,7 @@ class TonyCoordinator:
         # per-task kill() would serialize a full grace period per wedged
         # executor.
         self.backend.stop_all()
+        monitor_span.end()
         return session.status
 
     def _task_exit_event(self, task: TonyTask, code: int) -> FailureEvent:
@@ -711,6 +840,15 @@ class TonyCoordinator:
         self._session_failure = None
         self._faults.reset_session()
         self.client_signal_to_finish.clear()
+        # The next session's /metrics must not serve the dead session's
+        # per-task gauges as current (heartbeat totals survive: they are
+        # cumulative across the job).
+        self.aggregator.reset_tasks()
+        self._rendezvous_released = False
+        if self._rendezvous_span is not None:
+            self._rendezvous_span.set(aborted=True)
+            self._rendezvous_span.end()
+            self._rendezvous_span = None
 
     def stop(self, status: SessionStatus) -> SessionStatus:
         """stop (TonyApplicationMaster.java:621-637): write history, publish
@@ -742,6 +880,31 @@ class TonyCoordinator:
             "retries": self._retry_log,
             "best_checkpoint_step": best_step,
         }
+        # Observability terminal record: the last aggregated metrics
+        # snapshot, the registered TensorBoard URL (previously coordinator
+        # memory only — the history page now renders the link), and the
+        # job's trace id.
+        final["metrics"] = self.aggregator.summary()
+        final["tensorboard_url"] = self.tensorboard_url
+        final["trace_id"] = self.tracer.trace_id
+        self.events.emit(obs_events.FINAL_STATUS, state=status.value)
+        # A job that died AT the gang barrier leaves the rendezvous span
+        # open (_reset only runs between retries) — and that wait is
+        # exactly the interval a stalled-rendezvous post-mortem needs, so
+        # close it into the trace before merging.
+        if self._rendezvous_span is not None:
+            self._rendezvous_span.set(aborted=True)
+            self._rendezvous_span.end()
+            self._rendezvous_span = None
+        trace_doc = obs_trace.merge_job_trace(
+            self.tracer, self.app_dir / "logs"
+        )
+        try:
+            (self.app_dir / "trace.json").write_text(
+                json.dumps(trace_doc) + "\n"
+            )
+        except OSError:
+            log.warning("could not write trace.json", exc_info=True)
         hist = self.conf.get_str(keys.K_HISTORY_LOCATION)
         if hist:
             job_dir = setup_job_dir(hist, self.app_id, self.started_ms)
@@ -750,8 +913,11 @@ class TonyCoordinator:
             )
             # The terminal record also lands in history so the per-job page
             # can render run stats + slice plans (the reference's per-job
-            # page shows only config, JobConfigPageController.java:25-59).
+            # page shows only config, JobConfigPageController.java:25-59),
+            # along with the lifecycle timeline and the job trace.
             write_final_status(job_dir, final)
+            write_events_file(job_dir, self.events.to_dicts())
+            write_trace_file(job_dir, trace_doc)
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0

@@ -21,6 +21,8 @@ from pathlib import Path
 from tony_tpu import constants, utils
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.observability import metrics as obs_metrics
+from tony_tpu.observability import trace as obs_trace
 from tony_tpu.resilience.faults import ExecutorFaults, FaultPlan
 from tony_tpu.rpc.client import ApplicationRpcClient
 
@@ -132,11 +134,17 @@ class Heartbeater(threading.Thread):
         drop_pings: int = 0,
         delay_spec: tuple[int, int] | None = None,
         on_lost=_die_lost_coordinator,
+        metrics_source=None,
     ):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
         self._session_id = session_id
+        # Telemetry piggyback: a callable returning the latest metrics
+        # snapshot (or None). Called per ping; the snapshot rides the
+        # heartbeat's optional ``metrics`` arg, so the telemetry plane
+        # costs zero extra RPCs. Failures here must never cost a ping.
+        self._metrics_source = metrics_source
         self._interval_s = interval_ms / 1000.0
         self._max_failures = max(max_failures, 1)
         self._skip = int(os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
@@ -165,10 +173,21 @@ class Heartbeater(threading.Thread):
             if self._delay_count > 0:
                 self._delay_count -= 1
                 time.sleep(self._delay_ms / 1000.0)
+            payload = None
+            if self._metrics_source is not None:
+                try:
+                    payload = self._metrics_source()
+                except Exception:
+                    log.debug("metrics source failed", exc_info=True)
             try:
-                self._client.task_executor_heartbeat(
-                    self._task_id, self._session_id
-                )
+                if payload is not None:
+                    self._client.task_executor_heartbeat(
+                        self._task_id, self._session_id, metrics=payload
+                    )
+                else:
+                    self._client.task_executor_heartbeat(
+                        self._task_id, self._session_id
+                    )
                 self.consecutive_failures = 0
             except Exception:
                 self.consecutive_failures += 1
@@ -212,10 +231,34 @@ class TaskExecutor:
         self._call_timeout_s = (
             self.conf.get_int(keys.K_RPC_CALL_TIMEOUT_MS, 60000) / 1000.0
         )
+        # Distributed trace: join the coordinator's trace (TONY_TRACE_ID
+        # from the launch env); spans flush to the job scratch dir where
+        # the coordinator merges them into the per-job Chrome trace.
+        self.tracer = obs_trace.Tracer(
+            proc=f"executor:{self.task_id}"
+        )
+        # Metrics handoff file: the user process publishes its registry
+        # snapshot here (we export TONY_METRICS_FILE into its env); the
+        # heartbeater reads it back and piggybacks it on each ping.
+        log_dir = env.get(constants.TONY_LOG_DIR)
+        self._metrics_file: Path | None = (
+            Path(log_dir) / f".metrics-{self.job_name}-{self.task_index}.json"
+            if log_dir else None
+        )
+        if self._metrics_file is not None:
+            # The scratch dir is shared across session retries: a previous
+            # session's last published snapshot must not ride THIS
+            # session's first heartbeats as current data (the coordinator
+            # just reset its per-task aggregator for exactly that reason).
+            try:
+                self._metrics_file.unlink()
+            except OSError:
+                pass
         self.client = ApplicationRpcClient(
             self.am_host, self.am_port, secret=secret,
             call_timeout_s=self._call_timeout_s,
             fault_hook=self._faults.blackout_hook(self._started_monotonic),
+            trace_id=self.tracer.trace_id,
         )
         # The rendezvous port: what this task advertises as host:port. Under
         # the JAX runtime, chief:0's port becomes the jax.distributed
@@ -231,9 +274,31 @@ class TaskExecutor:
     def _local_mode(self) -> bool:
         return self.am_host in ("127.0.0.1", "localhost")
 
+    def _flush_trace(self) -> None:
+        """Write this executor's spans where the coordinator's stop()
+        merge picks them up (trace-*.jsonl in the job scratch dir). The
+        session id is part of the name: the scratch dir is shared across
+        session retries, and the retry waterfall is the trace's headline
+        use case — session 2 must not clobber session 1's spans."""
+        log_dir = os.environ.get(constants.TONY_LOG_DIR)
+        if log_dir:
+            self.tracer.write_jsonl(
+                Path(log_dir)
+                / f"trace-{self.job_name}-{self.task_index}"
+                  f"-s{self.session_id}.jsonl"
+            )
+
     @property
     def task_id(self) -> str:
         return f"{self.job_name}:{self.task_index}"
+
+    def _metrics_snapshot(self):
+        """Latest user-process metrics snapshot for the heartbeat
+        piggyback; None when the user never published (plain liveness
+        ping)."""
+        if self._metrics_file is None:
+            return None
+        return obs_metrics.load_snapshot_file(self._metrics_file)
 
     # -- rendezvous (TaskExecutor.registerAndGetClusterSpec:196-213) --------
     def register_and_get_cluster_spec(self) -> dict[str, list[str]]:
@@ -255,6 +320,7 @@ class TaskExecutor:
                 fault_hook=self._faults.blackout_hook(
                     self._started_monotonic
                 ),
+                trace_id=self.tracer.trace_id,
             ),
             self.task_id,
             self.session_id,
@@ -265,6 +331,7 @@ class TaskExecutor:
             ),
             drop_pings=self._faults.drop_heartbeats,
             delay_spec=self._faults.delay_heartbeats,
+            metrics_source=self._metrics_snapshot,
         )
         self.heartbeater.start()
         retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
@@ -300,6 +367,12 @@ class TaskExecutor:
             env[constants.TB_PORT] = str(self.tb_port)
         if self.profiler_port is not None:
             env[constants.PROFILER_PORT] = str(self.profiler_port)
+        # Observability contract: the trace id (spans in the user process
+        # join the job trace) and the snapshot file the default metrics
+        # registry publishes to (observability.report auto-publishes).
+        env[constants.TONY_TRACE_ID] = self.tracer.trace_id
+        if self._metrics_file is not None:
+            env[constants.TONY_METRICS_FILE] = str(self._metrics_file)
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(
@@ -357,7 +430,8 @@ class TaskExecutor:
                       self._faults.pre_register_exit)
             return self._faults.pre_register_exit
         self._maybe_sleep_for_skew()
-        cluster_spec = self.register_and_get_cluster_spec()
+        with self.tracer.span("rendezvous", task=self.task_id):
+            cluster_spec = self.register_and_get_cluster_spec()
         log.info("barrier released; cluster spec: %s", cluster_spec)
         if self.is_chief() and self.conf.get_bool(keys.K_TENSORBOARD_ENABLED, True):
             self.tb_port = utils.reserve_port()
@@ -380,11 +454,14 @@ class TaskExecutor:
             else 0
         )
         log.info("executing: %s", command)
-        rc = utils.execute_shell(
-            command, timeout_ms=timeout_ms, extra_env=env,
-            on_start=_register_user_proc,
-        )
+        with self.tracer.span("user_process", task=self.task_id) as up_span:
+            rc = utils.execute_shell(
+                command, timeout_ms=timeout_ms, extra_env=env,
+                on_start=_register_user_proc,
+            )
+            up_span.set(exit_code=rc)
         log.info("user process exited with %d", rc)
+        self._flush_trace()
         if self._venv_dir is not None:
             # Per-task venv extractions are scratch; don't litter the host.
             import shutil

@@ -85,7 +85,11 @@ def list_jobs(history_location: str | Path) -> list[JobMetadata]:
     entries are skipped, as the reference's parser does)."""
     jobs = []
     for job_dir in find_job_dirs(history_location):
-        for fname in _job_files(job_dir):
+        try:
+            fnames = _job_files(job_dir)
+        except OSError:
+            continue  # job dir vanished (or is unreadable) mid-scan
+        for fname in fnames:
             if not fname.endswith(".jhist"):
                 continue
             try:
@@ -118,6 +122,27 @@ def job_final_status(
     table, run stats, slice plans) — written by
     ``writer.write_final_status`` at job stop."""
     return _job_json(history_location, app_id, "final-status.json")
+
+
+def job_events(
+    history_location: str | Path, app_id: str
+) -> "list[dict] | None":
+    """One job's structured lifecycle timeline (``events.jsonl``), or
+    None when the job has none (pre-observability jobs, or a coordinator
+    that died before stop). Malformed lines are skipped."""
+    from tony_tpu.observability.events import parse_jsonl
+
+    for job_dir in find_job_dirs(history_location):
+        if _dir_name(job_dir) == app_id:
+            raw = _read_job_file(job_dir, "events.jsonl")
+            if raw is not None:
+                return parse_jsonl(raw)
+    return None
+
+
+def job_trace(history_location: str | Path, app_id: str) -> dict | None:
+    """One job's merged Chrome trace document (``trace.json``)."""
+    return _job_json(history_location, app_id, "trace.json")
 
 
 class TtlCache:

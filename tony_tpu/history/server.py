@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tony_tpu.history.reader import (
     TtlCache,
     job_config,
+    job_events,
     job_final_status,
     list_jobs,
 )
@@ -71,6 +72,12 @@ class HistoryHandler(BaseHTTPRequestHandler):
                     self._send_json({"error": "not found"}, status=404)
                 else:
                     self._send_json(final)
+            elif self.path.startswith("/api/events/"):
+                events = self._events(self.path[len("/api/events/"):])
+                if events is None:
+                    self._send_json({"error": "not found"}, status=404)
+                else:
+                    self._send_json(events)
             else:
                 self.send_error(404)
         except Exception as exc:  # pragma: no cover - defensive
@@ -99,6 +106,12 @@ class HistoryHandler(BaseHTTPRequestHandler):
         return self.cache.get_or_load(
             ("final", app_id),
             lambda: job_final_status(self.history_location, app_id),
+        )
+
+    def _events(self, app_id: str):
+        return self.cache.get_or_load(
+            ("events", app_id),
+            lambda: job_events(self.history_location, app_id),
         )
 
     # -- pages ---------------------------------------------------------------
@@ -146,6 +159,18 @@ class HistoryHandler(BaseHTTPRequestHandler):
             for k, v in stat_rows
         ]
         parts.append("</table>")
+        tb_url = final.get("tensorboard_url")
+        if tb_url:
+            # The URL is job-supplied (register_tensorboard_url RPC):
+            # only http(s) renders as a link — a javascript: URL must not
+            # become clickable in the history server's origin.
+            if str(tb_url).startswith(("http://", "https://")):
+                parts.append(
+                    f"<p>tensorboard: <a href='{esc(tb_url)}'>"
+                    f"{esc(tb_url)}</a></p>"
+                )
+            else:
+                parts.append(f"<p>tensorboard: {esc(tb_url)}</p>")
         slices = final.get("slices")
         if slices:
             parts.append("<h3>TPU slices</h3><table><tr><th>job</th>"
@@ -171,11 +196,68 @@ class HistoryHandler(BaseHTTPRequestHandler):
                         f"<td>{esc(t.get('exit_code'))}</td></tr>"
                     )
             parts.append("</table>")
+        parts.extend(self._metrics_section(final, esc))
+        parts.extend(self._timeline_section(app_id, esc))
         parts.append(f"<p><a href='/config/{esc(app_id)}'>frozen config</a>"
+                     f" · <a href='/api/events/{esc(app_id)}'>events</a>"
                      f" · <a href='/'>all jobs</a></p>")
         self._send_html(
             _PAGE.format(title=esc(app_id), body="".join(parts))
         )
+
+    def _metrics_section(self, final: dict, esc) -> list[str]:
+        """Final aggregated metric summary (final-status ``metrics``): one
+        row per task × metric, counters and gauges flattened."""
+        metrics = final.get("metrics")
+        if not isinstance(metrics, dict):
+            return []
+        rows = []
+        task_snaps = metrics.get("tasks") or {}
+        for task_id in sorted(task_snaps):
+            snap = task_snaps[task_id] or {}
+            for family in ("counters", "gauges"):
+                for name in sorted(snap.get(family) or {}):
+                    rows.append((task_id, name, snap[family][name]))
+        heartbeats = metrics.get("heartbeats") or {}
+        for task_id in sorted(heartbeats):
+            rows.append((task_id, "heartbeats_received", heartbeats[task_id]))
+        if not rows:
+            return []
+        parts = ["<h3>Final metrics</h3><table><tr><th>task</th>"
+                 "<th>metric</th><th>value</th></tr>"]
+        parts += [
+            f"<tr><td>{esc(t)}</td><td>{esc(n)}</td><td>{esc(v)}</td></tr>"
+            for t, n, v in rows
+        ]
+        parts.append("</table>")
+        return parts
+
+    def _timeline_section(self, app_id: str, esc) -> list[str]:
+        """The lifecycle timeline from events.jsonl (capped: a chaos run
+        with thousands of events must not melt the page)."""
+        events = self._events(app_id)
+        if not events:
+            return []
+        parts = ["<h3>Timeline</h3><table><tr><th>time</th><th>event</th>"
+                 "<th>task</th><th>detail</th></tr>"]
+        shown = events[:500]
+        for e in shown:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if k not in ("ts_ms", "kind", "task")
+            )
+            ts = e.get("ts_ms")
+            parts.append(
+                f"<tr><td>{esc(_fmt_ms(ts)) if ts else '?'}</td>"
+                f"<td>{esc(e.get('kind'))}</td>"
+                f"<td>{esc(e.get('task', ''))}</td>"
+                f"<td>{esc(detail)}</td></tr>"
+            )
+        parts.append("</table>")
+        if len(events) > len(shown):
+            parts.append(f"<p>({len(events) - len(shown)} more events in "
+                         f"/api/events/{esc(app_id)})</p>")
+        return parts
 
     def _config_page(self, app_id: str) -> None:
         cfg = self._config(app_id)

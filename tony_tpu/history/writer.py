@@ -113,46 +113,59 @@ def redact_config(cfg: dict) -> dict:
     return out
 
 
+def _write_job_file(job_dir: "Path | str", name: str, data: str) -> None:
+    """One persistence recipe for every per-job artifact: atomic on local
+    filesystems (tmp + rename — a concurrently-scanning history server
+    must never read a half-written file), a plain object put on gs://
+    (GCS object writes are atomic by construction)."""
+    if is_gs_uri(job_dir):
+        from tony_tpu.cloud import default_storage
+
+        default_storage().put_bytes(f"{job_dir}/{name}", data.encode())
+        return
+    import os
+
+    tmp = Path(job_dir) / f".{name}.tmp"
+    tmp.write_text(data)
+    os.replace(tmp, Path(job_dir) / name)
+
+
 def write_config_file(job_dir: "Path | str", conf: TonyConfiguration) -> None:
     """The history copy of the job config, with secret-bearing keys
     redacted (the live tony-final.json in the staging dir keeps the real
-    values — only executors and the client read that one). Atomic: a
-    concurrently-scanning history server must never read a half-written
-    file (GCS object writes are atomic by construction)."""
-    import os
-
+    values — only executors and the client read that one)."""
     data = (
         json.dumps(redact_config(conf.to_dict()), indent=2, sort_keys=True)
         + "\n"
     )
-    if is_gs_uri(job_dir):
-        from tony_tpu.cloud import default_storage
-
-        default_storage().put_bytes(f"{job_dir}/config.json", data.encode())
-        return
-    target = Path(job_dir) / "config.json"
-    tmp = Path(job_dir) / ".config.json.tmp"
-    tmp.write_text(data)
-    os.replace(tmp, target)
+    _write_job_file(job_dir, "config.json", data)
 
 
 def write_final_status(job_dir: "Path | str", final: dict) -> None:
     """The coordinator's terminal record (state, per-task table, run stats,
-    slice plans) for the history UI's per-job page. Task URLs may embed
-    local paths only; everything else is already display-safe."""
-    data = json.dumps(final, indent=2, sort_keys=True) + "\n"
-    if is_gs_uri(job_dir):
-        from tony_tpu.cloud import default_storage
+    slice plans, final metrics) for the history UI's per-job page. Task
+    URLs may embed local paths only; everything else is already
+    display-safe."""
+    _write_job_file(
+        job_dir, "final-status.json",
+        json.dumps(final, indent=2, sort_keys=True) + "\n",
+    )
 
-        default_storage().put_bytes(
-            f"{job_dir}/final-status.json", data.encode()
-        )
-        return
-    import os
 
-    tmp = Path(job_dir) / ".final-status.json.tmp"
-    tmp.write_text(data)
-    os.replace(tmp, Path(job_dir) / "final-status.json")
+def write_events_file(job_dir: "Path | str", events: "list[dict]") -> None:
+    """The job's structured lifecycle timeline (observability/events.py)
+    as ``events.jsonl`` — one JSON object per line, so tail-truncated
+    copies still parse line by line."""
+    _write_job_file(
+        job_dir, "events.jsonl",
+        "".join(json.dumps(e, sort_keys=True) + "\n" for e in events),
+    )
+
+
+def write_trace_file(job_dir: "Path | str", trace_doc: dict) -> None:
+    """The job's merged Chrome trace document (observability/trace.py) —
+    loadable directly in chrome://tracing / Perfetto."""
+    _write_job_file(job_dir, "trace.json", json.dumps(trace_doc) + "\n")
 
 
 def create_history_file(job_dir: "Path | str", metadata: JobMetadata) -> "Path | str":

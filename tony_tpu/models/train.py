@@ -10,12 +10,15 @@ communication.
 
 from __future__ import annotations
 
+import time
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu import observability
 
 from tony_tpu.models.mnist import MnistConfig, mnist_apply, mnist_init
 from tony_tpu.models.transformer import (
@@ -33,6 +36,25 @@ class TrainState(NamedTuple):
     step: jax.Array
     params: Any
     opt_state: Any
+
+
+def _instrumented(step_fn):
+    """Count dispatches + host-side dispatch time into the process
+    registry (telemetry plane). Deliberately measures only the DISPATCH
+    (async under jit — no sync is forced here): the loss readback the
+    caller already does is where step wall time gets reported."""
+    registry = observability.default_registry()
+    dispatches = registry.counter("train_step_dispatches_total")
+    dispatch_s = registry.histogram("train_step_dispatch_seconds")
+
+    def step(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = step_fn(*args, **kwargs)
+        dispatches.inc()
+        dispatch_s.observe(time.perf_counter() - t0)
+        return out
+
+    return step
 
 
 def _sharding_for_tree(abstract_tree, roles: dict, mesh: Mesh):
@@ -207,7 +229,7 @@ def make_train_step(
         # (and multi-process meshes need the local->global assembly).
         return jit_step(state, _to_global_batch(tokens, batch_sh))
 
-    return jit_init, step
+    return jit_init, _instrumented(step)
 
 
 def make_classifier_step(
@@ -305,4 +327,4 @@ def make_image_classifier_step(
             _to_global_batch(labels, batch_sh),
         )
 
-    return jit_init, step
+    return jit_init, _instrumented(step)

@@ -1,0 +1,38 @@
+"""``tony_tpu.observability`` — the telemetry plane.
+
+Dependency-free (no jax, no third-party packages), importable from any
+process in the job:
+
+* ``metrics``    — counter/gauge/histogram registry;
+  ``observability.report(step=i, loss=l, step_time_ms=t)`` is the
+  train-loop API, and in a tony-launched user process the snapshot
+  auto-publishes so the executor piggybacks it on its heartbeat.
+* ``events``     — the coordinator's structured lifecycle log
+  (``events.jsonl`` per job, rendered by the history server and
+  ``tony events``).
+* ``aggregator`` — coordinator-side per-task aggregation + the
+  ``/metrics`` (Prometheus) and ``/api/*`` (JSON) HTTP endpoints.
+* ``trace``      — distributed spans sharing one job trace id
+  (``TONY_TRACE_ID`` + RPC metadata), exported as a Chrome trace JSON
+  per job; ``with observability.span("load_data"): ...`` in user code.
+"""
+
+from __future__ import annotations
+
+from tony_tpu.observability.events import EventLog
+from tony_tpu.observability.metrics import (
+    MetricsRegistry,
+    default_registry,
+    report,
+)
+from tony_tpu.observability.trace import Tracer, default_tracer, span
+
+__all__ = [
+    "EventLog",
+    "MetricsRegistry",
+    "Tracer",
+    "default_registry",
+    "default_tracer",
+    "report",
+    "span",
+]

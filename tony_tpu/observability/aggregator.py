@@ -1,0 +1,268 @@
+"""Coordinator-side metric aggregation + the observability HTTP port.
+
+Executors piggyback their latest metrics snapshot on the heartbeat they
+already send (``task_executor_heartbeat``'s optional ``metrics`` arg);
+the aggregator keeps, per task, the latest snapshot plus a bounded
+series of every gauge, and serves:
+
+* ``GET /metrics``      — Prometheus text: the coordinator's own
+  registry unlabeled, every task's snapshot with a ``task`` label, and
+  ``tony_task_heartbeats_total{task=...}`` counted at ingest;
+* ``GET /api/metrics``  — the same data as JSON (latest + series);
+* ``GET /api/events``   — the lifecycle event log;
+* ``GET /api/trace``    — the Chrome trace document so far.
+
+The port comes from ``tony.am.http-port`` (0 = ephemeral, "disabled" =
+off) and is advertised in ``<app_dir>/coordinator.http`` next to the
+RPC address file, where ``tony metrics <app_id>`` finds it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Mapping
+
+from tony_tpu.observability import trace as trace_mod
+from tony_tpu.observability.events import EventLog
+from tony_tpu.observability.metrics import (
+    MetricsRegistry,
+    json_safe,
+    render_prometheus,
+)
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_COUNTER = "tony_task_heartbeats_total"
+
+
+def _numeric_family(obj: Any) -> dict[str, float]:
+    """Name -> float, dropping anything non-numeric."""
+    out: dict[str, float] = {}
+    if isinstance(obj, Mapping):
+        for name, value in obj.items():
+            try:
+                out[str(name)] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def _histogram_family(obj: Any) -> dict[str, dict[str, Any]]:
+    """Name -> {count, sum, buckets:[[le, cum], ...]}, shape-checked."""
+    out: dict[str, dict[str, Any]] = {}
+    if not isinstance(obj, Mapping):
+        return out
+    for name, h in obj.items():
+        if not isinstance(h, Mapping):
+            continue
+        buckets = []
+        for entry in h.get("buckets") or []:
+            try:
+                bound, cum = entry
+                buckets.append([float(bound), int(cum)])
+            except (TypeError, ValueError):
+                continue
+        try:
+            out[str(name)] = {
+                "count": int(h.get("count", 0)),
+                "sum": float(h.get("sum", 0.0)),
+                "buckets": buckets,
+            }
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class MetricsAggregator:
+    """Per-task metric state fed by heartbeat ingest."""
+
+    def __init__(
+        self, registry: MetricsRegistry | None = None,
+        series_limit: int = 512,
+    ) -> None:
+        self.registry = registry or MetricsRegistry()
+        self._series_limit = series_limit
+        self._lock = threading.Lock()
+        self._latest: dict[str, dict[str, Any]] = {}
+        self._heartbeats: dict[str, int] = {}
+        # (task_id, gauge name) -> deque[(ts_ms, value)]
+        self._series: dict[tuple[str, str], collections.deque] = {}
+
+    def ingest(
+        self, task_id: str, snapshot: Mapping[str, Any] | None,
+    ) -> None:
+        with self._lock:
+            self._heartbeats[task_id] = self._heartbeats.get(task_id, 0) + 1
+            if not isinstance(snapshot, Mapping):
+                return
+            # Normalize at the trust boundary: the snapshot comes from an
+            # executor-authenticated RPC peer relaying a user-writable
+            # file, so every family is coerced to a dict HERE — a
+            # malformed {"counters": null} must not crash summary() in
+            # stop() (losing the terminal record) or 500 every /metrics
+            # scrape.
+            snap = {
+                "ts_ms": snapshot.get("ts_ms"),
+                "counters": _numeric_family(snapshot.get("counters")),
+                "gauges": _numeric_family(snapshot.get("gauges")),
+                "histograms": _histogram_family(snapshot.get("histograms")),
+            }
+            if not isinstance(snap["ts_ms"], (int, float)):
+                snap["ts_ms"] = int(time.time() * 1000)
+            self._latest[task_id] = snap
+            ts = snap["ts_ms"]
+            for name, value in snap["gauges"].items():
+                key = (task_id, str(name))
+                series = self._series.get(key)
+                if series is None:
+                    series = self._series[key] = collections.deque(
+                        maxlen=self._series_limit
+                    )
+                if not series or series[-1][0] != ts:
+                    series.append((ts, value))
+
+    def reset_tasks(self) -> None:
+        with self._lock:
+            self._latest.clear()
+            self._series.clear()
+
+    # -- views -------------------------------------------------------------
+    def prometheus_text(self) -> str:
+        with self._lock:
+            latest = {t: dict(s) for t, s in self._latest.items()}
+            heartbeats = dict(self._heartbeats)
+        seen: set[str] = set()
+        parts = [render_prometheus(self.registry.snapshot(),
+                                   types_seen=seen)]
+        for task_id in sorted(heartbeats):
+            parts.append(render_prometheus(
+                {"counters": {HEARTBEAT_COUNTER: heartbeats[task_id]}},
+                labels={"task": task_id}, types_seen=seen,
+            ))
+        for task_id in sorted(latest):
+            parts.append(render_prometheus(
+                latest[task_id], labels={"task": task_id}, types_seen=seen,
+            ))
+        return "".join(p for p in parts if p)
+
+    def to_json(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "coordinator": self.registry.snapshot(),
+                "heartbeats": dict(self._heartbeats),
+                "tasks": {t: dict(s) for t, s in self._latest.items()},
+                "series": {
+                    f"{task}:{name}": list(points)
+                    for (task, name), points in self._series.items()
+                },
+            }
+
+    def summary(self) -> dict[str, Any]:
+        """Compact terminal record for final-status.json / history —
+        json-safe (final-status must stay parseable however training
+        diverged)."""
+        with self._lock:
+            tasks = {}
+            for task_id, snap in self._latest.items():
+                tasks[task_id] = {
+                    "counters": dict(snap.get("counters", {})),
+                    "gauges": dict(snap.get("gauges", {})),
+                }
+            return json_safe({
+                "coordinator": self.registry.summary(),
+                "heartbeats": dict(self._heartbeats),
+                "tasks": tasks,
+            })
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    aggregator: MetricsAggregator
+    events: EventLog | None = None
+    tracer: trace_mod.Tracer | None = None
+    logs_dir = None
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            if self.path == "/metrics":
+                self._send(self.aggregator.prometheus_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/api/metrics":
+                self._send_json(self.aggregator.to_json())
+            elif self.path == "/api/events":
+                events = self.events.to_dicts() if self.events else []
+                self._send_json(events)
+            elif self.path == "/api/trace":
+                if self.tracer is None:
+                    self._send_json({"traceEvents": []})
+                else:
+                    self._send_json(trace_mod.merge_job_trace(
+                        self.tracer, self.logs_dir
+                    ))
+            else:
+                self.send_error(404)
+        except Exception as exc:  # pragma: no cover - defensive
+            log.exception("observability request failed")
+            try:
+                self.send_error(500, str(exc))
+            except OSError:
+                pass
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("http: " + fmt, *args)
+
+    def _send(self, text: str, content_type: str, status: int = 200) -> None:
+        data = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, obj: Any, status: int = 200) -> None:
+        # json_safe: a diverged loss (NaN) must not make the whole API
+        # payload unparseable to strict JSON consumers.
+        self._send(json.dumps(json_safe(obj), indent=2),
+                   "application/json", status)
+
+
+class ObservabilityHttpServer:
+    """The coordinator's telemetry port. Binds all interfaces like the
+    RPC server (operators scrape the coordinator host); serves only
+    derived telemetry — no secrets ride any of these views."""
+
+    def __init__(
+        self,
+        aggregator: MetricsAggregator,
+        events: EventLog | None = None,
+        tracer: trace_mod.Tracer | None = None,
+        logs_dir=None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ) -> None:
+        handler = type("BoundObsHandler", (_ObsHandler,), {
+            "aggregator": aggregator, "events": events,
+            "tracer": tracer, "logs_dir": logs_dir,
+        })
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._serving = False
+
+    def serve_background(self) -> int:
+        self._serving = True
+        t = threading.Thread(
+            target=self.httpd.serve_forever, name="obs-http", daemon=True
+        )
+        t.start()
+        log.info("observability http on port %d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._serving:
+            self.httpd.shutdown()
+            self._serving = False
+        self.httpd.server_close()

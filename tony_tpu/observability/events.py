@@ -1,0 +1,125 @@
+"""Structured lifecycle event log.
+
+The reference's only record of "what happened" is the coordinator log
+plus the ``.jhist`` filename; this module gives every job a machine-
+readable timeline: one JSON object per lifecycle edge (submitted →
+staged → task registered → rendezvous released → heartbeat missed →
+retry decision → checkpoint progress → final status), appended to
+``events.jsonl`` in the app dir as it happens and persisted into job
+history at stop (``history.writer.write_events_file``). The history
+server renders it as the per-job timeline; ``tony events <app_id>``
+prints it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+# Well-known event kinds, in rough lifecycle order. The log accepts any
+# snake_case kind — these constants exist so emitters and assertions
+# cannot typo each other apart.
+JOB_SUBMITTED = "job_submitted"
+JOB_STAGED = "job_staged"
+SESSION_STARTED = "session_started"
+TASK_SCHEDULED = "task_scheduled"
+TASK_REGISTERED = "task_registered"
+RENDEZVOUS_RELEASED = "rendezvous_released"
+TENSORBOARD_REGISTERED = "tensorboard_registered"
+HEARTBEAT_MISSED = "heartbeat_missed"
+TASK_FINISHED = "task_finished"
+SESSION_FINISHED = "session_finished"
+RETRY_DECISION = "retry_decision"
+CHECKPOINT_PROGRESS = "checkpoint_progress"
+FINAL_STATUS = "final_status"
+
+
+class EventLog:
+    """Append-only, thread-safe event list with an optional per-event
+    ``sink`` (the coordinator appends each event to ``events.jsonl`` so
+    a crashed coordinator still leaves the timeline up to its death)."""
+
+    def __init__(
+        self,
+        sink: Callable[[dict[str, Any]], None] | None = None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self._events: list[dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._sink = sink
+        self._clock = clock
+
+    def emit(
+        self,
+        kind: str,
+        task: str | None = None,
+        session: int | None = None,
+        **data: Any,
+    ) -> dict[str, Any]:
+        event: dict[str, Any] = {
+            "ts_ms": int(self._clock() * 1000),
+            "kind": kind,
+        }
+        if session is not None:
+            event["session"] = session
+        if task is not None:
+            event["task"] = task
+        event.update(data)
+        with self._lock:
+            self._events.append(event)
+            # Sink inside the lock: concurrent emitters (liveness expiry
+            # vs monitor thread) must land in events.jsonl in the same
+            # order as the in-memory timeline, or the live file and the
+            # history copy would contradict each other.
+            if self._sink is not None:
+                try:
+                    self._sink(event)
+                except Exception:
+                    # Telemetry must never take the control plane down.
+                    log.warning("event sink failed", exc_info=True)
+        return event
+
+    def to_dicts(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def kinds(self) -> list[str]:
+        with self._lock:
+            return [e["kind"] for e in self._events]
+
+    def to_jsonl(self) -> str:
+        return "".join(
+            json.dumps(e, sort_keys=True) + "\n" for e in self.to_dicts()
+        )
+
+
+def jsonl_file_sink(path) -> Callable[[dict[str, Any]], None]:
+    """A sink appending one JSON line per event to ``path``."""
+
+    def sink(event: dict[str, Any]) -> None:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, sort_keys=True) + "\n")
+
+    return sink
+
+
+def parse_jsonl(text: str) -> list[dict[str, Any]]:
+    """Lenient events.jsonl parser: malformed lines are skipped (a torn
+    tail from a crashed writer must not hide the rest of the timeline)."""
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(event, dict):
+            events.append(event)
+    return events

@@ -1,0 +1,413 @@
+"""Process-local metrics registry — the telemetry plane's data model.
+
+The reference declares a metrics-core dependency and never uses it
+(SURVEY 5.5); this module is the native replacement: counters, gauges,
+and histograms with zero dependencies, a ``report()`` API train loops
+call once per step, a JSON snapshot the executor piggybacks on its
+heartbeat (``rpc.task_executor_heartbeat``'s optional ``metrics`` arg),
+and Prometheus text rendering for the coordinator's ``/metrics``
+endpoint.
+
+Metric names are validated at registration (TONY-M001: snake_case,
+counters end ``_total``, time/size metrics carry a unit suffix) so a
+bad name fails the first local run, not the fleet's dashboards.
+
+Cross-process handoff: the user process (where the train loop runs)
+cannot speak RPC, so a registry with a ``publish_path`` writes its
+snapshot atomically to that file after each ``report()`` (throttled);
+the executor on the same host reads the file and attaches the snapshot
+to its next heartbeat. The default registry publishes to
+``$TONY_METRICS_FILE`` when the executor exported it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import re
+import threading
+import time
+from typing import Any, Iterable, Mapping
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Unit-suffix rules (the runtime half of analysis/metrics_lint TONY-M001):
+# a name that implies a dimension must carry its unit, so two dashboards
+# can never disagree about what "step_time" means.
+_TIME_HINT = re.compile(r"(?:^|_)(?:time|duration|latency)(?:_|$)")
+_TIME_SUFFIXES = ("_ms", "_seconds", "_us")
+_SIZE_HINT = re.compile(r"(?:^|_)(?:memory|size)(?:_|$)")
+_SIZE_SUFFIXES = ("_bytes", "_mb", "_gb")
+
+# Classic Prometheus default buckets (seconds-scale); callers measuring in
+# other units pass their own.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+def validate_metric_name(name: str, kind: str) -> str | None:
+    """TONY-M001 at runtime: returns the complaint, or None when legal."""
+    if not NAME_RE.match(name):
+        return f"metric name {name!r} is not snake_case"
+    if kind == "counter" and not name.endswith("_total"):
+        return f"counter {name!r} must end with `_total`"
+    if _TIME_HINT.search(name) and not name.endswith(_TIME_SUFFIXES):
+        return (
+            f"time metric {name!r} must carry a unit suffix "
+            f"({', '.join(_TIME_SUFFIXES)})"
+        )
+    if _SIZE_HINT.search(name) and not name.endswith(_SIZE_SUFFIXES):
+        return (
+            f"size metric {name!r} must carry a unit suffix "
+            f"({', '.join(_SIZE_SUFFIXES)})"
+        )
+    return None
+
+
+def sanitize_metric_name(raw: str) -> str:
+    """Best-effort snake_case for dynamically-derived names (profiler op
+    names and the like); static names should just be written legally."""
+    name = re.sub(r"[^a-z0-9_]+", "_", raw.lower()).strip("_")
+    return name or "unnamed"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; +Inf is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help: str = "",
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        self.name = name
+        self.help = help
+        self.bounds = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, bound in enumerate(self.bounds):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            cumulative = []
+            running = 0
+            for bound, n in zip(self.bounds, self._counts):
+                running += n
+                cumulative.append([bound, running])
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "buckets": cumulative,
+            }
+
+
+class MetricsRegistry:
+    """Thread-safe name → metric registry with publish/snapshot plumbing.
+
+    ``report(step=..., loss=..., step_time_ms=...)`` is the train-loop
+    API: every keyword becomes a gauge; ``step`` additionally drives the
+    ``train_steps_total`` counter (incremented by the step delta, so a
+    resumed loop reports progress, not history).
+    """
+
+    def __init__(
+        self,
+        publish_path: str | os.PathLike[str] | None = None,
+        publish_min_interval_s: float = 0.5,
+    ) -> None:
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+        self._publish_path = str(publish_path) if publish_path else None
+        self._publish_min_interval_s = publish_min_interval_s
+        self._last_publish = 0.0
+        self._last_step: int | None = None
+        if self._publish_path:
+            atexit.register(self.flush)
+
+    # -- registration ------------------------------------------------------
+    def _get_or_register(self, cls, name: str, help: str, **kwargs):
+        complaint = validate_metric_name(name, cls.kind)
+        if complaint:
+            raise ValueError(complaint)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {cls.kind}"
+                    )
+                return existing
+            metric = cls(name, help, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_register(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_register(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_register(Histogram, name, help, buckets=buckets)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # -- the train-loop API ------------------------------------------------
+    def report(self, step: int | None = None, **values: float) -> None:
+        for name, value in values.items():
+            self.gauge(name).set(float(value))
+        if step is not None:
+            step = int(step)
+            self.gauge("train_step").set(step)
+            delta = step if self._last_step is None else step - self._last_step
+            if delta > 0:
+                self.counter("train_steps_total").inc(delta)
+            self._last_step = step
+        self._maybe_publish()
+
+    # -- snapshot / publish ------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-safe snapshot — the exact object that rides heartbeats."""
+        counters: dict[str, float] = {}
+        gauges: dict[str, float] = {}
+        histograms: dict[str, Any] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Counter):
+                counters[m.name] = m.value
+            elif isinstance(m, Gauge):
+                gauges[m.name] = m.value
+            else:
+                histograms[m.name] = m.snapshot()
+        return {
+            "ts_ms": int(time.time() * 1000),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": histograms,
+        }
+
+    def summary(self) -> dict[str, Any]:
+        """Compact snapshot for terminal records and BENCH lines:
+        histograms collapse to count/sum/mean, buckets dropped; values
+        are json-safe (non-finite floats -> null)."""
+        snap = self.snapshot()
+        return json_safe({
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "histograms": {
+                name: {
+                    "count": h["count"],
+                    "sum": round(h["sum"], 6),
+                    "mean": round(h["sum"] / h["count"], 6)
+                    if h["count"] else 0.0,
+                }
+                for name, h in snap["histograms"].items()
+            },
+        })
+
+    def _maybe_publish(self) -> None:
+        if not self._publish_path:
+            return
+        now = time.monotonic()
+        if now - self._last_publish < self._publish_min_interval_s:
+            return
+        self._last_publish = now
+        self.flush()
+
+    def flush(self) -> None:
+        """Atomic snapshot write: the executor reading mid-write must see
+        the previous complete snapshot, never a torn one."""
+        if not self._publish_path:
+            return
+        try:
+            data = json.dumps(self.snapshot())
+            tmp = f"{self._publish_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, self._publish_path)
+        except OSError:
+            pass  # scratch dir gone mid-teardown: telemetry is best-effort
+
+    def to_prometheus(self) -> str:
+        return render_prometheus(self.snapshot())
+
+
+def json_safe(obj: Any) -> Any:
+    """Replace non-finite floats with None, recursively. Python's json
+    happily emits the bare tokens ``NaN``/``Infinity`` (invalid JSON for
+    strict consumers — jq, browsers, Grafana), and a diverged loss
+    reporting ``loss=nan`` is exactly when operators read these views.
+    The Prometheus text path keeps real NaN via its own formatter."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def load_snapshot_file(path: str | os.PathLike[str]) -> dict[str, Any] | None:
+    """Read a published snapshot; None when absent or (transiently)
+    malformed — a missing snapshot must never fail a heartbeat."""
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
+def _fmt(value: float) -> str:
+    if isinstance(value, float) and (math.isnan(value) or math.isinf(value)):
+        return "NaN" if math.isnan(value) else (
+            "+Inf" if value > 0 else "-Inf"
+        )
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _labels(labels: Mapping[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_prometheus(
+    snapshot: Mapping[str, Any],
+    labels: Mapping[str, str] | None = None,
+    types_seen: set[str] | None = None,
+) -> str:
+    """Render one snapshot as Prometheus text (exposition format 0.0.4).
+    ``labels`` are attached to every sample (the aggregator passes
+    ``{"task": task_id}``); ``types_seen`` dedupes ``# TYPE`` headers
+    across multiple snapshots sharing one page."""
+    seen = types_seen if types_seen is not None else set()
+    out: list[str] = []
+
+    def header(name: str, kind: str) -> None:
+        if name not in seen:
+            seen.add(name)
+            out.append(f"# TYPE {name} {kind}")
+
+    for name, value in sorted(snapshot.get("counters", {}).items()):
+        header(name, "counter")
+        out.append(f"{name}{_labels(labels)} {_fmt(value)}")
+    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        header(name, "gauge")
+        out.append(f"{name}{_labels(labels)} {_fmt(value)}")
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        header(name, "histogram")
+        base = dict(labels or {})
+        for bound, cum in h.get("buckets", []):
+            out.append(
+                f"{name}_bucket{_labels({**base, 'le': _fmt(bound)})} {cum}"
+            )
+        out.append(f"{name}_bucket{_labels({**base, 'le': '+Inf'})} "
+                   f"{h.get('count', 0)}")
+        out.append(f"{name}_sum{_labels(labels)} {_fmt(h.get('sum', 0.0))}")
+        out.append(f"{name}_count{_labels(labels)} {h.get('count', 0)}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+_default_registry: MetricsRegistry | None = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry. In a tony-launched user process the
+    executor exports TONY_METRICS_FILE, so snapshots auto-publish and ride
+    heartbeats; anywhere else it is a plain in-memory registry."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry(
+                publish_path=os.environ.get("TONY_METRICS_FILE") or None
+            )
+        return _default_registry
+
+
+def report(step: int | None = None, **values: float) -> None:
+    """Module-level convenience: ``observability.report(step=i, loss=l)``."""
+    default_registry().report(step=step, **values)

@@ -10,7 +10,7 @@ import logging
 import socket
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Mapping
 
 from tony_tpu.rpc import wire
 from tony_tpu.rpc.protocol import ApplicationRpc, RpcError, TaskUrl
@@ -31,10 +31,15 @@ class ApplicationRpcClient(ApplicationRpc):
         retry_interval_s: float = 0.5,
         call_timeout_s: float = DEFAULT_CALL_TIMEOUT_S,
         fault_hook: Callable[[], None] | None = None,
+        trace_id: str | None = None,
     ) -> None:
         self.host = host
         self.port = port
         self._secret = secret
+        # Trace metadata: when set, every framed request carries the job
+        # trace id (observability/trace.py) so the server can attribute
+        # control-plane activity to the job's distributed trace.
+        self._trace_id = trace_id
         self._connect_timeout_s = connect_timeout_s
         self._call_retries = call_retries
         self._retry_interval_s = retry_interval_s
@@ -73,6 +78,8 @@ class ApplicationRpcClient(ApplicationRpc):
         req = {"method": method, "args": args}
         if self._secret is not None:
             req["auth"] = self._secret
+        if self._trace_id is not None:
+            req["trace"] = self._trace_id
         last_err: Exception | None = None
         with self._lock:
             for attempt in range(self._call_retries + 1):
@@ -126,10 +133,18 @@ class ApplicationRpcClient(ApplicationRpc):
     def finish_application(self) -> None:
         return self._call("finish_application")
 
-    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
-        return self._call(
-            "task_executor_heartbeat", task_id=task_id, session_id=session_id
-        )
+    def task_executor_heartbeat(
+        self,
+        task_id: str,
+        session_id: str,
+        metrics: Mapping[str, Any] | None = None,
+    ) -> None:
+        # The optional arg stays off the wire when absent: pings without
+        # telemetry (and pre-metrics peers) keep the 2-arg frame.
+        args: dict[str, Any] = {"task_id": task_id, "session_id": session_id}
+        if metrics is not None:
+            args["metrics"] = dict(metrics)
+        return self._call("task_executor_heartbeat", **args)
 
     def get_application_status(self) -> dict[str, Any]:
         return self._call("get_application_status")

@@ -73,10 +73,20 @@ class ApplicationRpc(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str, session_id: str) -> None:
+    def task_executor_heartbeat(
+        self,
+        task_id: str,
+        session_id: str,
+        metrics: Mapping[str, Any] | None = None,
+    ) -> None:
         """``session_id`` fences stale pings: an executor from a previous
         (failed, being-torn-down) session must not feed the retried
-        session's liveness monitor."""
+        session's liveness monitor.
+
+        ``metrics`` (optional) piggybacks the executor's latest metrics
+        snapshot (``observability.metrics`` schema) on the ping it
+        already sends — the telemetry plane costs zero extra RPCs. A
+        ping without it is a plain liveness signal."""
 
     @abc.abstractmethod
     def get_application_status(self) -> dict[str, Any]:
@@ -97,6 +107,15 @@ RPC_METHODS: dict[str, tuple[str, ...]] = {
     "register_tensorboard_url": ("spec", "url"),
     "register_execution_result": ("exit_code", "job_name", "job_index", "session_id"),
     "finish_application": (),
-    "task_executor_heartbeat": ("task_id", "session_id"),
+    "task_executor_heartbeat": ("task_id", "session_id", "metrics"),
     "get_application_status": (),
+}
+
+# Args a caller may omit (the server fills the interface default). Every
+# name here must be a TRAILING subset of the method's RPC_METHODS row and
+# carry a default on both the interface and the client stub — enforced by
+# analysis/protocol_check (TONY-P001/P003), so optional args cannot drift
+# into silently-required ones.
+RPC_OPTIONAL_ARGS: dict[str, tuple[str, ...]] = {
+    "task_executor_heartbeat": ("metrics",),
 }

@@ -17,7 +17,12 @@ import threading
 from typing import Any
 
 from tony_tpu.rpc import wire
-from tony_tpu.rpc.protocol import RPC_METHODS, ApplicationRpc, TaskUrl
+from tony_tpu.rpc.protocol import (
+    RPC_METHODS,
+    RPC_OPTIONAL_ARGS,
+    ApplicationRpc,
+    TaskUrl,
+)
 
 log = logging.getLogger(__name__)
 
@@ -139,12 +144,24 @@ class ApplicationRpcServer:
                     "error": f"role {role!r} is not permitted to call {method}",
                 }
         wanted = RPC_METHODS[method]
+        optional = set(RPC_OPTIONAL_ARGS.get(method, ()))
         args = req.get("args") or {}
-        if set(args) != set(wanted):
+        # Required args must all be present; optional ones may be omitted
+        # (the impl's declared default fills in) — that is how a new
+        # telemetry field rides an existing call without breaking peers
+        # that predate it.
+        if not (set(wanted) - optional <= set(args) <= set(wanted)):
             return {
                 "ok": False,
                 "error": f"{method} expects args {sorted(wanted)}, got {sorted(args)}",
             }
+        # Trace metadata: record the caller's trace id for this dispatch
+        # so handlers can stamp lifecycle events with it (the RPC half of
+        # TONY_TRACE_ID propagation).
+        from tony_tpu.observability import trace as _trace
+
+        trace_id = req.get("trace")
+        _trace.note_rpc_trace(trace_id if isinstance(trace_id, str) else None)
         try:
             result = getattr(self._impl, method)(**args)
             return {"ok": True, "result": _encode(result)}

@@ -1,12 +1,16 @@
 """Repo self-drift check: run the preflight analyzers over THIS tree.
 
-Two tables that must never drift are checked:
+Three registries that must never drift are checked:
 
 * config registry — every ``K_*`` key in ``conf/keys.py`` must appear in
   the shipped ``tony-default.json`` with the same default, and vice
   versa (the per-job-type families ship worker/ps rows);
 * the RPC protocol — registry ⟷ interface ⟷ ACL ⟷ client stubs ⟷
-  coordinator handler (``analysis/protocol_check``).
+  coordinator handler (``analysis/protocol_check``);
+* metric names — every statically-visible registration in the
+  framework, examples, and tools passes TONY-M001
+  (``analysis/metrics_lint``): snake_case, unit-suffixed, one kind per
+  name across the whole tree.
 
 Invoked from the tier-1 suite (``tests/test_analysis.py``) so drift
 fails CI, and runnable standalone::
@@ -68,8 +72,21 @@ def check_protocol_drift() -> list[str]:
     return [f.render() for f in check_protocol()]
 
 
+def check_metric_names() -> list[str]:
+    """TONY-M001 over every tree that registers metrics: the framework
+    itself, the examples, and the bench/profiling tools — they all land
+    on the same /metrics page, so one registry of names."""
+    from tony_tpu.analysis.metrics_lint import check_metric_names as check
+
+    roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+             REPO / "bench.py"]
+    return [f.render() for f in check(roots)]
+
+
 def main() -> int:
-    problems = check_config_drift() + check_protocol_drift()
+    problems = (
+        check_config_drift() + check_protocol_drift() + check_metric_names()
+    )
     for p in problems:
         print(p, file=sys.stderr)
     if problems:

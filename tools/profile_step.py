@@ -1,8 +1,22 @@
 """Device-trace breakdown of the full 200M train step at a given
-(batch, seq) — names the top-k ops by summed kernel time so MFU work
-targets the measured bottleneck, not a guess."""
+(batch, seq), emitted through the ``observability.metrics`` registry so
+bench tooling and telemetry share one schema.
+
+Two report variants (the former profile_step.py / profile_step2.py):
+
+* ``--variant ops``     — top-k individual ops by summed kernel time,
+  so MFU work targets the measured bottleneck, not a guess;
+* ``--variant grouped`` — ops bucketed by family (pallas kernels,
+  async copies, fusions, ...) plus the biggest individual copies.
+
+Timings land in a ``MetricsRegistry`` (``profile_device_total_ms`` and
+one sanitized ``profile_op_*_ms`` / ``profile_group_*_ms`` gauge per
+row); ``--json`` prints that snapshot instead of the table.
+"""
 from __future__ import annotations
 
+import argparse
+import json
 import sys
 
 import jax
@@ -12,13 +26,29 @@ import numpy as np
 sys.path.insert(0, "/root/repo")
 from tools.profile_flash import device_kernel_times  # noqa: E402
 
+from tony_tpu.observability.metrics import (  # noqa: E402
+    MetricsRegistry,
+    sanitize_metric_name,
+)
 
-def main():
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("batch", type=int, nargs="?", default=2)
+    p.add_argument("seq", type=int, nargs="?", default=8192)
+    p.add_argument("--variant", choices=("ops", "grouped"), default="ops")
+    p.add_argument("--top", type=int, default=22,
+                   help="rows to print/record")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the metrics-registry snapshot as JSON")
+    return p.parse_args(argv)
+
+
+def measure(batch: int, seq: int) -> dict[str, float]:
+    """One warmed train step under the device tracer: op name -> ms."""
     from tony_tpu.models import TransformerConfig, make_train_step
     from tony_tpu.parallel.mesh import MeshSpec, build_mesh
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 2
-    seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
     cfg = TransformerConfig(
         vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
         head_dim=64, d_ff=4096, max_seq=seq, dtype="bfloat16",
@@ -33,27 +63,77 @@ def main():
     )
     with jax.sharding.set_mesh(mesh):
         state = init_fn(jax.random.key(0))
-
-        def run(state, tokens):
-            state, m = step_fn(state, tokens)
-            return state, m
-
         holder = [state]
 
         def once():
-            s, m = run(holder[0], tokens)
+            s, m = step_fn(holder[0], tokens)
             holder[0] = s
             return m
 
-        times = device_kernel_times(lambda: once(), warmup=2, iters=4)
-    total = sum(ms for n, ms in times.items()
-                if not n.startswith("jit_"))
-    print(f"batch={batch} seq={seq} — top ops (ms/step), "
+        return device_kernel_times(lambda: once(), warmup=2, iters=4)
+
+
+def group_times(times: dict[str, float]) -> dict[str, float]:
+    """Bucket raw op rows into kernel families (the old profile_step2)."""
+    groups: dict[str, float] = {}
+    for n, ms in times.items():
+        if n.startswith("jit_") or (len(n) <= 2 and n.isdigit()):
+            continue
+        if "custom-call" in n:
+            key = "pallas:" + ("dkv" if " = (bf16" in n else
+                               "fwd" if "f32[" in n else "dq")
+        elif n.startswith("%copy-start") or n.startswith("%copy-done"):
+            key = "async-copy"
+        elif n.startswith("%copy"):
+            key = "copy"
+        elif n.startswith("%fusion") or ".fusion" in n:
+            key = "fusion"
+        else:
+            key = n.split(" = ")[0].lstrip("%").rstrip(".0123456789")
+        groups[key] = groups.get(key, 0.0) + ms
+    return groups
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    times = measure(args.batch, args.seq)
+    total = sum(ms for n, ms in times.items() if not n.startswith("jit_"))
+
+    registry = MetricsRegistry()
+    registry.gauge("profile_device_total_ms").set(round(total, 3))
+    registry.gauge("profile_batch_count").set(args.batch)
+    registry.gauge("profile_seq_count").set(args.seq)
+
+    if args.variant == "ops":
+        rows = list(times.items())[: args.top]
+        prefix = "profile_op_"
+        printable = [
+            (name.split(" = ")[0][:60] if " = " in name else name[:90], ms)
+            for name, ms in rows
+        ]
+    else:
+        groups = group_times(times)
+        rows = sorted(groups.items(), key=lambda kv: -kv[1])[: args.top]
+        prefix = "profile_group_"
+        printable = [(name, ms) for name, ms in rows]
+    for name, ms in rows:
+        metric = sanitize_metric_name(f"{prefix}{name}")[:120] + "_ms"
+        registry.gauge(metric).set(round(ms, 3))
+
+    if args.as_json:
+        print(json.dumps(registry.snapshot(), indent=2))
+        return 0
+    print(f"batch={args.batch} seq={args.seq} — {args.variant} (ms/step), "
           f"device total ~{total:.1f}:")
-    for name, ms in list(times.items())[:22]:
-        short = name.split(" = ")[0][:60] if " = " in name else name[:90]
-        print(f"  {ms:8.3f}  {short}")
+    for name, ms in printable:
+        print(f"  {ms:9.3f}  {name}")
+    if args.variant == "grouped":
+        big = [(ms, n) for n, ms in times.items()
+               if n.startswith("%copy-start")]
+        for ms, n in sorted(big, reverse=True)[:3]:
+            print(f"COPY {ms:8.2f}: {n[:400]}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())
