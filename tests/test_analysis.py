@@ -174,6 +174,24 @@ class TestConfigCheck:
         ids = [x.rule_id for x in check_config(conf)]
         assert ids.count("TONY-C002") == 2
 
+    def test_io_knobs_must_be_at_least_one(self):
+        """tony.io.* pipeline knobs reject 0 (the generic int rule only
+        floors at 0): a zero-depth prefetch or zero-record chunk is a
+        stalled pipeline, not a configuration."""
+        conf = self._conf(**{
+            keys.K_IO_PREFETCH_DEPTH: 0,
+            keys.K_IO_READ_WORKERS: 0,
+            keys.K_IO_CHUNK_RECORDS: 0,
+        })
+        ids = [x.rule_id for x in check_config(conf)]
+        assert ids.count("TONY-C002") == 3
+        clean = self._conf(**{
+            keys.K_IO_PREFETCH_DEPTH: 4,
+            keys.K_IO_READ_WORKERS: 8,
+            keys.K_IO_CHUNK_RECORDS: 128,
+        })
+        assert check_config(clean) == []
+
     def test_bad_port_range_and_enum(self):
         conf = self._conf(**{
             keys.K_AM_RPC_PORT_RANGE: "9000",

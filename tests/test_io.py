@@ -132,11 +132,15 @@ class TestTokenReader:
             np.testing.assert_array_equal(np.asarray(b), src[i])
 
     def test_device_prefetch_keeps_transfers_in_flight(self):
-        """The generator must ISSUE batch N+1's device_put before batch N
+        """The pipeline must ISSUE batch N+1's device_put before batch N
         is consumed — observed through a tracking iterator: after pulling
-        batch 0, the source must already have been advanced past batch
-        1 (depth=2 lookahead), which is what overlaps H2D with the
-        running step."""
+        batch 0, the background transfer thread advances the source past
+        batch 1 (depth=2 lookahead: the yielded batch plus one in
+        flight), which is what overlaps H2D with the running step — and
+        advances NO further until the consumer asks again (depth bounds
+        total in-flight batches)."""
+        import time
+
         from tony_tpu.io import device_prefetch
 
         pulled = []
@@ -149,9 +153,15 @@ class TestTokenReader:
         it = device_prefetch(src(), depth=2)
         first = next(it)
         np.testing.assert_array_equal(np.asarray(first), [0, 0])
+        deadline = time.monotonic() + 5
+        while len(pulled) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)  # the transfer thread races ahead async
         assert pulled == [0, 1], pulled  # one batch already in flight
+        time.sleep(0.05)
+        assert pulled == [0, 1], pulled  # ...and the depth bound holds
         rest = list(it)
         assert len(rest) == 4
+        assert pulled == [0, 1, 2, 3, 4]
         with pytest.raises(ValueError, match="depth"):
             next(device_prefetch(iter([np.zeros(1)]), depth=0))
 

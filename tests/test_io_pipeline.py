@@ -1,0 +1,559 @@
+"""Byte-heavy data-plane tests: the parallel span readers, the rollover
+batch assembly, the threaded ``device_prefetch`` pipeline's edge
+semantics, the on-device uint8 decode contract, and the ``tony_io_*``
+telemetry — the machinery behind the streamed-ResNet acceptance numbers
+in ``bench_input_pipeline``."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tony_tpu.io import (
+    DevicePrefetcher,
+    ShardedRecordReader,
+    device_prefetch,
+)
+from tony_tpu.io.reader import _IoMetrics
+
+
+def _write_tokens(path, n_rec, rl, dtype=np.uint16, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(
+        0, np.iinfo(dtype).max, size=(n_rec, rl)
+    ).astype(dtype)
+    data.tofile(path)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# device_prefetch edge semantics (threaded pipeline)
+# ---------------------------------------------------------------------------
+class TestDevicePrefetchEdges:
+    def test_producer_exception_surfaces_after_successes(self):
+        """A source failure AFTER `depth` successful puts must reach the
+        consumer at the position it occurred — not read as a clean end of
+        stream once the earlier batches drain."""
+
+        def src():
+            for i in range(4):
+                yield np.full((2,), i, np.int32)
+            raise OSError("disk died mid-shard")
+
+        it = device_prefetch(src(), depth=2)
+        got = [np.asarray(it.__next__())[0] for _ in range(4)]
+        assert got == [0, 1, 2, 3]
+        with pytest.raises(OSError, match="disk died"):
+            next(it)
+        # sticky: a catch-and-retry consumer keeps failing loudly
+        with pytest.raises(OSError, match="disk died"):
+            next(it)
+        it.close()
+
+    def test_transfer_exception_surfaces_in_order(self):
+        """A failed device put surfaces like a producer failure — via the
+        future at its position in the stream."""
+        calls = []
+
+        def bad_put(b):
+            calls.append(int(b[0]))
+            if int(b[0]) == 2:
+                raise RuntimeError("transfer rejected")
+            return b
+
+        src = (np.full((1,), i, np.int32) for i in range(5))
+        it = DevicePrefetcher(src, depth=3, put_fn=bad_put)
+        assert int(next(it)[0]) == 0
+        assert int(next(it)[0]) == 1
+        with pytest.raises(RuntimeError, match="transfer rejected"):
+            next(it)
+        with pytest.raises(RuntimeError, match="transfer rejected"):
+            next(it)  # sticky
+        it.close()
+
+    def test_depth_one_degenerates_to_eager(self):
+        """depth=1: the in-flight bound covers the yielded batch, so the
+        source advances only when the consumer asks — no lookahead."""
+        pulled = []
+
+        def src():
+            for i in range(3):
+                pulled.append(i)
+                yield np.full((1,), i, np.int32)
+
+        it = device_prefetch(src(), depth=1)
+        next(it)
+        time.sleep(0.05)
+        assert pulled == [0], pulled
+        next(it)
+        time.sleep(0.05)
+        assert pulled == [0, 1], pulled
+        it.close()
+
+    def test_close_mid_iteration_does_not_deadlock(self):
+        """close() with a full pipeline and an unbounded source must
+        release the transfer thread promptly (the slot wait polls the
+        stop event) — an abandoned fetcher would leak a thread per
+        epoch."""
+
+        def endless():
+            i = 0
+            while True:
+                yield np.full((4,), i, np.int32)
+                i += 1
+
+        it = device_prefetch(endless(), depth=2)
+        next(it)
+        t0 = time.monotonic()
+        it.close()
+        assert time.monotonic() - t0 < 3
+        it._thread.join(timeout=3)
+        assert not it._thread.is_alive()
+
+    def test_reader_close_unblocks_prefetcher(self, tmp_path):
+        """Closing the reader mid-epoch must terminate the stream for a
+        prefetcher blocked on its queue — the transfer thread sees
+        end-of-stream instead of hanging, and close() stays prompt."""
+        rl = 8
+        p = tmp_path / "c.bin"
+        _write_tokens(p, 2000, rl)
+        reader = ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=4, buffer_records=64,
+        )
+        it = device_prefetch(
+            (b for b in reader), depth=2, transfer_workers=1
+        )
+        next(it)
+        reader.close()
+        t0 = time.monotonic()
+        it.close()
+        assert time.monotonic() - t0 < 3
+        it._thread.join(timeout=3)
+        assert not it._thread.is_alive()
+
+    def test_context_manager_closes(self):
+        with device_prefetch(
+            (np.zeros(2, np.int32) for _ in range(100)), depth=2
+        ) as it:
+            next(it)
+        assert not it._thread.is_alive()
+
+    def test_next_after_close_terminates(self):
+        """next() on a closed pipeline must raise StopIteration, not hang
+        on the drained queue."""
+        it = device_prefetch(
+            (np.zeros(2, np.int32) for _ in range(10)), depth=2
+        )
+        next(it)
+        it.close()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_abandoned_prefetcher_thread_shuts_down(self):
+        """A prefetcher dropped without close() must not pin its producer
+        thread forever: the thread holds only a weakref, so collection of
+        the abandoned object stops the loop."""
+        import gc
+        import weakref
+
+        def src():
+            i = 0
+            while True:
+                yield np.full((2,), i, np.int32)
+                i += 1
+
+        it = device_prefetch(src(), depth=2)
+        next(it)
+        thread = it._thread
+        ref = weakref.ref(it)
+        del it
+        deadline = time.monotonic() + 10
+        while thread.is_alive() and time.monotonic() < deadline:
+            gc.collect()
+            time.sleep(0.05)
+        assert ref() is None
+        assert not thread.is_alive()
+
+    def test_exhausted_stream_keeps_raising_stopiteration(self):
+        it = device_prefetch(iter([np.zeros(1, np.int32)]), depth=2)
+        next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# parallel span readers
+# ---------------------------------------------------------------------------
+class TestParallelReaders:
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_exactly_once_across_tasks(self, tmp_path, workers):
+        rl, n_rec = 8, 103
+        p = tmp_path / "t.bin"
+        data = np.arange(rl * n_rec, dtype=np.uint16).reshape(n_rec, rl)
+        data.tofile(p)
+        seen = []
+        for t in range(4):
+            with ShardedRecordReader(
+                [str(p)], t, 4, fmt="tokens", record_len=rl,
+                dtype=np.uint16, batch_size=10, read_workers=workers,
+            ) as r:
+                for batch in r:
+                    seen.extend(batch[:, 0].tolist())
+        assert sorted(seen) == [i * rl for i in range(n_rec)]
+
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_order_is_stream_order(self, tmp_path, workers):
+        """Parallel reads must come back in submission order — batch N is
+        byte-identical to records [N*bs, (N+1)*bs) regardless of worker
+        count or chunk size."""
+        rl = 16
+        p = tmp_path / "big.bin"
+        data = _write_tokens(p, 1000, rl)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=64, read_workers=workers, chunk_records=32,
+        ) as r:
+            got = np.concatenate([b for b in r])
+        np.testing.assert_array_equal(got, data)
+
+    def test_native_and_python_paths_identical_under_pool(
+        self, tmp_path, monkeypatch
+    ):
+        """The tier-1 pin: with the worker pool active, the native pread
+        kernel and the pure-Python preadv fallback produce byte-identical
+        streams (satellite: CI floor for the new read path)."""
+        from tony_tpu.io import native
+
+        rl = 8
+        p = tmp_path / "pin.bin"
+        data = _write_tokens(p, 517, rl, seed=3)
+
+        def read_all(force_py):
+            if force_py:
+                monkeypatch.setattr(native, "available", lambda: False)
+            try:
+                with ShardedRecordReader(
+                    [str(p)], fmt="tokens", record_len=rl,
+                    dtype=np.uint16, batch_size=50, read_workers=4,
+                    chunk_records=16,
+                ) as r:
+                    return np.concatenate([b for b in r])
+            finally:
+                monkeypatch.undo()
+
+        py = read_all(True)
+        np.testing.assert_array_equal(py, data)
+        if native.available():
+            np.testing.assert_array_equal(read_all(False), py)
+
+    def test_multi_file_parallel(self, tmp_path):
+        rl = 4
+        parts, expect = [], []
+        for fi, n in enumerate([77, 3, 130]):
+            p = tmp_path / f"part-{fi}.bin"
+            expect.append(_write_tokens(p, n, rl, seed=fi))
+            parts.append(str(p))
+        with ShardedRecordReader(
+            parts, fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=32, read_workers=3, chunk_records=8,
+        ) as r:
+            got = np.concatenate([b for b in r])
+        np.testing.assert_array_equal(got, np.concatenate(expect))
+
+    def test_gs_ranged_reads_parallel_match_local(self, tmp_path):
+        from tony_tpu.cloud import default_storage, set_default_storage
+        from tony_tpu.cloud.gcs import FileObjectStorage
+
+        set_default_storage(FileObjectStorage(tmp_path / "obj"))
+        try:
+            rl, n_rec = 8, 300
+            local = tmp_path / "t.bin"
+            data = _write_tokens(local, n_rec, rl)
+            default_storage().put_bytes(
+                "gs://corpus/t.bin", local.read_bytes()
+            )
+            with ShardedRecordReader(
+                ["gs://corpus/t.bin"], fmt="tokens", record_len=rl,
+                dtype=np.uint16, batch_size=37, read_workers=4,
+                chunk_records=16,
+            ) as r:
+                got = np.concatenate([b for b in r])
+            np.testing.assert_array_equal(got, data)
+            # writable: the single-copy ranged-read fix must not hand
+            # out read-only frombuffer views
+            assert got.flags.writeable
+        finally:
+            set_default_storage(None)
+
+    def test_illegal_explicit_knobs_rejected(self, tmp_path):
+        p = tmp_path / "z.bin"
+        _write_tokens(p, 4, 4)
+        for kw in ({"chunk_records": 0}, {"read_workers": 0}):
+            with pytest.raises(ValueError):
+                ShardedRecordReader(
+                    [str(p)], fmt="tokens", record_len=4,
+                    dtype=np.uint16, batch_size=4, **kw,
+                )
+
+    def test_queue_bounded_in_bytes_for_byte_heavy_records(self, tmp_path):
+        """Image-sized records must cap BOTH the per-chunk bytes and the
+        total queue bytes — the buffer must not balloon to buffer_records
+        worth of 147 KB rows."""
+        rec = 224 * 224 * 3
+        p = tmp_path / "img.bin"
+        np.zeros((4, rec), np.uint8).tofile(p)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", dtype=np.uint8, record_len=rec,
+            batch_size=2,
+        ) as r:
+            chunk_bytes = r._chunk_rows * rec
+            assert chunk_bytes <= r._CHUNK_BYTES_CAP
+            assert r._queue.maxsize * chunk_bytes <= r._QUEUE_BYTES_CAP
+            assert sum(len(b) for b in r) == 4
+
+    def test_env_knobs_reach_reader(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TONY_IO_CHUNK_RECORDS", "7")
+        monkeypatch.setenv("TONY_IO_READ_WORKERS", "2")
+        p = tmp_path / "e.bin"
+        _write_tokens(p, 10, 4)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=4, dtype=np.uint16,
+            batch_size=4,
+        ) as r:
+            assert r.chunk_records == 7
+            assert r.read_workers == 2
+        with ShardedRecordReader(  # explicit args win over env
+            [str(p)], fmt="tokens", record_len=4, dtype=np.uint16,
+            batch_size=4, chunk_records=3, read_workers=5,
+        ) as r:
+            assert r.chunk_records == 3
+            assert r.read_workers == 5
+
+
+# ---------------------------------------------------------------------------
+# rollover batch assembly
+# ---------------------------------------------------------------------------
+class TestRollingAssembly:
+    @pytest.mark.parametrize("batch,chunk", [
+        (100, 64),   # batches cross chunk boundaries
+        (7, 16),     # several batches per chunk, misaligned
+        (32, 32),    # aligned: every batch is a zero-copy view
+        (256, 8),    # batch spans many chunks
+    ])
+    def test_batches_identical_to_records(self, tmp_path, batch, chunk):
+        rl = 8
+        p = tmp_path / "r.bin"
+        data = _write_tokens(p, 403, rl, seed=batch)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=batch, chunk_records=chunk,
+        ) as r:
+            batches = list(r)
+        for b in batches[:-1]:
+            assert b.shape == (batch, rl)
+        got = np.concatenate(batches)
+        np.testing.assert_array_equal(got, data)
+
+    def test_zero_copy_batches_are_writable_and_independent(self, tmp_path):
+        """Aligned batches are views into the span buffer; mutating one
+        batch in place (masking) must not corrupt its neighbours."""
+        rl = 4
+        p = tmp_path / "w.bin"
+        data = _write_tokens(p, 64, rl)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=16, chunk_records=16,
+        ) as r:
+            first = r.next_batch()
+            first *= 0  # consumer masks in place
+            second = r.next_batch()
+        np.testing.assert_array_equal(second, data[16:32])
+
+    def test_tail_batch_short(self, tmp_path):
+        p = tmp_path / "tail.bin"
+        _write_tokens(p, 41, 4)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=4, dtype=np.uint16,
+            batch_size=16, chunk_records=8,
+        ) as r:
+            sizes = [len(b) for b in r]
+        assert sizes == [16, 16, 9]
+
+
+# ---------------------------------------------------------------------------
+# on-device decode contract + end-to-end streamed training
+# ---------------------------------------------------------------------------
+class TestOnDeviceDecode:
+    def test_resnet_decodes_uint8_like_prescaled_float(self):
+        import jax.numpy as jnp
+
+        from tony_tpu.models import ResNetConfig, resnet_apply, resnet_init
+        import jax
+
+        cfg = ResNetConfig(depth=18, width=8, n_classes=4, dtype="float32")
+        params = resnet_init(jax.random.key(0), cfg)
+        raw = np.random.default_rng(0).integers(
+            0, 256, (2, 32, 32, 3), dtype=np.uint8
+        )
+        logits_u8 = resnet_apply(params, jnp.asarray(raw), cfg)
+        logits_f32 = resnet_apply(
+            params, jnp.asarray(raw, jnp.float32) / 255.0, cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_u8), np.asarray(logits_f32),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_streamed_uint8_training_end_to_end(self, tmp_path):
+        """The whole acceptance pipeline in miniature: uint8 records on
+        disk → parallel reader → threaded device_prefetch (uint8 over
+        H2D) → jitted step with on-device normalize — losses stay finite
+        and every layer's telemetry fires."""
+        import jax
+        import jax.numpy as jnp
+
+        from tony_tpu.models import (
+            make_image_classifier_step, uint8_image_normalizer,
+        )
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        size, classes, batch = 8, 4, 16
+        rec = size * size * 3
+        p = tmp_path / "img.bin"
+        rng = np.random.default_rng(0)
+        rng.integers(0, 256, (8 * batch, rec), dtype=np.uint8).tofile(p)
+
+        mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+        def apply_fn(params, images):
+            flat = images.reshape(images.shape[0], -1)
+            return flat @ params["w"] + params["b"]
+
+        init_fn, step_fn = make_image_classifier_step(
+            lambda key: {
+                "w": jax.random.normal(key, (rec, classes)) * 0.01,
+                "b": jnp.zeros((classes,)),
+            },
+            apply_fn,
+            mesh,
+            preprocess=uint8_image_normalizer(mean=127.5, std=127.5),
+        )
+        labels = jnp.asarray(rng.integers(0, classes, (batch,)), jnp.int32)
+        sharding = NamedSharding(mesh, P(("dp", "ep")))
+        metrics = _IoMetrics.get()
+        h2d0 = metrics.h2d_bytes.value
+        read0 = metrics.bytes_read.value
+        with jax.sharding.set_mesh(mesh):
+            state = init_fn(jax.random.key(1))
+            with ShardedRecordReader(
+                [str(p)], fmt="tokens", dtype=np.uint8, record_len=rec,
+                batch_size=batch, read_workers=2,
+            ) as reader:
+                def batches():
+                    for b in reader:
+                        if len(b) == batch:
+                            yield b.reshape(batch, size, size, 3)
+
+                with device_prefetch(batches(), sharding, depth=3) as it:
+                    losses = []
+                    for img in it:
+                        assert img.dtype == jnp.uint8  # bytes over H2D
+                        state, m = step_fn(state, img, labels)
+                        losses.append(float(m["loss"]))
+        assert len(losses) == 8
+        assert all(np.isfinite(losses))
+        assert metrics.bytes_read.value - read0 >= 8 * batch * rec
+        assert metrics.h2d_bytes.value - h2d0 >= 8 * batch * rec
+
+    def test_to_global_batch_skips_placed_arrays(self):
+        """A batch the prefetcher already placed with the step's sharding
+        must pass through _to_global_batch untouched — the second
+        device_put per batch was half the H2D bill."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tony_tpu.models.train import _to_global_batch
+        from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+        sharding = NamedSharding(mesh, P(("dp", "ep")))
+        placed = jax.device_put(np.zeros((4, 3), np.float32), sharding)
+        assert _to_global_batch(placed, sharding) is placed
+        # numpy input still takes the put
+        out = _to_global_batch(np.zeros((4, 3), np.float32), sharding)
+        assert isinstance(out, jax.Array)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+class TestIoTelemetry:
+    def test_reader_metrics_registered_and_counted(self, tmp_path):
+        from tony_tpu import observability
+
+        names = observability.default_registry().names()
+        p = tmp_path / "m.bin"
+        _write_tokens(p, 100, 8)
+        m = _IoMetrics.get()
+        before = m.bytes_read.value
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=8, dtype=np.uint16,
+            batch_size=10,
+        ) as r:
+            list(r)
+        assert m.bytes_read.value - before == 100 * 16
+        names = observability.default_registry().names()
+        for required in (
+            "tony_io_bytes_read_total", "tony_io_read_ms",
+            "tony_io_assemble_ms", "tony_io_batch_wait_ms",
+            "tony_io_prefetch_queue_depth", "tony_io_h2d_bytes_total",
+            "tony_io_h2d_ms", "tony_io_queue_wait_ms",
+            "tony_io_h2d_inflight_depth",
+        ):
+            assert required in names
+
+    def test_metrics_render_to_prometheus(self):
+        from tony_tpu import observability
+
+        _IoMetrics.get()
+        text = observability.default_registry().to_prometheus()
+        assert "tony_io_bytes_read_total" in text
+        assert "tony_io_h2d_ms_bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# throughput floor (slow): the reader must sustain real record rates on
+# the CPU fallback path — a regression that serializes the pool or
+# reintroduces per-batch concatenation shows up here long before a TPU
+# bench runs.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestThroughputFloor:
+    FLOOR_RECORDS_PER_SEC = 50_000
+
+    def test_python_fallback_sustains_floor(self, tmp_path, monkeypatch):
+        from tony_tpu.io import native
+
+        monkeypatch.setattr(native, "available", lambda: False)
+        rl, n_rec = 32, 200_000  # 12.8 MB corpus
+        p = tmp_path / "floor.bin"
+        rng = np.random.default_rng(0)
+        rng.integers(0, 2**16, (n_rec, rl)).astype(np.uint16).tofile(p)
+        with ShardedRecordReader(
+            [str(p)], fmt="tokens", record_len=rl, dtype=np.uint16,
+            batch_size=512, read_workers=4,
+        ) as r:
+            t0 = time.perf_counter()
+            total = sum(len(b) for b in r)
+            dt = time.perf_counter() - t0
+        assert total == n_rec
+        rate = total / dt
+        assert rate >= self.FLOOR_RECORDS_PER_SEC, (
+            f"python fallback read {rate:,.0f} records/s, floor is "
+            f"{self.FLOOR_RECORDS_PER_SEC:,}"
+        )

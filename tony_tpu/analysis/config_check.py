@@ -52,6 +52,15 @@ _ENUM_KEYS: dict[str, tuple[str, ...]] = {
     keys.K_PREFLIGHT_MODE: _PREFLIGHT_MODES,
 }
 
+# Integer keys where 0 is not a legal value (the generic int rule only
+# requires >= 0): the data-plane pipeline needs at least one in-flight
+# transfer, one read worker, and one record per chunk.
+_MIN_ONE_KEYS = frozenset({
+    keys.K_IO_PREFETCH_DEPTH,
+    keys.K_IO_READ_WORKERS,
+    keys.K_IO_CHUNK_RECORDS,
+})
+
 _TRUE_FALSE = frozenset(
     {"true", "1", "yes", "on", "false", "0", "no", "off"}
 )
@@ -115,8 +124,9 @@ def _check_value(key: str, value, default) -> str | None:
             return None  # empty = take the default (get_int contract)
         if not _is_int(value):
             return f"must be an integer; got {value!r}"
-        if int(value) < 0:
-            return f"must be >= 0; got {value!r}"
+        floor = 1 if key in _MIN_ONE_KEYS else 0
+        if int(value) < floor:
+            return f"must be >= {floor}; got {value!r}"
         return None
     return None
 

@@ -104,6 +104,19 @@ K_GCP_RUNTIME_VERSION = GCP_PREFIX + "runtime-version"  # TPU VM image
 K_GCP_NETWORK = GCP_PREFIX + "network"          # "" = project default
 K_AM_ADDRESS_HOST = AM_PREFIX + "address-host"  # reachable AM host for remote executors ("" = auto)
 
+# --- data plane (io/reader.py) ---------------------------------------------
+# Tuning for the sharded-reader → device_prefetch pipeline. The executor
+# exports these to user processes as TONY_IO_* env, which the reader and
+# prefetcher read as their defaults (explicit constructor args win).
+IO_PREFIX = TONY_PREFIX + "io."
+# Batches kept in flight host→device (incl. the one the step consumes):
+# 1 = eager, 2 = double buffering, deeper absorbs slow/bursty transfers.
+K_IO_PREFETCH_DEPTH = IO_PREFIX + "prefetch-depth"
+# Concurrent span reads (local preads / GCS ranged GETs) per reader.
+K_IO_READ_WORKERS = IO_PREFIX + "read-workers"
+# Records per prefetch-queue chunk; one read span covers 4 chunks.
+K_IO_CHUNK_RECORDS = IO_PREFIX + "chunk-records"
+
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
 # (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
@@ -192,6 +205,9 @@ DEFAULTS: dict[str, object] = {
     K_GCP_RUNTIME_VERSION: "",  # empty = per-generation default (cloud.gcp)
     K_GCP_NETWORK: "",
     K_AM_ADDRESS_HOST: "",
+    K_IO_PREFETCH_DEPTH: 2,
+    K_IO_READ_WORKERS: 4,
+    K_IO_CHUNK_RECORDS: 256,
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",

@@ -78,6 +78,11 @@ TONY_FAULT_PLAN = "TONY_FAULT_PLAN"
 # piggybacks the snapshot on its heartbeat).
 TONY_TRACE_ID = "TONY_TRACE_ID"
 TONY_METRICS_FILE = "TONY_METRICS_FILE"
+# Data-plane tuning (tony.io.* conf → user-process env → io/reader.py
+# defaults): prefetch depth, read workers, records per chunk.
+TONY_IO_PREFETCH_DEPTH = "TONY_IO_PREFETCH_DEPTH"
+TONY_IO_READ_WORKERS = "TONY_IO_READ_WORKERS"
+TONY_IO_CHUNK_RECORDS = "TONY_IO_CHUNK_RECORDS"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -92,6 +97,7 @@ DOCKER_FORWARD_ENV = (
     TB_PORT, PROFILER_PORT, TONY_LOG_DIR, PREPROCESSING_JOB, TASK_PARAM_KEY,
     TONY_RESUME_STEP, TONY_CHECKPOINT_DIR, TONY_FAULT_PLAN,
     TONY_TRACE_ID, TONY_METRICS_FILE,
+    TONY_IO_PREFETCH_DEPTH, TONY_IO_READ_WORKERS, TONY_IO_CHUNK_RECORDS,
 )
 
 # The executor's self-termination code after losing the coordinator (N

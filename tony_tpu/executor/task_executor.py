@@ -373,6 +373,18 @@ class TaskExecutor:
         env[constants.TONY_TRACE_ID] = self.tracer.trace_id
         if self._metrics_file is not None:
             env[constants.TONY_METRICS_FILE] = str(self._metrics_file)
+        # Data-plane tuning: the reader and device prefetcher read these
+        # at construction (io/reader.py), so tony.io.* conf reaches user
+        # processes without any API threading.
+        env[constants.TONY_IO_PREFETCH_DEPTH] = str(
+            self.conf.get_int(keys.K_IO_PREFETCH_DEPTH, 2)
+        )
+        env[constants.TONY_IO_READ_WORKERS] = str(
+            self.conf.get_int(keys.K_IO_READ_WORKERS, 4)
+        )
+        env[constants.TONY_IO_CHUNK_RECORDS] = str(
+            self.conf.get_int(keys.K_IO_CHUNK_RECORDS, 256)
+        )
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(

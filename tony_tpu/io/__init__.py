@@ -14,6 +14,7 @@ shards each batch over the mesh's (dp, ep) axes.
 from tony_tpu.io.blocks import read_header, write_jsonl_blocks
 from tony_tpu.io.splits import compute_read_split, create_read_info, FileSegment
 from tony_tpu.io.reader import (
+    DevicePrefetcher,
     ShardedRecordReader,
     device_prefetch,
     sharded_batches,
@@ -25,6 +26,7 @@ __all__ = [
     "FileSegment",
     "ShardedRecordReader",
     "sharded_batches",
+    "DevicePrefetcher",
     "device_prefetch",
     "write_jsonl_blocks",
     "read_header",

@@ -60,6 +60,13 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.tony_count_records.restype = ctypes.c_int64
         lib.tony_count_records.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        # Optional symbols (a .so built before they existed still loads;
+        # the Python wrappers degrade to no-ops).
+        if hasattr(lib, "tony_readahead"):
+            lib.tony_readahead.restype = ctypes.c_int64
+            lib.tony_readahead.argtypes = [
+                ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ]
         _lib = lib
         break
     return _lib
@@ -84,6 +91,17 @@ def count_records(chunk: bytes) -> int:
     lib = _load()
     assert lib is not None, "native library not loaded; check available()"
     return lib.tony_count_records(chunk, len(chunk))
+
+
+def readahead(fd: int, offset: int, length: int) -> bool:
+    """Kernel readahead hint (posix_fadvise WILLNEED) for a byte range of
+    an open fd — issued for the next span while the current one decodes.
+    Best-effort: returns False when unsupported (older .so, non-Linux) or
+    refused; callers never depend on it."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tony_readahead"):
+        return False
+    return lib.tony_readahead(fd, offset, length) == 0
 
 
 def pread_records(

@@ -37,6 +37,7 @@ from tony_tpu.models.train import (
     lm_loss,
     make_image_classifier_step,
     make_train_step,
+    uint8_image_normalizer,
 )
 
 __all__ = [
@@ -54,6 +55,7 @@ __all__ = [
     "TrainState",
     "make_train_step",
     "make_image_classifier_step",
+    "uint8_image_normalizer",
     "lm_loss",
     "advance",
     "DecodeSession",

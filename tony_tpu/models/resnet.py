@@ -164,7 +164,15 @@ def resnet_apply(params: dict, images: jax.Array, cfg: ResNetConfig) -> jax.Arra
     """images: [B, H, W, 3] -> logits [B, n_classes] (fp32)."""
     block_kind, stages = cfg.plan
     dt = cfg.compute_dtype
-    x = images.astype(dt)
+    if images.dtype == jnp.uint8:
+        # On-device decode of byte-transferred batches: the data plane
+        # ships raw uint8 (4× fewer H2D bytes than float32) and the cast
+        # + [0,1) scale happen here, fused into the stem conv. Callers
+        # needing a different normalization pass it via
+        # make_image_classifier_step(preprocess=...) instead.
+        x = images.astype(dt) * jnp.asarray(1.0 / 255.0, dt)
+    else:
+        x = images.astype(dt)
     x = _conv(x, params["stem"]["conv"], stride=2, dtype=dt)
     x = jax.nn.relu(_group_norm(x, params["stem"]["gn"], cfg.gn_groups))
     x = lax.reduce_window(

@@ -2,16 +2,21 @@
 (batch, seq), emitted through the ``observability.metrics`` registry so
 bench tooling and telemetry share one schema.
 
-Two report variants (the former profile_step.py / profile_step2.py):
+Three report variants:
 
 * ``--variant ops``     — top-k individual ops by summed kernel time,
   so MFU work targets the measured bottleneck, not a guess;
 * ``--variant grouped`` — ops bucketed by family (pallas kernels,
-  async copies, fusions, ...) plus the biggest individual copies.
+  async copies, fusions, ...) plus the biggest individual copies;
+* ``--variant io``      — the streamed ResNet data plane phase by phase
+  (read / assemble / h2d / queue-wait vs step wall), read back from the
+  ``tony_io_*`` registry family, so the NEXT bottleneck after a
+  data-plane change is attributable without rerunning the full bench.
 
 Timings land in a ``MetricsRegistry`` (``profile_device_total_ms`` and
-one sanitized ``profile_op_*_ms`` / ``profile_group_*_ms`` gauge per
-row); ``--json`` prints that snapshot instead of the table.
+one sanitized ``profile_op_*_ms`` / ``profile_group_*_ms`` /
+``profile_io_*_ms`` gauge per row); ``--json`` prints that snapshot
+instead of the table.
 """
 from __future__ import annotations
 
@@ -34,11 +39,18 @@ from tony_tpu.observability.metrics import (  # noqa: E402
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("batch", type=int, nargs="?", default=2)
-    p.add_argument("seq", type=int, nargs="?", default=8192)
-    p.add_argument("--variant", choices=("ops", "grouped"), default="ops")
+    # Defaults resolve per variant in main(): ops/grouped profile the LM
+    # step (batch 2, seq 8192); io streams images (batch 32, size 224).
+    p.add_argument("batch", type=int, nargs="?", default=None)
+    p.add_argument("seq", type=int, nargs="?", default=None)
+    p.add_argument("--variant", choices=("ops", "grouped", "io"),
+                   default="ops")
     p.add_argument("--top", type=int, default=22,
                    help="rows to print/record")
+    p.add_argument("--steps", type=int, default=8,
+                   help="streamed steps to measure (--variant io)")
+    p.add_argument("--depth", type=int, default=4,
+                   help="prefetch depth (--variant io)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="print the metrics-registry snapshot as JSON")
     return p.parse_args(argv)
@@ -94,15 +106,117 @@ def group_times(times: dict[str, float]) -> dict[str, float]:
     return groups
 
 
+def measure_io(steps: int, depth: int, registry: MetricsRegistry,
+               batch: int = 32, size: int = 224) -> list[tuple[str, float]]:
+    """Stream a generated uint8 image corpus through the full data plane
+    (parallel reader → device_prefetch → ResNet-50 step, the bench's
+    byte-heavy shape) and attribute the wall time to phases via the
+    ``tony_io_*`` registry deltas. Returned rows are per-STEP
+    milliseconds; overlapped phases (read, h2d) can legitimately sum
+    past the wall — the number to minimize is ``stall`` (queue-wait),
+    the only component the chip actually sees."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tony_tpu import observability
+    from tony_tpu.io import ShardedRecordReader, device_prefetch
+    from tony_tpu.models import (
+        ResNetConfig, make_image_classifier_step, resnet_apply, resnet_init,
+    )
+    from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    rec = size * size * 3
+    warm = 2
+    rng = np.random.default_rng(0)
+    images = rng.integers(
+        0, 256, ((steps + warm) * batch, rec), dtype=np.uint8
+    )
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    rcfg = ResNetConfig(depth=50, width=64, n_classes=1000, dtype="bfloat16")
+    rinit, rstep = make_image_classifier_step(
+        lambda key: resnet_init(key, rcfg),
+        lambda params, imgs: resnet_apply(params, imgs, rcfg),
+        mesh,
+    )
+    labels = jax.numpy.asarray(rng.integers(0, 1000, (batch,)), jax.numpy.int32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(("dp", "ep")))
+    live = observability.default_registry()
+    with tempfile.NamedTemporaryFile(suffix=".tokens") as f:
+        f.write(images.tobytes())
+        f.flush()
+        with jax.sharding.set_mesh(mesh), ShardedRecordReader(
+            [f.name], fmt="tokens", dtype=np.uint8, record_len=rec,
+            batch_size=batch,
+        ) as reader:
+            def batches():
+                for b in reader:
+                    if b.shape[0] == batch:
+                        yield b.reshape(batch, size, size, 3)
+
+            with device_prefetch(batches(), sharding, depth=depth) as it:
+                state = rinit(jax.random.key(0))
+                for _ in range(warm):
+                    state, m = rstep(state, next(it), labels)
+                float(m["loss"])
+                snap0 = live.snapshot()
+                import time as _time
+
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    state, m = rstep(state, next(it), labels)
+                    float(m["loss"])  # per-step fence
+                wall_ms = (_time.perf_counter() - t0) * 1000
+                snap1 = live.snapshot()
+
+    def dsum(name):
+        return (snap1["histograms"].get(name, {"sum": 0.0})["sum"]
+                - snap0["histograms"].get(name, {"sum": 0.0})["sum"])
+
+    rows = [
+        ("step_wall", wall_ms / steps),
+        ("read", dsum("tony_io_read_ms") / steps),
+        ("assemble", dsum("tony_io_assemble_ms") / steps),
+        ("h2d", dsum("tony_io_h2d_ms") / steps),
+        ("stall", dsum("tony_io_queue_wait_ms") / steps),
+        ("batch_wait", dsum("tony_io_batch_wait_ms") / steps),
+    ]
+    registry.gauge("profile_io_batch_count").set(batch)
+    registry.gauge("profile_io_depth_count").set(depth)
+    for name, ms in rows:
+        registry.gauge(
+            sanitize_metric_name(f"profile_io_{name}") + "_ms"
+        ).set(round(ms, 3))
+    return rows
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
-    times = measure(args.batch, args.seq)
+    if args.variant == "io":
+        batch = args.batch if args.batch is not None else 32
+        registry = MetricsRegistry()
+        rows = measure_io(args.steps, args.depth, registry, batch=batch)
+        if args.as_json:
+            print(json.dumps(registry.snapshot(), indent=2))
+            return 0
+        print(f"streamed ResNet-50 data plane, batch={batch} "
+              f"depth={args.depth} (ms/step; read+h2d overlap the step — "
+              f"'stall' is what the chip waits):")
+        for name, ms in rows:
+            print(f"  {ms:9.3f}  {name}")
+        return 0
+    batch = args.batch if args.batch is not None else 2
+    seq = args.seq if args.seq is not None else 8192
+    times = measure(batch, seq)
     total = sum(ms for n, ms in times.items() if not n.startswith("jit_"))
 
     registry = MetricsRegistry()
     registry.gauge("profile_device_total_ms").set(round(total, 3))
-    registry.gauge("profile_batch_count").set(args.batch)
-    registry.gauge("profile_seq_count").set(args.seq)
+    registry.gauge("profile_batch_count").set(batch)
+    registry.gauge("profile_seq_count").set(seq)
 
     if args.variant == "ops":
         rows = list(times.items())[: args.top]
@@ -123,7 +237,7 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps(registry.snapshot(), indent=2))
         return 0
-    print(f"batch={args.batch} seq={args.seq} — {args.variant} (ms/step), "
+    print(f"batch={batch} seq={seq} — {args.variant} (ms/step), "
           f"device total ~{total:.1f}:")
     for name, ms in printable:
         print(f"  {ms:9.3f}  {name}")
