@@ -1,0 +1,33 @@
+"""Jax-free health-analytics fixture: every worker reports a steady
+train loop through ``observability.report``, but the task named by
+``STRAGGLER_TASK`` reports a step time far above the fleet's — the
+coordinator's MAD-based straggler detector must flag exactly that task
+while the job runs. Step count and cadence come from the env so chaos
+tests can keep the job alive long enough for timed kills to land."""
+import os
+import sys
+import time
+
+from tony_tpu import observability
+
+if not os.environ.get("TONY_METRICS_FILE"):
+    print("TONY_METRICS_FILE not exported", file=sys.stderr)
+    sys.exit(4)
+
+# Publish on every report: the health e2e asserts on what rides the
+# very next heartbeat, so the default write throttle only adds latency.
+registry = observability.default_registry()
+registry._publish_min_interval_s = 0.0
+
+task = f"{os.environ['JOB_NAME']}:{os.environ['TASK_INDEX']}"
+straggling = os.environ.get("STRAGGLER_TASK") == task
+step_time_ms = 80.0 if straggling else 5.0
+steps = int(os.environ.get("FIXTURE_STEPS", "40"))
+cadence_s = float(os.environ.get("FIXTURE_CADENCE_S", "0.08"))
+
+for step in range(1, steps + 1):
+    registry.report(step=step, loss=1.0 / step, step_time_ms=step_time_ms)
+    time.sleep(cadence_s)
+
+time.sleep(float(os.environ.get("LINGER_S", "0.5")))
+sys.exit(0)

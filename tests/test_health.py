@@ -1,0 +1,890 @@
+"""Health-analytics subsystem tests: the streaming detectors
+(straggler / stall / loss / jitter / io), the crash flight recorder,
+the TONY-D postmortem rule catalogue + `tony doctor`, the TONY-E001
+event-catalogue lint, events.jsonl hardening, aggregator behavior
+under many tasks and clock skew, `tony events --follow`, and the
+mini-cluster chaos e2e that drives the whole chain (injected fault →
+health alert → blackbox → ranked diagnosis)."""
+
+import json
+import re
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.analysis import postmortem
+from tony_tpu.analysis.events_lint import check_event_catalogue
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.app_master import TonyCoordinator
+from tony_tpu.coordinator.backend import LocalProcessBackend
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.observability import events as obs_events
+from tony_tpu.observability import health as obs_health
+from tony_tpu.observability.aggregator import (
+    MetricsAggregator,
+    ObservabilityHttpServer,
+)
+from tony_tpu.observability.flight import FlightRecorder, find_blackboxes
+from tony_tpu.observability.health import HealthConfig, HealthMonitor
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _snap(gauges=None, counters=None, histograms=None):
+    return {
+        "ts_ms": int(time.time() * 1000),
+        "gauges": gauges or {},
+        "counters": counters or {},
+        "histograms": histograms or {},
+    }
+
+
+# ---------------------------------------------------------------------------
+# health.py — detectors
+# ---------------------------------------------------------------------------
+class TestMadScores:
+    def test_outlier_scores_high_uniform_fleet(self):
+        scores = obs_health.mad_scores(
+            {"w0": 5.0, "w1": 5.0, "w2": 5.0, "w3": 80.0}
+        )
+        assert scores["w3"] > 10
+        assert scores["w0"] < 3
+
+    def test_fewer_than_three_tasks_score_zero(self):
+        assert obs_health.mad_scores({"a": 1.0, "b": 100.0}) == {
+            "a": 0.0, "b": 0.0,
+        }
+
+
+class TestHealthMonitor:
+    def _monitor(self, clock, **overrides):
+        cfg = HealthConfig(
+            heartbeat_interval_ms=100, alert_cooldown_ms=10_000,
+            **overrides,
+        )
+        alerts = []
+
+        def emit(**kw):
+            alerts.append(kw)
+
+        return HealthMonitor(cfg, emit=emit, clock=clock), alerts
+
+    def test_straggler_alert_names_slow_task_only(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert [a["task"] for a in alerts
+                if a["detector"] == "straggler"] == ["w:2"]
+        scores = mon.straggler_scores()
+        assert scores["w:2"] > 3.0
+        # faster-than-median tasks never score as stragglers
+        assert scores["w:0"] == 0.0
+
+    def test_progress_stall_watchdog(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, stall_timeout_ms=1000,
+                                    heartbeat_jitter_factor=1000.0)
+        mon.observe("w:0", _snap(counters={"train_steps_total": 5}))
+        clock.advance(0.5)
+        mon.observe("w:0", _snap(counters={"train_steps_total": 6}))
+        clock.advance(1.5)  # no progress, past the timeout
+        mon.observe("w:0", _snap(counters={"train_steps_total": 6}))
+        assert [a["detector"] for a in alerts] == ["progress_stall"]
+        assert mon.to_json()["tasks"]["w:0"]["stalled"] is True
+        # progress clears the stall flag
+        mon.observe("w:0", _snap(counters={"train_steps_total": 7}))
+        assert mon.to_json()["tasks"]["w:0"]["stalled"] is False
+
+    def test_loss_nan_and_spike(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, loss_spike_factor=5.0)
+        for loss in (1.0, 0.9, 0.8, 0.7):
+            mon.observe("w:0", _snap(gauges={"loss": loss}))
+        mon.observe("w:0", _snap(gauges={"loss": 50.0}))  # > 5× median
+        mon.observe("w:1", _snap(gauges={"loss": float("nan")}))
+        detectors = [a["detector"] for a in alerts]
+        assert "loss_spike" in detectors
+        assert "loss_nan" in detectors
+
+    def test_heartbeat_jitter_uses_coordinator_clock(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, heartbeat_jitter_factor=3.0)
+        # Executor-claimed timestamps are irrelevant: only arrival gaps
+        # on OUR clock count.
+        mon.observe("w:0", None)
+        clock.advance(0.1)
+        mon.observe("w:0", None)  # 100ms gap: fine
+        clock.advance(0.9)        # 900ms > 3 × 100ms interval
+        mon.observe("w:0", None)
+        assert [a["detector"] for a in alerts] == ["heartbeat_jitter"]
+        assert alerts[0]["gap_ms"] == pytest.approx(900, abs=1)
+
+    def test_io_stall_ratio(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, io_stall_ratio=0.5,
+                                    heartbeat_jitter_factor=1000.0)
+        h = {"tony_io_queue_wait_ms": {"count": 1, "sum": 0.0,
+                                       "buckets": []}}
+        mon.observe("w:0", _snap(histograms=h))
+        clock.advance(1.0)
+        h2 = {"tony_io_queue_wait_ms": {"count": 5, "sum": 800.0,
+                                        "buckets": []}}
+        mon.observe("w:0", _snap(histograms=h2))  # 800ms wait / 1000ms wall
+        assert [a["detector"] for a in alerts] == ["io_stall"]
+        assert alerts[0]["stall_ratio"] == pytest.approx(0.8)
+
+    def test_cooldown_suppresses_repeat_alerts(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, heartbeat_jitter_factor=1.0)
+        mon.observe("w:0", None)
+        for _ in range(5):
+            clock.advance(1.0)  # every gap is over the limit
+            mon.observe("w:0", None)
+        assert len(alerts) == 1  # cooldown (10s) swallows the repeats
+        clock.advance(11.0)
+        mon.observe("w:0", None)
+        assert len(alerts) == 2
+
+    def test_disabled_monitor_is_inert(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, enabled=False)
+        mon.observe("w:0", _snap(gauges={"loss": float("nan")}))
+        assert alerts == [] and mon.to_json()["tasks"] == {}
+
+    def test_reset_tasks_keeps_alert_history(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock)
+        mon.observe("w:0", _snap(gauges={"loss": float("nan")}))
+        assert len(mon.alerts()) == 1
+        mon.reset_tasks()
+        assert mon.to_json()["tasks"] == {}
+        assert len(mon.alerts()) == 1  # history describes the job
+
+    def test_alert_counter_and_emit_failure_tolerated(self):
+        from tony_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+
+        def explode(**kw):
+            raise OSError("sink gone")
+
+        mon = HealthMonitor(HealthConfig(), emit=explode, registry=reg)
+        mon.observe("w:0", _snap(gauges={"loss": float("nan")}))  # no raise
+        assert reg.snapshot()["counters"][obs_health.ALERTS_COUNTER] == 1
+
+    def test_from_conf(self):
+        conf = TonyConfiguration()
+        conf.set(keys.K_HEALTH_STRAGGLER_THRESHOLD, "2.5")
+        conf.set(keys.K_HEALTH_ENABLED, "false")
+        cfg = HealthConfig.from_conf(conf)
+        assert cfg.straggler_threshold == 2.5
+        assert cfg.enabled is False
+        assert cfg.stall_timeout_ms == 60000  # default
+
+
+# ---------------------------------------------------------------------------
+# flight.py — crash flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_rings_are_bounded(self):
+        fr = FlightRecorder(proc="coordinator", limit=4)
+        for i in range(10):
+            fr.record_rpc("task_executor_heartbeat", task=f"w:{i}")
+            fr.record_event({"kind": "task_scheduled", "i": i})
+        snap = fr.snapshot()
+        assert len(snap["rpcs"]) == 4 and len(snap["events"]) == 4
+        assert snap["rpcs"][-1]["task"] == "w:9"  # newest survives
+
+    def test_record_report_compacts_and_coerces(self):
+        fr = FlightRecorder(proc="executor:w:0")
+        fr.record_report("w:0", {
+            "ts_ms": 7, "gauges": {"loss": 0.5, "step_time_ms": 5.0,
+                                   "irrelevant": 1.0,
+                                   "tokens_per_sec": "x" * 1000},
+            "counters": {"train_steps_total": 3, "other_total": 9},
+        })
+        fr.record_report("w:0", None)  # bare ping: not recorded
+        reports = fr.snapshot()["reports"]
+        assert len(reports) == 1
+        # user-supplied garbage is dropped at the trust boundary, not
+        # copied into the ring (and every future blackbox dump)
+        assert reports[0] == {"ts_ms": 7, "task": "w:0", "loss": 0.5,
+                              "step_time_ms": 5.0,
+                              "train_steps_total": 3}
+
+    def test_dump_atomic_and_json_safe(self, tmp_path):
+        fr = FlightRecorder(proc="coordinator")
+        fr.record_report("w:0", {"gauges": {"loss": float("nan")},
+                                 "ts_ms": 1})
+        path = fr.dump(tmp_path, "task-failure",
+                       name="coordinator-s1-task-failure",
+                       extra={"session": 1})
+        assert path is not None
+        assert path.name == "blackbox-coordinator-s1-task-failure.json"
+        doc = json.loads(path.read_text())  # strictly parseable (NaN→null)
+        assert doc["reason"] == "task-failure"
+        assert doc["session"] == 1
+        assert doc["reports"][0]["loss"] is None
+        assert not list(tmp_path.glob(".*tmp*"))  # no torn temp left
+
+    def test_dump_sanitizes_names(self, tmp_path):
+        fr = FlightRecorder(proc="executor:worker:1")
+        path = fr.dump(tmp_path, "x", name="executor-worker:1/s1")
+        assert path is not None and ":" not in path.name
+        assert "/" not in path.name.replace(str(tmp_path), "")
+
+    def test_find_blackboxes(self, tmp_path):
+        (tmp_path / "blackbox-a.json").write_text("{}")
+        (tmp_path / "logs").mkdir()
+        (tmp_path / "logs" / "blackbox-b.json").write_text("{}")
+        (tmp_path / "not-a-blackbox.json").write_text("{}")
+        found = find_blackboxes(tmp_path, tmp_path / "logs",
+                                tmp_path / "missing", None)
+        assert [p.name for p in found] == ["blackbox-a.json",
+                                           "blackbox-b.json"]
+
+
+# ---------------------------------------------------------------------------
+# aggregator under many tasks + clock skew (satellite), health wiring
+# ---------------------------------------------------------------------------
+class TestAggregatorScale:
+    def test_many_tasks_bounded_memory(self):
+        agg = MetricsAggregator(series_limit=16)
+        for t in range(50):
+            for i in range(40):
+                agg.ingest(f"w:{t}", {
+                    "ts_ms": i, "counters": {},
+                    "gauges": {"loss": float(i), "lr": 0.1},
+                    "histograms": {},
+                })
+        data = agg.to_json()
+        assert len(data["tasks"]) == 50
+        assert len(data["series"]) == 100  # 50 tasks × 2 gauges
+        for points in data["series"].values():
+            assert len(points) <= 16
+        assert data["heartbeats"]["w:0"] == 40
+
+    def test_skewed_clock_keeps_series_monotonic(self):
+        """An executor whose wall clock steps backwards must not
+        interleave out-of-order points into the per-task series."""
+        agg = MetricsAggregator()
+        for ts in (100, 50, 150, 150, 149, 200):
+            agg.ingest("w:0", {
+                "ts_ms": ts, "counters": {},
+                "gauges": {"loss": float(ts)}, "histograms": {},
+            })
+        series = agg.to_json()["series"]["w:0:loss"]
+        stamps = [ts for ts, _ in series]
+        assert stamps == [100, 150, 200]
+        assert stamps == sorted(stamps)
+
+    def test_non_numeric_ts_falls_back_to_coordinator_clock(self):
+        agg = MetricsAggregator()
+        agg.ingest("w:0", {"ts_ms": "yesterday", "counters": {},
+                           "gauges": {"loss": 1.0}, "histograms": {}})
+        ((ts, _),) = agg.to_json()["series"]["w:0:loss"]
+        assert isinstance(ts, int) and ts > 0
+
+    def test_health_fed_and_rendered(self):
+        mon = HealthMonitor(HealthConfig(heartbeat_interval_ms=100))
+        agg = MetricsAggregator(health=mon)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 80.0)):
+            agg.ingest(tid, _snap(gauges={"step_time_ms": st}))
+        text = agg.prometheus_text()
+        assert '# TYPE tony_task_straggler_score gauge' in text
+        m = re.search(
+            r'tony_task_straggler_score\{task="w:2"\} ([0-9.]+)', text
+        )
+        assert m and float(m.group(1)) > 3.0
+        assert 'tony_task_straggler_score{task="w:0"} 0' in text
+
+    def test_http_health_and_events_cursor(self):
+        mon = HealthMonitor(HealthConfig())
+        agg = MetricsAggregator(health=mon)
+        agg.ingest("w:0", _snap(gauges={"loss": float("nan")}))
+        events = obs_events.EventLog()
+        events.emit(obs_events.TASK_REGISTERED, task="w:0")
+        events.emit(obs_events.TASK_FINISHED, task="w:0", exit_code=0)
+        server = ObservabilityHttpServer(agg, events=events,
+                                         host="127.0.0.1")
+        port = server.serve_background()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/api/health").read()
+            )
+            assert health["alerts"][0]["detector"] == "loss_nan"
+            assert "w:0" in health["tasks"]
+            # cursorless: the plain list (back-compat)
+            plain = json.loads(
+                urllib.request.urlopen(f"{base}/api/events").read()
+            )
+            assert isinstance(plain, list) and len(plain) == 2
+            # cursor form: suffix + resume point
+            tail = json.loads(urllib.request.urlopen(
+                f"{base}/api/events?cursor=1"
+            ).read())
+            assert tail["cursor"] == 2
+            assert [e["kind"] for e in tail["events"]] == ["task_finished"]
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# events.jsonl hardening (satellite)
+# ---------------------------------------------------------------------------
+class TestEventsHardening:
+    def test_sink_appends_whole_lines(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        sink = obs_events.jsonl_file_sink(path)
+        sink({"kind": "a"})
+        sink({"kind": "b", "task": "w:0"})
+        lines = path.read_text().splitlines()
+        assert [json.loads(x)["kind"] for x in lines] == ["a", "b"]
+
+    def test_reader_and_doctor_skip_torn_tail(self, tmp_path):
+        """A coordinator SIGKILLed mid-append leaves a truncated last
+        line; the history reader and `tony doctor` must surface the
+        rest of the timeline instead of raising."""
+        from tony_tpu.history.reader import job_events
+        from tony_tpu.history.writer import setup_job_dir
+
+        job_dir = setup_job_dir(str(tmp_path), "application_torn_1",
+                                int(time.time() * 1000))
+        good = [
+            {"kind": "job_submitted", "ts_ms": 1},
+            {"kind": "task_finished", "task": "w:0", "exit_code": -9,
+             "ts_ms": 2},
+        ]
+        text = "".join(json.dumps(e) + "\n" for e in good)
+        (Path(job_dir) / "events.jsonl").write_text(
+            text + '{"kind": "final_sta'  # torn tail, no newline
+        )
+        events = job_events(str(tmp_path), "application_torn_1")
+        assert [e["kind"] for e in events] == ["job_submitted",
+                                               "task_finished"]
+        findings = postmortem.diagnose(events=events)
+        assert findings and findings[0].rule_id == "TONY-D001"
+        assert findings[0].task == "w:0"
+
+
+# ---------------------------------------------------------------------------
+# postmortem rules (TONY-D catalogue)
+# ---------------------------------------------------------------------------
+class TestPostmortem:
+    def test_signal_kill_ranks_first_and_quotes_evidence(self):
+        events = [
+            {"kind": "session_started", "session": 1},
+            {"kind": "health_alert", "detector": "straggler",
+             "task": "w:1", "reason": "step time 80.0ms vs fleet "
+                                      "median 5.0ms (score 200.0)"},
+            {"kind": "task_finished", "task": "w:1", "exit_code": -9},
+        ]
+        final = {
+            "state": "FAILED",
+            "tasks": [{"id": "w:1", "exit_code": -9}],
+            "stats": {"retries": [{
+                "session": 1, "failure": "task_exit w:1 exit=-9",
+                "category": "INFRA", "retried": False,
+            }]},
+        }
+        findings = postmortem.diagnose(events=events, final=final)
+        top = findings[0]
+        assert top.rule_id == "TONY-D001" and top.task == "w:1"
+        assert "SIGKILL" in top.cause
+        assert any("exit_code=-9" in e for e in top.evidence)
+        # the straggler corroboration is present, ranked below
+        rules = [f.rule_id for f in findings]
+        assert "TONY-D003" in rules
+        assert rules.index("TONY-D001") < rules.index("TONY-D003")
+        # corroborated straggler (same task as the failure) scores higher
+        straggler = next(f for f in findings if f.rule_id == "TONY-D003")
+        assert straggler.score == 65
+
+    def test_user_permanent_beats_signal(self):
+        final = {
+            "state": "FAILED",
+            "tasks": [{"id": "w:0", "exit_code": 127}],
+            "stats": {"retries": [{
+                "failure": "task_exit w:0 exit=127",
+                "category": "USER_PERMANENT",
+                "reason": "deterministic user failure",
+            }]},
+        }
+        findings = postmortem.diagnose(final=final)
+        assert findings[0].rule_id == "TONY-D007"
+        assert "command not found" in " ".join(f.cause for f in findings)
+
+    def test_heartbeat_expiry(self):
+        events = [
+            {"kind": "heartbeat_missed", "task": "w:2", "session": 1},
+            {"kind": "health_alert", "detector": "heartbeat_jitter",
+             "task": "w:2", "reason": "heartbeat gap 900ms exceeds 300ms"},
+        ]
+        findings = postmortem.diagnose(events=events)
+        assert findings[0].rule_id == "TONY-D002"
+        assert findings[0].task == "w:2"
+        assert any("900ms" in e for e in findings[0].evidence)
+
+    def test_rendezvous_rule_tolerates_sessionless_events(self):
+        """Hand-edited / older-version timelines may lack session ids;
+        the doctor must degrade, not traceback."""
+        findings = postmortem.diagnose(
+            events=[{"kind": "session_started"},
+                    {"kind": "task_scheduled", "task": "w:0"}],
+            final={"state": "FAILED"},
+        )
+        assert all(f.rule_id != "TONY-D006" for f in findings)
+
+    def test_rendezvous_timeout(self):
+        events = [
+            {"kind": "session_started", "session": 1},
+            {"kind": "task_scheduled", "task": "w:0", "session": 1},
+            {"kind": "task_scheduled", "task": "w:1", "session": 1},
+            {"kind": "task_registered", "task": "w:0", "session": 1},
+        ]
+        final = {"state": "FAILED"}
+        findings = postmortem.diagnose(events=events, final=final)
+        top = next(f for f in findings if f.rule_id == "TONY-D006")
+        assert "1 of 2 tasks registered" in top.cause
+        assert top.task == "w:1"
+
+    def test_preemption_suppresses_generic_signal_rule(self):
+        final = {
+            "state": "FAILED",
+            "tasks": [{"id": "w:3", "exit_code": -9}],
+            "stats": {"retries": [{
+                "failure": "preemption w:3 exit=-9 "
+                           "backend-reported preemption",
+                "category": "INFRA",
+            }]},
+        }
+        findings = postmortem.diagnose(final=final)
+        rules = [f.rule_id for f in findings]
+        assert rules[0] == "TONY-D008"
+        assert "TONY-D001" not in rules  # not double-reported
+
+    def test_lost_coordinator_reads_blackbox(self):
+        final = {"state": "FAILED",
+                 "tasks": [{"id": "w:0", "exit_code": 87}]}
+        blackboxes = {"blackbox-executor-w-0-s1.json": {
+            "reason": "lost-coordinator", "task": "w:0",
+            "rpcs": [{"method": "task_executor_heartbeat", "ok": False}] * 5,
+        }}
+        findings = postmortem.diagnose(final=final, blackboxes=blackboxes)
+        assert findings[0].rule_id == "TONY-D009"
+        assert any("5 failed heartbeat send(s)" in e
+                   for e in findings[0].evidence)
+
+    def test_task_id_prefix_does_not_corroborate(self):
+        """'worker:1' must not match inside 'worker:10' when attributing
+        the first failure — the cascade victim must not outrank the
+        root cause."""
+        final = {
+            "state": "FAILED",
+            "tasks": [{"id": "worker:10", "exit_code": -9},
+                      {"id": "worker:1", "exit_code": -15}],
+            "stats": {"retries": [{
+                "failure": "task_exit worker:10 exit=-9",
+                "category": "INFRA",
+            }]},
+        }
+        findings = postmortem.diagnose(final=final)
+        d001 = {f.task: f.score for f in findings
+                if f.rule_id == "TONY-D001"}
+        assert d001["worker:10"] == 80   # the recorded first failure
+        assert d001["worker:1"] == 55    # cascade SIGTERM, demoted
+        assert findings[0].task == "worker:10"
+
+    def test_large_plain_exit_is_not_a_signal(self):
+        """sys.exit(255) (or any unnamed 128+N code) is a plain exit —
+        TONY-D011, not a 'killed by signal 127' misdiagnosis; the shell
+        convention is only trusted for nameable signals (137 = KILL)."""
+        findings = postmortem.diagnose(final={
+            "state": "FAILED",
+            "tasks": [{"id": "w:0", "exit_code": 255}],
+        })
+        assert findings[0].rule_id == "TONY-D011"
+        assert all(f.rule_id != "TONY-D001" for f in findings)
+        findings = postmortem.diagnose(final={
+            "state": "FAILED",
+            "tasks": [{"id": "w:0", "exit_code": 137}],
+        })
+        assert findings[0].rule_id == "TONY-D001"
+        assert "SIGKILL" in findings[0].cause
+
+    def test_timeout_and_empty_inputs(self):
+        final = {"state": "FAILED",
+                 "diagnostics": "application timed out after 1000ms"}
+        findings = postmortem.diagnose(final=final)
+        assert findings[0].rule_id == "TONY-D010"
+        assert postmortem.diagnose() == []
+        report = postmortem.format_report("app_1", [])
+        assert "no adverse findings" in report
+
+    def test_health_view_feeds_io_and_loss_rules(self):
+        health = {"alerts": [
+            {"detector": "io_stall", "task": "w:0",
+             "reason": "input pipeline stalled 80% of the last 1000ms"},
+        ]}
+        findings = postmortem.diagnose(health=health)
+        assert findings[0].rule_id == "TONY-D004"
+
+
+# ---------------------------------------------------------------------------
+# TONY-E001 event-catalogue lint + TONY-M001 declared-name extension
+# ---------------------------------------------------------------------------
+class TestEventCatalogueLint:
+    def test_unknown_literal_kind_flagged(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("log.emit('totally_bogus_kind', task='w:0')\n")
+        findings = check_event_catalogue([bad])
+        assert len(findings) == 1
+        assert findings[0].rule_id == "TONY-E001"
+        assert "totally_bogus_kind" in findings[0].message
+
+    def test_known_constant_and_literal_pass(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text(
+            "from tony_tpu.observability import events as obs_events\n"
+            "log.emit(obs_events.TASK_FINISHED, exit_code=0)\n"
+            "log.emit('health_alert', detector='straggler')\n"
+            "handler.emit(record)\n"  # dynamic arg: ignored
+        )
+        assert check_event_catalogue([ok]) == []
+
+    def test_removed_constant_reference_flagged(self, tmp_path):
+        stale = tmp_path / "stale.py"
+        stale.write_text("self.events.emit(obs_events.NO_SUCH_KIND)\n")
+        findings = check_event_catalogue([stale])
+        assert findings and "NO_SUCH_KIND" in findings[0].message
+
+    def test_undocumented_kind_flagged(self, tmp_path):
+        docs = tmp_path / "DEPLOY.md"
+        docs.write_text("only `job_submitted` documented here")
+        findings = check_event_catalogue([], docs=docs)
+        flagged = {f.message.split("'")[1] for f in findings}
+        assert "health_alert" in flagged
+        assert "job_submitted" not in flagged
+
+    def test_declared_metric_constants_linted(self, tmp_path):
+        from tony_tpu.analysis.metrics_lint import check_metric_names
+
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            'GOOD_COUNTER = "things_total"\n'
+            'BAD_GAUGE = "Not-Snake"\n'
+            'WRONG_COUNTER = "missing_suffix"\n'
+            'UNRELATED = "Whatever This Is"\n'
+            "def f():\n"
+            '    local_GAUGE = "not a metric declaration"\n'
+            "    return local_GAUGE\n"
+        )
+        findings = check_metric_names([mod])
+        msgs = " ".join(f.message for f in findings)
+        assert "Not-Snake" in msgs and "missing_suffix" in msgs
+        assert "Whatever" not in msgs
+        # function-local strings are not declarations, whatever their name
+        assert "not a metric declaration" not in msgs
+        assert len(findings) == 2
+
+    def test_health_float_keys_reject_nonfinite_and_nonpositive(self):
+        from tony_tpu.analysis.config_check import check_config
+
+        conf = TonyConfiguration()
+        conf.set(keys.K_HEALTH_STRAGGLER_THRESHOLD, "nan")
+        conf.set(keys.K_HEALTH_IO_STALL_RATIO, "0")
+        findings = check_config(conf)
+        msgs = " ".join(f.message for f in findings
+                        if f.rule_id == "TONY-C002")
+        assert "straggler-threshold" in msgs and "finite" in msgs
+        assert "io-stall-ratio" in msgs
+        conf2 = TonyConfiguration()
+        conf2.set(keys.K_HEALTH_STRAGGLER_THRESHOLD, "2.5")
+        assert not [f for f in check_config(conf2)
+                    if f.rule_id == "TONY-C002"]
+
+
+# ---------------------------------------------------------------------------
+# tools/profile_step.py writes through $TONY_METRICS_FILE (satellite)
+# ---------------------------------------------------------------------------
+def test_profile_step_registry_publishes_to_metrics_file(
+    tmp_path, monkeypatch,
+):
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    try:
+        import profile_step
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "report.json"
+    monkeypatch.setenv("TONY_METRICS_FILE", str(out))
+    reg = profile_step.make_registry()
+    reg.gauge("profile_device_total_ms").set(12.5)
+    reg.flush()
+    snap = json.loads(out.read_text())
+    assert snap["gauges"]["profile_device_total_ms"] == 12.5
+    # without the env the registry is purely in-memory
+    monkeypatch.delenv("TONY_METRICS_FILE")
+    reg2 = profile_step.make_registry()
+    assert reg2._publish_path is None
+
+
+# ---------------------------------------------------------------------------
+# tony events --follow (cursor tail)
+# ---------------------------------------------------------------------------
+def test_events_follow_live_then_drains_staging(tmp_path, capsys):
+    from tony_tpu.client import cli
+
+    staging = tmp_path / "staging"
+    app_dir = staging / "application_follow_1"
+    app_dir.mkdir(parents=True)
+    events = obs_events.EventLog(
+        sink=obs_events.jsonl_file_sink(app_dir / "events.jsonl")
+    )
+    events.emit(obs_events.JOB_SUBMITTED, app_id="application_follow_1")
+    events.emit(obs_events.SESSION_STARTED, session=1)
+    server = ObservabilityHttpServer(
+        MetricsAggregator(), events=events, host="127.0.0.1"
+    )
+    port = server.serve_background()
+    (app_dir / "coordinator.http").write_text(f"127.0.0.1:{port}\n")
+    try:
+        rc = cli.main([
+            "events", "application_follow_1", "--follow", "--max-polls",
+            "1", "--staging-location", str(staging),
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "job_submitted" in out and "session_started" in out
+    finally:
+        server.stop()
+    # Coordinator gone: --follow drains the staging events.jsonl instead.
+    events.emit(obs_events.FINAL_STATUS, state="SUCCEEDED")
+    rc = cli.main([
+        "events", "application_follow_1", "--follow",
+        "--staging-location", str(staging),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "final_status" in out
+    # --follow --json streams one parseable object per line
+    rc = cli.main([
+        "events", "application_follow_1", "--follow", "--json",
+        "--staging-location", str(staging),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    parsed = [json.loads(line) for line in out.splitlines() if line]
+    assert [e["kind"] for e in parsed][-1] == "final_status"
+
+
+# ---------------------------------------------------------------------------
+# mini-cluster chaos e2e — the acceptance scenario
+# ---------------------------------------------------------------------------
+def test_health_chaos_e2e_straggler_kill_blackbox_doctor(tmp_path, capsys):
+    """Seeded fault plan (delay_heartbeats + kill_task) against a 3-worker
+    jax-free job where worker:1 also reports straggler step times:
+
+    * a nonzero tony_task_straggler_score{task="worker:1"} appears on the
+      live /metrics;
+    * health_alert events land in events.jsonl (persisted to history);
+    * blackbox-*.json dumps are persisted to history;
+    * `tony doctor` names worker:1 / the injected kill in its top-ranked
+      finding."""
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "health_train.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 3)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 150)
+    conf.set(keys.K_HEALTH_HB_JITTER_FACTOR, 2.0)
+    # Subprocess startup (executor spawn + user-process imports) can eat
+    # 10+ seconds on this 1-core box; the fixture reports for ~28s and
+    # the timed kill lands at 20s, leaving a wide live window in which
+    # all three workers are reporting step times.
+    conf.set(keys.K_SHELL_ENV,
+             "STRAGGLER_TASK=worker:1,FIXTURE_STEPS=350,LINGER_S=2.0")
+    conf.set(keys.K_FAULT_PLAN, json.dumps({
+        "seed": 5,
+        "faults": [
+            {"action": "delay_heartbeats", "target": "worker:1",
+             "ms": 500, "count": 4},
+            {"action": "kill_task", "target": "worker:1",
+             "after_ms": 20000},
+        ],
+    }))
+
+    app_id = "application_mini_health1"
+    app_dir = cluster.staging_dir / app_id
+    app_dir.mkdir(parents=True)
+    conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+    coordinator = TonyCoordinator(
+        conf, app_dir, app_id=app_id,
+        backend=LocalProcessBackend(app_dir / "logs"),
+    )
+    result = []
+    t = threading.Thread(
+        target=lambda: result.append(coordinator.run()), daemon=True
+    )
+    cluster._live.append(coordinator)
+    t.start()
+    try:
+        # -- live: a nonzero straggler score for worker:1 on /metrics ----
+        deadline = time.monotonic() + 90
+        addr_file = app_dir / "coordinator.http"
+        while not addr_file.is_file() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert addr_file.is_file(), "coordinator.http never advertised"
+        addr = addr_file.read_text().strip()
+        score = 0.0
+        pattern = re.compile(
+            r'tony_task_straggler_score\{task="worker:1"\} ([0-9.eE+]+)'
+        )
+        while time.monotonic() < deadline:
+            try:
+                text = urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5
+                ).read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            m = pattern.search(text)
+            if m and float(m.group(1)) > 0:
+                score = float(m.group(1))
+                break
+            time.sleep(0.1)
+        assert score > 0, (
+            "tony_task_straggler_score{task=\"worker:1\"} never went "
+            "nonzero on the live /metrics"
+        )
+    finally:
+        t.join(timeout=120)
+    assert result and result[0] is SessionStatus.FAILED, (
+        coordinator.session.diagnostics if coordinator.session else "no run"
+    )
+
+    # -- health_alert events persisted to history ------------------------
+    event_files = list(cluster.history_dir.rglob("events.jsonl"))
+    assert len(event_files) == 1
+    events = obs_events.parse_jsonl(event_files[0].read_text())
+    health_alerts = [e for e in events if e["kind"] == "health_alert"]
+    assert any(a.get("task") == "worker:1"
+               and a.get("detector") == "straggler"
+               for a in health_alerts), health_alerts
+    # the injected heartbeat delays register as jitter on the
+    # coordinator's clock
+    assert any(a.get("detector") == "heartbeat_jitter"
+               and a.get("task") == "worker:1"
+               for a in health_alerts), health_alerts
+
+    # -- blackboxes persisted to history ---------------------------------
+    history_blackboxes = [
+        p for p in cluster.history_dir.rglob("blackbox-*.json")
+    ]
+    names = sorted(p.name for p in history_blackboxes)
+    assert any("task-failure" in n for n in names), names
+    assert any("final-status" in n for n in names), names
+    doc = json.loads(next(
+        p for p in history_blackboxes if "task-failure" in p.name
+    ).read_text())
+    assert doc["reason"] == "task-failure"
+    # the ring captured heartbeat frames and per-step reports
+    assert any(r.get("method") == "task_executor_heartbeat"
+               for r in doc["rpcs"])
+    assert any(r.get("task") == "worker:1" for r in doc["reports"])
+    assert doc["health"]["alerts"], "blackbox carries the health state"
+
+    # -- retry record carries the active health alerts -------------------
+    final = json.loads((app_dir / "final-status.json").read_text())
+    retries = final["stats"]["retries"]
+    assert retries and retries[0]["health_alerts"], retries
+    assert any(a["task"] == "worker:1"
+               for a in retries[0]["health_alerts"])
+
+    # -- tony doctor: top-ranked finding names the injected task ---------
+    findings = postmortem.diagnose(
+        events=events, final=final,
+        blackboxes={p.name: json.loads(p.read_text())
+                    for p in history_blackboxes},
+    )
+    assert findings, "doctor found nothing"
+    top = findings[0]
+    assert top.rule_id == "TONY-D001"
+    assert top.task == "worker:1"
+    assert "SIGKILL" in top.cause
+    # straggler corroboration rides along, ranked below the kill
+    assert any(f.rule_id == "TONY-D003" and f.task == "worker:1"
+               for f in findings)
+
+    from tony_tpu.client import cli
+
+    rc = cli.main([
+        "doctor", app_id, "--staging-location", str(cluster.staging_dir),
+        "--history-location", str(cluster.history_dir),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    first_finding = next(line for line in out.splitlines()
+                         if line.startswith("#1"))
+    assert "TONY-D001" in first_finding and "worker:1" in first_finding
+
+
+def test_executor_blackbox_on_user_exit_e2e(tmp_path, capsys):
+    """A user script that exits nonzero leaves an executor blackbox in
+    the scratch dir; the coordinator persists it to history and the
+    per-job Diagnosis panel renders the postmortem."""
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "exit_1.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.FAILED
+    logs_boxes = list((coord.app_dir / "logs").glob("blackbox-*.json"))
+    assert len(logs_boxes) == 1
+    doc = json.loads(logs_boxes[0].read_text())
+    assert doc["reason"] == "user-exit-1"
+    assert doc["proc"].startswith("executor:worker:0")
+    # persisted to history alongside the coordinator's dumps
+    hist_names = sorted(
+        p.name for p in cluster.history_dir.rglob("blackbox-*.json")
+    )
+    assert logs_boxes[0].name in hist_names
+    assert any("coordinator" in n for n in hist_names)
+
+    # reader surfaces them; the history server renders a Diagnosis panel
+    from tony_tpu.history.reader import job_blackboxes
+    from tony_tpu.history.server import HistoryServer
+
+    boxes = job_blackboxes(cluster.history_dir, coord.app_id)
+    assert boxes and logs_boxes[0].name in boxes
+    server = HistoryServer(str(cluster.history_dir), port=0)
+    port = server.serve_background()
+    try:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/job/{coord.app_id}", timeout=5
+        ).read().decode()
+        assert "Diagnosis" in page
+    finally:
+        server.stop()

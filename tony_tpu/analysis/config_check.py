@@ -21,6 +21,7 @@ slice before failing). Every check here is pure and client-side:
 from __future__ import annotations
 
 import difflib
+import math
 import re
 
 from tony_tpu import constants
@@ -54,11 +55,23 @@ _ENUM_KEYS: dict[str, tuple[str, ...]] = {
 
 # Integer keys where 0 is not a legal value (the generic int rule only
 # requires >= 0): the data-plane pipeline needs at least one in-flight
-# transfer, one read worker, and one record per chunk.
+# transfer, one read worker, and one record per chunk; a flight
+# recorder with no ring slots records nothing and would dump empty
+# blackboxes.
 _MIN_ONE_KEYS = frozenset({
     keys.K_IO_PREFETCH_DEPTH,
     keys.K_IO_READ_WORKERS,
     keys.K_IO_CHUNK_RECORDS,
+    keys.K_HEALTH_FLIGHT_LIMIT,
+})
+
+# Float keys that must be strictly positive: a zero straggler threshold
+# or jitter factor would alert on every heartbeat of a healthy fleet.
+_POSITIVE_FLOAT_KEYS = frozenset({
+    keys.K_HEALTH_STRAGGLER_THRESHOLD,
+    keys.K_HEALTH_LOSS_SPIKE_FACTOR,
+    keys.K_HEALTH_HB_JITTER_FACTOR,
+    keys.K_HEALTH_IO_STALL_RATIO,
 })
 
 _TRUE_FALSE = frozenset(
@@ -127,6 +140,23 @@ def _check_value(key: str, value, default) -> str | None:
         floor = 1 if key in _MIN_ONE_KEYS else 0
         if int(value) < floor:
             return f"must be >= {floor}; got {value!r}"
+        return None
+    if isinstance(default, float):
+        if value == "" or value is None:
+            return None  # empty = take the default (get_float contract)
+        try:
+            f = float(value)
+        except (TypeError, ValueError):
+            return f"must be a number; got {value!r}"
+        if not math.isfinite(f):
+            # nan compares False against every threshold — a detector
+            # configured with it never fires, silently.
+            return f"must be a finite number; got {value!r}"
+        if key in _POSITIVE_FLOAT_KEYS:
+            if f <= 0:
+                return f"must be > 0; got {value!r}"
+        elif f < 0:
+            return f"must be >= 0; got {value!r}"
         return None
     return None
 

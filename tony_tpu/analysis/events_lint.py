@@ -1,0 +1,116 @@
+"""TONY-E001: event-catalogue drift check.
+
+The lifecycle timeline has three consumer families (history server,
+``tony events``, ``tony doctor``'s rule catalogue) that all key on the
+``kind`` field; an emitter inventing a kind the catalogue doesn't know
+silently produces timeline rows no tooling interprets. This lint keeps
+the catalogue closed both ways:
+
+* every statically-visible ``<log>.emit(...)`` call in the tree must
+  use a kind registered in ``observability.events.KNOWN_KINDS`` — as a
+  string literal or an ``obs_events.CONSTANT`` reference (a reference
+  to a constant that no longer exists is flagged too);
+* every registered kind must be documented in docs/DEPLOY.md, so the
+  operator-facing event table cannot rot.
+
+Run from ``tools/lint_self.py`` (tier-1), same as the config-parity,
+protocol, and TONY-M001 checks.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tony_tpu.analysis.findings import ERROR, Finding
+from tony_tpu.observability import events as events_mod
+
+RULE = "TONY-E001"
+
+# Module aliases under which emitters reference event constants
+# (``from tony_tpu.observability import events as obs_events`` is the
+# house style; plain ``events`` appears in tests/utilities).
+_EVENT_MODULE_NAMES = {"obs_events", "events", "events_mod"}
+
+
+def _emitted_kinds(tree: ast.AST):
+    """Yield (kind | None, ref_name | None, line) for each
+    statically-visible ``.emit(<arg>, ...)`` call: a literal kind, or a
+    constant reference to resolve, or neither (dynamic — skipped by the
+    caller)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "emit"):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield arg.value, None, node.lineno
+        elif (
+            isinstance(arg, ast.Attribute)
+            and isinstance(arg.value, ast.Name)
+            and arg.value.id in _EVENT_MODULE_NAMES
+        ):
+            yield None, arg.attr, node.lineno
+
+
+def check_event_catalogue(
+    paths: "list[str | Path]", docs: "str | Path | None" = None,
+) -> "list[Finding]":
+    """Lint every emit site across ``paths`` (files or directories,
+    scanned recursively for ``*.py``); with ``docs``, additionally
+    require every registered kind to appear in that document."""
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+
+    findings: list[Finding] = []
+    for path in files:
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except (SyntaxError, ValueError, OSError):
+            continue  # script_lint owns reporting unparseable sources
+        for kind, ref, line in _emitted_kinds(tree):
+            if ref is not None:
+                kind = getattr(events_mod, ref, None)
+                if not isinstance(kind, str):
+                    findings.append(Finding(
+                        RULE, ERROR,
+                        f"emit references unknown event constant "
+                        f"`events.{ref}`",
+                        file=str(path), line=line,
+                    ))
+                    continue
+            if kind not in events_mod.KNOWN_KINDS:
+                findings.append(Finding(
+                    RULE, ERROR,
+                    f"event kind {kind!r} is not registered in "
+                    f"observability.events.KNOWN_KINDS",
+                    file=str(path), line=line,
+                    suggestion="add a constant + KNOWN_KINDS entry and "
+                               "document it in docs/DEPLOY.md",
+                ))
+
+    if docs is not None:
+        doc_path = Path(docs)
+        try:
+            text = doc_path.read_text()
+        except OSError:
+            text = ""
+        for kind in sorted(events_mod.KNOWN_KINDS):
+            # Strictly the backticked form: a bare-substring hit inside
+            # unrelated prose or another identifier must not count as
+            # documentation.
+            if f"`{kind}`" not in text:
+                findings.append(Finding(
+                    RULE, ERROR,
+                    f"registered event kind {kind!r} is not documented "
+                    f"in {doc_path.name}",
+                    file=str(doc_path),
+                ))
+    return findings

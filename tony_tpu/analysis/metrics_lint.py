@@ -36,10 +36,37 @@ _REGISTER_ATTRS = {"counter": "counter", "gauge": "gauge",
 # report() keywords become gauges, minus the step driver.
 _REPORT_SKIP_KWARGS = {"step"}
 
+# Declared-name convention: a module-level string constant whose name
+# ends in one of these suffixes IS a metric name (served via a render
+# path rather than a registry call — the aggregator's per-task
+# HEARTBEAT_COUNTER and the health monitor's STRAGGLER_GAUGE). The
+# suffix declares the kind, so render-only names obey TONY-M001 too.
+_DECL_SUFFIX_KINDS = {
+    "_COUNTER": "counter",
+    "_GAUGE": "gauge",
+    "_HISTOGRAM": "histogram",
+}
+
 
 def _iter_registrations(tree: ast.AST, file: str):
     """Yield (name, kind, file, line) for every statically-visible
     registration in one parsed module."""
+    # Declared names are matched at MODULE level only (tree.body): a
+    # function-local string that happens to end in _GAUGE is not a
+    # metric declaration.
+    for node in getattr(tree, "body", []):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            var = node.targets[0].id
+            for suffix, kind in _DECL_SUFFIX_KINDS.items():
+                if var.endswith(suffix):
+                    yield (node.value.value, kind, file, node.lineno)
+                    break
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue

@@ -1,0 +1,518 @@
+"""``tony doctor`` — postmortem root-cause diagnosis.
+
+A small, auditable rule catalogue (TONY-D001..) over every artifact a
+job leaves behind: the lifecycle timeline (``events.jsonl``), the
+terminal record (``final-status.json``), the crash flight recorder's
+``blackbox-*.json`` dumps, and (for live jobs) the coordinator's
+``/api/health`` view. Each rule fires zero or more findings with a
+confidence score and quoted evidence lines; ``diagnose`` ranks them
+so the first finding answers "why did my job die / why is it slow".
+
+Consumers: the ``tony doctor <app_id>`` CLI subcommand
+(``client/cli.py``), and the history server's per-job "Diagnosis"
+panel. All inputs are optional — the doctor degrades gracefully to
+whatever survived the crash.
+
+Rule catalogue (documented in docs/DEPLOY.md):
+
+=========  ==============================================================
+TONY-D001  task killed by signal (SIGKILL/SIGTERM — preemption, OOM
+           reaper, external kill)
+TONY-D002  heartbeat expiry: task went silent (hung host / partition)
+TONY-D003  straggler: a task's step time is a robust-z outlier vs fleet
+TONY-D004  input-pipeline stall: the chip waited on data
+TONY-D005  loss went non-finite / spiked (numeric divergence)
+TONY-D006  rendezvous timeout: the gang barrier never released
+TONY-D007  deterministic user failure (bad command/path, pre-rendezvous
+           exit, USER_PERMANENT classification)
+TONY-D008  backend-reported slice preemption
+TONY-D009  executor lost the coordinator (exit 87 — control-plane
+           partition)
+TONY-D010  application timeout
+TONY-D011  task exited nonzero with no more specific cause (generic)
+=========  ==============================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping
+
+_SIGNAMES = {
+    1: "SIGHUP", 2: "SIGINT", 6: "SIGABRT", 9: "SIGKILL",
+    11: "SIGSEGV", 15: "SIGTERM",
+}
+
+# Exit codes with dedicated meanings (mirrors resilience/classifier.py).
+_EXIT_LOST_COORDINATOR = 87
+_USER_EXIT_CODES = (126, 127)
+
+
+@dataclass(frozen=True)
+class DoctorFinding:
+    """One ranked root-cause hypothesis."""
+
+    rule_id: str
+    score: int                  # 0-100 relative confidence
+    cause: str                  # one-line human statement
+    task: str | None = None
+    evidence: tuple = field(default_factory=tuple)
+
+    def render(self) -> str:
+        head = f"[{self.rule_id}] {self.cause}  (score {self.score})"
+        lines = [head]
+        lines.extend(f"    evidence: {e}" for e in self.evidence)
+        return "\n".join(lines)
+
+
+@dataclass
+class _Ctx:
+    events: "list[dict]"
+    final: "dict | None"
+    blackboxes: "dict[str, dict]"
+    health: "dict | None"
+
+    def events_of(self, kind: str) -> "list[dict]":
+        return [e for e in self.events if e.get("kind") == kind]
+
+    def alerts(self, detector: str) -> "list[dict]":
+        """health_alert evidence for one detector, merged from the
+        timeline, the live health view, and the terminal record."""
+        out = [
+            e for e in self.events_of("health_alert")
+            if e.get("detector") == detector
+        ]
+        pools: list[Iterable] = []
+        if isinstance(self.health, Mapping):
+            pools.append(self.health.get("alerts") or [])
+        if isinstance(self.final, Mapping):
+            pools.append(
+                (self.final.get("health") or {}).get("alerts") or []
+            )
+        seen = {(a.get("task"), a.get("reason")) for a in out}
+        for pool in pools:
+            for a in pool:
+                if not isinstance(a, Mapping):
+                    continue
+                if a.get("detector") != detector:
+                    continue
+                key = (a.get("task"), a.get("reason"))
+                if key not in seen:
+                    seen.add(key)
+                    out.append(dict(a))
+        return out
+
+    def first_failures(self) -> "list[dict]":
+        """stats.retries from final-status: one classified record per
+        failed session — the coordinator's own first-failure view."""
+        if not isinstance(self.final, Mapping):
+            return []
+        retries = (self.final.get("stats") or {}).get("retries")
+        return [r for r in retries or [] if isinstance(r, Mapping)]
+
+    def failed_tasks(self) -> "list[tuple[str, int]]":
+        """(task, exit_code) for every nonzero task exit, from the
+        timeline first, the terminal record as fallback."""
+        out: list[tuple[str, int]] = []
+        seen: set[str] = set()
+        for e in self.events_of("task_finished"):
+            code = e.get("exit_code")
+            if isinstance(code, int) and code != 0 and e.get("task"):
+                out.append((e["task"], code))
+                seen.add(e["task"])
+        if isinstance(self.final, Mapping):
+            for t in self.final.get("tasks") or []:
+                if not isinstance(t, Mapping):
+                    continue
+                code = t.get("exit_code")
+                if (isinstance(code, int) and code != 0
+                        and t.get("id") and t["id"] not in seen):
+                    out.append((t["id"], code))
+        return out
+
+
+def _signal_of(code: int) -> "int | None":
+    """The signal behind a task exit code, or None for a plain exit.
+    Negative codes are Popen-reported signal deaths; the 128+N shell
+    convention (how `bash -c` and the executor's own 128+signum exit
+    surface an in-container signal) is only trusted for signals we can
+    name — sys.exit(255) must not be diagnosed as 'signal 127'."""
+    if code < 0:
+        return -code
+    if code > 128 and (code - 128) in _SIGNAMES:
+        return code - 128
+    return None
+
+
+def _mentions_task(text: str, task: "str | None") -> bool:
+    """Whole-token task match: 'worker:1' must not match inside
+    'worker:10' (failure descriptions are space-joined tokens)."""
+    return task is not None and task in str(text).split()
+
+
+def _fmt_event(e: Mapping[str, Any]) -> str:
+    parts = [f"{k}={e[k]}" for k in ("kind", "task", "session", "exit_code",
+                                     "detector", "reason", "category")
+             if e.get(k) is not None]
+    return "events.jsonl: " + " ".join(str(p) for p in parts)[:200]
+
+
+def _corroborated(ctx: _Ctx, task: "str | None") -> bool:
+    """Did the terminal failure involve this task? Corroborated findings
+    outrank free-floating ones."""
+    if task is None:
+        return False
+    for r in ctx.first_failures():
+        if _mentions_task(r.get("failure", ""), task):
+            return True
+    return any(t == task for t, _ in ctx.failed_tasks())
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+def _rule_signal_kill(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    preempted = {
+        t for t, _ in ctx.failed_tasks()
+        if any("preemption" in str(r.get("failure", ""))
+               and _mentions_task(r.get("failure", ""), t)
+               for r in ctx.first_failures())
+    }
+    for task, code in ctx.failed_tasks():
+        sig = _signal_of(code)
+        if sig is None or task in preempted:
+            continue
+        name = _SIGNAMES.get(sig, f"signal {sig}")
+        hint = ("likely preemption, the OOM killer, or an external kill"
+                if sig == 9 else "external termination")
+        evidence = [f"task_finished: {task} exit_code={code} "
+                    f"({name})"]
+        for r in ctx.first_failures():
+            if _mentions_task(r.get("failure", ""), task):
+                evidence.append(
+                    f"final-status stats.retries: {r.get('failure')} "
+                    f"-> {r.get('category')}"
+                )
+        # The session's recorded FIRST failure outranks cascade kills
+        # (teardown SIGTERMs the survivors — they died because the
+        # session ended, not the other way around). With no terminal
+        # record to consult, every signal death scores alike.
+        first = [str(r.get("failure", "")) for r in ctx.first_failures()]
+        score = (55 if first
+                 and not any(_mentions_task(f, task) for f in first)
+                 else 80)
+        findings.append(DoctorFinding(
+            "TONY-D001", score, f"{task} was killed by {name} — {hint}",
+            task=task, evidence=tuple(evidence[:4]),
+        ))
+    return findings
+
+
+def _rule_heartbeat_expiry(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    for e in ctx.events_of("heartbeat_missed"):
+        task = e.get("task")
+        evidence = [_fmt_event(e)]
+        evidence.extend(
+            f"health: {a.get('reason')}"
+            for a in ctx.alerts("heartbeat_jitter")
+            if a.get("task") == task
+        )
+        findings.append(DoctorFinding(
+            "TONY-D002", 78,
+            f"{task} stopped heartbeating — hung host or network "
+            f"partition (the whole gang stalls on its collectives)",
+            task=task, evidence=tuple(evidence[:4]),
+        ))
+    return findings
+
+
+def _rule_straggler(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    seen: set[str] = set()
+    for a in ctx.alerts("straggler"):
+        task = a.get("task")
+        if task in seen:
+            continue
+        seen.add(task)
+        score = 65 if _corroborated(ctx, task) else 45
+        reason = a.get("reason") or "step time is a fleet outlier"
+        findings.append(DoctorFinding(
+            "TONY-D003", score,
+            f"{task} is a straggler — {reason}",
+            task=task,
+            evidence=(f"health_alert: {reason}",),
+        ))
+    return findings
+
+
+def _rule_io_stall(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    seen: set[str] = set()
+    for a in ctx.alerts("io_stall"):
+        task = a.get("task")
+        if task in seen:
+            continue
+        seen.add(task)
+        findings.append(DoctorFinding(
+            "TONY-D004", 40,
+            f"input pipeline stall on {task} — the step waited on data "
+            f"(raise tony.io.read-workers / prefetch-depth, or move "
+            f"storage closer)",
+            task=task,
+            evidence=(f"health_alert: {a.get('reason')}",),
+        ))
+    return findings
+
+
+def _rule_loss(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    for detector, score, what in (
+        ("loss_nan", 60, "went non-finite"),
+        ("loss_spike", 35, "spiked"),
+    ):
+        for a in ctx.alerts(detector)[:1]:
+            task = a.get("task")
+            evidence = [f"health_alert: {a.get('reason')}"]
+            if detector == "loss_nan" and isinstance(ctx.final, Mapping):
+                snap = ((ctx.final.get("metrics") or {})
+                        .get("tasks") or {}).get(task) or {}
+                if (snap.get("gauges") or {}).get("loss", 0.0) is None:
+                    evidence.append(
+                        f"final-status metrics: {task} loss=null "
+                        f"(non-finite)"
+                    )
+            findings.append(DoctorFinding(
+                "TONY-D005", score,
+                f"loss {what} on {task} — numeric divergence (check LR "
+                f"schedule, data corruption, or mixed-precision range)",
+                task=task, evidence=tuple(evidence),
+            ))
+    return findings
+
+
+def _rule_rendezvous(ctx: _Ctx) -> "list[DoctorFinding]":
+    state = (ctx.final or {}).get("state")
+    if state not in ("FAILED", "KILLED"):
+        return []
+    sessions = {
+        e.get("session") for e in ctx.events_of("session_started")
+        if isinstance(e.get("session"), int)
+    }
+    if not sessions:
+        return []
+    last = max(sessions)
+    released = any(
+        e.get("session") == last
+        for e in ctx.events_of("rendezvous_released")
+    )
+    scheduled = [e for e in ctx.events_of("task_scheduled")
+                 if e.get("session") == last]
+    if released or not scheduled:
+        return []
+    registered = {
+        e.get("task") for e in ctx.events_of("task_registered")
+        if e.get("session") == last
+    }
+    missing = sorted(
+        {e.get("task") for e in scheduled} - registered
+    )
+    return [DoctorFinding(
+        "TONY-D006", 70,
+        f"gang rendezvous never completed in session {last}: "
+        f"{len(registered)} of {len(scheduled)} tasks registered"
+        + (f" (missing: {', '.join(str(m) for m in missing[:4])})"
+           if missing else ""),
+        task=missing[0] if missing else None,
+        evidence=(
+            f"{len(scheduled)} task_scheduled vs "
+            f"{len(registered)} task_registered in session {last}, "
+            f"no rendezvous_released",
+        ),
+    )]
+
+
+def _rule_user_permanent(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    for r in ctx.first_failures():
+        if r.get("category") != "USER_PERMANENT":
+            continue
+        failure = str(r.get("failure", ""))
+        findings.append(DoctorFinding(
+            "TONY-D007", 85,
+            f"deterministic user failure — {failure or 'setup error'} "
+            f"(bad command/script path, import error, or illegal conf); "
+            f"retrying cannot help",
+            evidence=(f"final-status stats.retries: {failure} -> "
+                      f"USER_PERMANENT ({r.get('reason')})",),
+        ))
+    for task, code in ctx.failed_tasks():
+        if code in _USER_EXIT_CODES:
+            what = ("command not found" if code == 127
+                    else "command not executable")
+            findings.append(DoctorFinding(
+                "TONY-D007", 85,
+                f"{task} exited {code} ({what}) — check "
+                f"tony.application.executes and the python binary path",
+                task=task,
+                evidence=(f"task_finished: {task} exit_code={code}",),
+            ))
+    return findings
+
+
+def _rule_preemption(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    for r in ctx.first_failures():
+        failure = str(r.get("failure", ""))
+        if "preemption" not in failure:
+            continue
+        task = next((t for t, _ in ctx.failed_tasks()
+                     if _mentions_task(failure, t)), None)
+        findings.append(DoctorFinding(
+            "TONY-D008", 85,
+            f"the backend reported slice preemption"
+            + (f" ({task})" if task else "")
+            + " — capacity was reclaimed; retries with checkpoint "
+              "resume are the remedy",
+            task=task,
+            evidence=(f"final-status stats.retries: {failure}",),
+        ))
+    return findings
+
+
+def _rule_lost_coordinator(ctx: _Ctx) -> "list[DoctorFinding]":
+    findings = []
+    for task, code in ctx.failed_tasks():
+        if code != _EXIT_LOST_COORDINATOR:
+            continue
+        evidence = [f"task_finished: {task} exit_code={code} "
+                    f"(EXIT_CODE_LOST_COORDINATOR)"]
+        for name, doc in ctx.blackboxes.items():
+            if doc.get("reason") == "lost-coordinator" \
+                    and doc.get("task") == task:
+                fails = sum(
+                    1 for r in doc.get("rpcs") or []
+                    if r.get("ok") is False
+                )
+                evidence.append(
+                    f"{name}: {fails} failed heartbeat send(s) recorded"
+                )
+        findings.append(DoctorFinding(
+            "TONY-D009", 65,
+            f"{task} lost the coordinator (exit 87) — control-plane "
+            f"partition or coordinator death; the executor reaped its "
+            f"user process rather than squat the slice",
+            task=task, evidence=tuple(evidence[:3]),
+        ))
+    return findings
+
+
+def _rule_plain_exit(ctx: _Ctx) -> "list[DoctorFinding]":
+    """Generic fallback: a nonzero exit nothing more specific claims —
+    still worth naming, with a pointer at the task's own logs and any
+    blackbox the executor left."""
+    findings = []
+    for task, code in ctx.failed_tasks():
+        if (_signal_of(code) is not None
+                or code in _USER_EXIT_CODES
+                or code == _EXIT_LOST_COORDINATOR):
+            continue
+        evidence = [f"task_finished: {task} exit_code={code}"]
+        for name, doc in ctx.blackboxes.items():
+            if (str(doc.get("reason", "")).startswith("user-exit")
+                    and doc.get("task") == task):
+                reports = doc.get("reports") or []
+                if reports:
+                    last = reports[-1]
+                    evidence.append(
+                        f"{name}: last report "
+                        f"step={last.get('train_steps_total')} "
+                        f"loss={last.get('loss')}"
+                    )
+        findings.append(DoctorFinding(
+            "TONY-D011", 50,
+            f"{task} exited {code} — the user process failed on its "
+            f"own; its log (and blackbox, if any) has the traceback",
+            task=task, evidence=tuple(evidence[:3]),
+        ))
+    return findings
+
+
+def _rule_timeout(ctx: _Ctx) -> "list[DoctorFinding]":
+    diag = str((ctx.final or {}).get("diagnostics", ""))
+    if "timed out" not in diag:
+        return []
+    return [DoctorFinding(
+        "TONY-D010", 75,
+        f"the application hit its configured timeout — {diag}",
+        evidence=(f"final-status diagnostics: {diag}",),
+    )]
+
+
+_RULES = (
+    _rule_user_permanent,
+    _rule_preemption,
+    _rule_signal_kill,
+    _rule_heartbeat_expiry,
+    _rule_timeout,
+    _rule_rendezvous,
+    _rule_lost_coordinator,
+    _rule_plain_exit,
+    _rule_loss,
+    _rule_straggler,
+    _rule_io_stall,
+)
+
+
+def diagnose(
+    events: "list[dict] | None" = None,
+    final: "dict | None" = None,
+    blackboxes: "Mapping[str, dict] | None" = None,
+    health: "dict | None" = None,
+) -> "list[DoctorFinding]":
+    """Run the whole catalogue; findings come back ranked (score desc,
+    then rule id for a stable order), deduped per (rule, task)."""
+    ctx = _Ctx(
+        events=list(events or []),
+        final=final if isinstance(final, Mapping) else None,
+        blackboxes=dict(blackboxes or {}),
+        health=health if isinstance(health, Mapping) else None,
+    )
+    findings: list[DoctorFinding] = []
+    seen: set[tuple[str, "str | None"]] = set()
+    for rule in _RULES:
+        for f in rule(ctx):
+            key = (f.rule_id, f.task)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(f)
+    return sorted(findings, key=lambda f: (-f.score, f.rule_id,
+                                           f.task or ""))
+
+
+def format_report(
+    app_id: str,
+    findings: "list[DoctorFinding]",
+    final: "dict | None" = None,
+) -> str:
+    """The ``tony doctor`` console report."""
+    lines = []
+    state = (final or {}).get("state")
+    stats = (final or {}).get("stats") or {}
+    head = f"tony doctor — {app_id}"
+    if state:
+        wall = stats.get("wall_ms")
+        head += f": {state}"
+        if stats.get("sessions_run"):
+            head += f" after {stats['sessions_run']} session(s)"
+        if wall is not None:
+            head += f", {wall / 1000.0:.1f}s wall"
+    lines.append(head)
+    if not findings:
+        lines.append("no adverse findings — the artifacts look healthy")
+        return "\n".join(lines)
+    for rank, f in enumerate(findings, 1):
+        lines.append(f"#{rank} {f.render()}")
+    return "\n".join(lines)

@@ -306,6 +306,16 @@ def _obs_args(argv: list[str], prog: str):
                    help="override tony.history.location (finished jobs)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="print raw JSON instead of a table")
+    if prog == "events":
+        p.add_argument("--follow", action="store_true",
+                       help="tail a LIVE job: poll the coordinator's "
+                            "/api/events with a cursor, print new events "
+                            "as they land, drain the rest when it exits")
+        p.add_argument("--poll-interval", type=float, default=1.0,
+                       help="seconds between polls in --follow mode")
+        p.add_argument("--max-polls", type=int, default=0,
+                       help="stop following after N polls (0 = until the "
+                            "coordinator goes away)")
     return p.parse_args(argv)
 
 
@@ -344,26 +354,101 @@ def _live_coordinator_get(staging: Path, app_id: str, path: str):
         return None
 
 
-def events_cmd(argv: list[str]) -> int:
-    """``cli events <app_id>``: the job's structured lifecycle timeline —
-    live from the coordinator's /api/events, else events.jsonl from the
-    staging app dir, else job history."""
+def _print_event(e: dict) -> None:
+    ts = time.strftime(
+        "%H:%M:%S", time.localtime(e.get("ts_ms", 0) / 1000)
+    )
+    detail = " ".join(
+        f"{k}={v}" for k, v in sorted(e.items())
+        if k not in ("ts_ms", "kind", "task")
+    )
+    task = e.get("task", "")
+    print(f"{ts}  {e.get('kind', '?'):22s} {task:14s} {detail}")
+
+
+def _follow_events(staging: Path, app_id: str, interval_s: float,
+                   max_polls: int, as_json: bool = False) -> int:
+    """Tail a live job's timeline: cursor-poll /api/events, then drain
+    whatever landed in the staging events.jsonl after the coordinator
+    went away (its last events beat the final poll by construction).
+    ``as_json`` streams one JSON object per line instead of the table."""
     import json as _json
 
+    from tony_tpu.observability.events import parse_jsonl
+
+    def show(e: dict) -> None:
+        if as_json:
+            print(_json.dumps(e, sort_keys=True), flush=True)
+        else:
+            _print_event(e)
+
+    cursor = 0
+    polls = 0
+    saw_live = False
+    misses = 0
+    while True:
+        data = _live_coordinator_get(
+            staging, app_id, f"/api/events?cursor={cursor}"
+        )
+        if data is None:
+            # One failed poll is not a dead coordinator: a busy /api
+            # thread or a dropped connection mid-tail must not end a
+            # multi-hour follow. Three consecutive misses (never-live
+            # jobs get one) before declaring it gone.
+            misses += 1
+            if misses >= (3 if saw_live else 1):
+                break
+            time.sleep(interval_s)
+            continue
+        misses = 0
+        saw_live = True
+        for e in data.get("events") or []:
+            show(e)
+        cursor = int(data.get("cursor", cursor))
+        polls += 1
+        if max_polls and polls >= max_polls:
+            return 0
+        time.sleep(interval_s)
+    local = staging / app_id / "events.jsonl"
+    if local.is_file():
+        for e in parse_jsonl(local.read_text())[cursor:]:
+            show(e)
+    elif not saw_live:
+        print(f"no live coordinator (or events.jsonl) for {app_id}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _resolve_events(staging: Path, history: str, app_id: str):
+    """The one events fallback chain every consumer shares: live
+    coordinator /api/events → the staging app dir's incremental
+    events.jsonl → job history. None when all three come up empty."""
     from tony_tpu.history.reader import job_events
     from tony_tpu.observability.events import parse_jsonl
 
-    args = _obs_args(argv, "events")
-    staging, history = _obs_locations(args)
-    events = _live_coordinator_get(staging, args.app_id, "/api/events")
+    events = _live_coordinator_get(staging, app_id, "/api/events")
     if events is None:
-        # A dead-but-unarchived coordinator still left the incremental
-        # events.jsonl in its app dir.
-        local = staging / args.app_id / "events.jsonl"
+        local = staging / app_id / "events.jsonl"
         if local.is_file():
             events = parse_jsonl(local.read_text())
     if events is None and history:
-        events = job_events(history, args.app_id)
+        events = job_events(history, app_id)
+    return events
+
+
+def events_cmd(argv: list[str]) -> int:
+    """``cli events <app_id>``: the job's structured lifecycle timeline —
+    live from the coordinator's /api/events, else events.jsonl from the
+    staging app dir, else job history. ``--follow`` tails a live job."""
+    import json as _json
+
+    args = _obs_args(argv, "events")
+    staging, history = _obs_locations(args)
+    if args.follow:
+        return _follow_events(staging, args.app_id, args.poll_interval,
+                              args.max_polls, as_json=args.as_json)
+    events = _resolve_events(staging, history, args.app_id)
     if events is None:
         print(f"no events found for {args.app_id}", file=sys.stderr)
         return 1
@@ -371,15 +456,7 @@ def events_cmd(argv: list[str]) -> int:
         print(_json.dumps(events, indent=2))
         return 0
     for e in events:
-        ts = time.strftime(
-            "%H:%M:%S", time.localtime(e.get("ts_ms", 0) / 1000)
-        )
-        detail = " ".join(
-            f"{k}={v}" for k, v in sorted(e.items())
-            if k not in ("ts_ms", "kind", "task")
-        )
-        task = e.get("task", "")
-        print(f"{ts}  {e.get('kind', '?'):22s} {task:14s} {detail}")
+        _print_event(e)
     return 0
 
 
@@ -426,6 +503,61 @@ def metrics_cmd(argv: list[str]) -> int:
     return 0
 
 
+def doctor_cmd(argv: list[str]) -> int:
+    """``cli doctor <app_id>``: ranked root-cause postmortem. Gathers
+    every artifact the job left — the lifecycle timeline (live
+    /api/events → staging events.jsonl → history), the terminal record,
+    the blackbox flight-recorder dumps, and the live /api/health view —
+    and runs the TONY-D rule catalogue over them."""
+    import json as _json
+
+    from tony_tpu.analysis.postmortem import diagnose, format_report
+    from tony_tpu.history.reader import job_blackboxes, job_final_status
+
+    args = _obs_args(argv, "doctor")
+    staging, history = _obs_locations(args)
+    app_dir = staging / args.app_id
+
+    health = _live_coordinator_get(staging, args.app_id, "/api/health")
+    events = _resolve_events(staging, history, args.app_id)
+
+    final = None
+    local_final = app_dir / "final-status.json"
+    if local_final.is_file():
+        try:
+            final = _json.loads(local_final.read_text())
+        except ValueError:
+            final = None
+    if final is None and history:
+        final = job_final_status(history, args.app_id)
+
+    from tony_tpu.observability.flight import load_blackboxes
+
+    blackboxes = load_blackboxes(app_dir, app_dir / "logs")
+    if not blackboxes and history:
+        blackboxes = job_blackboxes(history, args.app_id) or {}
+
+    if events is None and final is None and not blackboxes:
+        print(f"no artifacts found for {args.app_id} — nothing to "
+              f"diagnose", file=sys.stderr)
+        return 1
+    findings = diagnose(events=events, final=final,
+                        blackboxes=blackboxes, health=health)
+    if args.as_json:
+        print(_json.dumps({
+            "app_id": args.app_id,
+            "state": (final or {}).get("state"),
+            "findings": [
+                {"rule_id": f.rule_id, "score": f.score, "cause": f.cause,
+                 "task": f.task, "evidence": list(f.evidence)}
+                for f in findings
+            ],
+        }, indent=2))
+        return 0
+    print(format_report(args.app_id, findings, final=final))
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
@@ -435,6 +567,7 @@ SUBMITTERS = {
     "cleanup": cleanup_resources,
     "events": events_cmd,
     "metrics": metrics_cmd,
+    "doctor": doctor_cmd,
 }
 
 

@@ -103,6 +103,12 @@ class TonyConfiguration:
             return default
         return int(v)
 
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._props.get(key)
+        if v is None or v == "":
+            return default
+        return float(v)
+
     def get_bool(self, key: str, default: bool = False) -> bool:
         v = self._props.get(key)
         if v is None or v == "":

@@ -117,6 +117,31 @@ K_IO_READ_WORKERS = IO_PREFIX + "read-workers"
 # Records per prefetch-queue chunk; one read span covers 4 chunks.
 K_IO_CHUNK_RECORDS = IO_PREFIX + "chunk-records"
 
+# --- health analytics (observability/health.py + flight.py) ----------------
+# Streaming detectors fed by the heartbeat piggyback on the coordinator:
+# straggler scoring (MAD z-score across tasks' step_time_ms), stalled
+# train_steps_total watchdog, loss NaN/spike, heartbeat arrival jitter,
+# and data-plane stall (tony_io_queue_wait_ms accumulation rate). Alerts
+# emit `health_alert` lifecycle events and bump tony_health_alerts_total.
+HEALTH_PREFIX = TONY_PREFIX + "health."
+K_HEALTH_ENABLED = HEALTH_PREFIX + "enabled"
+# Robust z-score above which a slow task is flagged a straggler.
+K_HEALTH_STRAGGLER_THRESHOLD = HEALTH_PREFIX + "straggler-threshold"
+# ms without train_steps_total advancing (while still heartbeating)
+# before the progress watchdog alerts; 0 disables.
+K_HEALTH_STALL_TIMEOUT_MS = HEALTH_PREFIX + "stall-timeout"
+# loss > factor × its recent rolling median => spike alert.
+K_HEALTH_LOSS_SPIKE_FACTOR = HEALTH_PREFIX + "loss-spike-factor"
+# heartbeat arrival gap > factor × tony.task.heartbeat-interval => alert.
+K_HEALTH_HB_JITTER_FACTOR = HEALTH_PREFIX + "heartbeat-jitter-factor"
+# input-pipeline queue-wait accumulating faster than ratio × wall time.
+K_HEALTH_IO_STALL_RATIO = HEALTH_PREFIX + "io-stall-ratio"
+# Per-(detector, task) re-alert suppression window, ms.
+K_HEALTH_ALERT_COOLDOWN_MS = HEALTH_PREFIX + "alert-cooldown"
+# Ring size of the crash flight recorder (recent reports / RPC frame
+# summaries / events kept for blackbox-*.json dumps).
+K_HEALTH_FLIGHT_LIMIT = HEALTH_PREFIX + "flight-recorder-limit"
+
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
 # (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
@@ -208,6 +233,14 @@ DEFAULTS: dict[str, object] = {
     K_IO_PREFETCH_DEPTH: 2,
     K_IO_READ_WORKERS: 4,
     K_IO_CHUNK_RECORDS: 256,
+    K_HEALTH_ENABLED: True,
+    K_HEALTH_STRAGGLER_THRESHOLD: 3.0,
+    K_HEALTH_STALL_TIMEOUT_MS: 60000,
+    K_HEALTH_LOSS_SPIKE_FACTOR: 10.0,
+    K_HEALTH_HB_JITTER_FACTOR: 5.0,
+    K_HEALTH_IO_STALL_RATIO: 0.5,
+    K_HEALTH_ALERT_COOLDOWN_MS: 30000,
+    K_HEALTH_FLIGHT_LIMIT: 256,
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",

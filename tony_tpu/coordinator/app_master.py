@@ -42,6 +42,7 @@ from tony_tpu.coordinator.session import (
 from tony_tpu.history import JobMetadata, setup_job_dir
 from tony_tpu.history.writer import (
     create_history_file,
+    write_blackbox_file,
     write_config_file,
     write_events_file,
     write_final_status,
@@ -52,6 +53,12 @@ from tony_tpu.observability import trace as obs_trace
 from tony_tpu.observability.aggregator import (
     MetricsAggregator,
     ObservabilityHttpServer,
+)
+from tony_tpu.observability.flight import FlightRecorder, find_blackboxes
+from tony_tpu.observability.health import (
+    ALERTS_COUNTER,
+    HealthConfig,
+    HealthMonitor,
 )
 from tony_tpu.observability.metrics import MetricsRegistry
 from tony_tpu.resilience import (
@@ -175,10 +182,34 @@ class TonyCoordinator:
         # crashed coordinator still leaves the timeline), and the job's
         # distributed trace (its id rides TONY_TRACE_ID + RPC metadata).
         self.metrics = MetricsRegistry()
-        self.aggregator = MetricsAggregator(registry=self.metrics)
-        self.events = obs_events.EventLog(
-            sink=obs_events.jsonl_file_sink(self.app_dir / "events.jsonl")
+        # Health analytics: streaming detectors (straggler / stall /
+        # loss / jitter / io) fed by the aggregator on every heartbeat;
+        # alerts become health_alert lifecycle events and count into
+        # tony_health_alerts_total.
+        self.health = HealthMonitor(
+            HealthConfig.from_conf(conf),
+            emit=self._emit_health_alert,
+            registry=self.metrics,
         )
+        self.aggregator = MetricsAggregator(
+            registry=self.metrics, health=self.health
+        )
+        # Crash flight recorder: recent per-task reports + RPC frame
+        # summaries + events, dumped as blackbox-*.json on task failure,
+        # retry decision, and final status (persisted into history).
+        self.flight = FlightRecorder(
+            proc="coordinator",
+            limit=conf.get_int(keys.K_HEALTH_FLIGHT_LIMIT, 256),
+        )
+        jsonl_sink = obs_events.jsonl_file_sink(
+            self.app_dir / "events.jsonl"
+        )
+
+        def _event_sink(event: dict) -> None:
+            self.flight.record_event(event)
+            jsonl_sink(event)
+
+        self.events = obs_events.EventLog(sink=_event_sink)
         self.tracer = obs_trace.Tracer(proc="coordinator")
         self.http_server: ObservabilityHttpServer | None = None
         self._rendezvous_released = False
@@ -203,12 +234,58 @@ class TonyCoordinator:
         lo, hi = (int(x) for x in conf.get_str(keys.K_AM_RPC_PORT_RANGE, "10000-15000").split("-"))
         self.rpc_server = ApplicationRpcServer(
             _RpcForClient(self), host="0.0.0.0", port_range=(lo, hi),
-            role_tokens=tokens,
+            role_tokens=tokens, observer=self._on_rpc_frame,
         )
         self.liveness = LivenessMonitor(
             heartbeat_interval_ms=conf.get_int(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000),
             max_missed_heartbeats=conf.get_int(keys.K_TASK_MAX_MISSED_HEARTBEATS, 25),
             on_expired=self._on_task_deemed_dead,
+        )
+
+    # -- health analytics + flight recorder ---------------------------------
+    def _emit_health_alert(
+        self, detector: str, task: str | None, reason: str, **data: Any,
+    ) -> None:
+        """A detector fired: the judgment joins the lifecycle timeline
+        (where `tony doctor`, `tony events --follow`, and the history
+        page read it back)."""
+        self.events.emit(
+            obs_events.HEALTH_ALERT, task=task,
+            session=self.session.session_id if self.session else None,
+            detector=detector, reason=reason, **data,
+        )
+
+    def _on_rpc_frame(self, method: str, ok: bool, args: dict) -> None:
+        """Every dispatched RPC leaves a frame summary in the flight
+        recorder (method + task identity, never payloads). Metric
+        REPORTS are fenced like on_heartbeat fences the aggregator: a
+        dead session's executor still pinging during teardown must not
+        write its stale loss/step values into the blackbox evidence
+        (the frame summary itself stays — stale traffic is evidence
+        too)."""
+        task = args.get("task_id") or args.get("worker")
+        self.flight.record_rpc(method, ok=ok, task=task)
+        if method == "task_executor_heartbeat":
+            session = self.session
+            if session is not None and str(session.session_id) == str(
+                args.get("session_id")
+            ):
+                self.flight.record_report(args.get("task_id", "?"),
+                                          args.get("metrics"))
+
+    def _dump_blackbox(self, trigger: str) -> None:
+        """Atomic blackbox-*.json into the staging app dir; one name per
+        (session, trigger) so a retry loop cannot grow the dir without
+        bound."""
+        session = self.session.session_id if self.session else 0
+        self.flight.dump(
+            self.app_dir, trigger,
+            name=f"coordinator-s{session}-{trigger}",
+            extra={
+                "app_id": self.app_id,
+                "session": session,
+                "health": self.health.to_json(),
+            },
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -358,6 +435,14 @@ class TonyCoordinator:
             )
         else:
             decision = self._retry_policy.decide(category)
+        # The health alerts active at decision time ride the retry
+        # record: "worker:3 was a straggler and then missed heartbeats"
+        # reads very differently from a bare exit code in final-status.
+        active_alerts = [
+            {"detector": a["detector"], "task": a["task"],
+             "reason": a["reason"]}
+            for a in self.health.alerts()[-8:]
+        ]
         self._retry_log.append({
             "session": self._session_seq,
             "failure": event.describe(),
@@ -366,6 +451,7 @@ class TonyCoordinator:
             "backoff_ms": decision.backoff_ms,
             "resume_step": best,
             "reason": decision.reason,
+            "health_alerts": active_alerts,
         })
         if best is not None:
             self.events.emit(obs_events.CHECKPOINT_PROGRESS,
@@ -377,6 +463,10 @@ class TonyCoordinator:
             reason=decision.reason,
         )
         self.metrics.counter("retry_decisions_total").inc()
+        # The retry decision is a flight-recorder moment: the blackbox
+        # records what the coordinator knew (recent reports, frames,
+        # events, health state) when it decided.
+        self._dump_blackbox("retry-decision")
         if decision.retry:
             self._resume_step = best
             log.warning(
@@ -398,9 +488,13 @@ class TonyCoordinator:
 
     def _record_failure(self, event: FailureEvent) -> None:
         """First failure wins: a killed slice takes every collective down
-        with it, and the cascade must not re-classify the root cause."""
+        with it, and the cascade must not re-classify the root cause.
+        The first failure also snapshots the flight recorder — the ring
+        as of NOW is the evidence trail; by final status the cascade has
+        overwritten it."""
         if self._session_failure is None:
             self._session_failure = event
+            self._dump_blackbox("task-failure")
 
     def _run_one_session(self) -> SessionStatus:
         # Fault injection: AM dies on purpose entering the schedule phase
@@ -842,8 +936,12 @@ class TonyCoordinator:
         self.client_signal_to_finish.clear()
         # The next session's /metrics must not serve the dead session's
         # per-task gauges as current (heartbeat totals survive: they are
-        # cumulative across the job).
+        # cumulative across the job). Health streaming state restarts
+        # too — a retried task must not inherit the dead session's
+        # straggler baseline or stall clock (its alert history survives:
+        # it describes the job).
         self.aggregator.reset_tasks()
+        self.health.reset_tasks()
         self._rendezvous_released = False
         if self._rendezvous_span is not None:
             self._rendezvous_span.set(aborted=True)
@@ -887,7 +985,15 @@ class TonyCoordinator:
         final["metrics"] = self.aggregator.summary()
         final["tensorboard_url"] = self.tensorboard_url
         final["trace_id"] = self.tracer.trace_id
+        # Health terminal record: totals + the alert ring, so `tony
+        # doctor` can diagnose from final-status alone when events.jsonl
+        # is gone.
+        final["health"] = {
+            "alerts_total": self.metrics.counter(ALERTS_COUNTER).value,
+            "alerts": self.health.alerts(),
+        }
         self.events.emit(obs_events.FINAL_STATUS, state=status.value)
+        self._dump_blackbox("final-status")
         # A job that died AT the gang barrier leaves the rendezvous span
         # open (_reset only runs between retries) — and that wait is
         # exactly the interval a stalled-rendezvous post-mortem needs, so
@@ -918,6 +1024,14 @@ class TonyCoordinator:
             write_final_status(job_dir, final)
             write_events_file(job_dir, self.events.to_dicts())
             write_trace_file(job_dir, trace_doc)
+            # Every blackbox the job left — the coordinator's own dumps
+            # (app dir) and the executors' (logs dir) — rides into
+            # history for `tony doctor` and the per-job Diagnosis panel.
+            for bb in find_blackboxes(self.app_dir, self.app_dir / "logs"):
+                try:
+                    write_blackbox_file(job_dir, bb.name, bb.read_text())
+                except OSError:
+                    log.warning("could not persist %s", bb, exc_info=True)
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0

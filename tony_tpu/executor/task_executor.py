@@ -23,6 +23,7 @@ from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
 from tony_tpu.observability import metrics as obs_metrics
 from tony_tpu.observability import trace as obs_trace
+from tony_tpu.observability.flight import FlightRecorder
 from tony_tpu.resilience.faults import ExecutorFaults, FaultPlan
 from tony_tpu.rpc.client import ApplicationRpcClient
 
@@ -135,6 +136,7 @@ class Heartbeater(threading.Thread):
         delay_spec: tuple[int, int] | None = None,
         on_lost=_die_lost_coordinator,
         metrics_source=None,
+        on_send=None,
     ):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
@@ -151,6 +153,9 @@ class Heartbeater(threading.Thread):
         self._drop = drop_pings
         self._delay_count, self._delay_ms = delay_spec or (0, 0)
         self._on_lost = on_lost
+        # Flight-recorder tap: called with (ok: bool) after every send
+        # attempt. Must never cost a ping.
+        self._on_send = on_send
         self.consecutive_failures = 0
         # NOT named _stop: threading.Thread has a private _stop METHOD that
         # join() calls when the thread finishes; shadowing it with an Event
@@ -189,14 +194,24 @@ class Heartbeater(threading.Thread):
                         self._task_id, self._session_id
                     )
                 self.consecutive_failures = 0
+                self._note_send(True)
             except Exception:
                 self.consecutive_failures += 1
+                self._note_send(False)
                 log.warning("heartbeat failed (%d consecutive)",
                             self.consecutive_failures)
                 if self.consecutive_failures >= self._max_failures:
                     log.error("lost the coordinator — exiting")
                     self._on_lost()
                     return
+
+    def _note_send(self, ok: bool) -> None:
+        if self._on_send is None:
+            return
+        try:
+            self._on_send(ok)
+        except Exception:
+            log.debug("heartbeat send tap failed", exc_info=True)
 
 
 class TaskExecutor:
@@ -236,6 +251,15 @@ class TaskExecutor:
         # the coordinator merges them into the per-job Chrome trace.
         self.tracer = obs_trace.Tracer(
             proc=f"executor:{self.task_id}"
+        )
+        # Crash flight recorder: the user process's recent published
+        # reports plus heartbeat-send outcomes; dumped as blackbox-*.json
+        # into the scratch dir on a nonzero user exit or the
+        # lost-coordinator path, where the coordinator's stop() persists
+        # it to history.
+        self.flight = FlightRecorder(
+            proc=f"executor:{self.task_id}",
+            limit=self.conf.get_int(keys.K_HEALTH_FLIGHT_LIMIT, 256),
         )
         # Metrics handoff file: the user process publishes its registry
         # snapshot here (we export TONY_METRICS_FILE into its env); the
@@ -298,7 +322,31 @@ class TaskExecutor:
         ping)."""
         if self._metrics_file is None:
             return None
-        return obs_metrics.load_snapshot_file(self._metrics_file)
+        snap = obs_metrics.load_snapshot_file(self._metrics_file)
+        if snap is not None:
+            self.flight.record_report(self.task_id, snap)
+        return snap
+
+    def _dump_blackbox(self, reason: str) -> None:
+        """One blackbox per (executor, session) in the scratch dir —
+        later dumps overwrite earlier ones, so the file count stays
+        bounded however the process dies."""
+        log_dir = os.environ.get(constants.TONY_LOG_DIR)
+        if not log_dir:
+            return
+        self.flight.dump(
+            log_dir, reason,
+            name=(f"executor-{self.job_name}-{self.task_index}"
+                  f"-s{self.session_id}"),
+            extra={"task": self.task_id, "session": self.session_id},
+        )
+
+    def _lost_coordinator(self) -> None:
+        """Heartbeater's on_lost: leave the blackbox (the postmortem's
+        only record of WHEN the sends started failing), then take the
+        standard lost-coordinator exit."""
+        self._dump_blackbox("lost-coordinator")
+        _die_lost_coordinator()
 
     # -- rendezvous (TaskExecutor.registerAndGetClusterSpec:196-213) --------
     def register_and_get_cluster_spec(self) -> dict[str, list[str]]:
@@ -332,6 +380,10 @@ class TaskExecutor:
             drop_pings=self._faults.drop_heartbeats,
             delay_spec=self._faults.delay_heartbeats,
             metrics_source=self._metrics_snapshot,
+            on_lost=self._lost_coordinator,
+            on_send=lambda ok: self.flight.record_rpc(
+                "task_executor_heartbeat", ok=ok, task=self.task_id
+            ),
         )
         self.heartbeater.start()
         retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
@@ -473,6 +525,10 @@ class TaskExecutor:
             )
             up_span.set(exit_code=rc)
         log.info("user process exited with %d", rc)
+        if rc != 0:
+            # The postmortem wants what THIS host saw just before the
+            # failure: the last published reports and heartbeat outcomes.
+            self._dump_blackbox(f"user-exit-{rc}")
         self._flush_trace()
         if self._venv_dir is not None:
             # Per-task venv extractions are scratch; don't litter the host.

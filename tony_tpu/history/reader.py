@@ -145,6 +145,38 @@ def job_trace(history_location: str | Path, app_id: str) -> dict | None:
     return _job_json(history_location, app_id, "trace.json")
 
 
+def job_blackboxes(
+    history_location: str | Path, app_id: str
+) -> "dict[str, dict] | None":
+    """One job's persisted flight-recorder dumps, name -> parsed
+    document; None when the job has none (clean runs dump only the
+    final-status blackbox; pre-health jobs dump nothing). Malformed
+    dumps are skipped — a torn blackbox must not hide the others from
+    the postmortem."""
+    for job_dir in find_job_dirs(history_location):
+        if _dir_name(job_dir) != app_id:
+            continue
+        out: dict[str, dict] = {}
+        try:
+            names = _job_files(job_dir)
+        except OSError:
+            return None
+        for name in sorted(names):
+            if not (name.startswith("blackbox-") and name.endswith(".json")):
+                continue
+            raw = _read_job_file(job_dir, name)
+            if raw is None:
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                out[name] = doc
+        return out or None
+    return None
+
+
 class TtlCache:
     """Tiny TTL cache (CacheWrapper.java:11-40 uses Guava caches so repeat
     page loads don't rescan HDFS; same idea for directory walks)."""

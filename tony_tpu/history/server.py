@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tony_tpu.history.reader import (
     TtlCache,
+    job_blackboxes,
     job_config,
     job_events,
     job_final_status,
@@ -114,6 +115,12 @@ class HistoryHandler(BaseHTTPRequestHandler):
             lambda: job_events(self.history_location, app_id),
         )
 
+    def _blackboxes(self, app_id: str):
+        return self.cache.get_or_load(
+            ("blackboxes", app_id),
+            lambda: job_blackboxes(self.history_location, app_id),
+        )
+
     # -- pages ---------------------------------------------------------------
     def _jobs_page(self) -> str:
         rows = "".join(
@@ -196,6 +203,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
                         f"<td>{esc(t.get('exit_code'))}</td></tr>"
                     )
             parts.append("</table>")
+        parts.extend(self._diagnosis_section(app_id, final, esc))
         parts.extend(self._metrics_section(final, esc))
         parts.extend(self._timeline_section(app_id, esc))
         parts.append(f"<p><a href='/config/{esc(app_id)}'>frozen config</a>"
@@ -204,6 +212,41 @@ class HistoryHandler(BaseHTTPRequestHandler):
         self._send_html(
             _PAGE.format(title=esc(app_id), body="".join(parts))
         )
+
+    def _diagnosis_section(self, app_id: str, final: dict, esc) -> list[str]:
+        """Ranked root-cause findings (``analysis/postmortem``, the same
+        TONY-D catalogue ``tony doctor`` runs) over the persisted
+        artifacts — the "why did it die / why was it slow" panel."""
+        from tony_tpu.analysis.postmortem import diagnose
+
+        try:
+            findings = diagnose(
+                events=self._events(app_id) or [],
+                final=final,
+                blackboxes=self._blackboxes(app_id) or {},
+            )
+        except Exception:  # pragma: no cover - diagnosis never 500s a page
+            log.warning("diagnosis failed for %s", app_id, exc_info=True)
+            return []
+        if not findings:
+            return []
+        parts = ["<h3>Diagnosis</h3><table><tr><th>#</th><th>rule</th>"
+                 "<th>task</th><th>finding</th><th>score</th></tr>"]
+        for rank, f in enumerate(findings[:8], 1):
+            parts.append(
+                f"<tr><td>{rank}</td><td>{esc(f.rule_id)}</td>"
+                f"<td>{esc(f.task or '')}</td><td>{esc(f.cause)}</td>"
+                f"<td>{esc(f.score)}</td></tr>"
+            )
+        parts.append("</table>")
+        top = findings[0]
+        if top.evidence:
+            parts.append(
+                "<p>evidence: "
+                + " · ".join(esc(e) for e in top.evidence[:3])
+                + "</p>"
+            )
+        return parts
 
     def _metrics_section(self, final: dict, esc) -> list[str]:
         """Final aggregated metric summary (final-status ``metrics``): one

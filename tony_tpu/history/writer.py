@@ -162,6 +162,13 @@ def write_events_file(job_dir: "Path | str", events: "list[dict]") -> None:
     )
 
 
+def write_blackbox_file(job_dir: "Path | str", name: str, data: str) -> None:
+    """One crash-flight-recorder dump (``blackbox-*.json``,
+    observability/flight.py) persisted verbatim; the name already
+    carries the producing process and trigger."""
+    _write_job_file(job_dir, name, data)
+
+
 def write_trace_file(job_dir: "Path | str", trace_doc: dict) -> None:
     """The job's merged Chrome trace document (observability/trace.py) —
     loadable directly in chrome://tracing / Perfetto."""

@@ -6,10 +6,15 @@ the aggregator keeps, per task, the latest snapshot plus a bounded
 series of every gauge, and serves:
 
 * ``GET /metrics``      — Prometheus text: the coordinator's own
-  registry unlabeled, every task's snapshot with a ``task`` label, and
-  ``tony_task_heartbeats_total{task=...}`` counted at ingest;
+  registry unlabeled, every task's snapshot with a ``task`` label,
+  ``tony_task_heartbeats_total{task=...}`` counted at ingest, and the
+  health monitor's ``tony_task_straggler_score{task=...}``;
 * ``GET /api/metrics``  — the same data as JSON (latest + series);
-* ``GET /api/events``   — the lifecycle event log;
+* ``GET /api/events``   — the lifecycle event log (``?cursor=N``
+  returns ``{"cursor": total, "events": [N:]}`` for ``tony events
+  --follow`` tailing);
+* ``GET /api/health``   — the streaming health state (straggler
+  scores, per-task liveness, recent alerts);
 * ``GET /api/trace``    — the Chrome trace document so far.
 
 The port comes from ``tony.am.http-port`` (0 = ephemeral, "disabled" =
@@ -38,6 +43,19 @@ from tony_tpu.observability.metrics import (
 log = logging.getLogger(__name__)
 
 HEARTBEAT_COUNTER = "tony_task_heartbeats_total"
+
+
+def _parse_cursor(query: str) -> int | None:
+    """``cursor=N`` from a query string; None when absent/garbage (the
+    plain-list response shape stays for cursorless callers)."""
+    for part in query.split("&"):
+        key, _, value = part.partition("=")
+        if key == "cursor":
+            try:
+                return max(int(value), 0)
+            except ValueError:
+                return None
+    return None
 
 
 def _numeric_family(obj: Any) -> dict[str, float]:
@@ -84,8 +102,10 @@ class MetricsAggregator:
     def __init__(
         self, registry: MetricsRegistry | None = None,
         series_limit: int = 512,
+        health=None,
     ) -> None:
         self.registry = registry or MetricsRegistry()
+        self.health = health  # HealthMonitor fed on every ingest
         self._series_limit = series_limit
         self._lock = threading.Lock()
         self._latest: dict[str, dict[str, Any]] = {}
@@ -96,35 +116,50 @@ class MetricsAggregator:
     def ingest(
         self, task_id: str, snapshot: Mapping[str, Any] | None,
     ) -> None:
+        snap: dict[str, Any] | None = None
         with self._lock:
             self._heartbeats[task_id] = self._heartbeats.get(task_id, 0) + 1
-            if not isinstance(snapshot, Mapping):
-                return
-            # Normalize at the trust boundary: the snapshot comes from an
-            # executor-authenticated RPC peer relaying a user-writable
-            # file, so every family is coerced to a dict HERE — a
-            # malformed {"counters": null} must not crash summary() in
-            # stop() (losing the terminal record) or 500 every /metrics
-            # scrape.
-            snap = {
-                "ts_ms": snapshot.get("ts_ms"),
-                "counters": _numeric_family(snapshot.get("counters")),
-                "gauges": _numeric_family(snapshot.get("gauges")),
-                "histograms": _histogram_family(snapshot.get("histograms")),
-            }
-            if not isinstance(snap["ts_ms"], (int, float)):
-                snap["ts_ms"] = int(time.time() * 1000)
-            self._latest[task_id] = snap
-            ts = snap["ts_ms"]
-            for name, value in snap["gauges"].items():
-                key = (task_id, str(name))
-                series = self._series.get(key)
-                if series is None:
-                    series = self._series[key] = collections.deque(
-                        maxlen=self._series_limit
-                    )
-                if not series or series[-1][0] != ts:
-                    series.append((ts, value))
+            if isinstance(snapshot, Mapping):
+                # Normalize at the trust boundary: the snapshot comes from
+                # an executor-authenticated RPC peer relaying a
+                # user-writable file, so every family is coerced to a dict
+                # HERE — a malformed {"counters": null} must not crash
+                # summary() in stop() (losing the terminal record) or 500
+                # every /metrics scrape.
+                snap = {
+                    "ts_ms": snapshot.get("ts_ms"),
+                    "counters": _numeric_family(snapshot.get("counters")),
+                    "gauges": _numeric_family(snapshot.get("gauges")),
+                    "histograms": _histogram_family(
+                        snapshot.get("histograms")
+                    ),
+                }
+                if not isinstance(snap["ts_ms"], (int, float)):
+                    snap["ts_ms"] = int(time.time() * 1000)
+                self._latest[task_id] = snap
+                ts = snap["ts_ms"]
+                for name, value in snap["gauges"].items():
+                    key = (task_id, str(name))
+                    series = self._series.get(key)
+                    if series is None:
+                        series = self._series[key] = collections.deque(
+                            maxlen=self._series_limit
+                        )
+                    # Strictly monotonic per task: an executor whose wall
+                    # clock stepped backwards (NTP slew, VM migration)
+                    # must not interleave out-of-order points — the
+                    # series is a timeline, and downstream deltas assume
+                    # it reads forward.
+                    if not series or ts > series[-1][0]:
+                        series.append((ts, value))
+        # The health detectors run outside the aggregator lock: they
+        # take their own lock and may emit lifecycle events (file sink
+        # I/O) — neither belongs under the ingest hot path's lock.
+        if self.health is not None:
+            try:
+                self.health.observe(task_id, snap)
+            except Exception:  # pragma: no cover - defensive
+                log.warning("health observe failed", exc_info=True)
 
     def reset_tasks(self) -> None:
         with self._lock:
@@ -148,6 +183,15 @@ class MetricsAggregator:
             parts.append(render_prometheus(
                 latest[task_id], labels={"task": task_id}, types_seen=seen,
             ))
+        if self.health is not None:
+            from tony_tpu.observability.health import STRAGGLER_GAUGE
+
+            scores = self.health.straggler_scores()
+            for task_id in sorted(scores):
+                parts.append(render_prometheus(
+                    {"gauges": {STRAGGLER_GAUGE: scores[task_id]}},
+                    labels={"task": task_id}, types_seen=seen,
+                ))
         return "".join(p for p in parts if p)
 
     def to_json(self) -> dict[str, Any]:
@@ -184,19 +228,36 @@ class _ObsHandler(BaseHTTPRequestHandler):
     aggregator: MetricsAggregator
     events: EventLog | None = None
     tracer: trace_mod.Tracer | None = None
+    health = None
     logs_dir = None
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         try:
-            if self.path == "/metrics":
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
                 self._send(self.aggregator.prometheus_text(),
                            "text/plain; version=0.0.4; charset=utf-8")
-            elif self.path == "/api/metrics":
+            elif path == "/api/metrics":
                 self._send_json(self.aggregator.to_json())
-            elif self.path == "/api/events":
+            elif path == "/api/events":
                 events = self.events.to_dicts() if self.events else []
-                self._send_json(events)
-            elif self.path == "/api/trace":
+                cursor = _parse_cursor(query)
+                if cursor is None:
+                    self._send_json(events)
+                else:
+                    # Tail protocol for `tony events --follow`: the cursor
+                    # is the count already seen; the reply carries only
+                    # the suffix plus the new cursor to resume from.
+                    self._send_json({
+                        "cursor": len(events),
+                        "events": events[cursor:],
+                    })
+            elif path == "/api/health":
+                self._send_json(
+                    self.health.to_json() if self.health is not None
+                    else {"enabled": False, "tasks": {}, "alerts": []}
+                )
+            elif path == "/api/trace":
                 if self.tracer is None:
                     self._send_json({"traceEvents": []})
                 else:
@@ -240,6 +301,7 @@ class ObservabilityHttpServer:
         aggregator: MetricsAggregator,
         events: EventLog | None = None,
         tracer: trace_mod.Tracer | None = None,
+        health=None,
         logs_dir=None,
         host: str = "0.0.0.0",
         port: int = 0,
@@ -247,6 +309,7 @@ class ObservabilityHttpServer:
         handler = type("BoundObsHandler", (_ObsHandler,), {
             "aggregator": aggregator, "events": events,
             "tracer": tracer, "logs_dir": logs_dir,
+            "health": health if health is not None else aggregator.health,
         })
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

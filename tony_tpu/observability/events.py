@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -32,11 +33,35 @@ TASK_REGISTERED = "task_registered"
 RENDEZVOUS_RELEASED = "rendezvous_released"
 TENSORBOARD_REGISTERED = "tensorboard_registered"
 HEARTBEAT_MISSED = "heartbeat_missed"
+HEALTH_ALERT = "health_alert"
 TASK_FINISHED = "task_finished"
 SESSION_FINISHED = "session_finished"
 RETRY_DECISION = "retry_decision"
 CHECKPOINT_PROGRESS = "checkpoint_progress"
 FINAL_STATUS = "final_status"
+
+# The event catalogue: every kind any emitter may use. TONY-E001
+# (analysis/events_lint.py, run from tools/lint_self.py in tier-1)
+# checks that every ``.emit(...)`` in the tree uses a registered kind
+# and that every registered kind is documented in docs/DEPLOY.md — the
+# timeline consumers (history server, ``tony events``, ``tony doctor``)
+# and the emitters cannot drift apart silently.
+KNOWN_KINDS = frozenset({
+    JOB_SUBMITTED,
+    JOB_STAGED,
+    SESSION_STARTED,
+    TASK_SCHEDULED,
+    TASK_REGISTERED,
+    RENDEZVOUS_RELEASED,
+    TENSORBOARD_REGISTERED,
+    HEARTBEAT_MISSED,
+    HEALTH_ALERT,
+    TASK_FINISHED,
+    SESSION_FINISHED,
+    RETRY_DECISION,
+    CHECKPOINT_PROGRESS,
+    FINAL_STATUS,
+})
 
 
 class EventLog:
@@ -99,11 +124,23 @@ class EventLog:
 
 
 def jsonl_file_sink(path) -> Callable[[dict[str, Any]], None]:
-    """A sink appending one JSON line per event to ``path``."""
+    """A sink appending one JSON line per event to ``path``.
+
+    Line-atomic by construction: the whole line goes down in a single
+    ``os.write`` on an O_APPEND descriptor, so a concurrent reader (the
+    live ``tony events`` / ``--follow`` poll, or a crashing coordinator
+    mid-append) sees either the complete line or nothing — the worst
+    artifact a SIGKILL can leave is one torn TAIL line, which
+    ``parse_jsonl`` skips."""
 
     def sink(event: dict[str, Any]) -> None:
-        with open(path, "a") as f:
-            f.write(json.dumps(event, sort_keys=True) + "\n")
+        data = (json.dumps(event, sort_keys=True) + "\n").encode()
+        fd = os.open(str(path), os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
 
     return sink
 

@@ -1,0 +1,189 @@
+"""Crash flight recorder — the last N seconds of a process's life.
+
+Both control-plane processes keep a bounded in-memory ring of what just
+happened — recent per-task metric reports, recent RPC frame summaries,
+recent lifecycle events — and dump it atomically as a
+``blackbox-*.json`` in the job's staging dir at the moments that matter:
+
+* coordinator — first task failure of a session, every retry decision,
+  and final status (``app_master``);
+* executor    — nonzero user-process exit and the lost-coordinator
+  death path (``task_executor``).
+
+The coordinator persists every blackbox it finds (its own plus the
+executors' in ``logs/``) into job history at stop, where the history
+server and ``tony doctor`` read them back. Ring size is
+``tony.health.flight-recorder-limit``; memory stays bounded however
+long the job runs, and the dump is tmp+rename so a crash mid-dump can
+never leave a torn file for the postmortem to choke on.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+from tony_tpu.observability.metrics import json_safe
+
+log = logging.getLogger(__name__)
+
+BLACKBOX_PREFIX = "blackbox-"
+
+# The per-report fields worth replaying in a postmortem (the full
+# snapshot rides /metrics already; the ring keeps the compact trail).
+_REPORT_GAUGES = ("train_step", "loss", "step_time_ms", "tokens_per_sec")
+
+
+def _as_float(value: Any) -> "float | None":
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def _sanitize(part: str) -> str:
+    """Task ids ("worker:1") and reasons become filename-safe."""
+    return "".join(c if c.isalnum() or c in "-_." else "-" for c in part)
+
+
+class FlightRecorder:
+    """Three bounded rings + an atomic dump. Thread-safe: the
+    coordinator records from RPC handler threads, the liveness monitor,
+    and the monitor loop concurrently."""
+
+    def __init__(self, proc: str, limit: int = 256) -> None:
+        self.proc = proc
+        self._limit = max(int(limit), 1)
+        self._lock = threading.Lock()
+        self._reports: collections.deque = collections.deque(maxlen=self._limit)
+        self._rpcs: collections.deque = collections.deque(maxlen=self._limit)
+        self._events: collections.deque = collections.deque(maxlen=self._limit)
+
+    # -- recording -----------------------------------------------------------
+    def record_report(
+        self, task_id: str, snapshot: Mapping[str, Any] | None,
+    ) -> None:
+        """One per-task metrics report (heartbeat piggyback / published
+        snapshot), compacted to the step-trail fields. Values are
+        float-coerced at this trust boundary — the snapshot relays a
+        user-writable file, and a multi-megabyte string in a gauge slot
+        must not occupy the coordinator's ring (×256) and every blackbox
+        dump."""
+        if not isinstance(snapshot, Mapping):
+            return
+        gauges = snapshot.get("gauges")
+        counters = snapshot.get("counters")
+        ts = snapshot.get("ts_ms")
+        entry: dict[str, Any] = {
+            "ts_ms": ts if isinstance(ts, (int, float))
+            else int(time.time() * 1000),
+            "task": str(task_id)[:200],
+        }
+        if isinstance(gauges, Mapping):
+            for name in _REPORT_GAUGES:
+                value = _as_float(gauges.get(name))
+                if value is not None:
+                    entry[name] = value
+        if isinstance(counters, Mapping):
+            steps = _as_float(counters.get("train_steps_total"))
+            if steps is not None:
+                entry["train_steps_total"] = steps
+        with self._lock:
+            self._reports.append(entry)
+
+    def record_rpc(
+        self, method: str, ok: bool = True,
+        task: str | None = None, detail: str | None = None,
+    ) -> None:
+        """One RPC frame summary (never the payload: blackboxes land in
+        browsable history, so they carry frame shapes, not arguments)."""
+        entry: dict[str, Any] = {
+            "ts_ms": int(time.time() * 1000),
+            "method": method,
+            "ok": bool(ok),
+        }
+        if task is not None:
+            entry["task"] = task
+        if detail:
+            entry["detail"] = str(detail)[:200]
+        with self._lock:
+            self._rpcs.append(entry)
+
+    def record_event(self, event: Mapping[str, Any]) -> None:
+        with self._lock:
+            self._events.append(dict(event))
+
+    # -- export --------------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "proc": self.proc,
+                "reports": list(self._reports),
+                "rpcs": list(self._rpcs),
+                "events": list(self._events),
+            }
+
+    def dump(
+        self,
+        directory: str | os.PathLike[str],
+        reason: str,
+        name: str | None = None,
+        extra: Mapping[str, Any] | None = None,
+    ) -> "Path | None":
+        """Write ``blackbox-<name>.json`` atomically into ``directory``;
+        best-effort by contract (a full disk at crash time must not mask
+        the crash itself). Returns the path, or None on failure."""
+        doc = self.snapshot()
+        doc["reason"] = reason
+        doc["dumped_ts_ms"] = int(time.time() * 1000)
+        if extra:
+            doc.update(extra)
+        fname = f"{BLACKBOX_PREFIX}{_sanitize(name or self.proc)}.json"
+        path = Path(directory) / fname
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.parent / f".{fname}.tmp.{os.getpid()}"
+            tmp.write_text(json.dumps(json_safe(doc), indent=2,
+                                      sort_keys=True) + "\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            log.warning("could not dump blackbox %s", path, exc_info=True)
+            return None
+
+
+def find_blackboxes(*directories) -> "list[Path]":
+    """Every ``blackbox-*.json`` under the given dirs (non-recursive),
+    sorted by name — the coordinator's persist-to-history sweep and the
+    doctor's staging-dir fallback share this."""
+    found: list[Path] = []
+    for d in directories:
+        if d is None:
+            continue
+        root = Path(d)
+        if not root.is_dir():
+            continue
+        found.extend(sorted(root.glob(f"{BLACKBOX_PREFIX}*.json")))
+    return found
+
+
+def load_blackboxes(*directories) -> "dict[str, dict]":
+    """Parsed dumps (name -> document) from the given dirs; malformed
+    or non-object files are skipped — a torn dump must not hide the
+    others from whoever is diagnosing (same tolerance contract as
+    ``history.reader.job_blackboxes`` on the history side)."""
+    out: dict[str, dict] = {}
+    for path in find_blackboxes(*directories):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            out[path.name] = doc
+    return out

@@ -1,0 +1,366 @@
+"""Streaming health analytics — the layer that *interprets* telemetry.
+
+PR 3 gave every job raw signals (per-task metric snapshots riding
+heartbeats, events.jsonl, trace spans); this module turns them into
+judgments while the job is still running. The coordinator feeds
+``HealthMonitor.observe`` from the aggregator on every heartbeat, and
+five streaming detectors watch for the fleet-scale failure shapes the
+MLSys straggler/fail-slow literature keeps finding:
+
+* **straggler**        — per-task ``step_time_ms`` scored against the
+  fleet by robust z-score (median absolute deviation across tasks, so
+  one slow host cannot drag the baseline toward itself); the score is
+  served per task as ``tony_task_straggler_score`` on ``/metrics``;
+* **progress_stall**   — ``train_steps_total`` stopped advancing while
+  the task keeps heartbeating (wedged collective, deadlocked input);
+* **loss_nan** / **loss_spike** — the reported ``loss`` went
+  non-finite, or jumped past ``spike-factor ×`` its recent median;
+* **heartbeat_jitter** — arrival gaps far beyond the configured
+  interval (slow/partitioning network, GC-style pauses) measured on
+  the COORDINATOR's clock, so executor clock skew cannot fake health;
+* **io_stall**         — the data plane's ``tony_io_queue_wait_ms``
+  accumulating faster than ``io-stall-ratio ×`` wall time: the chip is
+  waiting on input, not compute.
+
+Every detection emits a ``health_alert`` lifecycle event (bounded by a
+per-(detector, task) cooldown so a stuck condition cannot flood
+events.jsonl), increments ``tony_health_alerts_total``, and lands in
+the ``/api/health`` JSON view. All thresholds are ``tony.health.*``
+conf keys; ``tony doctor`` reads the resulting alerts back as
+postmortem evidence.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+log = logging.getLogger(__name__)
+
+# Served per task (labeled) by the aggregator's /metrics render; the
+# *_GAUGE / *_COUNTER declaration suffix keeps them under TONY-M001.
+STRAGGLER_GAUGE = "tony_task_straggler_score"
+ALERTS_COUNTER = "tony_health_alerts_total"
+
+# Detector names (the ``detector`` field of every health_alert event).
+STRAGGLER = "straggler"
+PROGRESS_STALL = "progress_stall"
+LOSS_NAN = "loss_nan"
+LOSS_SPIKE = "loss_spike"
+HEARTBEAT_JITTER = "heartbeat_jitter"
+IO_STALL = "io_stall"
+
+_QUEUE_WAIT_HISTOGRAM = "tony_io_queue_wait_ms"
+_LOSS_WINDOW = 16
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Detector tuning, one field per ``tony.health.*`` key."""
+
+    enabled: bool = True
+    straggler_threshold: float = 3.0
+    stall_timeout_ms: int = 60000        # 0 disables the watchdog
+    loss_spike_factor: float = 10.0
+    heartbeat_jitter_factor: float = 5.0
+    io_stall_ratio: float = 0.5
+    alert_cooldown_ms: int = 30000
+    heartbeat_interval_ms: int = 1000
+
+    @classmethod
+    def from_conf(cls, conf) -> "HealthConfig":
+        from tony_tpu.conf import keys
+
+        return cls(
+            enabled=conf.get_bool(keys.K_HEALTH_ENABLED, True),
+            straggler_threshold=conf.get_float(
+                keys.K_HEALTH_STRAGGLER_THRESHOLD, 3.0
+            ),
+            stall_timeout_ms=conf.get_int(
+                keys.K_HEALTH_STALL_TIMEOUT_MS, 60000
+            ),
+            loss_spike_factor=conf.get_float(
+                keys.K_HEALTH_LOSS_SPIKE_FACTOR, 10.0
+            ),
+            heartbeat_jitter_factor=conf.get_float(
+                keys.K_HEALTH_HB_JITTER_FACTOR, 5.0
+            ),
+            io_stall_ratio=conf.get_float(keys.K_HEALTH_IO_STALL_RATIO, 0.5),
+            alert_cooldown_ms=conf.get_int(
+                keys.K_HEALTH_ALERT_COOLDOWN_MS, 30000
+            ),
+            heartbeat_interval_ms=conf.get_int(
+                keys.K_TASK_HEARTBEAT_INTERVAL_MS, 1000
+            ),
+        )
+
+
+@dataclass
+class _TaskHealth:
+    """Streaming per-task state. Intervals are measured on the local
+    monotonic clock (the coordinator's), never on snapshot ``ts_ms`` —
+    an executor with a skewed wall clock must not look hung (or
+    healthy) because of its clock."""
+
+    last_arrival: float | None = None
+    jitter_ms: float = 0.0
+    steps: float | None = None
+    last_progress: float | None = None
+    stalled: bool = False
+    step_time_ms: float | None = None
+    straggler_score: float = 0.0
+    losses: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=_LOSS_WINDOW)
+    )
+    io_wait_ms: float | None = None
+    io_wall_ms: float | None = None
+
+
+def mad_scores(values: Mapping[str, float]) -> dict[str, float]:
+    """Robust z-score per key: ``|x - median| / (1.4826 · MAD)``, with
+    the MAD floored at 5% of the median so a perfectly-uniform fleet
+    (MAD 0) still scores a lone outlier finitely instead of dividing by
+    zero. Fewer than 3 values score 0 — with two tasks the median sits
+    between them and both would look equally deviant."""
+    if len(values) < 3:
+        return {k: 0.0 for k in values}
+    xs = sorted(values.values())
+    med = _median(xs)
+    mad = _median(sorted(abs(x - med) for x in xs))
+    scale = 1.4826 * max(mad, 0.05 * abs(med), 1e-9)
+    return {k: abs(v - med) / scale for k, v in values.items()}
+
+
+def _median(xs: "list[float]") -> float:
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+class HealthMonitor:
+    """The coordinator's streaming detectors. ``observe`` is called from
+    RPC handler threads (one per executor connection) — all state is
+    behind one lock, and ``emit`` fires outside it."""
+
+    def __init__(
+        self,
+        config: HealthConfig | None = None,
+        emit: Callable[..., Any] | None = None,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+        alert_limit: int = 128,
+    ) -> None:
+        self.config = config or HealthConfig()
+        self._emit = emit
+        self._counter = (
+            registry.counter(ALERTS_COUNTER) if registry is not None else None
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tasks: dict[str, _TaskHealth] = {}
+        self._alerts: collections.deque = collections.deque(maxlen=alert_limit)
+        self._alerts_total = 0
+        # (detector, task) -> monotonic time of the last emitted alert.
+        self._last_alert: dict[tuple[str, str], float] = {}
+
+    # -- ingest --------------------------------------------------------------
+    def observe(
+        self, task_id: str, snapshot: Mapping[str, Any] | None,
+    ) -> None:
+        """One heartbeat from ``task_id`` (``snapshot`` is the aggregator-
+        normalized metrics payload, or None for a bare liveness ping)."""
+        if not self.config.enabled:
+            return
+        now = self._clock()
+        alerts: list[dict[str, Any]] = []
+        with self._lock:
+            state = self._tasks.setdefault(task_id, _TaskHealth())
+            self._check_jitter(task_id, state, now, alerts)
+            state.last_arrival = now
+            if isinstance(snapshot, Mapping):
+                gauges = snapshot.get("gauges") or {}
+                counters = snapshot.get("counters") or {}
+                histograms = snapshot.get("histograms") or {}
+                self._check_progress(task_id, state, counters, now, alerts)
+                self._check_loss(task_id, state, gauges, now, alerts)
+                self._check_straggler(task_id, state, gauges, now, alerts)
+                self._check_io(task_id, state, histograms, now, alerts)
+        for alert in alerts:
+            self._publish(alert)
+
+    def reset_tasks(self) -> None:
+        """Session retry: per-task streaming state restarts with the new
+        session (the alert history and total survive — they describe the
+        job, not one session)."""
+        with self._lock:
+            self._tasks.clear()
+            self._last_alert.clear()
+
+    # -- detectors (all called with the lock held) ---------------------------
+    def _check_jitter(self, task_id, state, now, alerts) -> None:
+        if state.last_arrival is not None:
+            gap_ms = (now - state.last_arrival) * 1000.0
+            state.jitter_ms = gap_ms
+            limit = (self.config.heartbeat_jitter_factor
+                     * self.config.heartbeat_interval_ms)
+            if gap_ms > limit:
+                self._queue(alerts, HEARTBEAT_JITTER, task_id, now,
+                            f"heartbeat gap {gap_ms:.0f}ms exceeds "
+                            f"{limit:.0f}ms",
+                            gap_ms=round(gap_ms, 1), limit_ms=limit)
+
+    def _check_progress(self, task_id, state, counters, now, alerts) -> None:
+        steps = counters.get("train_steps_total")
+        if steps is None:
+            return
+        if state.steps is None or steps > state.steps:
+            state.steps = steps
+            state.last_progress = now
+            state.stalled = False
+            return
+        timeout = self.config.stall_timeout_ms
+        if not timeout or state.last_progress is None:
+            return
+        stalled_ms = (now - state.last_progress) * 1000.0
+        if stalled_ms > timeout:
+            state.stalled = True
+            self._queue(alerts, PROGRESS_STALL, task_id, now,
+                        f"train_steps_total stuck at {steps:.0f} for "
+                        f"{stalled_ms:.0f}ms",
+                        step=steps, stalled_ms=round(stalled_ms, 1))
+
+    def _check_loss(self, task_id, state, gauges, now, alerts) -> None:
+        loss = gauges.get("loss")
+        if loss is None:
+            return
+        if not math.isfinite(loss):
+            self._queue(alerts, LOSS_NAN, task_id, now,
+                        "reported loss went non-finite", loss=str(loss))
+            return
+        if len(state.losses) >= 4:
+            med = _median(sorted(state.losses))
+            if med > 0 and loss > self.config.loss_spike_factor * med:
+                self._queue(alerts, LOSS_SPIKE, task_id, now,
+                            f"loss {loss:.4g} spiked past "
+                            f"{self.config.loss_spike_factor:g}× recent "
+                            f"median {med:.4g}",
+                            loss=loss, median=med)
+        state.losses.append(loss)
+
+    def _check_straggler(self, task_id, state, gauges, now, alerts) -> None:
+        st = gauges.get("step_time_ms")
+        if st is None or not math.isfinite(st):
+            return
+        state.step_time_ms = st
+        observed = {
+            tid: t.step_time_ms for tid, t in self._tasks.items()
+            if t.step_time_ms is not None
+        }
+        scores = mad_scores(observed)
+        med = _median(sorted(observed.values())) if observed else 0.0
+        for tid, score in scores.items():
+            t = self._tasks[tid]
+            # Only SLOW outliers are stragglers; a task faster than the
+            # fleet scores 0 (an early finisher is not a health problem).
+            if t.step_time_ms is not None and t.step_time_ms < med:
+                score = 0.0
+            t.straggler_score = score
+            if score > self.config.straggler_threshold:
+                self._queue(alerts, STRAGGLER, tid, now,
+                            f"step time {t.step_time_ms:.1f}ms vs fleet "
+                            f"median {med:.1f}ms (score {score:.1f})",
+                            score=round(score, 2),
+                            step_time_ms=t.step_time_ms,
+                            median_ms=round(med, 2))
+
+    def _check_io(self, task_id, state, histograms, now, alerts) -> None:
+        h = histograms.get(_QUEUE_WAIT_HISTOGRAM)
+        if not isinstance(h, Mapping):
+            return
+        try:
+            wait_ms = float(h.get("sum", 0.0))
+        except (TypeError, ValueError):
+            return
+        wall_ms = now * 1000.0
+        if state.io_wait_ms is not None and state.io_wall_ms is not None:
+            d_wait = wait_ms - state.io_wait_ms
+            d_wall = wall_ms - state.io_wall_ms
+            if d_wall > 0 and d_wait / d_wall > self.config.io_stall_ratio:
+                self._queue(alerts, IO_STALL, task_id, now,
+                            f"input pipeline stalled "
+                            f"{d_wait / d_wall:.0%} of the last "
+                            f"{d_wall:.0f}ms",
+                            stall_ratio=round(d_wait / d_wall, 3))
+        state.io_wait_ms = wait_ms
+        state.io_wall_ms = wall_ms
+
+    # -- alert plumbing ------------------------------------------------------
+    def _queue(self, alerts, detector, task_id, now, reason, **data) -> None:
+        key = (detector, task_id)
+        last = self._last_alert.get(key)
+        cooldown_s = self.config.alert_cooldown_ms / 1000.0
+        if last is not None and now - last < cooldown_s:
+            return
+        self._last_alert[key] = now
+        record = {
+            "ts_ms": int(time.time() * 1000),
+            "detector": detector,
+            "task": task_id,
+            "reason": reason,
+            **data,
+        }
+        self._alerts.append(record)
+        self._alerts_total += 1
+        alerts.append(record)
+
+    def _publish(self, alert: dict[str, Any]) -> None:
+        log.warning("health alert [%s] %s: %s", alert["detector"],
+                    alert["task"], alert["reason"])
+        if self._counter is not None:
+            self._counter.inc()
+        if self._emit is not None:
+            try:
+                self._emit(**{k: v for k, v in alert.items()
+                              if k != "ts_ms"})
+            except Exception:
+                # Diagnosis must never take the control plane down.
+                log.warning("health alert emit failed", exc_info=True)
+
+    # -- views ---------------------------------------------------------------
+    def straggler_scores(self) -> dict[str, float]:
+        with self._lock:
+            return {t: s.straggler_score for t, s in self._tasks.items()}
+
+    def alerts(self) -> "list[dict[str, Any]]":
+        with self._lock:
+            return list(self._alerts)
+
+    def to_json(self) -> dict[str, Any]:
+        """The ``/api/health`` document (also embedded in blackbox
+        dumps): per-task streaming state plus the recent alert ring."""
+        now = self._clock()
+        with self._lock:
+            tasks = {}
+            for tid, s in self._tasks.items():
+                tasks[tid] = {
+                    "straggler_score": round(s.straggler_score, 3),
+                    "step_time_ms": s.step_time_ms,
+                    "steps": s.steps,
+                    "stalled": s.stalled,
+                    "heartbeat_age_ms": (
+                        round((now - s.last_arrival) * 1000.0, 1)
+                        if s.last_arrival is not None else None
+                    ),
+                    "last_gap_ms": round(s.jitter_ms, 1),
+                }
+            return {
+                "enabled": self.config.enabled,
+                "tasks": tasks,
+                "alerts": list(self._alerts),
+                "alerts_total": self._alerts_total,
+            }

@@ -66,13 +66,17 @@ class ApplicationRpcServer:
         port_range: tuple[int, int] = (10000, 15000),
         secret: str | None = None,
         role_tokens: dict[str, str] | None = None,
+        observer=None,
     ) -> None:
         """``secret`` is the flat shared-secret mode; ``role_tokens``
         (token → role) additionally enforces ``security.METHOD_ACL`` per
-        caller role — the TFPolicyProvider analogue."""
+        caller role — the TFPolicyProvider analogue. ``observer`` is an
+        optional ``(method, ok, args)`` callback fired after every
+        dispatch — the coordinator's flight recorder hangs off it."""
         self._impl = impl
         self._secret = secret
         self._role_tokens = role_tokens
+        self._observer = observer
         self.host = host
         self.port = self._bind(host, port_range)
         self._thread: threading.Thread | None = None
@@ -164,7 +168,17 @@ class ApplicationRpcServer:
         _trace.note_rpc_trace(trace_id if isinstance(trace_id, str) else None)
         try:
             result = getattr(self._impl, method)(**args)
+            self._observe(method, True, args)
             return {"ok": True, "result": _encode(result)}
         except Exception as e:  # noqa: BLE001 — errors must travel back framed
             log.exception("RPC %s failed", method)
+            self._observe(method, False, args)
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _observe(self, method: str, ok: bool, args: dict) -> None:
+        if self._observer is None:
+            return
+        try:
+            self._observer(method, ok, args)
+        except Exception:  # pragma: no cover - telemetry never breaks RPC
+            log.warning("rpc observer failed", exc_info=True)

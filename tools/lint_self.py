@@ -10,7 +10,10 @@ Three registries that must never drift are checked:
 * metric names — every statically-visible registration in the
   framework, examples, and tools passes TONY-M001
   (``analysis/metrics_lint``): snake_case, unit-suffixed, one kind per
-  name across the whole tree.
+  name across the whole tree;
+* the event catalogue — every lifecycle event kind emitted anywhere is
+  registered in ``observability.events.KNOWN_KINDS`` and documented in
+  docs/DEPLOY.md (TONY-E001, ``analysis/events_lint``).
 
 Invoked from the tier-1 suite (``tests/test_analysis.py``) so drift
 fails CI, and runnable standalone::
@@ -83,9 +86,24 @@ def check_metric_names() -> list[str]:
     return [f.render() for f in check(roots)]
 
 
+def check_event_drift() -> list[str]:
+    """TONY-E001 over every tree that emits lifecycle events, plus the
+    operator docs: emitters, the KNOWN_KINDS catalogue, and the
+    DEPLOY.md event table move in lockstep or CI fails."""
+    from tony_tpu.analysis.events_lint import check_event_catalogue
+
+    roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+             REPO / "bench.py"]
+    return [
+        f.render()
+        for f in check_event_catalogue(roots, docs=REPO / "docs" / "DEPLOY.md")
+    ]
+
+
 def main() -> int:
     problems = (
         check_config_drift() + check_protocol_drift() + check_metric_names()
+        + check_event_drift()
     )
     for p in problems:
         print(p, file=sys.stderr)

@@ -16,12 +16,16 @@ Three report variants:
 Timings land in a ``MetricsRegistry`` (``profile_device_total_ms`` and
 one sanitized ``profile_op_*_ms`` / ``profile_group_*_ms`` /
 ``profile_io_*_ms`` gauge per row); ``--json`` prints that snapshot
-instead of the table.
+instead of the table. When ``$TONY_METRICS_FILE`` is set (a
+tony-launched process, or an operator capturing machine-readable
+output) the same snapshot is also written there atomically — the
+human-readable table and the telemetry plane share one report.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import jax
@@ -35,6 +39,16 @@ from tony_tpu.observability.metrics import (  # noqa: E402
     MetricsRegistry,
     sanitize_metric_name,
 )
+
+
+def make_registry() -> MetricsRegistry:
+    """The report registry: plain in-memory, plus an atomic JSON copy
+    to ``$TONY_METRICS_FILE`` when exported (flushed in main, so the
+    machine-readable report always accompanies the stdout table)."""
+    return MetricsRegistry(
+        publish_path=os.environ.get("TONY_METRICS_FILE") or None,
+        publish_min_interval_s=0.0,
+    )
 
 
 def parse_args(argv=None):
@@ -197,8 +211,9 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.variant == "io":
         batch = args.batch if args.batch is not None else 32
-        registry = MetricsRegistry()
+        registry = make_registry()
         rows = measure_io(args.steps, args.depth, registry, batch=batch)
+        registry.flush()
         if args.as_json:
             print(json.dumps(registry.snapshot(), indent=2))
             return 0
@@ -213,7 +228,7 @@ def main(argv=None) -> int:
     times = measure(batch, seq)
     total = sum(ms for n, ms in times.items() if not n.startswith("jit_"))
 
-    registry = MetricsRegistry()
+    registry = make_registry()
     registry.gauge("profile_device_total_ms").set(round(total, 3))
     registry.gauge("profile_batch_count").set(batch)
     registry.gauge("profile_seq_count").set(seq)
@@ -233,6 +248,7 @@ def main(argv=None) -> int:
     for name, ms in rows:
         metric = sanitize_metric_name(f"{prefix}{name}")[:120] + "_ms"
         registry.gauge(metric).set(round(ms, 3))
+    registry.flush()
 
     if args.as_json:
         print(json.dumps(registry.snapshot(), indent=2))
