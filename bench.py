@@ -15,7 +15,10 @@ TFLOP/s with a readback), so every timer edge forces a device->host copy.
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import sys
 import time
 
 import jax
@@ -359,6 +362,7 @@ def bench_resnet50(batch: int = 32, size: int = 224, measure: int = 20):
         lambda key: resnet_init(key, cfg),
         lambda params, images: resnet_apply(params, images, cfg),
         mesh,
+        config=cfg,
     )
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.normal(size=(batch, size, size, 3)), jnp.float32)
@@ -425,7 +429,8 @@ def _io_rates(snap0: dict, snap1: dict) -> dict:
     }
 
 
-def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20):
+def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20,
+                         workloads: tuple = ("lm", "resnet")):
     """VERDICT r4 weak #2: prove the data plane can FEED the chip. Writes
     a real on-disk tokens corpus, streams it through ShardedRecordReader
     (parallel span reads) → ``device_prefetch`` (background-thread H2D,
@@ -436,20 +441,12 @@ def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20):
     are the constraint) transferred as uint8 and decoded ON DEVICE
     (resnet_apply's cast+scale), with the sustained disk→HBM byte rate
     and the registry-attributed io sub-rates. Every step is fenced by a
-    loss readback so the per-step distribution (p50/p95) is real."""
-    import os as _os
-    import tempfile
+    loss readback so the per-step distribution (p50/p95) is real.
 
+    ``workloads`` selects the sections — the post-PR-4 streamed-ResNet
+    re-measurement runs ``("resnet",)`` alone (the 200M LM section is
+    pointless on hosts where that model cannot hit steady state)."""
     from tony_tpu import observability
-    from tony_tpu.io import ShardedRecordReader, device_prefetch, sharded_batches
-    from tony_tpu.models import (
-        ResNetConfig,
-        TransformerConfig,
-        make_image_classifier_step,
-        make_train_step,
-        resnet_apply,
-        resnet_init,
-    )
     from tony_tpu.parallel.mesh import MeshSpec, build_mesh
 
     mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
@@ -467,6 +464,23 @@ def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20):
         return walls
 
     # -- LM: 200M flagship config, same shape as bench_transformer --------
+    if "lm" in workloads:
+        out.update(_bench_input_lm(mesh, registry, rng, lm_measure, warm,
+                                   timed_steps))
+    if "resnet" in workloads:
+        out.update(_bench_input_resnet(mesh, registry, rng, resnet_measure,
+                                       warm, timed_steps))
+    return out
+
+
+def _bench_input_lm(mesh, registry, rng, lm_measure, warm, timed_steps):
+    import os as _os
+    import tempfile
+
+    from tony_tpu.io import ShardedRecordReader, sharded_batches
+    from tony_tpu.models import TransformerConfig, make_train_step
+
+    out = {}
     batch, seq = 8, 2048
     cfg = TransformerConfig(
         vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
@@ -518,7 +532,23 @@ def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20):
         }
     finally:
         _os.unlink(lm_path)
+    return out
 
+
+def _bench_input_resnet(mesh, registry, rng, resnet_measure, warm,
+                        timed_steps):
+    import os as _os
+    import tempfile
+
+    from tony_tpu.io import ShardedRecordReader, device_prefetch
+    from tony_tpu.models import (
+        ResNetConfig,
+        make_image_classifier_step,
+        resnet_apply,
+        resnet_init,
+    )
+
+    out = {}
     # -- ResNet-50: uint8 image records, bytes are the constraint ---------
     ibatch, size = 32, 224
     rec = size * size * 3
@@ -527,6 +557,7 @@ def bench_input_pipeline(lm_measure: int = 16, resnet_measure: int = 20):
         lambda key: resnet_init(key, rcfg),
         lambda params, images: resnet_apply(params, images, rcfg),
         mesh,
+        config=rcfg,
     )
     rows = (resnet_measure + warm) * ibatch
     images = rng.integers(0, 256, (rows, rec), dtype=np.uint8)
@@ -668,6 +699,141 @@ def bench_flash_attention(seq: int, batch: int, heads: int = 8,
     }
 
 
+# ---------------------------------------------------------------------------
+# Regression gate (`bench.py --check`)
+# ---------------------------------------------------------------------------
+# BENCH r01–r05 showed real regressions sailing through because only the
+# headline mnist number was eyeballed: mnist 3548 → 750 → 2401
+# steps/sec/chip, resnet50 2036 → 1786 img/s, flash 2k speedup
+# 2.19× → 1.56×. The gate makes every SUB-metric first-class: a baseline
+# per metric per platform persists in BASELINE.json, and any >10% drop
+# exits nonzero.
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE.json")
+BASELINE_KEY = "bench_baselines"  # platform -> {metric path -> value}
+DEFAULT_THRESHOLD = 0.10
+
+# Direction by name suffix. Anything matching neither list is a shape /
+# config parameter (batch, seq, params_m, ...) and is not gated.
+_HIGHER_SUFFIXES = ("per_sec", "per_sec_per_chip", "mfu", "speedup",
+                    "mb_per_sec", "vs_baseline")
+_LOWER_SUFFIXES = ("_ms", "_pct", "ms_mean", "step_ms", "p50_ms", "p95_ms")
+
+
+def metric_direction(name: str) -> str | None:
+    """'higher' / 'lower' / None (ungated parameter)."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf == "mfu" or leaf.endswith(_HIGHER_SUFFIXES):
+        return "higher"
+    if leaf.endswith(_LOWER_SUFFIXES):
+        return "lower"
+    return None
+
+
+def collect_submetrics(line: dict) -> dict[str, float]:
+    """Flatten one bench JSON line into {dotted.path: value} for every
+    gated (direction-carrying, numeric, finite) sub-metric. Errored
+    extras (`{"error": ...}` from _safe) contribute nothing — their
+    metrics go MISSING, which --check reports as a failure rather than
+    silently shrinking the gate."""
+    out: dict[str, float] = {}
+
+    def walk(node, path: str) -> None:
+        if isinstance(node, dict):
+            if "error" in node:
+                return
+            for k, v in node.items():
+                walk(v, f"{path}.{k}" if path else str(k))
+            return
+        if isinstance(node, bool) or not isinstance(node, (int, float)):
+            return
+        if metric_direction(path) and np.isfinite(node):
+            out[path] = float(node)
+
+    if isinstance(line.get("value"), (int, float)):
+        out["mnist_train_steps_per_sec_per_chip"] = float(line["value"])
+    walk(line.get("extras", {}), "")
+    return out
+
+
+def check_regressions(
+    current: dict[str, float],
+    baseline: dict[str, float],
+    threshold: float = DEFAULT_THRESHOLD,
+) -> list[str]:
+    """Every baseline metric that regressed past ``threshold`` (or went
+    missing), as human-readable complaints. Empty list = gate passes.
+    Metrics present only in ``current`` are new and pass free — run
+    --update-baseline to start gating them."""
+    problems: list[str] = []
+    for name in sorted(baseline):
+        base = baseline[name]
+        if name not in current:
+            problems.append(f"{name}: missing from this run "
+                            f"(baseline {base:g})")
+            continue
+        cur = current[name]
+        direction = metric_direction(name) or "higher"
+        if base == 0:
+            continue  # nothing to scale a drop against
+        if direction == "higher" and cur < base * (1 - threshold):
+            problems.append(
+                f"{name}: {cur:g} is {(1 - cur / base) * 100:.1f}% below "
+                f"baseline {base:g}"
+            )
+        elif direction == "lower" and cur > base * (1 + threshold):
+            # Percent-point metrics near zero (a 1.3% io overhead) would
+            # otherwise gate on fractions of a point — pure noise. They
+            # get 5 points of absolute slack on top of the ratio.
+            if name.endswith("_pct") and cur - base <= 5.0:
+                continue
+            problems.append(
+                f"{name}: {cur:g} is {(cur / base - 1) * 100:.1f}% above "
+                f"baseline {base:g}"
+            )
+    return problems
+
+
+def load_baselines(path: str = BASELINE_FILE) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    table = doc.get(BASELINE_KEY, {})
+    return table if isinstance(table, dict) else {}
+
+
+def save_baselines(platform: str, metrics: dict[str, float],
+                   path: str = BASELINE_FILE) -> None:
+    """Merge this platform's baselines into BASELINE.json — per METRIC,
+    not per platform: a partial-workload run (`--update-baseline` after
+    a resnet-only re-measure) must refresh only the metrics it produced,
+    never silently drop the transformer/decode/flash gates it didn't run
+    (that would reopen exactly the silent-regression window the gate
+    closes). Other keys in the file — north star, configs — pass through
+    untouched. Retire a truly dead metric by hand-editing the file."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    table = doc.setdefault(BASELINE_KEY, {}).setdefault(platform, {})
+    table.update(metrics)
+    doc[BASELINE_KEY][platform] = {k: table[k] for k in sorted(table)}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _bench_platform() -> str:
+    d = jax.devices()[0]
+    return d.device_kind or d.platform
+
+
 def _safe(fn, *args, **kwargs):
     """One extra must not sink the whole bench line: the driver records
     exactly one JSON object per round, so a transient failure (tunnel
@@ -679,7 +845,7 @@ def _safe(fn, *args, **kwargs):
         return {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
 
-def main() -> None:
+def run_benches() -> dict:
     steps_per_sec_per_chip = bench_mnist()
     if jax.devices()[0].platform in ("tpu", "axon"):
         extras = {
@@ -728,7 +894,7 @@ def main() -> None:
     # numbers.
     from tony_tpu import observability
 
-    print(json.dumps({
+    return {
         "metric": "mnist_train_steps_per_sec_per_chip",
         "value": round(steps_per_sec_per_chip, 2),
         "unit": f"steps/sec/chip (batch={BATCH}, cnn, adam)",
@@ -737,8 +903,91 @@ def main() -> None:
         ),
         "extras": extras,
         "metrics": observability.default_registry().summary(),
-    }))
+    }
+
+
+def _load_line(path: str) -> dict:
+    """A bench line from a file: either a bare JSON object or the last
+    JSON-parseable line of a log (the driver's record format)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        pass
+    for raw in reversed(text.splitlines()):
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                return json.loads(raw)
+            except ValueError:
+                continue
+    raise ValueError(f"no JSON bench line found in {path}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="tony_tpu benchmark harness / perf-regression gate"
+    )
+    p.add_argument("--check", action="store_true",
+                   help="compare sub-metrics against the persisted "
+                        "baseline; exit 1 on any >threshold drop")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="persist this run's sub-metrics as the new "
+                        "baseline for this platform")
+    p.add_argument("--input", metavar="PATH",
+                   help="use an existing bench JSON line instead of "
+                        "running the benches")
+    p.add_argument("--baseline", default=BASELINE_FILE,
+                   help=f"baseline file (default {BASELINE_FILE})")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="fractional regression tolerance (default 0.10)")
+    args = p.parse_args(argv)
+
+    if args.input:
+        line = _load_line(args.input)
+    else:
+        # Warm persistent compile cache: repeat bench invocations (the
+        # per-PR driver rounds) skip every XLA compile that the model
+        # zoo's plan-instrumented steps share with a prior round.
+        from tony_tpu.parallel.plan import configure_compile_cache
+
+        configure_compile_cache()
+        line = run_benches()
+        print(json.dumps(line))
+
+    if not (args.check or args.update_baseline):
+        return 0
+
+    platform = (line.get("extras") or {}).get("device") or _bench_platform()
+    current = collect_submetrics(line)
+    rc = 0
+    # Check BEFORE update: `--check --update-baseline` must gate against
+    # the PRIOR baseline (update-first would make the check vacuous and
+    # bless the very regression it was asked to catch).
+    if args.check:
+        baseline = load_baselines(args.baseline).get(platform, {})
+        if not baseline:
+            print(f"bench --check: no baseline for platform {platform!r} "
+                  f"in {args.baseline}; run --update-baseline first",
+                  file=sys.stderr)
+        else:
+            problems = check_regressions(current, baseline, args.threshold)
+            for prob in problems:
+                print(f"bench --check: REGRESSION {prob}", file=sys.stderr)
+            if problems:
+                rc = 1
+            else:
+                print(f"bench --check: {len(baseline)} gated metrics "
+                      f"within {args.threshold * 100:.0f}% of baseline "
+                      f"({platform})", file=sys.stderr)
+    if args.update_baseline:
+        save_baselines(platform, current, args.baseline)
+        print(f"bench: baseline for {platform!r} updated "
+              f"({len(current)} metrics) in {args.baseline}",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -78,6 +78,20 @@ _TRUE_FALSE = frozenset(
     {"true", "1", "yes", "on", "false", "0", "no", "off"}
 )
 
+# Path prefixes that are reboot-scoped (or outright RAM-backed) on every
+# mainstream distro: an XLA compile cache rooted here is silently cold on
+# every fresh run — the exact failure mode the cache exists to kill.
+_SCRATCH_PREFIXES = ("/tmp/", "/var/tmp/", "/dev/shm/", "/run/")
+
+
+def _is_scratch_path(path: str) -> bool:
+    import tempfile
+
+    p = path.rstrip("/") + "/"
+    prefixes = set(_SCRATCH_PREFIXES)
+    prefixes.add(tempfile.gettempdir().rstrip("/") + "/")
+    return any(p.startswith(pre) for pre in prefixes)
+
 
 def _known_static_keys() -> frozenset[str]:
     return frozenset(keys.DEFAULTS)
@@ -310,6 +324,24 @@ def _cross_key_checks(conf, job_names: set[str]) -> list[Finding]:
             "TONY-C007", WARNING,
             f"tony.application.single-node=true but {total} task "
             f"instances are configured",
+        ))
+
+    # A compile cache rooted on non-persistent scratch misses every run
+    # while claiming to be enabled — worse than off, because nobody goes
+    # looking for the cold-compile tax they believe they've paid off.
+    try:
+        cache_enabled = conf.get_bool(keys.K_COMPILE_CACHE_ENABLED, True)
+    except ValueError:
+        cache_enabled = True
+    cache_dir = conf.get_str(keys.K_COMPILE_CACHE_DIR, "")
+    if cache_enabled and cache_dir and _is_scratch_path(cache_dir):
+        findings.append(Finding(
+            "TONY-C010", WARNING,
+            f"tony.compile.cache-dir={cache_dir} points at non-persistent "
+            f"scratch — the XLA compile cache will be cold on every run",
+            suggestion="use a home- or durable-volume path (empty = "
+                       "~/.cache/tony_tpu/xla-cache), or set "
+                       "tony.compile.cache-enabled=false",
         ))
 
     # Every TPU ask must land on a legal slice topology — run the real

@@ -128,6 +128,7 @@ class TonyClient:
             # lib.zip; the stage-0 loader on each TPU VM fetches it before
             # anything else (ClusterSubmitter.java:59-63 stages the fat jar).
             utils.zip_dir(lib_path, app_dir / "lib.zip")
+        self._resolve_compile_cache_dir()
         # Fresh per-job credentials (TonyClient.getTokens analogue); the
         # frozen conf carries them, so restrict it to the submitting user.
         from tony_tpu import security
@@ -148,6 +149,34 @@ class TonyClient:
                 "staged %s to %s/%s", self.app_id, staging_conf, self.app_id
             )
         return app_dir
+
+    def _resolve_compile_cache_dir(self) -> None:
+        """Pin an EXPLICIT ``tony.compile.cache-dir`` into the frozen
+        conf BEFORE it ships: relative and ``~`` paths absolutize
+        against the client cwd/home, so the coordinator, every executor,
+        and every retry of this job agree on ONE durable cache location
+        (a re-submit that resolved a relative path against a different
+        cwd would silently recompile cold). The dir is created eagerly:
+        a bad path surfaces here, at submission, not as a cold cache on
+        the fleet. An EMPTY key stays empty — each host then resolves
+        its own per-user default (pinning the client's expanded $HOME
+        would hand executors running as another user an uncreatable
+        path). ``gs://`` URIs pass through — jax's cache layer reads
+        them natively on TPU-VMs."""
+        if not self.conf.get_bool(keys.K_COMPILE_CACHE_ENABLED, True):
+            return
+        raw = self.conf.get_str(keys.K_COMPILE_CACHE_DIR, "")
+        if not raw or is_gs_uri(raw):
+            return
+        resolved = os.path.abspath(os.path.expanduser(raw))
+        try:
+            os.makedirs(resolved, exist_ok=True)
+        except OSError as exc:
+            log.warning(
+                "compile cache dir %s is not creatable (%s); jobs run "
+                "with a cold compile every session", resolved, exc,
+            )
+        self.conf.set(keys.K_COMPILE_CACHE_DIR, resolved)
 
     # -- submit + monitor (TonyClient.run:146-208) --------------------------
     def run(self) -> int:

@@ -117,6 +117,20 @@ K_IO_READ_WORKERS = IO_PREFIX + "read-workers"
 # Records per prefetch-queue chunk; one read span covers 4 chunks.
 K_IO_CHUNK_RECORDS = IO_PREFIX + "chunk-records"
 
+# --- compilation (parallel/plan.py) ----------------------------------------
+# Persistent XLA compile cache: coordinator-driven retries, checkpoint
+# resumes, and scheduler re-submits of an unchanged program skip
+# compilation entirely. The client resolves cache-dir at staging (empty =
+# per-user ~/.cache/tony_tpu/xla-cache; relative paths are absolutized so
+# every process agrees on one dir), the executor exports TONY_COMPILE_*
+# env, and runtime.initialize()/plan.configure_compile_cache wire jax.
+COMPILE_PREFIX = TONY_PREFIX + "compile."
+K_COMPILE_CACHE_DIR = COMPILE_PREFIX + "cache-dir"
+K_COMPILE_CACHE_ENABLED = COMPILE_PREFIX + "cache-enabled"
+# Smallest XLA artifact worth persisting, bytes (0 = keep everything —
+# the retry path wants every executable back, not just the big ones).
+K_COMPILE_MIN_ENTRY_SIZE = COMPILE_PREFIX + "min-entry-size"
+
 # --- health analytics (observability/health.py + flight.py) ----------------
 # Streaming detectors fed by the heartbeat piggyback on the coordinator:
 # straggler scoring (MAD z-score across tasks' step_time_ms), stalled
@@ -233,6 +247,9 @@ DEFAULTS: dict[str, object] = {
     K_IO_PREFETCH_DEPTH: 2,
     K_IO_READ_WORKERS: 4,
     K_IO_CHUNK_RECORDS: 256,
+    K_COMPILE_CACHE_DIR: "",
+    K_COMPILE_CACHE_ENABLED: True,
+    K_COMPILE_MIN_ENTRY_SIZE: 0,
     K_HEALTH_ENABLED: True,
     K_HEALTH_STRAGGLER_THRESHOLD: 3.0,
     K_HEALTH_STALL_TIMEOUT_MS: 60000,

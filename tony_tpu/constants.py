@@ -83,6 +83,12 @@ TONY_METRICS_FILE = "TONY_METRICS_FILE"
 TONY_IO_PREFETCH_DEPTH = "TONY_IO_PREFETCH_DEPTH"
 TONY_IO_READ_WORKERS = "TONY_IO_READ_WORKERS"
 TONY_IO_CHUNK_RECORDS = "TONY_IO_CHUNK_RECORDS"
+# Persistent XLA compile cache (tony.compile.* conf → user-process env →
+# parallel/plan.py configure_compile_cache): retried/resumed/re-submitted
+# runs of an unchanged program skip compilation entirely.
+TONY_COMPILE_CACHE_DIR = "TONY_COMPILE_CACHE_DIR"
+TONY_COMPILE_CACHE_ENABLED = "TONY_COMPILE_CACHE_ENABLED"
+TONY_COMPILE_MIN_ENTRY_SIZE = "TONY_COMPILE_MIN_ENTRY_SIZE"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -98,6 +104,8 @@ DOCKER_FORWARD_ENV = (
     TONY_RESUME_STEP, TONY_CHECKPOINT_DIR, TONY_FAULT_PLAN,
     TONY_TRACE_ID, TONY_METRICS_FILE,
     TONY_IO_PREFETCH_DEPTH, TONY_IO_READ_WORKERS, TONY_IO_CHUNK_RECORDS,
+    TONY_COMPILE_CACHE_DIR, TONY_COMPILE_CACHE_ENABLED,
+    TONY_COMPILE_MIN_ENTRY_SIZE,
 )
 
 # The executor's self-termination code after losing the coordinator (N

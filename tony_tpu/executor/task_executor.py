@@ -437,6 +437,19 @@ class TaskExecutor:
         env[constants.TONY_IO_CHUNK_RECORDS] = str(
             self.conf.get_int(keys.K_IO_CHUNK_RECORDS, 256)
         )
+        # Persistent compile cache (tony.compile.* conf → user-process
+        # env → parallel/plan.configure_compile_cache, called from
+        # runtime.initialize()): a retried/resumed session of an
+        # unchanged program reuses the previous session's executables.
+        env[constants.TONY_COMPILE_CACHE_ENABLED] = str(
+            self.conf.get_bool(keys.K_COMPILE_CACHE_ENABLED, True)
+        ).lower()
+        cache_dir = self.conf.get_str(keys.K_COMPILE_CACHE_DIR, "")
+        if cache_dir:
+            env[constants.TONY_COMPILE_CACHE_DIR] = cache_dir
+        env[constants.TONY_COMPILE_MIN_ENTRY_SIZE] = str(
+            self.conf.get_int(keys.K_COMPILE_MIN_ENTRY_SIZE, 0)
+        )
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(

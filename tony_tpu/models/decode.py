@@ -581,6 +581,10 @@ class DecodeSession:
         self.cfg = cfg
         self.mesh = mesh
         self.params: dict = {}
+        # Compile instrumentation (parallel/plan.py): each distinct
+        # generate signature compiles one executable; its first call is
+        # timed and counted as a persistent-cache hit or miss.
+        self._compiled: set[tuple] = set()
         self.refresh(params)
 
     def refresh(self, params: dict) -> None:
@@ -634,6 +638,29 @@ class DecodeSession:
 
     def generate(self, prompt: jax.Array, max_new_tokens: int, **kwargs):
         """Same surface as module-level ``generate`` minus params/cfg."""
+        # EVERY kwarg joins the signature: eos_token and the rest change
+        # the traced program too, and a missed distinction would leave a
+        # real compile uncounted (a false hit), never a wrong result.
+        sig = (
+            tuple(prompt.shape), str(prompt.dtype), max_new_tokens,
+            tuple(sorted((k, repr(v)) for k, v in kwargs.items())),
+        )
+        if sig not in self._compiled:
+            from tony_tpu.parallel import plan as plan_lib
+
+            key = plan_lib.plan_cache_key(
+                "decode_generate", config=self.cfg, mesh=self.mesh,
+                extra={"sig": repr(sig)},
+            )
+            with plan_lib.timed_compile(key):
+                out = self._generate(prompt, max_new_tokens, **kwargs)
+            # Marked compiled only on success: a failed first call must
+            # not exempt the next one from instrumentation.
+            self._compiled.add(sig)
+            return out
+        return self._generate(prompt, max_new_tokens, **kwargs)
+
+    def _generate(self, prompt: jax.Array, max_new_tokens: int, **kwargs):
         if self.mesh is not None:
             with jax.sharding.set_mesh(self.mesh):
                 return generate(

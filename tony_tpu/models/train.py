@@ -29,6 +29,7 @@ from tony_tpu.models.transformer import (
     param_roles,
 )
 from tony_tpu.ops import softmax_cross_entropy
+from tony_tpu.parallel import plan as plan_lib
 from tony_tpu.parallel.sharding import logical_sharding
 
 
@@ -169,7 +170,7 @@ def lm_loss(
 
 def make_train_step(
     cfg: TransformerConfig,
-    mesh: Mesh,
+    mesh: Mesh | None = None,
     *,
     learning_rate: float = 3e-4,
     weight_decay: float = 0.1,
@@ -178,13 +179,34 @@ def make_train_step(
     pipeline_schedule: str = "gpipe",
     pipeline_virtual: int = 1,
     optimizer: optax.GradientTransformation | None = None,
+    plan: plan_lib.Plan | None = None,
 ):
     """Returns (init_fn, step_fn), both jitted over ``mesh``.
 
     init_fn(key) -> TrainState, every leaf placed by its logical roles.
     step_fn(state, tokens[B, T+1]) -> (state', {"loss": f32}); donates the
     old state so params update in place in HBM.
+
+    ``plan`` (parallel/plan.py) is the declarative alternative to the
+    mesh + pipeline kwargs: it supplies the mesh (built from its spec
+    when ``mesh`` is None) and the trunk/microbatching knobs in one
+    object — the planner's output plugs in directly. Explicit pipeline
+    kwargs win over the plan's. Both jitted functions are compile-
+    instrumented: their first call lands in ``tony_compile_ms`` and
+    counts a persistent-cache hit or miss against the plan-key index.
     """
+    if plan is not None:
+        if mesh is None:
+            mesh = plan.build_mesh()
+        if pipeline_microbatches is None:
+            pipeline_microbatches = plan.microbatches
+            # Explicit schedule/virtual kwargs still win over the plan's:
+            # only defaults are replaced.
+            if pipeline_schedule == "gpipe" and pipeline_virtual == 1:
+                pipeline_schedule = plan.pipeline_schedule
+                pipeline_virtual = plan.pipeline_virtual
+    if mesh is None:
+        raise ValueError("make_train_step needs a mesh or a plan")
     opt = optimizer or optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(learning_rate, weight_decay=weight_decay),
@@ -207,7 +229,33 @@ def make_train_step(
     batch_sh = logical_sharding(mesh, "batch", None)
     repl = NamedSharding(mesh, P())
 
-    jit_init = jax.jit(init_fn, out_shardings=state_sh)
+    # Everything whose change must invalidate a cached executable rides
+    # the plan cache key (argument shapes join at the first call). An
+    # EXPLICIT optimizer is a pile of closures with no stable identity
+    # (every optax factory returns a 'GradientTransformation'), so its
+    # opt-state TREEDEF stands in: adamw/adafactor/sgd/chain arities all
+    # differ there. Residual gap: hyperparameters buried inside a custom
+    # optimizer (adafactor(1e-3) vs (1e-4)) share a treedef and may
+    # read as a hit while XLA, keying on real HLO, recompiles — a
+    # metric mislabel only, never a wrong executable.
+    fingerprint = {
+        "learning_rate": learning_rate,
+        "weight_decay": weight_decay,
+        "grad_clip": grad_clip,
+        "microbatches": pipeline_microbatches,
+        "schedule": pipeline_schedule,
+        "virtual": pipeline_virtual,
+        "optimizer": "default-adamw" if optimizer is None else str(
+            jax.tree_util.tree_structure(abstract.opt_state)
+        ),
+    }
+    jit_init = plan_lib.instrument_jit(
+        jax.jit(init_fn, out_shardings=state_sh),
+        plan_lib.plan_cache_key(
+            "lm_train_init", config=cfg, mesh=mesh, plan=plan,
+            extra=fingerprint,
+        ),
+    )
 
     def step_fn(state: TrainState, tokens: jax.Array):
         (loss, metrics), grads = jax.value_and_grad(lm_loss, has_aux=True)(
@@ -230,11 +278,18 @@ def make_train_step(
             "moe_balance", "moe_zloss", "moe_drop_rate", "moe_entropy",
         ]
     metrics_sh = {k: repl for k in metric_keys}
-    jit_step = jax.jit(
-        step_fn,
-        in_shardings=(state_sh, batch_sh),
-        out_shardings=(state_sh, metrics_sh),
-        donate_argnums=(0,),
+    jit_step = plan_lib.instrument_jit(
+        jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, metrics_sh),
+            donate_argnums=(0,) if (plan is None or plan.donate_state)
+            else (),
+        ),
+        plan_lib.plan_cache_key(
+            "lm_train_step", config=cfg, mesh=mesh, plan=plan,
+            extra=fingerprint,
+        ),
     )
 
     def step(state, tokens):
@@ -261,6 +316,7 @@ def make_classifier_step(
         mesh,
         learning_rate=learning_rate,
         steps_per_call=steps_per_call,
+        config=cfg,
     )
 
 
@@ -286,6 +342,7 @@ def make_image_classifier_step(
     learning_rate: float = 1e-3,
     steps_per_call: int = 1,
     preprocess=None,
+    config=None,
 ):
     """Data-parallel supervised step for any image classifier
     ``(params, images) -> logits``: batch split over (dp, ep); params
@@ -350,12 +407,31 @@ def make_image_classifier_step(
             state, metrics = jax.lax.scan(body, state, (images, labels))
             return state, jax.tree.map(lambda m: m[-1], metrics)
 
-    jit_init = jax.jit(init_fn, out_shardings=state_sh)
-    jit_step = jax.jit(
-        step_fn,
-        in_shardings=(state_sh, batch_sh, batch_sh),
-        out_shardings=(state_sh, {"loss": repl, "accuracy": repl}),
-        donate_argnums=(0,),
+    # ``config`` rides the plan cache key when given (MnistConfig /
+    # ResNetConfig from the named builders); without it the state's leaf
+    # shapes — folded in at the first call — carry the model identity.
+    fingerprint = {
+        "learning_rate": learning_rate,
+        "steps_per_call": steps_per_call,
+        "preprocess": getattr(preprocess, "__name__", repr(preprocess))
+        if preprocess is not None else None,
+    }
+    jit_init = plan_lib.instrument_jit(
+        jax.jit(init_fn, out_shardings=state_sh),
+        plan_lib.plan_cache_key(
+            "classifier_init", config=config, mesh=mesh, extra=fingerprint,
+        ),
+    )
+    jit_step = plan_lib.instrument_jit(
+        jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh, batch_sh),
+            out_shardings=(state_sh, {"loss": repl, "accuracy": repl}),
+            donate_argnums=(0,),
+        ),
+        plan_lib.plan_cache_key(
+            "classifier_step", config=config, mesh=mesh, extra=fingerprint,
+        ),
     )
 
     def step(state, images, labels):

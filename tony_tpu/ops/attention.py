@@ -610,21 +610,31 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def _default_blocks(t_q: int, t_k: int,
                     block_q: int | None, block_k: int | None):
-    """Measured sweet spots on v5e via device-trace kernel timing (r5
-    sweeps, fwd/dq/dkv swept independently at seq 2k and 8k for d=64 AND
-    d=128, post mask-branching): 1024×1024 wins or ties every cell —
-    fewer grid steps amortize the per-block scalar+VPU work — so it is
-    the default at every length, clamped here to the sequence (2048-wide
-    tiles fail to compile against the 16M scoped-VMEM budget). The
-    r3-era 512-for-short-seq rule predated the bf16-operand and
-    branch-masked kernels and no longer holds. Caveat: the sweeps
-    covered 2k/8k — a length that is a multiple of 512 but not 1024
-    (1536, 2560, ...) pays a partially-padded tail tile the old default
-    avoided; callers with such lengths can still pin either block."""
+    """Length-bucketed defaults, pinned from measured evidence:
+
+    * seq > 2048: 1024×1024 — the r5 device-trace sweeps (fwd/dq/dkv
+      independently, d=64 and d=128, post mask-branching) had it winning
+      or tying every 8k cell; fewer grid steps amortize the per-block
+      scalar+VPU work.
+    * seq <= 2048: 512×512 — the r5 "1024 everywhere" pin regressed the
+      2k WALL time that the kernel-trace sweep did not see: BENCH r02
+      (512-block era) ran flash_attention_2k at 3.095 ms / 2.19× vs
+      blockwise-XLA, r05 (1024 default) runs the identical bench at
+      4.651 ms / 1.56×. At 2k a 1024 tile leaves a 2-step kv grid —
+      too few blocks to hide the pipeline ramp — while 512 keeps 4.
+
+    Both clamp to the sequence (2048-wide tiles fail to compile against
+    the 16M scoped-VMEM budget). Lengths that are a multiple of 512 but
+    not 1024 (2560, 3072, ...) land in the 1024 bucket and pay a
+    partially-padded tail tile; callers can still pin either block.
+    Re-derive with ``tools/sweep_flash_blocks.py`` (device-trace kernel
+    timing + wall check; needs a real TPU — Pallas on CPU is
+    interpret-only)."""
+    default = 512 if max(t_q, t_k) <= 2048 else 1024
     if block_q is None:
-        block_q = min(1024, t_q)
+        block_q = min(default, t_q)
     if block_k is None:
-        block_k = min(1024, t_k)
+        block_k = min(default, t_k)
     return block_q, block_k
 
 

@@ -12,6 +12,14 @@ from tony_tpu.parallel.collectives import (
     ring_halo_exchange,
 )
 from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+from tony_tpu.parallel.plan import (
+    Plan,
+    candidate_plans,
+    configure_compile_cache,
+    plan_cache_key,
+    plan_for,
+    record_step_time,
+)
 from tony_tpu.parallel.sharding import (
     LOGICAL_RULES,
     logical_sharding,
@@ -25,6 +33,12 @@ from tony_tpu.parallel.pipeline import pipeline_apply
 __all__ = [
     "MeshSpec",
     "build_mesh",
+    "Plan",
+    "candidate_plans",
+    "configure_compile_cache",
+    "plan_cache_key",
+    "plan_for",
+    "record_step_time",
     "all_gather_tp",
     "all_to_all_ep",
     "pmean_gradients",

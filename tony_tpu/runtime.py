@@ -68,6 +68,14 @@ def initialize(**kwargs) -> TaskContext:
     job (or in a single-process job) this is a no-op, so scripts run
     unchanged locally."""
     ctx = task_context()
+    # Persistent compile cache first: the executor exported TONY_COMPILE_*
+    # (tony.compile.* conf), and wiring it before any compilation means a
+    # retried/resumed session of an unchanged program skips XLA entirely.
+    # Outside a tony job this resolves the per-user default dir — local
+    # iteration gets warm compiles too.
+    from tony_tpu.parallel.plan import configure_compile_cache
+
+    configure_compile_cache()
     if ctx.is_distributed:
         import jax
 

@@ -2,7 +2,14 @@
 durations (the r4 wall-clock sweep drowned in the tunnel's ~80-90 ms
 dispatch floor; kernel durations are immune). Sweeps (block_q, block_k)
 independently for the fwd kernel and the two backward kernels and prints
-a table; BASELINE.md records the chosen defaults."""
+a table; ops/attention.py `_default_blocks` records the chosen defaults.
+
+A WALL-clock cross-check closes the sweep (fwd+bwd through the public
+`flash_attention`, many iterations so the dispatch floor amortizes):
+the r5 kernel-only sweep pinned 1024 everywhere while the 2k wall time
+regressed 3.095 → 4.651 ms (BENCH r02 → r05) — per-kernel durations
+miss inter-kernel pipelining, so a pin needs both tables to agree.
+Needs a real TPU: Pallas on the CPU backend is interpret-only."""
 from __future__ import annotations
 
 import sys
@@ -76,6 +83,38 @@ def main():
                             if "custom-call" in n) - dkv_ms
                 print(f"  bq={bq:5d} bk={bk:5d}  dq={dq_ms:7.3f}  "
                       f"dkv={dkv_ms:7.3f}")
+            except Exception as e:
+                print(f"  bq={bq:5d} bk={bk:5d}  FAIL "
+                      f"{str(e).splitlines()[0][:70]}")
+
+    # Wall cross-check: grad of a sum through the public entry point, the
+    # full fwd+bwd pipeline per iteration. Best-of-3 windows of `iters`
+    # calls each; a scalar readback is the fence (block_until_ready is
+    # not one on the tunneled platform — see bench.py).
+    import time
+
+    from tony_tpu.ops import flash_attention
+
+    q4 = q.reshape(bh // 8, seq, 8, d)  # [B, T, H, D] public layout
+    k4, v4 = k.reshape(q4.shape), v.reshape(q4.shape)
+    print(f"== wall fwd+bwd, seq={seq} (ms/iter, best of 3) ==")
+    for bq in blocks:
+        for bk in blocks:
+            try:
+                g = jax.jit(jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, block_q=bq, block_k=bk
+                    ).astype(jnp.float32).sum()
+                ))
+                float(g(q4, k4, v4).sum())  # warm + fence
+                iters, best = 10, float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = g(q4, k4, v4)
+                    float(out.sum())
+                    best = min(best, time.perf_counter() - t0)
+                print(f"  bq={bq:5d} bk={bk:5d}  {best / iters * 1e3:7.3f}")
             except Exception as e:
                 print(f"  bq={bq:5d} bk={bk:5d}  FAIL "
                       f"{str(e).splitlines()[0][:70]}")
