@@ -122,7 +122,7 @@ class TestScriptLint:
     def test_examples_are_lint_clean(self):
         """Self-dogfooding: every shipped example passes its own lint."""
         scripts = sorted(EXAMPLES.glob("*.py"))
-        assert len(scripts) == 5
+        assert len(scripts) == 6
         for script in scripts:
             findings = lint_script(str(script))
             assert not findings, (
@@ -134,7 +134,7 @@ class TestScriptLint:
 
         assert lint([str(EXAMPLES)]) == 0
         out = capsys.readouterr().out
-        assert "5 script(s), 0 error(s), 0 warning(s)" in out
+        assert "6 script(s), 0 error(s), 0 warning(s)" in out
 
 
 # ---------------------------------------------------------------------------

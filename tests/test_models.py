@@ -1069,3 +1069,145 @@ class TestDecode:
 
         with pytest.raises(Exception, match="KV cache overflow"):
             err2.throw()  # 20 > 16
+
+
+class TestEosIdGeneration:
+    """generate(..., eos_id=): done-mask early exit + effective lengths
+    (the serving-era EOS contract, distinct from legacy eos_token's
+    post-hoc pad masking)."""
+
+    def _setup(self):
+        from tony_tpu.models import TransformerConfig, init_params
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+        )
+        return cfg, init_params(jax.random.key(0), cfg)
+
+    def test_lengths_and_forced_tail_match_plain_greedy(self):
+        from tony_tpu.models import generate
+
+        cfg, params = self._setup()
+        prompt = jnp.asarray(
+            np.random.default_rng(8).integers(0, 64, (3, 6)), jnp.int32
+        )
+        plain = np.asarray(generate(params, prompt, cfg, 8))
+        eos = int(plain[0, 1])  # row 0 stops at its 2nd token
+        res = generate(params, prompt, cfg, 8, eos_id=eos)
+        toks, lens = np.asarray(res.tokens), np.asarray(res.lengths)
+        for b in range(3):
+            hits = np.flatnonzero(plain[b] == eos)
+            want_len = hits[0] + 1 if hits.size else 8
+            assert lens[b] == want_len
+            # Unfinished prefix matches the plain trajectory exactly
+            # (positional key schedule), tail is forced to eos_id.
+            np.testing.assert_array_equal(toks[b, :want_len],
+                                          plain[b, :want_len])
+            assert (toks[b, want_len:] == eos).all()
+
+    def test_effective_length_one_when_first_token_is_eos(self):
+        from tony_tpu.models import generate
+
+        cfg, params = self._setup()
+        prompt = jnp.asarray(
+            np.random.default_rng(8).integers(0, 64, (2, 5)), jnp.int32
+        )
+        plain = np.asarray(generate(params, prompt, cfg, 4))
+        res = generate(params, prompt, cfg, 4, eos_id=int(plain[1, 0]))
+        assert int(np.asarray(res.lengths)[1]) == 1
+
+    def test_eos_id_and_eos_token_mutually_exclusive(self):
+        from tony_tpu.models import generate
+
+        cfg, params = self._setup()
+        with pytest.raises(ValueError, match="different contracts"):
+            generate(params, jnp.ones((1, 4), jnp.int32), cfg, 4,
+                     eos_id=3, eos_token=3)
+
+    def test_temperature_rows_match_plain_path_until_eos(self):
+        """The while_loop's positional key schedule: a sampling row that
+        has NOT hit EOS draws exactly what the plain scan path draws at
+        that step, even while other rows sit done."""
+        from tony_tpu.models import generate
+
+        cfg, params = self._setup()
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(0, 64, (3, 6)), jnp.int32
+        )
+        key = jax.random.key(11)
+        plain = np.asarray(generate(
+            params, prompt, cfg, 8, temperature=0.9, key=key
+        ))
+        eos = int(plain[0, 2])
+        res = generate(params, prompt, cfg, 8, temperature=0.9, key=key,
+                       eos_id=eos)
+        toks, lens = np.asarray(res.tokens), np.asarray(res.lengths)
+        for b in range(3):
+            hits = np.flatnonzero(plain[b] == eos)
+            want_len = hits[0] + 1 if hits.size else 8
+            assert lens[b] == want_len
+            np.testing.assert_array_equal(toks[b, :want_len],
+                                          plain[b, :want_len])
+
+
+class TestDecodeSessionRefresh:
+    """Satellite: DecodeSession.refresh + repeated generate — fused
+    weights are reused (never re-fused), and the compile-cache
+    instrumentation neither double-counts reused executables nor misses
+    new signatures across a refresh."""
+
+    def _setup(self):
+        from tony_tpu.models import TransformerConfig, init_params
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+            d_ff=64, max_seq=64, dtype="float32", remat=False,
+        )
+        return cfg, init_params(jax.random.key(0), cfg)
+
+    def test_refresh_with_fused_layout_is_identity(self):
+        from tony_tpu.models import DecodeSession
+
+        cfg, params = self._setup()
+        session = DecodeSession(params, cfg)
+        fused = session.params
+        assert "qkv" in fused["layers"]
+        session.refresh(fused)  # already fused: adopted as-is, no re-fuse
+        assert session.params is fused
+
+    def test_repeated_generate_and_refresh_instrumentation(self):
+        from tony_tpu.models import DecodeSession, generate
+        from tony_tpu.observability.metrics import default_registry
+
+        cfg, params = self._setup()
+        reg = default_registry()
+
+        def totals():
+            snap = reg.snapshot()["counters"]
+            return (snap.get("tony_compile_cache_hits_total", 0)
+                    + snap.get("tony_compile_cache_misses_total", 0))
+
+        session = DecodeSession(params, cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (2, 5)), jnp.int32
+        )
+        base = totals()
+        session.generate(prompt, max_new_tokens=4)
+        assert totals() == base + 1  # first signature instruments once
+        session.generate(prompt, max_new_tokens=4)
+        assert totals() == base + 1  # cached executable: not re-counted
+
+        # refresh() swaps weights only — same avals, same executable —
+        # so the signature must stay marked compiled...
+        params2 = jax.tree.map(lambda p: p * 1.5, params)
+        session.refresh(params2)
+        got = session.generate(prompt, max_new_tokens=4)
+        assert totals() == base + 1
+        # ...while still producing the refreshed weights' output.
+        want = generate(params2, prompt, cfg, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+        # A genuinely new signature (different horizon) counts again.
+        session.generate(prompt, max_new_tokens=6)
+        assert totals() == base + 2

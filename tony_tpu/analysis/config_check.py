@@ -63,6 +63,14 @@ _MIN_ONE_KEYS = frozenset({
     keys.K_IO_READ_WORKERS,
     keys.K_IO_CHUNK_RECORDS,
     keys.K_HEALTH_FLIGHT_LIMIT,
+    # A zero proxy connect timeout fails every upstream attempt
+    # instantly; a zero-slot or zero-chunk serving engine can never
+    # admit a request, and a zero-depth queue sheds all load.
+    keys.K_PROXY_CONNECT_TIMEOUT_MS,
+    keys.K_SERVING_SLOTS,
+    keys.K_SERVING_PREFILL_CHUNK,
+    keys.K_SERVING_DECODE_WINDOW,
+    keys.K_SERVING_MAX_QUEUE,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold

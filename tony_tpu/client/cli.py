@@ -137,7 +137,12 @@ def notebook_submit(argv: list[str]) -> int:
             if url:
                 m = re.match(r"(?:https?://)?([^:/]+):(\d+)", url)
                 if m:
-                    proxy = ProxyServer(m.group(1), int(m.group(2)), 0)
+                    proxy = ProxyServer(
+                        m.group(1), int(m.group(2)), 0,
+                        connect_timeout_s=conf.get_int(
+                            keys.K_PROXY_CONNECT_TIMEOUT_MS, 5000
+                        ) / 1000.0,
+                    )
                     port = proxy.start()
                     proxy_holder.append(proxy)
                     log.info("notebook tunnel: http://localhost:%d", port)

@@ -156,6 +156,37 @@ K_HEALTH_ALERT_COOLDOWN_MS = HEALTH_PREFIX + "alert-cooldown"
 # summaries / events kept for blackbox-*.json dumps).
 K_HEALTH_FLIGHT_LIMIT = HEALTH_PREFIX + "flight-recorder-limit"
 
+# --- proxy (proxy/server.py) ------------------------------------------------
+PROXY_PREFIX = TONY_PREFIX + "proxy."
+# Per-ATTEMPT upstream connect timeout, ms (attempts retry until the
+# tunnel's connect deadline). Replaced a hardcoded 5 s: cross-region
+# backends need more, a LAN serving mesh wants to fail over in less.
+K_PROXY_CONNECT_TIMEOUT_MS = PROXY_PREFIX + "connect-timeout"
+
+# --- serving engine (serving/) ---------------------------------------------
+# Continuous-batching knobs for the ``serving`` task type. The executor
+# exports these to user processes as TONY_SERVING_* env; examples/
+# lm_serve.py (and any custom serving script) reads them as defaults.
+SERVING_PREFIX = TONY_PREFIX + "serving."
+# Fixed slot-batch width: concurrent decode streams per engine. Each
+# slot owns a KV-cache row, so HBM cost scales linearly — see
+# docs/DEPLOY.md "Serving" for the sizing rule.
+K_SERVING_SLOTS = SERVING_PREFIX + "slots"
+# Prefill chunk length, tokens: the longest a new prompt may stall the
+# in-flight decode streams per engine iteration.
+K_SERVING_PREFILL_CHUNK = SERVING_PREFIX + "prefill-chunk"
+# Decode steps per host sync (the throughput/latency knob): 1 retires
+# at EOS exactly per-token; deeper windows amortize the per-dispatch
+# host cost over N tokens at up to N-1 wasted lane-steps per retiring
+# stream and N-step admission latency.
+K_SERVING_DECODE_WINDOW = SERVING_PREFIX + "decode-window"
+# Admission backpressure: queued (not-yet-slotted) requests beyond this
+# are shed (HTTP 503) instead of buffered.
+K_SERVING_MAX_QUEUE = SERVING_PREFIX + "max-queue"
+# HTTP port the serving task binds (0 = the executor-reserved chief
+# port when available, else ephemeral).
+K_SERVING_PORT = SERVING_PREFIX + "port"
+
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
 # (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
@@ -258,6 +289,12 @@ DEFAULTS: dict[str, object] = {
     K_HEALTH_IO_STALL_RATIO: 0.5,
     K_HEALTH_ALERT_COOLDOWN_MS: 30000,
     K_HEALTH_FLIGHT_LIMIT: 256,
+    K_PROXY_CONNECT_TIMEOUT_MS: 5000,
+    K_SERVING_SLOTS: 8,
+    K_SERVING_PREFILL_CHUNK: 32,
+    K_SERVING_DECODE_WINDOW: 1,
+    K_SERVING_MAX_QUEUE: 1024,
+    K_SERVING_PORT: 0,
     K_STAGING_LOCATION: "",
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",

@@ -89,6 +89,13 @@ TONY_IO_CHUNK_RECORDS = "TONY_IO_CHUNK_RECORDS"
 TONY_COMPILE_CACHE_DIR = "TONY_COMPILE_CACHE_DIR"
 TONY_COMPILE_CACHE_ENABLED = "TONY_COMPILE_CACHE_ENABLED"
 TONY_COMPILE_MIN_ENTRY_SIZE = "TONY_COMPILE_MIN_ENTRY_SIZE"
+# Continuous-batching serving engine (tony.serving.* conf → user-process
+# env → examples/lm_serve.py / tony_tpu.serving defaults).
+TONY_SERVING_SLOTS = "TONY_SERVING_SLOTS"
+TONY_SERVING_PREFILL_CHUNK = "TONY_SERVING_PREFILL_CHUNK"
+TONY_SERVING_DECODE_WINDOW = "TONY_SERVING_DECODE_WINDOW"
+TONY_SERVING_MAX_QUEUE = "TONY_SERVING_MAX_QUEUE"
+TONY_SERVING_PORT = "TONY_SERVING_PORT"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -106,6 +113,8 @@ DOCKER_FORWARD_ENV = (
     TONY_IO_PREFETCH_DEPTH, TONY_IO_READ_WORKERS, TONY_IO_CHUNK_RECORDS,
     TONY_COMPILE_CACHE_DIR, TONY_COMPILE_CACHE_ENABLED,
     TONY_COMPILE_MIN_ENTRY_SIZE,
+    TONY_SERVING_SLOTS, TONY_SERVING_PREFILL_CHUNK,
+    TONY_SERVING_DECODE_WINDOW, TONY_SERVING_MAX_QUEUE, TONY_SERVING_PORT,
 )
 
 # The executor's self-termination code after losing the coordinator (N

@@ -450,6 +450,25 @@ class TaskExecutor:
         env[constants.TONY_COMPILE_MIN_ENTRY_SIZE] = str(
             self.conf.get_int(keys.K_COMPILE_MIN_ENTRY_SIZE, 0)
         )
+        # Serving engine tuning (tony.serving.* conf → user-process env):
+        # the serving task type's script reads these as its engine
+        # defaults, so slot/chunk/backpressure sizing is a conf change,
+        # not a script change.
+        env[constants.TONY_SERVING_SLOTS] = str(
+            self.conf.get_int(keys.K_SERVING_SLOTS, 8)
+        )
+        env[constants.TONY_SERVING_PREFILL_CHUNK] = str(
+            self.conf.get_int(keys.K_SERVING_PREFILL_CHUNK, 32)
+        )
+        env[constants.TONY_SERVING_DECODE_WINDOW] = str(
+            self.conf.get_int(keys.K_SERVING_DECODE_WINDOW, 1)
+        )
+        env[constants.TONY_SERVING_MAX_QUEUE] = str(
+            self.conf.get_int(keys.K_SERVING_MAX_QUEUE, 1024)
+        )
+        env[constants.TONY_SERVING_PORT] = str(
+            self.conf.get_int(keys.K_SERVING_PORT, 0)
+        )
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(

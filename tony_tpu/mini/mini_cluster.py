@@ -65,12 +65,12 @@ class MiniTonyCluster:
         conf.set(keys.K_AM_STOP_GRACE_MS, 0)  # no client finish-signal to wait for
         return conf
 
-    def run_job(
-        self, conf: TonyConfiguration, timeout_s: float = 120.0
-    ) -> tuple[SessionStatus, TonyCoordinator]:
-        """Run one job to completion with an in-process coordinator. The
-        RPC server + executor subprocesses are real; only the "RM" container
-        allocation is replaced by local process spawning."""
+    def start_job(self, conf: TonyConfiguration) -> "RunningMiniJob":
+        """Launch one job and return immediately — the interactive twin
+        of ``run_job`` for tests that must talk TO the job while it runs
+        (the serving e2e drives generate requests through the proxy and
+        only then lets the session finish). ``RunningMiniJob.wait()``
+        has ``run_job``'s completion/cleanup semantics."""
         self._app_seq += 1
         # Preflight in WARN mode regardless of the conf's own setting:
         # mini-cluster jobs are dev/test runs, so findings should print
@@ -99,13 +99,44 @@ class MiniTonyCluster:
         )
         self._live.append(coordinator)
         t.start()
+        return RunningMiniJob(self, coordinator, t, result, app_id)
+
+    def run_job(
+        self, conf: TonyConfiguration, timeout_s: float = 120.0
+    ) -> tuple[SessionStatus, TonyCoordinator]:
+        """Run one job to completion with an in-process coordinator. The
+        RPC server + executor subprocesses are real; only the "RM" container
+        allocation is replaced by local process spawning."""
+        job = self.start_job(conf)
+        return job.wait(timeout_s), job.coordinator
+
+
+class RunningMiniJob:
+    """Handle for a ``start_job`` launch: the live coordinator (RPC/HTTP
+    addresses, staging dir) plus ``wait()`` for the final status."""
+
+    def __init__(self, cluster: MiniTonyCluster,
+                 coordinator: TonyCoordinator, thread: threading.Thread,
+                 result: "list[SessionStatus]", app_id: str) -> None:
+        self.cluster = cluster
+        self.coordinator = coordinator
+        self.app_id = app_id
+        self.app_dir = cluster.staging_dir / app_id
+        self._thread = thread
+        self._result = result
+
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def wait(self, timeout_s: float = 120.0) -> SessionStatus:
+        t, coordinator = self._thread, self.coordinator
         try:
             t.join(timeout=timeout_s)
             if t.is_alive():
                 coordinator.kill()
                 t.join(timeout=10)
                 raise TimeoutError(
-                    f"job {app_id} did not finish within {timeout_s}s"
+                    f"job {self.app_id} did not finish within {timeout_s}s"
                 )
         finally:
             if not t.is_alive():
@@ -115,10 +146,11 @@ class MiniTonyCluster:
                     coordinator.backend.stop_all()
                 except Exception:
                     pass
-                self._live.remove(coordinator)
-        if not result:
+                if coordinator in self.cluster._live:
+                    self.cluster._live.remove(coordinator)
+        if not self._result:
             raise RuntimeError(
-                f"coordinator for {app_id} crashed without a status — "
+                f"coordinator for {self.app_id} crashed without a status — "
                 f"see its log output"
             )
-        return result[0], coordinator
+        return self._result[0]

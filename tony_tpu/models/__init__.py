@@ -24,6 +24,7 @@ from tony_tpu.models.transformer import (
 )
 from tony_tpu.models.decode import (
     DecodeSession,
+    GenerateResult,
     advance,
     decode_param_specs,
     decode_weights,
@@ -59,6 +60,7 @@ __all__ = [
     "lm_loss",
     "advance",
     "DecodeSession",
+    "GenerateResult",
     "decode_param_specs",
     "decode_weights",
     "generate",

@@ -35,6 +35,7 @@ streaming. A ``routed`` top-k-only evaluation
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -481,6 +482,17 @@ def _sample(logits, temperature, top_k, top_p, key):
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
+class GenerateResult(NamedTuple):
+    """``generate(..., eos_id=)`` result: ``tokens`` [B, max_new_tokens]
+    with every position from a row's first EOS onward forced to
+    ``eos_id``, and ``lengths`` [B] — generated tokens up to and
+    INCLUDING the EOS (``max_new_tokens`` when a row never stops).
+    ``tokens[b, :lengths[b]]`` is row b's effective output."""
+
+    tokens: jax.Array
+    lengths: jax.Array
+
+
 def generate(
     params: dict,
     prompt: jax.Array,
@@ -491,21 +503,30 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_token: int | None = None,
+    eos_id: int | None = None,
     pad_token: int = 0,
     key: jax.Array | None = None,
-) -> jax.Array:
+) -> jax.Array | GenerateResult:
     """Autoregressive generation: prefill the prompt [B, T0], then decode
     ``max_new_tokens`` greedily (temperature 0) or by temperature sampling
     with optional ``top_k`` / ``top_p`` (nucleus) truncation. Returns the
     generated tokens [B, max_new_tokens].
 
-    ``eos_token``: positions after a sequence's first EOS come back as
-    ``pad_token``. The masking is post-hoc: the loop still runs the full
-    static horizon (XLA needs static shapes; per-sequence early exit would
-    retrace per length) and finished sequences keep feeding their SAMPLED
-    continuation internally — the mask only guarantees callers never see
-    it. Cache contents past EOS are therefore sampled-token-conditioned,
-    and sampling keys are consumed for masked positions too.
+    ``eos_id``: EOS-aware decoding. The loop carries a per-row done mask:
+    finished rows stop sampling (their positions are forced to ``eos_id``)
+    and the loop EXITS as soon as every row is done — a ``while_loop``
+    with a dynamic trip count, so a batch whose rows all stop early stops
+    paying for the full static horizon. Returns ``GenerateResult(tokens,
+    lengths)``; unfinished rows still match the plain path token-for-token
+    at a given step (the sampling key schedule is positional, and the
+    categorical draw's noise is independent of other rows' logits).
+
+    ``eos_token`` (legacy): positions after a sequence's first EOS come
+    back as ``pad_token``. The masking is post-hoc: the loop still runs
+    the full static horizon and finished sequences keep feeding their
+    SAMPLED continuation internally — the mask only guarantees callers
+    never see it. Mutually exclusive with ``eos_id``; serving-era callers
+    want ``eos_id``.
 
     Two jitted executables: weight fusion (``decode_weights``) runs as its
     own dispatch, then the prefill+loop runs over the fused params. Fusing
@@ -513,6 +534,11 @@ def generate(
     into the while body and re-materializes it every token (measured 5
     extra DMA copies/step), so the split is deliberate."""
     b, t0 = prompt.shape
+    if eos_token is not None and eos_id is not None:
+        raise ValueError(
+            "eos_token (post-hoc pad masking) and eos_id (done-mask early "
+            "exit) are different contracts — pass one"
+        )
     if t0 + max_new_tokens > cfg.max_seq:
         raise ValueError(
             f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
@@ -532,6 +558,12 @@ def generate(
         key = jax.random.key(0)  # unused in greedy mode
     if "qkv" not in params["layers"]:
         params = _decode_weights_jit(params, cfg)
+    if eos_id is not None:
+        toks, lengths = _generate_loop_eos(
+            params, prompt, cfg, max_new_tokens, temperature, top_k,
+            top_p, key, jnp.int32(eos_id),
+        )
+        return GenerateResult(toks, lengths)
     toks = _generate_loop(params, prompt, cfg, max_new_tokens, temperature,
                           top_k, top_p, key)
     if eos_token is not None:
@@ -705,3 +737,67 @@ def _generate_loop(
 
     (_, _), toks = lax.scan(step, (cache, tok0), keys[1:])
     return jnp.concatenate([tok0[:, None], toks.T], axis=1)  # [B, N]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                     "top_p"),
+)
+def _generate_loop_eos(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+    key: jax.Array,
+    eos_id: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """EOS-aware twin of ``_generate_loop``: a ``while_loop`` carrying a
+    per-row done mask that exits when every row has emitted ``eos_id``
+    (or the horizon runs out). Shapes stay static — the output buffer is
+    the full [B, max_new_tokens], pre-filled with ``eos_id`` so
+    never-written tail positions already carry the forced value — only
+    the TRIP COUNT is dynamic, which is where the saving lives: a batch
+    of short answers stops advancing the model the step its last row
+    finishes. ``eos_id`` rides as a traced scalar so changing it never
+    recompiles.
+
+    Key schedule parity: ``keys[i]`` is indexed by absolute step, and
+    the categorical draw's Gumbel noise is keyed per (row, vocab)
+    position — so a still-running row samples exactly what the plain
+    scan path would have sampled at that step, even though finished
+    rows now feed ``eos_id`` instead of their sampled continuation."""
+    b, t0 = prompt.shape
+    if max_new_tokens == 0:
+        return (jnp.zeros((b, 0), jnp.int32), jnp.zeros((b,), jnp.int32))
+    cache = init_cache(cfg, b, t0 + max_new_tokens)
+    logits, cache = advance(params, cache, prompt, cfg, prefill=True)
+    keys = jax.random.split(key, max_new_tokens)
+    tok0 = _sample(logits, temperature, top_k, top_p, keys[0])
+    done0 = tok0 == eos_id
+    out0 = jnp.full((b, max_new_tokens), eos_id, jnp.int32)
+    out0 = lax.dynamic_update_slice(out0, tok0[:, None], (0, 0))
+    lengths0 = jnp.ones((b,), jnp.int32)
+
+    def cond(carry):
+        _, _, done, _, _, i = carry
+        return (i < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        cache, tok, done, out, lengths, i = carry
+        logits, cache = advance(params, cache, tok[:, None], cfg)
+        step_key = lax.dynamic_index_in_dim(keys, i, 0, keepdims=False)
+        nxt = _sample(logits, temperature, top_k, top_p, step_key)
+        nxt = jnp.where(done, eos_id, nxt)
+        out = lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        lengths = jnp.where(done, lengths, i + 1)
+        done = done | (nxt == eos_id)
+        return (cache, nxt, done, out, lengths, i + 1)
+
+    _, _, _, out, lengths, _ = lax.while_loop(
+        cond, body, (cache, tok0, done0, out0, lengths0, jnp.int32(1))
+    )
+    return out, lengths

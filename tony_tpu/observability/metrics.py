@@ -75,6 +75,24 @@ def sanitize_metric_name(raw: str) -> str:
     return name or "unnamed"
 
 
+def _labeled_key(name: str, labels: Mapping[str, str]) -> str:
+    """Registry key for a labeled metric child: the Prometheus sample
+    syntax itself (``name{a="b"}``), sorted for a canonical identity.
+    Snapshots carry these keys verbatim — the render path splits them
+    back apart, JSON consumers see the self-describing sample name."""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return f"{name}{{{inner}}}"
+
+
+def split_labeled_key(key: str) -> tuple[str, str]:
+    """(base_name, inline-label text) — inverse of ``_labeled_key``
+    for the render path; plain names come back with empty labels."""
+    base, sep, rest = key.partition("{")
+    return (base, rest[:-1] if sep and rest.endswith("}") else "")
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -188,28 +206,36 @@ class MetricsRegistry:
             atexit.register(self.flush)
 
     # -- registration ------------------------------------------------------
-    def _get_or_register(self, cls, name: str, help: str, **kwargs):
+    def _get_or_register(self, cls, name: str, help: str,
+                         labels: Mapping[str, str] | None = None, **kwargs):
+        # Labeled children validate the BASE name (the labels are data,
+        # not name) and register under the Prometheus sample key, so one
+        # base name fans out into per-label series that snapshots and
+        # renders carry natively.
         complaint = validate_metric_name(name, cls.kind)
         if complaint:
             raise ValueError(complaint)
+        key = _labeled_key(name, labels) if labels else name
         with self._lock:
-            existing = self._metrics.get(name)
+            existing = self._metrics.get(key)
             if existing is not None:
                 if not isinstance(existing, cls):
                     raise ValueError(
-                        f"metric {name!r} already registered as "
+                        f"metric {key!r} already registered as "
                         f"{existing.kind}, not {cls.kind}"
                     )
                 return existing
-            metric = cls(name, help, **kwargs)
-            self._metrics[name] = metric
+            metric = cls(key, help, **kwargs)
+            self._metrics[key] = metric
             return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_register(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labels: Mapping[str, str] | None = None) -> Counter:
+        return self._get_or_register(Counter, name, help, labels=labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_register(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labels: Mapping[str, str] | None = None) -> Gauge:
+        return self._get_or_register(Gauge, name, help, labels=labels)
 
     def histogram(
         self, name: str, help: str = "",
@@ -345,13 +371,15 @@ def _escape_label(value: str) -> str:
     )
 
 
-def _labels(labels: Mapping[str, str] | None) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(
-        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+def _labels(labels: Mapping[str, str] | None, inline: str = "") -> str:
+    """Render a label block, merging a sample key's INLINE labels (from
+    ``_labeled_key``, already escaped) with the caller's extra labels
+    (the aggregator's ``{"task": id}``)."""
+    extra = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted((labels or {}).items())
     )
-    return "{" + inner + "}"
+    inner = ",".join(p for p in (inline, extra) if p)
+    return "{" + inner + "}" if inner else ""
 
 
 def render_prometheus(
@@ -371,12 +399,14 @@ def render_prometheus(
             seen.add(name)
             out.append(f"# TYPE {name} {kind}")
 
-    for name, value in sorted(snapshot.get("counters", {}).items()):
+    for key, value in sorted(snapshot.get("counters", {}).items()):
+        name, inline = split_labeled_key(key)
         header(name, "counter")
-        out.append(f"{name}{_labels(labels)} {_fmt(value)}")
-    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        out.append(f"{name}{_labels(labels, inline)} {_fmt(value)}")
+    for key, value in sorted(snapshot.get("gauges", {}).items()):
+        name, inline = split_labeled_key(key)
         header(name, "gauge")
-        out.append(f"{name}{_labels(labels)} {_fmt(value)}")
+        out.append(f"{name}{_labels(labels, inline)} {_fmt(value)}")
     for name, h in sorted(snapshot.get("histograms", {}).items()):
         header(name, "histogram")
         base = dict(labels or {})

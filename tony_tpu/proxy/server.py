@@ -1,5 +1,9 @@
 """Bidirectional TCP byte pump (ProxyServer.java:33-97: thread per
-connection, two pump loops per tunnel)."""
+connection, two pump loops per tunnel). Tunnel traffic is counted into
+the observability registry as ``tony_proxy_bytes_total{direction=}`` —
+``up`` is client→upstream, ``down`` is upstream→client — so a serving
+deployment's proxy shows its load on the same ``/metrics`` plane as the
+engine behind it."""
 
 from __future__ import annotations
 
@@ -8,9 +12,16 @@ import socket
 import threading
 import time
 
+from tony_tpu.observability import metrics as obs_metrics
+
 log = logging.getLogger(__name__)
 
 _BUF = 65536
+
+# Default per-attempt upstream connect timeout, seconds; deployments
+# tune it via ``tony.proxy.connect-timeout`` (ms) — the CLI threads the
+# conf value through ``connect_timeout_s``.
+DEFAULT_CONNECT_TIMEOUT_S = 5.0
 
 
 class ProxyServer:
@@ -20,6 +31,8 @@ class ProxyServer:
         remote_port: int,
         local_port: int,
         connect_deadline_s: float = 20.0,
+        connect_timeout_s: float = DEFAULT_CONNECT_TIMEOUT_S,
+        registry: obs_metrics.MetricsRegistry | None = None,
     ) -> None:
         self.remote_host = remote_host
         self.remote_port = remote_port
@@ -27,10 +40,25 @@ class ProxyServer:
         # Upstream connects retry until this deadline: the tunnel URL is
         # registered before the notebook process binds its port, so the
         # first browser connection routinely beats the backend coming up.
+        # Each attempt gets connect_timeout_s (tony.proxy.connect-timeout
+        # replaced the old hardcoded 5 s: a slow-SYN cross-region backend
+        # needs more, a LAN serving mesh wants to fail over in less).
         self.connect_deadline_s = connect_deadline_s
+        self.connect_timeout_s = connect_timeout_s
         self._server: socket.socket | None = None
         self._stopped = threading.Event()
         self._threads: list[threading.Thread] = []
+        reg = registry if registry is not None else (
+            obs_metrics.default_registry()
+        )
+        self._bytes_up = reg.counter(
+            "tony_proxy_bytes_total", "bytes pumped through the tunnel",
+            labels={"direction": "up"},
+        )
+        self._bytes_down = reg.counter(
+            "tony_proxy_bytes_total", "bytes pumped through the tunnel",
+            labels={"direction": "down"},
+        )
 
     def start(self) -> int:
         """Listen on local_port (0 = ephemeral) and serve in background
@@ -67,9 +95,12 @@ class ProxyServer:
         # Pump threads are daemons that exit with their sockets; they
         # are not tracked (a 24h notebook tunnel would otherwise
         # accumulate two dead Thread objects per browser connection).
-        for src, dst in ((client, remote), (remote, client)):
+        for src, dst, counter in (
+            (client, remote, self._bytes_up),
+            (remote, client, self._bytes_down),
+        ):
             threading.Thread(
-                target=self._pump, args=(src, dst), daemon=True
+                target=self._pump, args=(src, dst, counter), daemon=True
             ).start()
 
     def _connect_upstream(self) -> socket.socket | None:
@@ -77,7 +108,8 @@ class ProxyServer:
         while not self._stopped.is_set():
             try:
                 sock = socket.create_connection(
-                    (self.remote_host, self.remote_port), timeout=5
+                    (self.remote_host, self.remote_port),
+                    timeout=self.connect_timeout_s,
                 )
                 sock.settimeout(None)  # pump loops block on idle tunnels
                 return sock
@@ -90,13 +122,15 @@ class ProxyServer:
         return None
 
     @staticmethod
-    def _pump(src: socket.socket, dst: socket.socket) -> None:
+    def _pump(src: socket.socket, dst: socket.socket,
+              counter: obs_metrics.Counter) -> None:
         try:
             while True:
                 data = src.recv(_BUF)
                 if not data:
                     break
                 dst.sendall(data)
+                counter.inc(len(data))
         except OSError:
             pass
         finally:

@@ -1,0 +1,17 @@
+"""Continuous-batching serving engine — the "heavy traffic" half of the
+north star.
+
+One jitted slot-batch decode step + bounded chunked prefill
+(``engine.py``), a host scheduler owning admission / EOS retirement /
+slot reuse (``scheduler.py``), and an HTTP front end (``http.py``) a
+``serving`` task type runs behind the proxy. See docs/DEPLOY.md
+"Serving".
+"""
+
+from tony_tpu.serving.scheduler import (
+    ServingEngine,
+    ServingQueueFull,
+    ServingRequest,
+)
+
+__all__ = ["ServingEngine", "ServingQueueFull", "ServingRequest"]

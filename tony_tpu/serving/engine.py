@@ -1,0 +1,319 @@
+"""Device half of the continuous-batching serving engine.
+
+The single-shot ``generate`` path compiles one executable per (batch,
+prompt width, horizon) signature and runs every row to the full static
+horizon — fine for eval generation, a throughput wall for serving
+(BENCH r03–r05: the marginal GQA decode step sustains 12.4k tok/s/chip
+while ``generate_wall`` sits at ~5.5k; the kernel is fine, the
+orchestration is the tax). This module is the orchestration fix: TWO
+executables total, compiled once per engine lifetime, shared by every
+request that ever passes through —
+
+* ``decode_step`` — ONE token for ALL slots. The slot batch is a fixed
+  [S] lane array; each slot owns a row of the stacked KV cache
+  [L, S, Tmax, Hkv, Dh], its own position, and its own sampling
+  temperature, so requests of different lengths share every decode
+  iteration (Orca-style iteration-level scheduling). Per-slot cache
+  writes are a vmapped ``dynamic_update_slice`` at each slot's own
+  offset; attention masks per row with ``key_index <= pos[slot]``.
+* ``prefill_chunk`` — a bounded chunk of ONE request's prompt into its
+  slot's cache row. Chunking bounds how long a new prompt can stall the
+  in-flight decode streams: the host interleaves one chunk per engine
+  iteration, so time-to-first-token for the new request trades off
+  against inter-token latency for everyone else at a fixed, configured
+  granularity (``tony.serving.prefill-chunk``).
+
+Both run over the fused ``decode_weights`` layout (weights fuse once per
+engine, exactly like ``DecodeSession``) and carry the stacked caches as
+scan CARRY (the xs/ys re-stack cost decode.py's docstring documents).
+KV buffers are donated, so the two big cache arrays update in place.
+
+Overwrite-before-read invariant: slot reuse never zeroes a cache row.
+A freed slot's stale K/V rows are only ever unmasked after the new
+request's own prefill/decode has written those positions (prefill
+covers [0, P); each decode step writes index ``pos`` before attention
+reads it), so stale data is structurally unreadable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.decode import NEG_INF, _moe_mlp_decode
+from tony_tpu.models.transformer import TransformerConfig
+from tony_tpu.ops import apply_rope, rms_norm, rope_frequencies
+
+
+def init_slot_cache(
+    cfg: TransformerConfig, slots: int, max_len: int
+) -> tuple[jax.Array, jax.Array]:
+    """Zeroed stacked KV cache pair [L, S, Tmax, Hkv, Dh] — one row per
+    slot, sized once for the engine's lifetime. Serving HBM budget is
+    2 · L · S · Tmax · Hkv · Dh · dtype bytes; see docs/DEPLOY.md
+    "Serving" for the sizing table."""
+    shape = (cfg.n_layers, slots, max_len, cfg.kv_heads, cfg.head_dim)
+    dt = cfg.compute_dtype
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def _mlp(x, lp, cfg):
+    """SwiGLU over the fused gate|up projection, or the dense MoE
+    mixture for expert trunks — the same math as decode's
+    ``_layer_decode`` MLP half (serving always takes the dense mixture:
+    the measured winner at decode batch sizes, see decode.py)."""
+    dt = cfg.compute_dtype
+    if "router" in lp:
+        return x + _moe_mlp_decode(x, lp, cfg)
+    hn = rms_norm(x, lp["ln2"]).astype(dt)
+    gu = jnp.einsum("btd,df->btf", hn, lp["gate_up"])
+    f = gu.shape[-1] // 2
+    act = (
+        jax.nn.silu(gu[..., :f].astype(jnp.float32)).astype(dt)
+        * gu[..., f:]
+    )
+    return x + jnp.einsum("btf,fd->btd", act, lp["w_down"])
+
+
+def _attend_cache(q, k_cache, v_cache, mask, cfg):
+    """Grouped attention against cache rows — q regrouped
+    [B, S, Hkv, G, Dh] so GQA never head-repeats the cache, stored-dtype
+    reads with fp32 MXU accumulation and fp32 softmax (the decode.py
+    recipe). mask: [B, S_q, T] True where the key is visible."""
+    dt = cfg.compute_dtype
+    b, s, n_h, _ = q.shape
+    h_kv = k_cache.shape[2]
+    g = n_h // h_kv
+    scale = cfg.head_dim ** -0.5
+    qg = q.reshape(b, s, h_kv, g, cfg.head_dim)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(dt), v_cache,
+        preferred_element_type=jnp.float32,
+    ).astype(dt).reshape(b, s, n_h, cfg.head_dim)
+
+
+def _sample_slots(logits, temp, key):
+    """Per-slot sampling: greedy where ``temp == 0``, else temperature
+    sampling. One key serves the whole slot batch — the Gumbel noise
+    tensor is keyed per (row, vocab) position, so each row's draw is
+    independent of every other row's logits. The categorical branch
+    hides behind ``lax.cond``: threefry over [S, V] costs ~16% of a
+    micro decode step on CPU, and an all-greedy slot batch (the common
+    serving default) must not pay it."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(_):
+        scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+        drawn = jax.random.categorical(key, scaled, axis=-1).astype(
+            jnp.int32
+        )
+        return jnp.where(temp > 0.0, drawn, greedy)
+
+    return lax.cond(jnp.any(temp > 0.0), sample, lambda _: greedy, None)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(1, 2)
+)
+def decode_window(params, k_all, v_all, pos, wpos, tokens, temp,
+                  base_key, draw0, cfg: TransformerConfig,
+                  steps: int = 1):
+    """``steps`` decode iterations for every slot in ONE dispatch: feed
+    ``tokens`` [S] at each slot's own ``pos``, write the new K/V row at
+    ``wpos``, attend the slot's cache prefix, sample the next token per
+    slot, advance, repeat. ``steps`` is the host-sync window — the
+    throughput/latency knob (``tony.serving.decode-window``): 1 keeps
+    admission and EOS retirement exactly per-token; a deeper window
+    amortizes the per-dispatch host cost over ``steps`` tokens at the
+    price of up to ``steps - 1`` wasted lane-steps per retiring stream
+    (measured on the CPU micro bench: host dispatch + PRNG fold cost
+    ~2× the model step itself at window 1).
+
+    pos/wpos/temp live on the HOST between windows (tiny [S] arrays;
+    the scheduler mutates them freely on admit/retire) and ride in as
+    arguments; only the KV caches are device-resident state (donated —
+    the caller must adopt the returned buffers). Sampling keys derive
+    INSIDE the jit (``fold_in(base_key, draw0 + i)`` — a host-side
+    fold_in is a whole extra dispatch per iteration), so the schedule
+    is positional and reproducible from (seed, draw counter).
+
+    Inactive slots still compute (the lane array is fixed) and still
+    WRITE — the scheduler parks their ``wpos`` at ``Tmax - 1``, the one
+    index the overwrite-before-read invariant protects unconditionally.
+    Parking matters: an inactive lane writing at its stale ``pos``
+    would clobber cache rows a CONCURRENT prefill into that slot
+    already filled (the measured parity break that introduced
+    ``wpos``). For active slots ``wpos == pos``; past a stream's
+    retirement point mid-window its writes clamp at ``Tmax - 1`` too.
+
+    Returns (k_all, v_all, window_tokens [S, steps] int32).
+    """
+    dt = cfg.compute_dtype
+    t_max = k_all.shape[2]
+    n_h, h_kv = cfg.n_heads, cfg.kv_heads
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                theta=cfg.rope_theta)
+
+    def one_step(carry, i):
+        k_all, v_all, pos, wpos, tokens = carry
+        x = params["embed"][tokens][:, None, :].astype(dt)  # [S, 1, d]
+        # Visibility after the write: keys 0..pos inclusive (index pos
+        # holds the token being fed this step). Inactive lanes' pos can
+        # run past the table mid-window — clamp the RoPE gather (their
+        # output is discarded; the mask itself cannot overflow).
+        rp = jnp.minimum(pos, cfg.max_seq - 1)[:, None]
+        mask = jnp.arange(t_max)[None, :] <= pos[:, None]   # [S, T]
+
+        def body(carry, layer_in):
+            x, k_all, v_all = carry
+            lp, layer = layer_in
+            h = rms_norm(x, lp["ln1"]).astype(dt)
+            qkv = jnp.einsum("btd,dhk->bthk", h, lp["qkv"])
+            q = qkv[:, :, :n_h]
+            k_new = qkv[:, :, n_h:n_h + h_kv]
+            v_new = qkv[:, :, n_h + h_kv:]
+            q = apply_rope(q, cos, sin, positions=rp)
+            k_new = apply_rope(k_new, cos, sin, positions=rp)
+            k_layer = lax.dynamic_index_in_dim(k_all, layer, 0,
+                                               keepdims=False)
+            v_layer = lax.dynamic_index_in_dim(v_all, layer, 0,
+                                               keepdims=False)
+            write = jax.vmap(
+                lambda row, new, p: lax.dynamic_update_slice(
+                    row, new, (p, 0, 0)
+                )
+            )
+            k_layer = write(k_layer, k_new.astype(k_all.dtype), wpos)
+            v_layer = write(v_layer, v_new.astype(v_all.dtype), wpos)
+            k_all = lax.dynamic_update_slice(
+                k_all, k_layer[None], (layer, 0, 0, 0, 0)
+            )
+            v_all = lax.dynamic_update_slice(
+                v_all, v_layer[None], (layer, 0, 0, 0, 0)
+            )
+            o = _attend_cache(q, k_layer, v_layer, mask[:, None, :], cfg)
+            x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
+            x = _mlp(x, lp, cfg)
+            return (x, k_all, v_all), None
+
+        (x, k_all, v_all), _ = lax.scan(
+            body, (x, k_all, v_all),
+            (params["layers"], jnp.arange(cfg.n_layers)),
+        )
+        x = rms_norm(x[:, -1:], params["final_norm"]).astype(dt)
+        logits = jnp.einsum(
+            "btd,dv->btv", x, params["unembed"]
+        )[:, 0].astype(jnp.float32)
+        nxt = _sample_slots(
+            logits, temp, jax.random.fold_in(base_key, draw0 + i)
+        )
+        pos = pos + 1
+        wpos = jnp.minimum(wpos + 1, t_max - 1)
+        return (k_all, v_all, pos, wpos, nxt), nxt
+
+    (k_all, v_all, _, _, _), toks = lax.scan(
+        one_step, (k_all, v_all, pos, wpos, tokens), jnp.arange(steps)
+    )
+    return k_all, v_all, toks.T  # [S, steps]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(1, 2)
+)
+def prefill_chunks(params, k_all, v_all, tokens, slots, starts, n_valids,
+                   temps, base_key, draw, cfg: TransformerConfig):
+    """Prefill one chunk for EACH of P pending slots in one dispatch:
+    ``tokens`` [P, C] row i is written into slot ``slots[i]`` at
+    positions [starts[i], starts[i] + C). Batching the pending slots is
+    the prefill twin of the slot-batch decode step — per-chunk batch-1
+    dispatches measured ~3× the comparator's batched-prefill wall on
+    the CPU micro bench (fixed dispatch + op overhead per chunk), and
+    on TPU a [1, C] chunk cannot fill the MXU.
+
+    The host guarantees distinct slots per batch and ``start + C <=
+    Tmax``; it PADS short batches by duplicating row 0 — the duplicate
+    rewrites identical K/V (idempotent), so one executable serves every
+    pending count. Padded tails past ``n_valids[i]`` write garbage the
+    overwrite-before-read invariant keeps unreadable.
+
+    Returns (k_all, v_all, first_tokens [P], logits [P, V] fp32): row
+    i's token samples from position ``n_valids[i] - 1`` — meaningful
+    only on a request's FINAL chunk (earlier chunks' sample is
+    discarded by the scheduler; computing it unconditionally keeps one
+    executable)."""
+    dt = cfg.compute_dtype
+    p, c = tokens.shape
+    t_max = k_all.shape[2]
+    n_h, h_kv = cfg.n_heads, cfg.kv_heads
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                theta=cfg.rope_theta)
+    positions = starts[:, None] + jnp.arange(c)[None, :]       # [P, C]
+    # Padded tail positions can run past the RoPE table; clamp the
+    # gather (values are garbage, discarded) — the write offset itself
+    # is host-validated.
+    rope_pos = jnp.minimum(positions, cfg.max_seq - 1)
+    x = params["embed"][tokens].astype(dt)                     # [P, C, d]
+    mask = (positions[:, :, None]
+            >= jnp.arange(t_max)[None, None, :])               # [P, C, T]
+
+    def body(carry, layer_in):
+        x, k_all, v_all = carry
+        lp, layer = layer_in
+        h = rms_norm(x, lp["ln1"]).astype(dt)
+        qkv = jnp.einsum("btd,dhk->bthk", h, lp["qkv"])
+        q = qkv[:, :, :n_h]
+        k_new = qkv[:, :, n_h:n_h + h_kv]
+        v_new = qkv[:, :, n_h + h_kv:]
+        q = apply_rope(q, cos, sin, positions=rope_pos)
+        k_new = apply_rope(k_new, cos, sin, positions=rope_pos)
+        k_layer = lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
+        v_layer = lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
+
+        def write_one(i, kv):
+            k_l, v_l = kv
+            kc = lax.dynamic_index_in_dim(k_new, i, 0)   # [1, C, Hkv, Dh]
+            vc = lax.dynamic_index_in_dim(v_new, i, 0)
+            at = (slots[i], starts[i], 0, 0)
+            return (
+                lax.dynamic_update_slice(k_l, kc.astype(k_l.dtype), at),
+                lax.dynamic_update_slice(v_l, vc.astype(v_l.dtype), at),
+            )
+
+        # Sequential writes, not a vmap-scatter: P is small and
+        # duplicate (padding) rows must overwrite cleanly in order.
+        k_layer, v_layer = lax.fori_loop(0, p, write_one,
+                                         (k_layer, v_layer))
+        k_all = lax.dynamic_update_slice(
+            k_all, k_layer[None], (layer, 0, 0, 0, 0)
+        )
+        v_all = lax.dynamic_update_slice(
+            v_all, v_layer[None], (layer, 0, 0, 0, 0)
+        )
+        o = _attend_cache(q, k_layer[slots], v_layer[slots], mask, cfg)
+        x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
+        x = _mlp(x, lp, cfg)
+        return (x, k_all, v_all), None
+
+    (x, k_all, v_all), _ = lax.scan(
+        body, (x, k_all, v_all),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+    )
+    last = jnp.take_along_axis(
+        x, jnp.maximum(n_valids - 1, 0)[:, None, None], axis=1
+    )                                                          # [P, 1, d]
+    last = rms_norm(last, params["final_norm"]).astype(dt)
+    logits = jnp.einsum(
+        "btd,dv->btv", last, params["unembed"]
+    )[:, 0].astype(jnp.float32)
+    toks = _sample_slots(logits, temps,
+                         jax.random.fold_in(base_key, draw))
+    return k_all, v_all, toks, logits

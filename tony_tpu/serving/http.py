@@ -1,0 +1,119 @@
+"""HTTP front end for the serving engine — what a ``serving`` task runs
+behind the proxy tunnel.
+
+Deliberately minimal (stdlib ``ThreadingHTTPServer``, one thread per
+in-flight client like the rest of the control plane):
+
+* ``POST /generate`` — body ``{"prompt": [ids], "max_new_tokens": n,
+  "temperature": t?, "eos_id": id?}``; blocks until the request retires
+  (long-poll — continuous batching means admission is immediate once a
+  slot frees) and returns ``{"tokens": [...], "length": n, "ttft_ms":
+  ..., "wall_ms": ...}``. 400 on a malformed body, 503 when the bounded
+  queue sheds load.
+* ``GET /healthz`` — engine stats JSON (active slots, queue depth);
+  what an autoscaler or the proxy's liveness probe polls.
+* ``POST /shutdown`` — graceful stop: the serve loop returns, so a
+  tony-launched serving task exits 0 and the session SUCCEEDs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.serving.scheduler import ServingEngine, ServingQueueFull
+
+log = logging.getLogger(__name__)
+
+
+class ServingServer:
+    """Binds ``port`` (0 = ephemeral) on ``host`` and serves the engine
+    until ``/shutdown`` or ``stop()``."""
+
+    def __init__(self, engine: ServingEngine, port: int = 0,
+                 host: str = "0.0.0.0",
+                 request_timeout_s: float = 600.0) -> None:
+        self.engine = engine
+        self._shutdown = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: the engine has metrics
+                pass
+
+            def _reply(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, outer.engine.stats())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path == "/shutdown":
+                    self._reply(200, {"ok": True})
+                    outer._shutdown.set()
+                    return
+                if self.path != "/generate":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = body["prompt"]
+                    max_new = int(body["max_new_tokens"])
+                    temperature = float(body.get("temperature", 0.0))
+                    eos = body.get("eos_id")
+                    eos_id = None if eos is None else int(eos)
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._reply(400, {"error": f"bad request: {exc}"})
+                    return
+                try:
+                    req = outer.engine.submit(
+                        prompt, max_new, temperature=temperature,
+                        eos_id=eos_id,
+                    )
+                    self._reply(200, req.result(timeout=request_timeout_s))
+                except ServingQueueFull as exc:
+                    self._reply(503, {"error": str(exc)})
+                except ValueError as exc:  # truly the client's fault
+                    self._reply(400, {"error": str(exc)})
+                except TimeoutError as exc:
+                    # Server capacity, not a malformed request: retryable.
+                    self._reply(504, {"error": str(exc)})
+                except RuntimeError as exc:  # engine shutdown/failure
+                    self._reply(503, {"error": str(exc)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        """Serve in a background thread; returns the bound port."""
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serving-http",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("serving engine listening on :%d", self.port)
+        return self.port
+
+    def wait_shutdown(self, timeout: float | None = None) -> bool:
+        """Block until ``POST /shutdown`` (or ``stop()``)."""
+        return self._shutdown.wait(timeout)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,600 @@
+"""Host half of the continuous-batching serving engine.
+
+``ServingEngine`` owns the request queue and the slot pool and drives
+the two jitted executables from ``serving/engine.py`` in a single loop
+thread. Each iteration:
+
+1. **admit** — pop queued requests into freed slots (a slot is a lane
+   of the fixed slot batch plus its KV-cache row);
+2. **prefill** — run at most ``prefill_chunks_per_iter`` bounded chunks
+   of admitted prompts (chunked so a long prompt can never stall the
+   in-flight decode streams for more than a chunk's worth of compute);
+3. **decode** — a ``decode_window`` for every slot; read the sampled
+   tokens back, append to each active request, and retire sequences at
+   EOS (or their token budget), returning the slot to the pool —
+   immediately at window 1, within the window otherwise.
+
+Requests of different lengths therefore share every decode iteration
+(iteration-level scheduling), and wall throughput tracks the marginal
+slot-batch decode rate instead of the padded single-shot ``generate``
+wall. Telemetry goes through the PR-3 observability registry —
+``tony_serving_{queue_depth,active_slots,ttft_ms,inter_token_ms,
+tokens_per_sec}`` plus request/token counters — so a tony-launched
+serving task's numbers ride heartbeats onto the coordinator's
+``/metrics`` and the health detectors see serving load.
+
+Greedy parity contract (pinned by tests/test_serving.py): a request
+decoded through the slot engine yields token-for-token the same output
+as a single-request ``models.generate(..., eos_id=)`` call — chunked
+prefill writes the same K/V the one-shot prefill would, and the decode
+step is the same math at per-slot positions.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+from tony_tpu.models.decode import _decode_weights_jit
+from tony_tpu.models.transformer import TransformerConfig
+from tony_tpu.observability import metrics as obs_metrics
+from tony_tpu.serving import engine as _engine
+
+# ms-scale buckets for the serving latency histograms (the registry
+# default buckets are seconds-scale).
+_MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+               500.0, 1000.0, 2500.0, 5000.0)
+
+# Rolling window for the tony_serving_tokens_per_sec gauge.
+_RATE_WINDOW_S = 5.0
+
+
+class ServingQueueFull(RuntimeError):
+    """Admission backpressure: the bounded request queue is at
+    ``max_queue`` — callers should shed load (HTTP 503), not buffer."""
+
+
+class ServingRequest:
+    """One in-flight generation request: submitted token prompt, token
+    budget, per-request sampling temperature and EOS id; filled in by
+    the engine loop and resolved through ``result()``."""
+
+    def __init__(self, request_id: str, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float,
+                 eos_id: int | None) -> None:
+        self.id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.tokens: list[int] = []
+        self.error: str | None = None
+        self.t_submit = time.perf_counter()
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+        self._done = threading.Event()
+        # Chunk plan [(start, n_valid), ...] filled at admission.
+        self._chunks: list[tuple[int, int]] = []
+        self._chunk_i = 0
+
+    @property
+    def ttft_ms(self) -> float | None:
+        if self.t_first_token is None:
+            return None
+        return (self.t_first_token - self.t_submit) * 1000.0
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None) -> dict:
+        """Block until the request retires; returns the response dict
+        (tokens, length, ttft_ms, wall_ms). Raises on engine-side
+        failure or timeout."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done in {timeout}s")
+        if self.error:
+            raise RuntimeError(f"request {self.id}: {self.error}")
+        return {
+            "id": self.id,
+            "tokens": list(self.tokens),
+            "length": len(self.tokens),
+            "ttft_ms": round(self.ttft_ms or 0.0, 3),
+            "wall_ms": round(
+                ((self.t_done or self.t_submit) - self.t_submit) * 1000.0, 3
+            ),
+        }
+
+
+def _chunk_plan(prompt_len: int, chunk: int) -> list[tuple[int, int]]:
+    """(start, n_valid) chunks covering a prompt. Prompts shorter than
+    one chunk pad (garbage K/V past ``n_valid`` is overwritten before it
+    is ever unmasked); longer prompts emit full chunks with an
+    OVERLAPPED final chunk at ``P - chunk`` — re-writing identical K/V
+    for the overlap instead of padding, so every chunk is fully valid
+    and no alignment constraint leaks into admission."""
+    if prompt_len <= chunk:
+        return [(0, prompt_len)]
+    full = prompt_len // chunk
+    plan = [(i * chunk, chunk) for i in range(full)]
+    if prompt_len % chunk:
+        plan.append((prompt_len - chunk, chunk))
+    return plan
+
+
+class ServingEngine:
+    """Continuous-batching engine over a fixed slot batch.
+
+    ``params`` may be raw training params or the fused
+    ``decode_weights`` layout (a ``DecodeSession.params``); fusion runs
+    once here either way. ``max_len`` sizes each slot's KV row (default
+    ``cfg.max_seq``); admission requires ``len(prompt) +
+    max_new_tokens <= max_len``.
+
+    Both executables are compile-cache instrumented through
+    ``parallel/plan.py`` (labels ``serving_decode_window`` /
+    ``serving_prefill_chunks``), so an engine restart on a warm
+    persistent cache skips both XLA compiles — the DecodeSession story
+    extended to the serving loop.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: TransformerConfig,
+        *,
+        slots: int = 8,
+        max_len: int | None = None,
+        prefill_chunk: int = 32,
+        prefill_chunks_per_iter: int | None = None,
+        prefill_batch: int = 4,
+        decode_window: int = 1,
+        max_queue: int = 1024,
+        registry: obs_metrics.MetricsRegistry | None = None,
+        seed: int = 0,
+    ) -> None:
+        import jax
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1, got {decode_window}"
+            )
+        max_len = int(max_len or cfg.max_seq)
+        if not 0 < max_len <= cfg.max_seq:
+            raise ValueError(
+                f"max_len {max_len} must be in (0, cfg.max_seq="
+                f"{cfg.max_seq}] — RoPE tables are sized by cfg.max_seq"
+            )
+        prefill_chunk = min(int(prefill_chunk), max_len)
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        # None = auto: one chunk per PENDING SLOT per iteration
+        # (round-robin). Prefill work only exists while slots sit free —
+        # idle decode capacity — so the budget self-limits as slots
+        # fill; a fixed budget of 1 measured as pure starvation (the
+        # CPU micro bench spent 93% of its wall with empty slots).
+        self.prefill_chunks_per_iter = (
+            None if prefill_chunks_per_iter is None
+            else max(1, int(prefill_chunks_per_iter))
+        )
+        self.decode_window = int(decode_window)
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.max_queue = int(max_queue)
+        if "qkv" in params["layers"]:
+            self.params = params
+        else:
+            self.params = _decode_weights_jit(params, cfg)
+        self._k, self._v = _engine.init_slot_cache(cfg, self.slots, max_len)
+        self._pos = np.zeros(self.slots, np.int32)
+        self._active = np.zeros(self.slots, bool)
+        self._last = np.zeros(self.slots, np.int32)
+        self._temp = np.zeros(self.slots, np.float32)
+        self._slot_req: list[ServingRequest | None] = [None] * self.slots
+        self._queue: deque[ServingRequest] = deque()
+        self._pf: deque[tuple[ServingRequest, int]] = deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._draining = False
+        self._thread: threading.Thread | None = None
+        self._iter = 0
+        self._decode_calls = 0
+        self._pf_draws = 0
+        # Engine-local tallies: the registry counters below may be the
+        # process-wide default registry (shared by every engine in the
+        # process), so stats()/tokens_generated must not read them back.
+        self._n_requests = 0
+        self._n_retired = 0
+        self._n_tokens = 0
+        self._ids = itertools.count()
+        self._base_key = jax.random.key(seed)
+        self._rate_window: deque[tuple[float, int]] = deque()
+        # Raw latency samples for bench percentile reporting (the
+        # histogram buckets are too coarse for a p95 readout).
+        self.inter_token_ms_samples: deque[float] = deque(maxlen=8192)
+        self.ttft_ms_samples: deque[float] = deque(maxlen=8192)
+
+        reg = registry if registry is not None else (
+            obs_metrics.default_registry()
+        )
+        self._reg = reg
+        self._g_queue = reg.gauge(
+            "tony_serving_queue_depth",
+            "requests admitted-pending (queued, not yet in a slot)",
+        )
+        self._g_active = reg.gauge(
+            "tony_serving_active_slots", "slots currently decoding"
+        )
+        self._g_rate = reg.gauge(
+            "tony_serving_tokens_per_sec",
+            f"generated tokens/sec over the last {_RATE_WINDOW_S:.0f}s",
+        )
+        self._h_ttft = reg.histogram(
+            "tony_serving_ttft_ms", "submit -> first token",
+            buckets=_MS_BUCKETS,
+        )
+        self._h_inter = reg.histogram(
+            "tony_serving_inter_token_ms",
+            "decode iteration wall (== per-stream inter-token gap)",
+            buckets=_MS_BUCKETS,
+        )
+        self._c_requests = reg.counter(
+            "tony_serving_requests_total", "requests accepted"
+        )
+        self._c_retired = reg.counter(
+            "tony_serving_retired_total", "requests completed"
+        )
+        self._c_tokens = reg.counter(
+            "tony_serving_generated_tokens_total", "tokens sampled"
+        )
+
+        from tony_tpu.parallel import plan as plan_lib
+
+        extra = {"slots": self.slots, "max_len": self.max_len,
+                 "chunk": self.prefill_chunk,
+                 "window": self.decode_window,
+                 "prefill_batch": self.prefill_batch}
+        self._decode = plan_lib.instrument_jit(
+            functools.partial(_engine.decode_window, cfg=cfg,
+                              steps=self.decode_window),
+            plan_lib.plan_cache_key("serving_decode_window", config=cfg,
+                                    extra=extra),
+        )
+        self._prefill = plan_lib.instrument_jit(
+            functools.partial(_engine.prefill_chunks, cfg=cfg),
+            plan_lib.plan_cache_key("serving_prefill_chunks", config=cfg,
+                                    extra=extra),
+        )
+
+    # -- client surface ----------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        request_id: str | None = None,
+    ) -> ServingRequest:
+        """Enqueue one request; returns a handle whose ``result()``
+        blocks until EOS/budget retirement. Thread-safe; raises
+        ``ServingQueueFull`` past ``max_queue`` (shed, don't buffer)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot KV capacity "
+                f"({self.max_len})"
+            )
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        req = ServingRequest(
+            request_id or f"req-{next(self._ids)}", prompt,
+            int(max_new_tokens), float(temperature), eos_id,
+        )
+        with self._cond:
+            if self._stop.is_set():
+                raise RuntimeError("engine is shut down")
+            if self._draining:
+                raise RuntimeError("engine is draining")
+            if len(self._queue) >= self.max_queue:
+                raise ServingQueueFull(
+                    f"serving queue at max_queue={self.max_queue}"
+                )
+            self._queue.append(req)
+            self._c_requests.inc()
+            self._n_requests += 1
+            self._cond.notify_all()
+        return req
+
+    @property
+    def tokens_generated(self) -> int:
+        """Tokens sampled and accepted by THIS engine (the bench samples
+        it around iterations to split sustained from ramp/drain
+        throughput)."""
+        return self._n_tokens
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "slots": self.slots,
+                "active_slots": int(self._active.sum()),
+                "queue_depth": len(self._queue),
+                "prefilling": len(self._pf),
+                "iterations": self._iter,
+                "requests": self._n_requests,
+                "retired": self._n_retired,
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="serving-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Stop ADMITTING (submit raises) and wait for everything
+        queued or in flight to retire — the graceful half of shutdown;
+        ``close()`` after a successful drain fails nothing. Returns
+        False if the timeout expired with work still in flight."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._stop.is_set():
+            s = self.stats()
+            if (s["queue_depth"] == 0 and s["active_slots"] == 0
+                    and s["prefilling"] == 0):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        """Stop the loop and fail whatever is still in flight — a
+        served request must never hang a client past engine teardown.
+        Call ``drain()`` first for a graceful stop."""
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        with self._cond:
+            pending = list(self._queue) + [
+                r for r in self._slot_req if r is not None
+            ] + [r for r, _ in self._pf]
+            self._queue.clear()
+            self._pf.clear()
+            self._slot_req = [None] * self.slots
+        for req in pending:
+            if not req.done():
+                req.error = "engine shut down"
+                req._done.set()
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self.step():
+                    with self._cond:
+                        if not self._queue and not self._stop.is_set():
+                            self._cond.wait(timeout=0.05)
+        except Exception as exc:  # noqa: BLE001 — the loop IS the engine
+            # A dying loop must never look healthy: without this, the
+            # daemon thread would vanish while submit()/healthz keep
+            # accepting work and every client long-polls to timeout.
+            log.exception("serving engine loop died")
+            self._stop.set()
+            with self._cond:
+                pending = list(self._queue) + [
+                    r for r in self._slot_req if r is not None
+                ]
+                self._queue.clear()
+                self._pf.clear()
+                self._slot_req = [None] * self.slots
+            for req in pending:
+                if not req.done():
+                    req.error = f"engine loop failed: {exc}"
+                    req._done.set()
+
+    # -- the iteration -----------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration (admit -> prefill chunk(s) -> decode
+        window for all slots -> retire). Public so tests and the bench
+        can drive the loop without threads. Returns False when fully
+        idle."""
+        t0 = time.perf_counter()
+        self._admit()
+        did_prefill = self._prefill_some()
+        decoded = False
+        if self._active.any():
+            w = self.decode_window
+            # Inactive lanes park their write at Tmax-1 (engine.py's
+            # wpos contract): writing at their stale pos would clobber
+            # a concurrent prefill into the same slot.
+            wpos = np.where(self._active, self._pos,
+                            np.int32(self.max_len - 1)).astype(np.int32)
+            # Decode draws live in [0, 2**30), prefill draws in
+            # [2**30, 2**31): modular so a long-lived engine can neither
+            # overflow int32 nor cross domains (keys repeat only after
+            # 2**30 draws of the same kind — billions of tokens).
+            self._k, self._v, window = self._decode(
+                self.params, self._k, self._v, self._pos, wpos,
+                self._last, self._temp, self._base_key,
+                np.int32((self._decode_calls * w) % 2**30),
+            )
+            self._decode_calls += 1
+            toks = np.asarray(window)  # device sync: the iteration fence
+            wall_ms = (time.perf_counter() - t0) * 1000.0
+            # Recorded PER TOKEN (wall / window): with a deep window the
+            # client sees bursts, but the sustained per-stream gap is
+            # what capacity planning reads.
+            self._h_inter.observe(wall_ms / w)
+            self.inter_token_ms_samples.append(wall_ms / w)
+            n_new = 0
+            for s in np.flatnonzero(self._active):
+                req = self._slot_req[s]
+                for j in range(w):
+                    tok = int(toks[s, j])
+                    req.tokens.append(tok)
+                    n_new += 1
+                    if ((req.eos_id is not None and tok == req.eos_id)
+                            or len(req.tokens) >= req.max_new_tokens):
+                        # Mid-window retirement: the device kept
+                        # decoding this lane to the window edge; those
+                        # tokens are discarded and the slot frees NOW.
+                        self._retire(s)
+                        break
+                else:
+                    self._pos[s] += w
+                    self._last[s] = int(toks[s, -1])
+            self._c_tokens.inc(n_new)
+            self._n_tokens += n_new
+            self._note_rate(n_new)
+            decoded = True
+        self._iter += 1
+        with self._cond:
+            self._g_queue.set(len(self._queue))
+        self._g_active.set(int(self._active.sum()))
+        # Publish (throttled inside the registry): serving metrics only
+        # reach the executor heartbeat via the $TONY_METRICS_FILE
+        # snapshot, and nothing else in a serving loop calls report().
+        self._reg.report()
+        return did_prefill or decoded
+
+    def _admit(self) -> None:
+        with self._cond:
+            for s in range(self.slots):
+                if not self._queue:
+                    break
+                if self._slot_req[s] is not None:
+                    continue
+                req = self._queue.popleft()
+                self._slot_req[s] = req
+                self._pos[s] = 0
+                self._active[s] = False
+                self._temp[s] = req.temperature
+                req._chunks = _chunk_plan(req.prompt.size,
+                                          self.prefill_chunk)
+                req._chunk_i = 0
+                self._pf.append((req, s))
+
+    def _prefill_some(self) -> bool:
+        """Run one prefill ROUND: one chunk for every pending slot (the
+        auto budget — prefill work only exists while slots sit idle),
+        batched ``prefill_batch`` slots per dispatch and padded by
+        duplicating entry 0 (idempotent rewrite), so the executable
+        count stays at one whatever the pending population."""
+        if not self._pf:
+            return False
+        budget = (len(self._pf) if self.prefill_chunks_per_iter is None
+                  else min(self.prefill_chunks_per_iter, len(self._pf)))
+        while budget > 0:
+            n = min(self.prefill_batch, budget, len(self._pf))
+            entries = [self._pf.popleft() for _ in range(n)]
+            budget -= n
+            pb = self.prefill_batch
+            toks = np.zeros((pb, self.prefill_chunk), np.int32)
+            slots_a = np.zeros(pb, np.int32)
+            starts = np.zeros(pb, np.int32)
+            n_valids = np.ones(pb, np.int32)
+            temps = np.zeros(pb, np.float32)
+            finals = []
+            for i, (req, slot) in enumerate(entries):
+                start, n_valid = req._chunks[req._chunk_i]
+                toks[i, :n_valid] = req.prompt[start:start + n_valid]
+                slots_a[i] = slot
+                starts[i] = start
+                n_valids[i] = n_valid
+                temps[i] = req.temperature
+                finals.append(req._chunk_i == len(req._chunks) - 1)
+                req._chunk_i += 1
+            for i in range(n, pb):  # pad by duplicating row 0
+                toks[i] = toks[0]
+                slots_a[i] = slots_a[0]
+                starts[i] = starts[0]
+                n_valids[i] = n_valids[0]
+                temps[i] = temps[0]
+            # Separate draw counter from the decode stream (2**30
+            # offset) so no prefill sample can ever share a decode
+            # step's key.
+            self._pf_draws += 1
+            self._k, self._v, first_toks, _ = self._prefill(
+                self.params, self._k, self._v, toks, slots_a, starts,
+                n_valids, temps, self._base_key,
+                np.int32(2**30 + self._pf_draws % 2**30),
+            )
+            firsts = np.asarray(first_toks)  # device sync
+            now = time.perf_counter()
+            for i, (req, slot) in enumerate(entries):
+                if not finals[i]:
+                    # More chunks to go: back of the queue (round-robin
+                    # keeps every pending slot progressing).
+                    self._pf.append((req, slot))
+                    continue
+                first = int(firsts[i])
+                req.t_first_token = now  # post-sync: TTFT really is now
+                ttft = (now - req.t_submit) * 1000.0
+                self._h_ttft.observe(ttft)
+                self.ttft_ms_samples.append(ttft)
+                self._pos[slot] = req.prompt.size
+                self._last[slot] = first
+                req.tokens.append(first)
+                self._c_tokens.inc()
+                self._n_tokens += 1
+                self._note_rate(1)
+                if ((req.eos_id is not None and first == req.eos_id)
+                        or req.max_new_tokens <= 1):
+                    self._retire(slot)
+                else:
+                    self._active[slot] = True
+        return True
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        # Reset the lane temperature: a stale hot value would keep the
+        # all-greedy lax.cond fast path disabled (threefry over [S, V]
+        # per step) while the slot sits empty.
+        self._temp[slot] = 0.0
+        self._c_retired.inc()
+        self._n_retired += 1
+        req.t_done = time.perf_counter()
+        req._done.set()
+
+    def _note_rate(self, n_tokens: int) -> None:
+        now = time.perf_counter()
+        self._rate_window.append((now, n_tokens))
+        while (self._rate_window
+               and now - self._rate_window[0][0] > _RATE_WINDOW_S):
+            self._rate_window.popleft()
+        span = now - self._rate_window[0][0] if self._rate_window else 0.0
+        total = sum(n for _, n in self._rate_window)
+        self._g_rate.set(total / span if span > 0 else 0.0)
