@@ -895,6 +895,123 @@ def bench_flash_attention(seq: int, batch: int, heads: int = 8,
     }
 
 
+# The per-job script bench_scheduler submits: compile one instrumented
+# classifier step (the plan-keyed compile the warm pool's cache serves)
+# and stamp the first-step completion time for submit-to-first-step.
+_SCHED_JOB_SCRIPT = """\
+import os, time
+import tony_tpu.runtime as rt
+ctx = rt.initialize()
+import jax
+import jax.numpy as jnp
+import numpy as np
+from tony_tpu.models import MnistConfig
+from tony_tpu.models.train import make_classifier_step
+from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+init_fn, step_fn = make_classifier_step(
+    MnistConfig(arch="cnn", dtype="float32"), mesh)
+rng = np.random.default_rng(0)
+images = jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32)
+labels = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+state = init_fn(jax.random.key(0))
+state, metrics = step_fn(state, images, labels)
+float(metrics["loss"])
+with open(os.environ["FIRST_STEP_OUT"], "w") as f:
+    f.write(str(time.time()))
+"""
+
+
+def bench_scheduler(jobs: int = 3, provision_ms: int = 4000):
+    """Multi-tenant scheduler warm-pool amortization: N identical jobs
+    through one ``SchedulerDaemon`` on a 1-slice pool. Job 1 pays the
+    full cold path (slice provisioning — modeled at ``provision_ms``,
+    far below the minutes a real queued-resource create takes — plus a
+    cold XLA compile); jobs 2..N lease the slice warm: provisioning
+    skipped, compiles served from the slice's pool-owned cache. The
+    headline is warm vs cold submit-to-first-step and jobs/hour over
+    the drained batch."""
+    import sys as _sys
+    import tempfile as _tempfile
+    from pathlib import Path as _Path
+
+    if jobs < 2:
+        raise ValueError("bench_scheduler needs >= 2 jobs: the warm "
+                         "figure is jobs 2..N")
+
+    from tony_tpu.conf import keys as _keys
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.scheduler import SchedulerDaemon
+    from tony_tpu.scheduler.pool import (
+        COLD_PROVISIONS_COUNTER, WARM_HITS_COUNTER,
+    )
+
+    with _tempfile.TemporaryDirectory(prefix="tony-bench-sched-") as root:
+        d = _Path(root)
+        script = d / "first_step.py"
+        script.write_text(_SCHED_JOB_SCRIPT)
+        conf = TonyConfiguration()
+        conf.set(_keys.K_SCHED_TICK_MS, 50)
+        conf.set(_keys.K_SCHED_MAX_SLICES, 1)
+        conf.set(_keys.K_SCHED_LOCAL_PROVISION_MS, provision_ms)
+        daemon = SchedulerDaemon(d / "sched", conf=conf).start(
+            serve_http=False
+        )
+        # Executor children ALWAYS run on CPU: this bench measures the
+        # orchestration layer (provision/staging/compile-cache
+        # amortization), and on a TPU host the parent bench process
+        # already holds the chip — libtpu is exclusive per host, so a
+        # TPU child could never initialize anyway.
+        platform = "cpu"
+        lat_ms: list[float] = []
+        t_batch0 = time.perf_counter()
+        try:
+            for i in range(jobs):
+                c = TonyConfiguration()
+                c.set(_keys.K_EXECUTES, str(script))
+                c.set(_keys.K_PYTHON_BINARY, _sys.executable)
+                c.set(_keys.instances_key("worker"), 1)
+                c.set(_keys.instances_key("ps"), 0)
+                # Children must land on the same backend the bench runs
+                # on (a CPU bench box must not have executors probe TPUs).
+                c.set(_keys.K_SHELL_ENV,
+                      f"FIRST_STEP_OUT={d}/step-{i}.ts,"
+                      f"JAX_PLATFORMS={platform}")
+                t0 = time.time()
+                job_id = daemon.submit(c)
+                state = daemon.wait_job(job_id, timeout_s=600)
+                ts_file = d / f"step-{i}.ts"
+                if state.value != "SUCCEEDED" or not ts_file.is_file():
+                    raise RuntimeError(
+                        f"scheduler bench job {i} ended {state.value} "
+                        f"without a first step"
+                    )
+                lat_ms.append((float(ts_file.read_text()) - t0) * 1000)
+            wall_s = time.perf_counter() - t_batch0
+            counters = daemon.registry.snapshot()["counters"]
+        finally:
+            daemon.shutdown()
+    cold = lat_ms[0]
+    warm = sum(lat_ms[1:]) / (len(lat_ms) - 1)
+    warm_hits = counters.get(WARM_HITS_COUNTER, 0)
+    provisions = counters.get(COLD_PROVISIONS_COUNTER, 0)
+    return {
+        "jobs": jobs,
+        # A config parameter of the bench, not a measurement — named
+        # WITHOUT the _ms suffix so the gate's direction heuristic
+        # leaves it ungated (raising the model must not read as a
+        # latency regression). Unit is milliseconds.
+        "provision_model": provision_ms,
+        "cold_submit_to_step_ms": round(cold, 1),
+        "warm_submit_to_step_ms": round(warm, 1),
+        "warm_cold_speedup": round(cold / warm, 3),
+        "jobs_per_hour": round(jobs / (wall_s / 3600.0), 1),
+        "warm_hit_rate": round(warm_hits / max(warm_hits + provisions, 1),
+                               3),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Regression gate (`bench.py --check`)
 # ---------------------------------------------------------------------------
@@ -913,7 +1030,7 @@ DEFAULT_THRESHOLD = 0.10
 # Direction by name suffix. Anything matching neither list is a shape /
 # config parameter (batch, seq, params_m, ...) and is not gated.
 _HIGHER_SUFFIXES = ("per_sec", "per_sec_per_chip", "mfu", "speedup",
-                    "mb_per_sec", "vs_baseline")
+                    "mb_per_sec", "vs_baseline", "per_hour", "hit_rate")
 _LOWER_SUFFIXES = ("_ms", "_pct", "ms_mean", "step_ms", "p50_ms", "p95_ms")
 
 
@@ -1071,6 +1188,7 @@ def run_benches() -> dict:
             "moe": _safe(bench_moe),
             "moe_decode_routed": _safe(bench_moe_decode),
             "input_pipeline": _safe(bench_input_pipeline),
+            "scheduler": _safe(bench_scheduler),
             "flash_attention_2k": _safe(
                 bench_flash_attention, seq=2048, batch=4
             ),
@@ -1086,6 +1204,7 @@ def run_benches() -> dict:
         # batching vs single-shot) is a ratio, portable across hosts.
         extras = {"skipped": "transformer/flash extras are TPU-only",
                   "serving": _safe(bench_serving, **SERVING_CPU_MICRO),
+                  "scheduler": _safe(bench_scheduler),
                   "device": jax.devices()[0].device_kind}
     # Final aggregated telemetry snapshot (observability.metrics): the
     # instrumented train steps populate the default registry while the

@@ -1,0 +1,14 @@
+"""Fixture: a preemptible worker for scheduler e2e tests. First run
+(no TONY_RESUME_STEP) appends its resume state to $MARKER_OUT and
+sleeps — the window the test preempts into; a resumed run (the
+scheduler seeded TONY_RESUME_STEP from the probed checkpoint) records
+the step and exits 0 immediately."""
+import os
+import sys
+import time
+
+with open(os.environ["MARKER_OUT"], "a") as f:
+    f.write(f"resume={os.environ.get('TONY_RESUME_STEP')}\n")
+if os.environ.get("TONY_RESUME_STEP") is None:
+    time.sleep(float(os.environ.get("SLEEP_S", "60")))
+sys.exit(0)

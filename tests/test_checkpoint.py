@@ -35,6 +35,27 @@ def test_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 3.0)
 
 
+def test_save_records_snapshot_stall_metric(tmp_path):
+    """Every save observes its synchronous D2H snapshot phase into
+    ``tony_ckpt_snapshot_ms`` (the save-stall the train loop pays — the
+    batched-transfer satellite's observable)."""
+    from tony_tpu.checkpoint import CKPT_SNAPSHOT_HISTOGRAM
+    from tony_tpu.observability.metrics import default_registry
+
+    def count():
+        h = default_registry().snapshot()["histograms"].get(
+            CKPT_SNAPSHOT_HISTOGRAM
+        )
+        return 0 if h is None else h["count"]
+
+    before = count()
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, _state(1.0), blocking=True)
+    mgr.save(2, _state(2.0))
+    mgr.wait()
+    assert count() == before + 2
+
+
 def test_saved_num_processes_tolerates_corrupt_metadata(tmp_path):
     """A corrupt metadata.json (unparseable, or parsing to a non-dict,
     or carrying a non-numeric num_processes) must fall back to the

@@ -1,0 +1,687 @@
+"""Multi-tenant scheduler + warm slice pool (tony_tpu/scheduler/):
+queue ordering / quota units, pool lease-release-expiry units, staging
+dedup, the leased (external-slice) backend mode, and mini-cluster e2e —
+two sequential jobs sharing one warm slice, and a high-priority submit
+preempting a low-priority job that later resumes from its checkpoint
+step via TONY_RESUME_STEP."""
+
+import json
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.observability.metrics import MetricsRegistry
+from tony_tpu.scheduler import (
+    JobQueue,
+    JobState,
+    SchedJob,
+    SchedulerDaemon,
+    SlicePool,
+    SliceState,
+    TenantQuotas,
+)
+from tony_tpu.scheduler.pool import (
+    BOOTSTRAP_MARKER,
+    COLD_PROVISIONS_COUNTER,
+    LEASE_EXPIRED_COUNTER,
+    WARM_HITS_COUNTER,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def _job(job_id: str, priority: int = 0, tenant: str = "default") -> SchedJob:
+    return SchedJob(job_id=job_id, conf=TonyConfiguration(), app_dir="/x",
+                    priority=priority, tenant=tenant)
+
+
+# ---------------------------------------------------------------------------
+# Queue ordering + quotas
+# ---------------------------------------------------------------------------
+class TestJobQueue:
+    def test_priority_order_fifo_within_band(self):
+        q = JobQueue()
+        q.submit(_job("a", priority=0))
+        q.submit(_job("b", priority=5))
+        q.submit(_job("c", priority=5))
+        q.submit(_job("d", priority=1))
+        order = [q.pop_next().job_id for _ in range(4)]
+        assert order == ["b", "c", "d", "a"]
+
+    def test_popped_job_is_launching(self):
+        q = JobQueue()
+        q.submit(_job("a"))
+        assert q.pop_next().state is JobState.LAUNCHING
+        assert q.pop_next() is None
+
+    def test_requeue_keeps_original_seq(self):
+        """A preempted job re-enters at the HEAD of its priority band —
+        preemption defers it, it must not also send it to the back."""
+        q = JobQueue()
+        first = q.submit(_job("first", priority=1))
+        q.submit(_job("second", priority=1))
+        popped = q.pop_next()
+        assert popped is first
+        q.submit(_job("third", priority=1))
+        q.requeue(first)  # preempted
+        assert [j.job_id for j in q.queued()] == ["first", "second", "third"]
+
+    def test_quota_skips_tenant_at_limit(self):
+        q = JobQueue(TenantQuotas(default=1))
+        q.submit(_job("a1", tenant="alice"))
+        q.submit(_job("b1", tenant="bob"))
+        # alice already runs one job: her queued job is skipped, bob pops.
+        job = q.pop_next(running_per_tenant={"alice": 1})
+        assert job.job_id == "b1"
+        # both at quota: nothing eligible.
+        assert q.pop_next(running_per_tenant={"alice": 1, "bob": 1}) is None
+        # alice freed: her job pops.
+        assert q.pop_next(running_per_tenant={}).job_id == "a1"
+
+    def test_quota_overrides_and_parse(self):
+        conf = TonyConfiguration()
+        conf.set(keys.K_SCHED_TENANT_QUOTA, 1)
+        conf.set(keys.K_SCHED_TENANT_QUOTAS, "alice=3, bob=0")
+        quotas = TenantQuotas.from_conf(conf)
+        assert quotas.limit("alice") == 3
+        assert quotas.limit("carol") == 1
+        assert quotas.admits("alice", 2)
+        assert not quotas.admits("carol", 1)
+        assert quotas.admits("bob", 99)  # 0 = unlimited
+
+    def test_bad_quota_string_raises(self):
+        conf = TonyConfiguration()
+        conf.set(keys.K_SCHED_TENANT_QUOTAS, "alice=lots")
+        with pytest.raises(ValueError, match="tenant=N"):
+            TenantQuotas.from_conf(conf)
+
+    def test_remove_queued(self):
+        q = JobQueue()
+        q.submit(_job("a"))
+        assert q.remove("a").job_id == "a"
+        assert q.remove("a") is None
+        assert q.depth() == 0
+
+
+# ---------------------------------------------------------------------------
+# Slice pool
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.now = 1_000_000
+
+    def __call__(self):
+        return self.now
+
+
+def _pool(tmp_path, **kw) -> tuple[SlicePool, _Clock]:
+    clock = _Clock()
+    kw.setdefault("max_slices", 2)
+    kw.setdefault("lease_timeout_ms", 1000)
+    kw.setdefault("idle_timeout_ms", 0)
+    pool = SlicePool(tmp_path / "slices", registry=MetricsRegistry(),
+                     clock_ms=clock, **kw)
+    return pool, clock
+
+
+class TestSlicePool:
+    def test_cold_then_warm_reuse(self, tmp_path):
+        pool, _ = _pool(tmp_path)
+        lease1 = pool.lease("local", "job1")
+        assert not lease1.warm
+        s = lease1.slice
+        assert (s.workspace / BOOTSTRAP_MARKER).is_file()
+        assert s.compile_cache_dir.is_dir()
+        # The warm payload: whatever a job leaves in the workspace (venv
+        # blobs, xla cache entries) survives release → next lease.
+        (s.compile_cache_dir / "entry").write_text("compiled")
+        pool.release(s.slice_id)
+        lease2 = pool.lease("local", "job2")
+        assert lease2.warm
+        assert lease2.slice.slice_id == s.slice_id
+        assert lease2.slice.jobs_served == 2
+        assert (lease2.slice.compile_cache_dir / "entry").read_text() \
+            == "compiled"
+        snap = pool.registry.snapshot()["counters"]
+        assert snap[WARM_HITS_COUNTER] == 1
+        assert snap[COLD_PROVISIONS_COUNTER] == 1
+
+    def test_profile_mismatch_provisions_new(self, tmp_path):
+        pool, _ = _pool(tmp_path)
+        a = pool.lease("v5litepod-16x1", "job1")
+        pool.release(a.slice.slice_id)
+        b = pool.lease("v5litepod-32x1", "job2")
+        assert not b.warm
+        assert b.slice.slice_id != a.slice.slice_id
+
+    def test_capacity_cap_returns_none(self, tmp_path):
+        pool, _ = _pool(tmp_path, max_slices=1)
+        assert pool.lease("local", "job1") is not None
+        assert pool.lease("local", "job2") is None
+
+    def test_full_pool_evicts_idle_mismatched_profile(self, tmp_path):
+        """A pool full of FREE slices of the WRONG profile must not
+        starve a new-profile job: the LRU idle slice is evicted to make
+        headroom. Leased slices are never evicted."""
+        pool, _ = _pool(tmp_path, max_slices=1)
+        a = pool.lease("profA", "job1")
+        pool.release(a.slice.slice_id)
+        b = pool.lease("profB", "job2")
+        assert b is not None and not b.warm
+        assert pool.get(a.slice.slice_id) is None
+        assert not a.slice.workspace.exists()
+        # Pool full of LEASED capacity: nothing evictable.
+        assert pool.lease("profC", "job3") is None
+
+    def test_lease_expiry_retires_slice(self, tmp_path):
+        pool, clock = _pool(tmp_path, lease_timeout_ms=1000)
+        s = pool.lease("local", "job1").slice
+        clock.now += 500
+        assert pool.expire_leases() == []
+        clock.now += 501
+        expired = pool.expire_leases()
+        assert [e.slice_id for e in expired] == [s.slice_id]
+        # Retired, torn down, and NOT warm-reusable.
+        assert not s.workspace.exists()
+        assert pool.get(s.slice_id) is None
+        assert pool.registry.snapshot()["counters"][
+            LEASE_EXPIRED_COUNTER] == 1
+
+    def test_renew_extends_lease(self, tmp_path):
+        pool, clock = _pool(tmp_path, lease_timeout_ms=1000)
+        s = pool.lease("local", "job1").slice
+        clock.now += 900
+        pool.renew(s.slice_id)
+        clock.now += 900
+        assert pool.expire_leases() == []
+
+    def test_unhealthy_release_retires(self, tmp_path):
+        pool, _ = _pool(tmp_path)
+        s = pool.lease("local", "job1").slice
+        pool.release(s.slice_id, healthy=False)
+        assert pool.get(s.slice_id) is None
+        assert not s.workspace.exists()
+
+    def test_idle_reap(self, tmp_path):
+        pool, clock = _pool(tmp_path, idle_timeout_ms=5000)
+        s = pool.lease("local", "job1").slice
+        pool.release(s.slice_id)
+        clock.now += 4000
+        assert pool.reap_idle() == []
+        clock.now += 1001
+        assert [r.slice_id for r in pool.reap_idle()] == [s.slice_id]
+
+    def test_expired_capacity_is_freed(self, tmp_path):
+        pool, clock = _pool(tmp_path, max_slices=1, lease_timeout_ms=100)
+        pool.lease("local", "job1")
+        assert pool.lease("local", "job2") is None
+        clock.now += 101
+        pool.expire_leases()
+        assert pool.lease("local", "job2") is not None
+
+
+# ---------------------------------------------------------------------------
+# Leased (external-slice) backend mode
+# ---------------------------------------------------------------------------
+class _LeaseFakeApi:
+    """Minimal TpuApi fake: slices READY immediately, executors exit 0
+    on their first status poll."""
+
+    def __init__(self):
+        self.created: dict[str, tuple[str, int]] = {}
+        self.deleted: list[str] = []
+        self.started: list[tuple[str, int]] = []
+
+    def create_slice(self, name, accelerator_type, num_slices):
+        self.created[name] = (accelerator_type, num_slices)
+
+    def slice_state(self, name):
+        return "READY"
+
+    def start_executor(self, name, host_index, env):
+        self.started.append((name, host_index))
+        return {"name": name}
+
+    def executor_status(self, handle):
+        return 0
+
+    def kill_executor(self, handle):
+        pass
+
+    def delete_slice(self, name):
+        self.deleted.append(name)
+
+
+def test_tpu_provisioner_speaks_daemon_profiles(tmp_path):
+    """The pool's TPU seam end to end against a fake control plane: the
+    daemon-format profile ('job=accelxN,...') provisions one slice
+    group per job type, external_slices() yields the leased-backend
+    mapping, and teardown deletes every group."""
+    from tony_tpu.scheduler import TpuSliceProvisioner
+
+    api = _LeaseFakeApi()
+    prov = TpuSliceProvisioner(api, poll_interval_s=0.01)
+    profile = "ps=v4-8x1,worker=v5litepod-16x2"
+    assert TpuSliceProvisioner.parse_profile(profile) == {
+        "ps": ("v4-8", 1), "worker": ("v5litepod-16", 2),
+    }
+    ws = tmp_path / "ws"
+    prov.provision("slice-abc", profile, ws)
+    assert api.created == {
+        "slice-abc-ps": ("v4-8", 1),
+        "slice-abc-worker": ("v5litepod-16", 2),
+    }
+    assert (ws / BOOTSTRAP_MARKER).is_file()
+    from tony_tpu.scheduler.pool import PooledSlice
+
+    pooled = PooledSlice("slice-abc", profile, ws)
+    assert TpuSliceProvisioner.external_slices(pooled) == {
+        "ps": "slice-abc-ps", "worker": "slice-abc-worker",
+    }
+    prov.teardown("slice-abc", profile, ws)
+    assert sorted(api.deleted) == ["slice-abc-ps", "slice-abc-worker"]
+    with pytest.raises(ValueError, match="job=accelerator_type"):
+        TpuSliceProvisioner.parse_profile("local")
+
+
+def test_tpu_backend_external_slices_not_created_or_deleted(tmp_path):
+    from tony_tpu.coordinator.backend import TpuVmBackend, plan_slices
+    from tony_tpu.coordinator.session import TonyTask
+
+    api = _LeaseFakeApi()
+    backend = TpuVmBackend(api, "app1",
+                           external_slices={"worker": "pool-slice-7"})
+    backend.prepare_slices({"worker": plan_slices(4, 4, "v5e")})
+    task = TonyTask("worker", 0, 1)
+    handle = backend.launch(task, {"E": "1"})
+    # No create: the pool owns the slice; poll starts the executor on it.
+    assert api.created == {}
+    assert backend.poll(handle) is None
+    assert api.started == [("pool-slice-7", 0)]
+    assert backend.poll(handle) == 0
+    backend.stop_all()
+    assert api.deleted == []  # release, not teardown
+
+
+# ---------------------------------------------------------------------------
+# Content-hash staging dedup (client._stage)
+# ---------------------------------------------------------------------------
+def test_staging_dedup_second_submit_skips_copy(tmp_path):
+    from tony_tpu.client.client import STAGING_DEDUP_COUNTER, TonyClient
+    from tony_tpu.observability.metrics import default_registry
+
+    venv = tmp_path / "env.zip"
+    venv.write_bytes(b"PK\x05\x06" + bytes(18))  # minimal empty zip
+    staging = tmp_path / "staging"
+
+    def stage():
+        client = TonyClient().init([
+            "--python_venv", str(venv),
+            "--conf", f"{keys.K_STAGING_LOCATION}={staging}",
+        ])
+        app_dir = client._stage()
+        return client, app_dir
+
+    before = default_registry().snapshot()["counters"].get(
+        STAGING_DEDUP_COUNTER, 0)
+    c1, app1 = stage()
+    c2, app2 = stage()
+    blob1 = Path(c1.conf.get_str(keys.K_PYTHON_VENV))
+    blob2 = Path(c2.conf.get_str(keys.K_PYTHON_VENV))
+    # One blob, content-addressed, shared by both frozen confs.
+    assert blob1 == blob2
+    assert blob1.is_file() and blob1.parent.parent.name == "blobs"
+    assert len(list((staging / "blobs").rglob("*.zip"))) == 1
+    # No per-app copy in either app dir.
+    assert not (app1 / "env.zip").exists()
+    assert not (app2 / "env.zip").exists()
+    after = default_registry().snapshot()["counters"][STAGING_DEDUP_COUNTER]
+    assert after == before + 1
+
+    # A DIFFERENT venv gets its own blob (no false dedup).
+    venv.write_bytes(b"PK\x05\x06" + bytes(17) + b"x")
+    _, _ = stage()
+    assert len(list((staging / "blobs").rglob("*.zip"))) == 2
+
+
+def test_blob_store_prune_lru_spares_current_blob(tmp_path):
+    import os
+    import time as _time
+
+    from tony_tpu.client.client import prune_blob_store, stage_blob
+
+    blob_root = tmp_path / "blobs"
+    blobs = []
+    for i in range(3):
+        src = tmp_path / f"v{i}.zip"
+        src.write_bytes(bytes(100))
+        # Distinct content => distinct blobs; distinct mtimes => LRU order.
+        src.write_bytes(bytes(99) + bytes([i]))
+        blob, _ = stage_blob(src, blob_root)
+        os.utime(blob, (1000.0 + i, 1000.0 + i))
+        blobs.append(blob)
+    # Cap at 2 blobs' worth: the oldest goes, the excluded current blob
+    # survives even if the cap is tighter than its size.
+    assert prune_blob_store(blob_root, 200) == 1
+    assert not blobs[0].exists() and blobs[1].exists() and blobs[2].exists()
+    assert prune_blob_store(blob_root, 50, exclude=blobs[2]) == 1
+    assert blobs[2].exists() and not blobs[1].exists()
+    # A dedup hit refreshes the LRU stamp.
+    src = tmp_path / "v2.zip"
+    old = blobs[2].stat().st_mtime
+    _time.sleep(0.01)
+    _, hit = stage_blob(src, blob_root)
+    assert hit and blobs[2].stat().st_mtime > old
+
+
+# ---------------------------------------------------------------------------
+# Daemon e2e on the mini cluster (jax-free fixtures)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def cluster(tmp_path):
+    with MiniTonyCluster(tmp_path) as c:
+        yield c
+
+
+def _sched_conf(cluster, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_SCHED_TICK_MS, 50)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _job_conf(cluster, fixture, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / fixture))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _events(daemon, kind):
+    return [e for e in daemon.events.to_dicts() if e["kind"] == kind]
+
+
+def test_two_sequential_jobs_share_warm_slice(cluster):
+    """The warm-reuse acceptance shape, jax-free: job 2 skips
+    provisioning (one cold provision total, warm hit counted, same
+    slice serves both) and the published state file + events say so."""
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1}),
+        serve_http=False,
+    )
+    j1 = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    assert daemon.wait_job(j1, 60) is JobState.SUCCEEDED
+    j2 = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    assert daemon.wait_job(j2, 60) is JobState.SUCCEEDED
+
+    snap = daemon.registry.snapshot()["counters"]
+    assert snap[COLD_PROVISIONS_COUNTER] == 1  # provisioning skipped for j2
+    assert snap[WARM_HITS_COUNTER] == 1
+    launches = _events(daemon, "job_launched")
+    assert [e["warm"] for e in launches] == [False, True]
+    assert len({e["slice_id"] for e in launches}) == 1
+    slices = daemon.pool.slices()
+    assert len(slices) == 1 and slices[0].jobs_served == 2
+    assert slices[0].state is SliceState.FREE
+
+    # The state file is published just AFTER completion is signalled —
+    # poll briefly for it to catch up.
+    deadline = time.monotonic() + 5
+    while True:
+        state = json.loads(
+            (daemon.base_dir / "scheduler-state.json").read_text()
+        )
+        if {j["state"] for j in state["jobs"]} == {"SUCCEEDED"}:
+            break
+        assert time.monotonic() < deadline, state
+        time.sleep(0.05)
+    assert state["queue_depth"] == 0
+
+
+def test_failed_job_still_releases_slice_warm(cluster):
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1}),
+        serve_http=False,
+    )
+    j1 = daemon.submit(_job_conf(cluster, "exit_1.py"))
+    assert daemon.wait_job(j1, 60) is JobState.FAILED
+    j2 = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    assert daemon.wait_job(j2, 60) is JobState.SUCCEEDED
+    assert daemon.registry.snapshot()["counters"][WARM_HITS_COUNTER] == 1
+
+
+def _fabricate_checkpoint(ckpt_dir: Path, step: int) -> None:
+    """A complete CheckpointManager step (commit marker + the one
+    process shard) the scheduler's resume probe will find."""
+    d = ckpt_dir / f"step_{step}"
+    d.mkdir(parents=True)
+    (d / "metadata.json").write_text(
+        json.dumps({"step": step, "num_processes": 1})
+    )
+    (d / "process_0.npz").write_bytes(b"shard")
+
+
+def test_preemption_requeues_and_resumes_from_checkpoint(cluster, tmp_path):
+    """High-priority submit preempts the running low-priority job; the
+    victim requeues at the head of its band and its relaunch resumes
+    from the probed checkpoint step (TONY_RESUME_STEP seeded for the
+    FIRST session of the new coordinator)."""
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1}),
+        serve_http=False,
+    )
+    marker = tmp_path / "marker.txt"
+    ckpt = tmp_path / "ckpt"
+    _fabricate_checkpoint(ckpt, 7)
+    low = daemon.submit(_job_conf(
+        cluster, "preemptible.py",
+        **{keys.K_SHELL_ENV: f"MARKER_OUT={marker}",
+           keys.K_SCHED_PRIORITY: 0,
+           keys.K_CHECKPOINT_LOCATION: str(ckpt)},
+    ))
+    # Wait until the low-pri worker actually runs (its marker appears).
+    deadline = time.monotonic() + 60
+    while not marker.exists():
+        assert time.monotonic() < deadline, "low-pri job never started"
+        time.sleep(0.1)
+    hi = daemon.submit(_job_conf(
+        cluster, "exit_0.py", **{keys.K_SCHED_PRIORITY: 10},
+    ))
+    assert daemon.wait_job(hi, 90) is JobState.SUCCEEDED
+    assert daemon.wait_job(low, 90) is JobState.SUCCEEDED
+
+    job = daemon.job(low)
+    assert job.preemptions == 1
+    assert job.attempts == 2
+    assert job.resume_step == 7
+    preempt_events = _events(daemon, "job_preempted")
+    assert len(preempt_events) == 1
+    assert preempt_events[0]["resume_step"] == 7
+    # The fixture saw no resume on attempt 1, step 7 on attempt 2.
+    assert marker.read_text().splitlines() == ["resume=None", "resume=7"]
+    assert daemon.registry.snapshot()["counters"][
+        "tony_sched_preemptions_total"] == 1
+
+
+def test_kill_queued_and_running_jobs(cluster, tmp_path):
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1}),
+        serve_http=False,
+    )
+    marker = tmp_path / "m.txt"
+    running = daemon.submit(_job_conf(
+        cluster, "preemptible.py",
+        **{keys.K_SHELL_ENV: f"MARKER_OUT={marker}"},
+    ))
+    deadline = time.monotonic() + 60
+    while not marker.exists():
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    queued = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    assert daemon.kill(queued)
+    assert daemon.job(queued).state is JobState.KILLED
+    assert daemon.kill(running)
+    assert daemon.wait_job(running, 60) is JobState.KILLED
+    assert not daemon.kill(running)  # already terminal
+
+
+# ---------------------------------------------------------------------------
+# HTTP API + thin client + CLI + history panel
+# ---------------------------------------------------------------------------
+def test_scheduler_api_client_submit_and_cli_tables(cluster, capsys):
+    """The whole thin-submit loop: TonyClient in scheduler mode stages
+    and POSTs the app dir, monitors via the job API; `tony ps` and
+    `tony queue` read the live API, then fall back to the state file
+    once the daemon is gone."""
+    daemon = cluster.start_scheduler(_sched_conf(cluster))
+    addr = (daemon.base_dir / "scheduler.addr").read_text().strip()
+
+    from tony_tpu.client.client import TonyClient
+
+    client = TonyClient().init([
+        "--executes", str(FIXTURES / "exit_0.py"),
+        "--python_binary_path", sys.executable,
+        "--conf", f"{keys.K_STAGING_LOCATION}={cluster.staging_dir}",
+        "--conf", f"{keys.K_HISTORY_LOCATION}={cluster.history_dir}",
+        "--conf", f"{keys.K_SCHED_ADDRESS}={addr}",
+        "--conf", f"{keys.instances_key('ps')}=0",
+    ])
+    assert client.run() == 0
+    assert client.job_id is not None
+    job = daemon.job(client.job_id)
+    assert job is not None and job.state is JobState.SUCCEEDED
+    # The staged app dir (client-side) is what ran.
+    assert Path(job.app_dir) == client.app_dir
+
+    with urllib.request.urlopen(
+        f"http://{addr}/api/state", timeout=5
+    ) as resp:
+        state = json.loads(resp.read())
+    assert state["jobs"][0]["job_id"] == client.job_id
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=5) as r:
+        prom = r.read().decode()
+    assert "tony_sched_jobs_submitted_total 1" in prom
+
+    from tony_tpu.client.cli import ps_cmd, queue_cmd
+
+    assert ps_cmd(["--scheduler", addr]) == 0
+    out = capsys.readouterr().out
+    assert client.job_id in out and "SUCCEEDED" in out
+    assert queue_cmd(["--scheduler", addr]) == 0
+    out = capsys.readouterr().out
+    assert "pool" in out
+
+    # Daemon gone -> state-file fallback through --scheduler-dir.
+    base_dir = str(daemon.base_dir)
+    cluster.shutdown()
+    assert ps_cmd(["--scheduler-dir", base_dir]) == 0
+    out = capsys.readouterr().out
+    assert "state-file" in out and client.job_id in out
+
+
+def test_history_server_scheduler_panel(cluster):
+    daemon = cluster.start_scheduler(_sched_conf(cluster),
+                                     serve_http=False)
+    j = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    assert daemon.wait_job(j, 60) is JobState.SUCCEEDED
+
+    from tony_tpu.history.server import HistoryServer
+
+    server = HistoryServer(str(cluster.history_dir),
+                           scheduler_dir=str(daemon.base_dir))
+    port = server.serve_background()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/scheduler", timeout=5
+        ) as resp:
+            state = json.loads(resp.read())
+        assert state["jobs"][0]["job_id"] == j
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/scheduler", timeout=5
+        ) as resp:
+            page = resp.read().decode()
+        assert j in page and "Slice pool" in page
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5
+        ) as resp:
+            assert "/scheduler" in resp.read().decode()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# The full warm-reuse acceptance e2e (jax in executors: compile cache)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_warm_pool_second_job_skips_provisioning_staging_and_compiles_warm(
+    cluster, tmp_path,
+):
+    """Acceptance: two sequential identical jobs through the scheduler
+    share a pooled slice; the second proves (a) provisioning skipped,
+    (b) staging dedup hit for its venv archive, and (c) compile-cache
+    hits > 0 with misses == 0 — the daemon pinned the slice's
+    pool-owned cache dir into the frozen conf, the executor exported
+    TONY_COMPILE_*, and runtime.initialize() wired jax."""
+    import zipfile
+
+    from tony_tpu.client.client import STAGING_DEDUP_COUNTER, TonyClient
+    from tony_tpu.observability.metrics import default_registry
+
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1})
+    )
+    addr = (daemon.base_dir / "scheduler.addr").read_text().strip()
+    probe_out = tmp_path / "probe.jsonl"
+    venv = tmp_path / "env.zip"
+    with zipfile.ZipFile(venv, "w") as z:
+        z.writestr("payload.txt", "venv-shaped artifact, no bin/python")
+
+    def submit() -> str:
+        client = TonyClient().init([
+            "--executes", str(FIXTURES / "compile_cache_probe.py"),
+            "--python_binary_path", sys.executable,
+            "--python_venv", str(venv),
+            "--shell_env", f"PROBE_OUT={probe_out}",
+            "--shell_env", "JAX_PLATFORMS=cpu",
+            "--conf", f"{keys.K_STAGING_LOCATION}={cluster.staging_dir}",
+            "--conf", f"{keys.K_SCHED_ADDRESS}={addr}",
+            "--conf", f"{keys.instances_key('ps')}=0",
+        ])
+        assert client.submit() == 0
+        return client.job_id
+
+    dedup0 = default_registry().snapshot()["counters"].get(
+        STAGING_DEDUP_COUNTER, 0)
+    j1 = submit()
+    assert daemon.wait_job(j1, 300) is JobState.SUCCEEDED
+    j2 = submit()
+    assert daemon.wait_job(j2, 300) is JobState.SUCCEEDED
+
+    # (a) provisioning skipped: one cold provision, one warm hit.
+    snap = daemon.registry.snapshot()["counters"]
+    assert snap[COLD_PROVISIONS_COUNTER] == 1
+    assert snap[WARM_HITS_COUNTER] == 1
+    # (b) staging dedup: the second client submit found the venv blob.
+    dedup1 = default_registry().snapshot()["counters"][
+        STAGING_DEDUP_COUNTER]
+    assert dedup1 == dedup0 + 1
+    # (c) warm compiles: cold run all misses, warm run hits only.
+    lines = [json.loads(line)
+             for line in probe_out.read_text().splitlines()]
+    assert len(lines) == 2
+    cold, warm = lines
+    assert cold["tony_compile_cache_misses_total"] == 2  # init + step
+    assert cold.get("tony_compile_cache_hits_total", 0) == 0
+    assert warm["tony_compile_cache_hits_total"] == 2
+    assert warm.get("tony_compile_cache_misses_total", 0) == 0

@@ -71,6 +71,10 @@ _MIN_ONE_KEYS = frozenset({
     keys.K_SERVING_PREFILL_CHUNK,
     keys.K_SERVING_DECODE_WINDOW,
     keys.K_SERVING_MAX_QUEUE,
+    # A zero-tick scheduler loop spins; a zero-slice pool can never
+    # place a job.
+    keys.K_SCHED_TICK_MS,
+    keys.K_SCHED_MAX_SLICES,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold
@@ -141,6 +145,15 @@ def _check_value(key: str, value, default) -> str | None:
     if key in (keys.K_HTTP_PORT, keys.K_AM_HTTP_PORT):
         if str(value) != "disabled" and not _is_int(value):
             return f"must be an integer port or 'disabled'; got {value!r}"
+        return None
+    if key == keys.K_SCHED_TENANT_QUOTAS:
+        if str(value).strip() and not re.fullmatch(
+            r"\s*[\w.-]+\s*=\s*\d+\s*(,\s*[\w.-]+\s*=\s*\d+\s*)*",
+            str(value),
+        ):
+            return (
+                f"must be 'tenant=N,tenant=N' pairs; got {value!r}"
+            )
         return None
     if key == keys.K_AM_RPC_PORT_RANGE:
         m = re.fullmatch(r"\s*(\d+)\s*-\s*(\d+)\s*", str(value))

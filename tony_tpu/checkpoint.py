@@ -59,6 +59,29 @@ log = logging.getLogger(__name__)
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _MANIFEST = "__manifest__"
 
+# Declared metric name (TONY-M001 lints module-scope constants): wall
+# time of the synchronous device→host snapshot phase of every save — the
+# train-loop stall a checkpoint costs (the async writer hides the rest).
+CKPT_SNAPSHOT_HISTOGRAM = "tony_ckpt_snapshot_ms"
+_SNAPSHOT_BUCKETS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
+                     10000.0)
+
+
+def _start_d2h(leaf: Any) -> None:
+    """Kick the device→host copy for one leaf without waiting on it.
+    Best-effort: any array type that cannot async-copy just falls back
+    to the blocking path in ``_snapshot_leaf``."""
+    if not isinstance(leaf, jax.Array):
+        return
+    try:
+        if leaf.is_fully_addressable:
+            leaf.copy_to_host_async()
+        else:
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+    except Exception:  # deleted buffer, exotic layout — blocking path owns it
+        pass
+
 
 def _normalize_index(
     index: tuple, shape: tuple[int, ...]
@@ -288,13 +311,30 @@ class CheckpointManager:
         ``blocking``. Raises a prior async write's failure rather than
         piling new checkpoints on top of a broken disk."""
         self.wait()  # one in-flight write at a time; re-raises past failure
+        t0 = time.monotonic()
+        leaves = _tree_paths(state)
+        # Batch the D2H: start EVERY leaf's (and shard's) copy first, then
+        # materialize — a per-leaf blocking ``device_get`` serialized one
+        # transfer round-trip per leaf on the caller thread, which is
+        # exactly the save-stall the async writer was built to hide.
+        for _, leaf in leaves:
+            _start_d2h(leaf)
         manifest: dict[str, dict] = {}
         blobs: dict[str, np.ndarray] = {}
-        for path, leaf in _tree_paths(state):
+        for path, leaf in leaves:
             pieces, info = _snapshot_leaf(leaf)
             manifest[path] = info
             for i, piece in enumerate(pieces):
                 blobs[f"{path}#s{i}"] = _encode(piece)
+        snapshot_ms = (time.monotonic() - t0) * 1000.0
+        try:
+            from tony_tpu.observability.metrics import default_registry
+
+            default_registry().histogram(
+                CKPT_SNAPSHOT_HISTOGRAM, buckets=_SNAPSHOT_BUCKETS
+            ).observe(snapshot_ms)
+        except ValueError:  # a foreign registry squatting the name
+            pass
 
         def write() -> None:
             import io

@@ -563,10 +563,166 @@ def doctor_cmd(argv: list[str]) -> int:
     return 0
 
 
+def submit_cmd(argv: list[str]) -> int:
+    """``cli submit``: the THIN submit path — stage the job, POST the
+    app dir to the scheduler daemon (``tony.scheduler.address``), print
+    the job id, and return without monitoring. ``--wait`` re-attaches
+    the monitor loop (``tony ps``/``tony queue`` watch detached jobs)."""
+    wait = "--wait" in argv
+    argv = [a for a in argv if a != "--wait"]
+    client = TonyClient().init(argv)
+    if not client.conf.get_str(keys.K_SCHED_ADDRESS):
+        print(f"submit requires {keys.K_SCHED_ADDRESS} (a running "
+              f"scheduler daemon); use `cluster`/`local` for "
+              f"direct-coordinator submission", file=sys.stderr)
+        return 2
+    rc = client.submit()
+    if rc:
+        return rc
+    print(client.job_id)
+    return client.monitor() if wait else 0
+
+
+def _sched_args(argv: list[str], prog: str):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog=f"tony_tpu.client.cli {prog}",
+        description=f"{prog}: scheduler daemon job/pool tables — live "
+                    f"from the JSON API, else the persisted state file, "
+                    f"else job history.",
+    )
+    p.add_argument("--scheduler", default=None,
+                   help="daemon host:port (default: tony.scheduler.address)")
+    p.add_argument("--scheduler-dir", default=None,
+                   help="daemon base dir holding scheduler.addr / "
+                        "scheduler-state.json (default: "
+                        "tony.scheduler.base-dir)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--history-location", default=None,
+                   help="override tony.history.location (ps fallback)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def _scheduler_state(args) -> tuple[dict | None, str]:
+    """Resolve the address/base-dir from flags and conf, then run the
+    shared live → state-file fallback chain (scheduler.http.read_state,
+    same helper the history server's panel uses)."""
+    from tony_tpu.conf.configuration import load_job_config
+    from tony_tpu.scheduler.http import read_state
+
+    conf = load_job_config(conf_file=args.conf_file)
+    base_dir = Path(
+        args.scheduler_dir or conf.get_str(keys.K_SCHED_BASE_DIR) or "."
+    )
+    addr = args.scheduler or conf.get_str(keys.K_SCHED_ADDRESS) or None
+    return read_state(base_dir, addr=addr)
+
+
+def _fmt_age(now_ms: int, then_ms: int | None) -> str:
+    if not then_ms:
+        return "-"
+    s = max(0, (now_ms - then_ms) // 1000)
+    if s < 60:
+        return f"{s}s"
+    if s < 3600:
+        return f"{s // 60}m{s % 60:02d}s"
+    return f"{s // 3600}h{(s % 3600) // 60:02d}m"
+
+
+def ps_cmd(argv: list[str]) -> int:
+    """``cli ps``: every job the scheduler knows — queued, running,
+    preempted-and-requeued, finished — with slice, attempts, and age;
+    falls back to the job-history listing when no daemon is findable."""
+    import json as _json
+
+    args = _sched_args(argv, "ps")
+    state, source = _scheduler_state(args)
+    if state is None:
+        from tony_tpu.conf.configuration import load_job_config
+        from tony_tpu.history.reader import list_jobs
+
+        conf = load_job_config(conf_file=args.conf_file)
+        history = args.history_location or conf.get_str(
+            keys.K_HISTORY_LOCATION
+        )
+        if not history:
+            print("no scheduler daemon reachable (and no history "
+                  "location to fall back to)", file=sys.stderr)
+            return 1
+        jobs = list_jobs(history)
+        if args.as_json:
+            from dataclasses import asdict
+
+            print(_json.dumps([asdict(j) for j in jobs], indent=2))
+            return 0
+        print("# history fallback (no scheduler daemon reachable)")
+        for j in jobs:
+            print(f"{j.app_id:40s} {j.status:10s}")
+        return 0
+    if args.as_json:
+        print(_json.dumps(state, indent=2))
+        return 0
+    now = int(time.time() * 1000)
+    print(f"# scheduler ({source}) — queue depth "
+          f"{state.get('queue_depth', 0)}")
+    print(f"{'JOB':26s} {'STATE':11s} {'PRIO':>4s} {'TENANT':10s} "
+          f"{'SLICE':16s} {'TRY':>3s} {'PREEMPT':>7s} {'AGE':>8s}")
+    for j in state.get("jobs", []):
+        print(f"{j['job_id']:26s} {j['state']:11s} {j['priority']:4d} "
+              f"{j['tenant']:10s} {(j.get('slice_id') or '-'):16s} "
+              f"{j['attempts']:3d} {j['preemptions']:7d} "
+              f"{_fmt_age(now, j.get('submit_ms')):>8s}")
+    return 0
+
+
+def queue_cmd(argv: list[str]) -> int:
+    """``cli queue``: the waiting line plus the slice pool — what is
+    queued ahead of you and which warm slices exist to take it."""
+    import json as _json
+
+    args = _sched_args(argv, "queue")
+    state, source = _scheduler_state(args)
+    if state is None:
+        print("no scheduler daemon reachable (live or state file)",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps({"queue": state.get("queue", []),
+                           "pool": state.get("pool", [])}, indent=2))
+        return 0
+    by_id = {j["job_id"]: j for j in state.get("jobs", [])}
+    print(f"# scheduler ({source}) — {len(state.get('queue', []))} queued")
+    for job_id in state.get("queue", []):
+        j = by_id.get(job_id, {})
+        print(f"{job_id:26s} prio {j.get('priority', 0):4d} "
+              f"tenant {j.get('tenant', '?'):10s} "
+              f"resume_step {j.get('resume_step')}")
+    print(f"# pool — {len(state.get('pool', []))} slice(s)")
+    for s in state.get("pool", []):
+        print(f"{s['slice_id']:18s} {s['state']:12s} "
+              f"profile {s['profile']:24s} jobs_served "
+              f"{s['jobs_served']:3d} lease {s.get('lease_job_id') or '-'}")
+    return 0
+
+
+def scheduler_cmd(argv: list[str]) -> int:
+    """``cli scheduler``: run the daemon in the foreground (the analogue
+    of running the RM; see scheduler/service.py)."""
+    from tony_tpu.scheduler.service import main as scheduler_main
+
+    return scheduler_main(argv)
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
     "notebook": notebook_submit,
+    "submit": submit_cmd,
+    "ps": ps_cmd,
+    "queue": queue_cmd,
+    "scheduler": scheduler_cmd,
     "lint": lint,
     "list": list_resources,
     "cleanup": cleanup_resources,

@@ -187,10 +187,58 @@ K_SERVING_MAX_QUEUE = SERVING_PREFIX + "max-queue"
 # port when available, else ephemeral).
 K_SERVING_PORT = SERVING_PREFIX + "port"
 
+# --- multi-tenant scheduler (scheduler/) ------------------------------------
+# A persistent daemon that queues many jobs, gang-schedules them onto a
+# POOL of slices, and reuses warm slices across jobs: a released slice
+# keeps its bootstrap, staged venv blobs, and XLA compile cache, so the
+# next compatible job skips provisioning + staging and compiles warm.
+SCHEDULER_PREFIX = TONY_PREFIX + "scheduler."
+# host:port of a running scheduler daemon. Non-empty switches the client
+# submit path from "spawn a coordinator" to "POST the staged app dir to
+# the scheduler" (the YARN-RM-submission analogue).
+K_SCHED_ADDRESS = SCHEDULER_PREFIX + "address"
+# The daemon's working dir (slices, staging, scheduler.addr,
+# scheduler-state.json). Discovery fallback for `tony ps|queue`, the
+# history server's queue/pool panel, and the daemon itself.
+K_SCHED_BASE_DIR = SCHEDULER_PREFIX + "base-dir"
+# Daemon bind port (0 = ephemeral; the bound port is advertised in
+# <base_dir>/scheduler.addr the way coordinators advertise theirs).
+K_SCHED_PORT = SCHEDULER_PREFIX + "port"
+# Scheduling-loop tick, ms: queue pops, lease renewals, expiry sweeps.
+K_SCHED_TICK_MS = SCHEDULER_PREFIX + "tick-interval"
+# Pool capacity: slices provisioned at most, across all profiles.
+K_SCHED_MAX_SLICES = SCHEDULER_PREFIX + "max-slices"
+# A FREE slice idle longer than this is torn down (cloud slices bill
+# while warm); 0 = keep warm forever.
+K_SCHED_IDLE_TIMEOUT_MS = SCHEDULER_PREFIX + "slice-idle-timeout"
+# A LEASED slice whose runner stops renewing for this long is reclaimed
+# and retired (the holder may have crashed mid-job; its state is suspect).
+K_SCHED_LEASE_TIMEOUT_MS = SCHEDULER_PREFIX + "lease-timeout"
+# Simulated control-plane latency for LOCAL slice provisioning, ms —
+# models the minutes a real TPU queued-resource create takes; 0 for
+# tests that only care about ordering.
+K_SCHED_LOCAL_PROVISION_MS = SCHEDULER_PREFIX + "local-provision-ms"
+# Per-job submission attributes (read from the SUBMITTED job's conf).
+K_SCHED_PRIORITY = SCHEDULER_PREFIX + "priority"   # higher preempts lower
+K_SCHED_TENANT = SCHEDULER_PREFIX + "tenant"
+# Max concurrently-RUNNING jobs per tenant (0 = unlimited), plus
+# per-tenant overrides as "alice=2,bob=1".
+K_SCHED_TENANT_QUOTA = SCHEDULER_PREFIX + "tenant-quota"
+K_SCHED_TENANT_QUOTAS = SCHEDULER_PREFIX + "tenant-quotas"
+# May a higher-priority submit preempt a running lower-priority job?
+# (Preempted jobs requeue and resume from their best checkpoint step.)
+K_SCHED_PREEMPTION = SCHEDULER_PREFIX + "preemption-enabled"
+
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
 # (extra HDFS delegation tokens) and tony.yarn.queue have no substrate here.
 K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
+# Cap on the content-hash venv blob store under <staging>/blobs/ (the
+# dedup store client._stage fills): after each stage, least-recently-
+# used blobs beyond this many bytes are pruned. 0 = unbounded (operator
+# owns cleanup). A dedup HIT refreshes the blob's mtime, so live venvs
+# stay resident.
+K_STAGING_BLOB_MAX_BYTES = TONY_PREFIX + "staging.blob-store-max-bytes"
 K_LIB_PATH = TONY_PREFIX + "lib.path"                    # staged framework copy for executors
 K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
 # CheckpointManager directory (dir or gs:// URI). When set, the coordinator
@@ -295,7 +343,21 @@ DEFAULTS: dict[str, object] = {
     K_SERVING_DECODE_WINDOW: 1,
     K_SERVING_MAX_QUEUE: 1024,
     K_SERVING_PORT: 0,
+    K_SCHED_ADDRESS: "",
+    K_SCHED_BASE_DIR: "",
+    K_SCHED_PORT: 0,
+    K_SCHED_TICK_MS: 200,
+    K_SCHED_MAX_SLICES: 4,
+    K_SCHED_IDLE_TIMEOUT_MS: 600000,
+    K_SCHED_LEASE_TIMEOUT_MS: 60000,
+    K_SCHED_LOCAL_PROVISION_MS: 0,
+    K_SCHED_PRIORITY: 0,
+    K_SCHED_TENANT: "default",
+    K_SCHED_TENANT_QUOTA: 0,
+    K_SCHED_TENANT_QUOTAS: "",
+    K_SCHED_PREEMPTION: True,
     K_STAGING_LOCATION: "",
+    K_STAGING_BLOB_MAX_BYTES: 0,
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",
     K_CHECKPOINT_LOCATION: "",

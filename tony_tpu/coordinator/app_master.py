@@ -142,6 +142,7 @@ class TonyCoordinator:
         app_dir: str | os.PathLike[str],
         app_id: str | None = None,
         backend: ContainerBackend | None = None,
+        resume_step: int | None = None,
     ) -> None:
         self.conf = conf
         self.app_dir = Path(app_dir)
@@ -164,7 +165,11 @@ class TonyCoordinator:
         # the current session (cascades are noise), the step retried tasks
         # resume from, and one record per retry decision for final-status.
         self._session_failure: FailureEvent | None = None
-        self._resume_step: int | None = None
+        # Seeded resume step: a scheduler relaunch of a PREEMPTED job
+        # passes the best checkpoint step it probed, so the FIRST session
+        # already exports TONY_RESUME_STEP (the PR-2 retry loop only sets
+        # it between sessions of one coordinator).
+        self._resume_step: int | None = resume_step
         self._retry_log: list[dict[str, Any]] = []
         self._retry_policy: RetryPolicy | None = None
         # Structured fault injection (tony.fault.plan + deprecated TEST_*
@@ -1066,6 +1071,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="tony_tpu coordinator (AM analogue)")
     parser.add_argument("--app-dir", required=True)
     parser.add_argument("--app-id", default=None)
+    parser.add_argument("--resume-step", type=int, default=None,
+                        help="seed TONY_RESUME_STEP for the first session "
+                             "(scheduler preemption relaunch)")
     args = parser.parse_args(argv)
     conf = TonyConfiguration.from_final(
         Path(args.app_dir) / constants.TONY_FINAL_CONF
@@ -1110,7 +1118,8 @@ def main(argv: list[str] | None = None) -> int:
             lib_path=lib_path,
         )
     coordinator = TonyCoordinator(
-        conf, args.app_dir, app_id=args.app_id, backend=backend
+        conf, args.app_dir, app_id=args.app_id, backend=backend,
+        resume_step=args.resume_step,
     )
     status = coordinator.run()
     return 0 if status is SessionStatus.SUCCEEDED else 1

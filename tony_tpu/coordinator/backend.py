@@ -475,11 +475,21 @@ class TpuVmBackend:
     # multiply control-plane requests by 32 every monitor tick.
     STATE_CACHE_TTL_S = 1.0
 
-    def __init__(self, api: TpuApi, app_id: str) -> None:
+    def __init__(
+        self, api: TpuApi, app_id: str,
+        external_slices: Mapping[str, str] | None = None,
+    ) -> None:
+        """``external_slices`` switches the backend from provision/teardown
+        to lease/release: {job_name: slice_name} names slices SOMEONE ELSE
+        (the scheduler's warm pool) created and will delete — launch skips
+        ``create_slice`` and ``stop_all`` skips ``delete_slice`` for them,
+        so a finished job hands its slice back still bootstrapped instead
+        of tearing it down."""
         self.api = api
         self.app_id = app_id
         self._plans: dict[str, SlicePlan] = {}
         self._created: set[str] = set()
+        self._external = dict(external_slices or {})
         self._handles: list[_TpuHandle] = []
         self._state_cache: dict[str, tuple[float, str]] = {}
 
@@ -500,7 +510,7 @@ class TpuVmBackend:
         self._plans = dict(plans)
 
     def _slice_name(self, job_name: str) -> str:
-        return f"{self.app_id}-{job_name}"
+        return self._external.get(job_name, f"{self.app_id}-{job_name}")
 
     def launch(self, task: TonyTask, env: Mapping[str, str]) -> _TpuHandle:
         plan = self._plans.get(task.job_name)
@@ -511,7 +521,11 @@ class TpuVmBackend:
                 f"jobs only"
             )
         name = self._slice_name(task.job_name)
-        if name not in self._created:
+        if task.job_name in self._external:
+            # Leased from the pool: already created (and usually READY —
+            # the poll path start-executes as soon as the state says so).
+            pass
+        elif name not in self._created:
             log.info(
                 "creating %d x %s (%d hosts each) as %s",
                 plan.num_slices, plan.accelerator_type, plan.hosts_per_slice,
@@ -569,6 +583,8 @@ class TpuVmBackend:
         for h in self._handles:
             self.kill(h)
         self._handles.clear()
+        # Only slices THIS backend created are deleted; leased
+        # (external) slices go back to their pool warm.
         for name in self._created:
             try:
                 self.api.delete_slice(name)

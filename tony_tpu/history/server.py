@@ -48,6 +48,7 @@ def _fmt_ms(ms: int) -> str:
 
 class HistoryHandler(BaseHTTPRequestHandler):
     history_location: str = "."
+    scheduler_dir: str = ""  # "" = no queue/pool panel
     cache: TtlCache = TtlCache(ttl_s=30.0)
 
     # -- routes -------------------------------------------------------------
@@ -55,6 +56,15 @@ class HistoryHandler(BaseHTTPRequestHandler):
         try:
             if self.path in ("/", "/index.html"):
                 self._send_html(self._jobs_page())
+            elif self.path == "/scheduler":
+                self._send_html(self._scheduler_page())
+            elif self.path == "/api/scheduler":
+                state, _ = self._scheduler_state()
+                if state is None:
+                    self._send_json({"error": "no scheduler state"},
+                                    status=404)
+                else:
+                    self._send_json(state)
             elif self.path == "/api/jobs":
                 self._send_json([j.__dict__ for j in self._jobs()])
             elif self.path.startswith("/config/"):
@@ -135,7 +145,58 @@ class HistoryHandler(BaseHTTPRequestHandler):
             "<table><tr><th>job</th><th>started</th><th>completed</th>"
             f"<th>user</th><th>status</th><th></th></tr>{rows}</table>"
         )
+        if self.scheduler_dir:
+            body = ("<p><a href='/scheduler'>scheduler queue &amp; "
+                    "pool</a></p>") + body
         return _PAGE.format(title="Jobs", body=body)
+
+    # -- scheduler queue/pool panel ------------------------------------------
+    def _scheduler_state(self):
+        """Live daemon state falling back to its atomically-published
+        scheduler-state.json — the one shared chain (`tony ps` uses the
+        same helper)."""
+        if not self.scheduler_dir:
+            return None, ""
+        from tony_tpu.scheduler.http import read_state
+
+        return read_state(self.scheduler_dir)
+
+    def _scheduler_page(self) -> str:
+        state, source = self._scheduler_state()
+        if state is None:
+            return _PAGE.format(
+                title="Scheduler",
+                body="<p>no scheduler daemon reachable (live or state "
+                     "file)</p>",
+            )
+        esc = html.escape
+        job_rows = "".join(
+            f"<tr><td>{esc(j['job_id'])}</td>"
+            f"<td class='{esc(j['state'])}'>{esc(j['state'])}</td>"
+            f"<td>{j['priority']}</td><td>{esc(j['tenant'])}</td>"
+            f"<td>{esc(j.get('slice_id') or '-')}</td>"
+            f"<td>{j['attempts']}</td><td>{j['preemptions']}</td>"
+            f"<td>{esc(str(j.get('resume_step')))}</td></tr>"
+            for j in state.get("jobs", [])
+        )
+        pool_rows = "".join(
+            f"<tr><td>{esc(s['slice_id'])}</td><td>{esc(s['state'])}</td>"
+            f"<td>{esc(s['profile'])}</td><td>{s['jobs_served']}</td>"
+            f"<td>{esc(s.get('lease_job_id') or '-')}</td></tr>"
+            for s in state.get("pool", [])
+        )
+        body = (
+            f"<p>source: {esc(source)} &middot; queue depth "
+            f"{state.get('queue_depth', 0)}</p>"
+            "<h3>Jobs</h3><table><tr><th>job</th><th>state</th>"
+            "<th>prio</th><th>tenant</th><th>slice</th><th>try</th>"
+            f"<th>preempt</th><th>resume step</th></tr>{job_rows}</table>"
+            "<h3>Slice pool</h3><table><tr><th>slice</th><th>state</th>"
+            "<th>profile</th><th>jobs served</th><th>lease</th></tr>"
+            f"{pool_rows}</table>"
+            "<p><a href='/'>jobs</a></p>"
+        )
+        return _PAGE.format(title="Scheduler", body=body)
 
     def _job_page(self, app_id: str) -> None:
         """Per-job run report: terminal state, run statistics, slice plans,
@@ -345,10 +406,12 @@ class HistoryServer:
         host: str = "127.0.0.1",
         certfile: str | None = None,
         keyfile: str | None = None,
+        scheduler_dir: str | None = None,
     ) -> None:
         handler = type(
             "BoundHandler", (HistoryHandler,),
-            {"history_location": history_location, "cache": TtlCache(30.0)},
+            {"history_location": history_location, "cache": TtlCache(30.0),
+             "scheduler_dir": scheduler_dir or ""},
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.scheme = "http"
@@ -374,6 +437,7 @@ class HistoryServer:
         from tony_tpu.conf import keys
 
         location = history_location or conf.get_str(keys.K_HISTORY_LOCATION)
+        sched_dir = conf.get_str(keys.K_SCHED_BASE_DIR) or None
         cert = conf.get_str(keys.K_HTTPS_CERT) or None
         if cert:
             return cls(
@@ -382,6 +446,7 @@ class HistoryServer:
                 host=host,
                 certfile=cert,
                 keyfile=conf.get_str(keys.K_HTTPS_KEY) or None,
+                scheduler_dir=sched_dir,
             )
         http_port = conf.get_str(keys.K_HTTP_PORT, "disabled")
         if http_port == "disabled":
@@ -389,7 +454,8 @@ class HistoryServer:
                 f"{keys.K_HTTP_PORT} is 'disabled' and no {keys.K_HTTPS_CERT} "
                 f"is configured — nothing to serve on"
             )
-        return cls(location, port=int(http_port), host=host)
+        return cls(location, port=int(http_port), host=host,
+                   scheduler_dir=sched_dir)
 
     _serving = False
 
@@ -421,6 +487,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="override the configured port")
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 is an explicit opt-in)")
+    p.add_argument("--scheduler-dir", default=None,
+                   help="scheduler daemon base dir for the queue/pool "
+                        "panel (default: tony.scheduler.base-dir)")
     args = p.parse_args(argv)
     from tony_tpu.conf import keys
     from tony_tpu.conf.configuration import load_job_config
@@ -429,13 +498,16 @@ def main(argv: list[str] | None = None) -> int:
     location = args.history_location or conf.get_str(keys.K_HISTORY_LOCATION)
     if not location:
         p.error("--history-location (or tony.history.location) is required")
+    sched_dir = args.scheduler_dir or conf.get_str(keys.K_SCHED_BASE_DIR) \
+        or None
     cert = conf.get_str(keys.K_HTTPS_CERT) or None
     keyf = conf.get_str(keys.K_HTTPS_KEY) or None
     if args.port is not None:
         # Port override keeps the configured TLS material — --port must
         # never silently downgrade an https deployment to plaintext.
         server = HistoryServer(location, args.port, host=args.host,
-                               certfile=cert, keyfile=keyf)
+                               certfile=cert, keyfile=keyf,
+                               scheduler_dir=sched_dir)
     else:
         try:
             server = HistoryServer.from_conf(conf, location, host=args.host)
@@ -446,7 +518,8 @@ def main(argv: list[str] | None = None) -> int:
                 p.error(str(exc))
             # Nothing configured at all: starting the server IS the opt-in,
             # so fall back to plain http on the reference's default port.
-            server = HistoryServer(location, 19886, host=args.host)
+            server = HistoryServer(location, 19886, host=args.host,
+                                   scheduler_dir=sched_dir)
     print(f"history server on {server.scheme}://localhost:{server.port}")
     try:
         server.httpd.serve_forever()

@@ -39,11 +39,19 @@ class MiniTonyCluster:
             d.mkdir(parents=True, exist_ok=True)
         self._app_seq = 0
         self._live: list[TonyCoordinator] = []
+        self._scheduler = None
         atexit.register(self.shutdown)
 
     def shutdown(self) -> None:
         """Kill every coordinator this cluster started that is still
-        running (idempotent; called by __exit__ and atexit)."""
+        running, and the scheduler daemon with its jobs (idempotent;
+        called by __exit__ and atexit)."""
+        if self._scheduler is not None:
+            try:
+                self._scheduler.shutdown()
+            except Exception:
+                pass
+            self._scheduler = None
         for coordinator in self._live:
             try:
                 coordinator.kill()
@@ -51,6 +59,24 @@ class MiniTonyCluster:
             except Exception:
                 pass
         self._live.clear()
+
+    def start_scheduler(self, conf: TonyConfiguration | None = None,
+                        serve_http: bool = True):
+        """Run a ``SchedulerDaemon`` against this cluster's dirs — the
+        multi-job mode: many queued submissions share a warm slice pool
+        instead of each ``run_job`` provisioning its own world. Jobs
+        submitted to it should carry ``base_conf()``'s staging/history
+        locations (``submit`` freezes whatever conf it is given)."""
+        from tony_tpu.scheduler.service import SchedulerDaemon
+
+        if self._scheduler is not None:
+            return self._scheduler
+        sconf = conf or self.base_conf()
+        sconf.set(keys.K_SCHED_BASE_DIR, str(self.base_dir / "scheduler"))
+        self._scheduler = SchedulerDaemon(
+            self.base_dir / "scheduler", conf=sconf
+        ).start(serve_http=serve_http)
+        return self._scheduler
 
     def __enter__(self) -> "MiniTonyCluster":
         return self

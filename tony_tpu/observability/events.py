@@ -40,6 +40,15 @@ RETRY_DECISION = "retry_decision"
 CHECKPOINT_PROGRESS = "checkpoint_progress"
 FINAL_STATUS = "final_status"
 
+# Scheduler-daemon lifecycle (scheduler/service.py): the queue/pool
+# timeline, appended to the scheduler's own events.jsonl.
+JOB_QUEUED = "job_queued"
+JOB_LAUNCHED = "job_launched"
+JOB_PREEMPTED = "job_preempted"
+JOB_FINISHED = "job_finished"
+SLICE_LEASED = "slice_leased"
+SLICE_RELEASED = "slice_released"
+
 # The event catalogue: every kind any emitter may use. TONY-E001
 # (analysis/events_lint.py, run from tools/lint_self.py in tier-1)
 # checks that every ``.emit(...)`` in the tree uses a registered kind
@@ -61,6 +70,12 @@ KNOWN_KINDS = frozenset({
     RETRY_DECISION,
     CHECKPOINT_PROGRESS,
     FINAL_STATUS,
+    JOB_QUEUED,
+    JOB_LAUNCHED,
+    JOB_PREEMPTED,
+    JOB_FINISHED,
+    SLICE_LEASED,
+    SLICE_RELEASED,
 })
 
 

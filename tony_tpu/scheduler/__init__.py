@@ -1,0 +1,34 @@
+"""Multi-tenant scheduler with a warm slice pool — the layer TonY
+delegated to YARN's ResourceManager, rebuilt TPU-native: a persistent
+daemon queues many jobs (priorities + per-tenant quotas), gang-schedules
+them onto a pool of slices, reuses warm slices across jobs (skip
+provisioning, staging, and cold XLA compiles), and preempts across jobs
+with checkpoint-step resume."""
+
+from tony_tpu.scheduler.pool import (
+    LocalSliceProvisioner,
+    PooledSlice,
+    SlicePool,
+    SliceState,
+    TpuSliceProvisioner,
+)
+from tony_tpu.scheduler.queue import (
+    JobQueue,
+    JobState,
+    SchedJob,
+    TenantQuotas,
+)
+from tony_tpu.scheduler.service import SchedulerDaemon
+
+__all__ = [
+    "JobQueue",
+    "JobState",
+    "LocalSliceProvisioner",
+    "PooledSlice",
+    "SchedJob",
+    "SchedulerDaemon",
+    "SlicePool",
+    "SliceState",
+    "TenantQuotas",
+    "TpuSliceProvisioner",
+]

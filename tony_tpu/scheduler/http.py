@@ -1,0 +1,186 @@
+"""JSON HTTP API for the scheduler daemon.
+
+What thin clients speak: ``tony submit`` POSTs a staged app dir,
+``tony ps`` / ``tony queue`` read the job/pool tables, scrapers read
+``/metrics``. Same stdlib ``ThreadingHTTPServer`` shape as the serving
+front end and the coordinator's observability port — and like those, it
+is a trusted-network control port (deployments front it with their own
+authn the way the reference fronted the RM).
+
+Routes::
+
+    POST /api/submit   {"app_dir": ..., "priority"?: n, "tenant"?: s}
+                       -> {"job_id": ...}
+    POST /api/kill     {"job_id": ...} -> {"ok": bool}
+    GET  /api/state    -> {queue, queue_depth, jobs, pool, ts_ms}
+    GET  /api/jobs     -> {"jobs": [...]}
+    GET  /api/queue    -> {"queue": [...], "queue_depth": n}
+    GET  /api/pool     -> {"pool": [...]}
+    GET  /api/job/<id> -> one job record
+    GET  /metrics      -> Prometheus text
+    GET  /healthz      -> {"ok": true, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger(__name__)
+
+
+def read_state(base_dir, addr: str | None = None,
+               timeout_s: float = 5.0):
+    """The one scheduler-state fallback chain every consumer shares
+    (`tony ps`/`queue`, the history server's queue/pool panel): live
+    daemon ``/api/state`` — address given explicitly or read from
+    ``<base_dir>/scheduler.addr`` — then the atomically-published
+    ``scheduler-state.json``. Returns ``(state, source)``;
+    ``(None, "")`` when both miss."""
+    import urllib.request
+    from pathlib import Path
+
+    base = Path(base_dir) if base_dir else None
+    if not addr and base is not None:
+        addr_file = base / "scheduler.addr"
+        if addr_file.is_file():
+            try:
+                addr = addr_file.read_text().strip()
+            except OSError:
+                addr = None
+    if addr:
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/api/state", timeout=timeout_s
+            ) as resp:
+                return json.loads(resp.read()), "live"
+        except (OSError, ValueError):
+            pass
+    if base is not None:
+        state_file = base / "scheduler-state.json"
+        try:
+            return json.loads(state_file.read_text()), "state-file"
+        except (OSError, ValueError):
+            pass
+    return None, ""
+
+
+class SchedulerHttpServer:
+    """Binds localhost by default, like the history server: an
+    unauthenticated submit/kill port on the open network must be an
+    explicit deployment opt-in (``host="0.0.0.0"`` behind the
+    deployment's own authn), not a side effect of starting the
+    daemon."""
+
+    def __init__(self, daemon, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        self.daemon = daemon
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, obj, content_type="application/json",
+                       ) -> None:
+                body = (obj if isinstance(obj, bytes)
+                        else json.dumps(obj).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                d = outer.daemon
+                try:
+                    if self.path == "/healthz":
+                        self._reply(200, {
+                            "ok": True,
+                            "queue_depth": d.queue.depth(),
+                            "running": len(d._runners),
+                        })
+                    elif self.path == "/metrics":
+                        self._reply(
+                            200, d.registry.to_prometheus().encode(),
+                            content_type="text/plain; version=0.0.4",
+                        )
+                    elif self.path == "/api/state":
+                        self._reply(200, d.state_json())
+                    elif self.path == "/api/jobs":
+                        self._reply(200, {
+                            "jobs": [j.to_json() for j in d.jobs()]
+                        })
+                    elif self.path == "/api/queue":
+                        state = d.state_json()
+                        self._reply(200, {
+                            "queue": state["queue"],
+                            "queue_depth": state["queue_depth"],
+                        })
+                    elif self.path == "/api/pool":
+                        self._reply(200, {"pool": d.pool.to_json()})
+                    elif self.path.startswith("/api/job/"):
+                        job = d.job(self.path[len("/api/job/"):])
+                        if job is None:
+                            self._reply(404, {"error": "unknown job"})
+                        else:
+                            self._reply(200, job.to_json())
+                    else:
+                        self._reply(404,
+                                    {"error": f"no route {self.path}"})
+                except Exception as exc:  # a poll must not kill the port
+                    log.warning("scheduler api GET %s failed", self.path,
+                                exc_info=True)
+                    self._reply(500, {"error": str(exc)})
+
+            def do_POST(self):
+                d = outer.daemon
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, TypeError) as exc:
+                    self._reply(400, {"error": f"bad body: {exc}"})
+                    return
+                try:
+                    if self.path == "/api/submit":
+                        pr = body.get("priority")
+                        job_id = d.submit_app_dir(
+                            body["app_dir"],
+                            priority=None if pr is None else int(pr),
+                            tenant=body.get("tenant"),
+                        )
+                        self._reply(200, {"job_id": job_id})
+                    elif self.path == "/api/kill":
+                        self._reply(200,
+                                    {"ok": d.kill(str(body["job_id"]))})
+                    else:
+                        self._reply(404,
+                                    {"error": f"no route {self.path}"})
+                except (KeyError, ValueError) as exc:
+                    self._reply(400, {"error": f"bad request: {exc}"})
+                except Exception as exc:
+                    log.warning("scheduler api POST %s failed", self.path,
+                                exc_info=True)
+                    self._reply(500, {"error": str(exc)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="scheduler-http",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("scheduler api listening on :%d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,454 @@
+"""Warm slice pool: lease/release instead of provision/teardown.
+
+The headline optimisation of the scheduler layer. Today a slice lives
+and dies with one coordinator: every submit (and every retry that
+escalates to re-provision) pays the full provisioning + venv-staging +
+warm-up tax. Here the pool owns slice lifecycle: a slice released by a
+finished job goes back FREE — still bootstrapped, its workspace holding
+the staged venv blobs and the PR-6 XLA compile cache — so the next
+compatible job leases it warm: provisioning skipped, staging a
+content-hash no-op, compiles served from cache.
+
+Substrate is injectable (``SliceProvisioner``): ``LocalSliceProvisioner``
+models a slice as a persistent workspace directory (what the mini
+cluster and ``bench_scheduler`` run on, with an optional simulated
+control-plane delay); ``TpuSliceProvisioner`` drives the same
+``TpuApi`` seam the ``TpuVmBackend`` uses — the backend then runs in
+leased mode (``external_slices``) and never creates or deletes what the
+pool owns.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import shutil
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Protocol
+
+log = logging.getLogger(__name__)
+
+# Declared metric names (TONY-M001 lints these module-scope constants).
+WARM_HITS_COUNTER = "tony_sched_warm_hits_total"
+COLD_PROVISIONS_COUNTER = "tony_sched_cold_provisions_total"
+LEASE_EXPIRED_COUNTER = "tony_sched_lease_expired_total"
+POOL_SLICES_GAUGE = "tony_sched_pool_slices"
+PROVISION_HISTOGRAM = "tony_sched_provision_ms"
+
+# Workspace layout every warm slice keeps between jobs.
+XLA_CACHE_DIRNAME = "xla-cache"
+BOOTSTRAP_MARKER = ".bootstrapped"
+
+
+class SliceState(enum.Enum):
+    PROVISIONING = "PROVISIONING"
+    FREE = "FREE"
+    LEASED = "LEASED"
+    RETIRED = "RETIRED"
+
+
+@dataclass
+class PooledSlice:
+    slice_id: str
+    profile: str
+    workspace: Path
+    state: SliceState = SliceState.PROVISIONING
+    created_ms: int = 0
+    last_released_ms: int = 0
+    jobs_served: int = 0
+    lease_job_id: str | None = None
+    lease_expires_ms: int | None = None
+
+    @property
+    def compile_cache_dir(self) -> Path:
+        return self.workspace / XLA_CACHE_DIRNAME
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "slice_id": self.slice_id,
+            "profile": self.profile,
+            "state": self.state.value,
+            "workspace": str(self.workspace),
+            "created_ms": self.created_ms,
+            "jobs_served": self.jobs_served,
+            "lease_job_id": self.lease_job_id,
+            "lease_expires_ms": self.lease_expires_ms,
+        }
+
+
+class SliceProvisioner(Protocol):
+    def provision(self, slice_id: str, profile: str, workspace: Path) -> None:
+        """Bring a slice up (blocking) and bootstrap its workspace."""
+
+    def teardown(self, slice_id: str, profile: str, workspace: Path) -> None:
+        """Release the underlying resources."""
+
+
+class LocalSliceProvisioner:
+    """A "slice" on the local substrate: a persistent workspace dir with
+    a bootstrap marker and an XLA cache dir. ``provision_ms`` simulates
+    the control-plane latency a real queued-resource create pays (0 for
+    ordering-only tests; bench configs set it to model TPU numbers)."""
+
+    def __init__(self, provision_ms: int = 0,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.provision_ms = int(provision_ms)
+        self._sleep = sleep
+
+    def provision(self, slice_id: str, profile: str, workspace: Path) -> None:
+        if self.provision_ms > 0:
+            self._sleep(self.provision_ms / 1000.0)
+        workspace.mkdir(parents=True, exist_ok=True)
+        (workspace / XLA_CACHE_DIRNAME).mkdir(exist_ok=True)
+        (workspace / BOOTSTRAP_MARKER).write_text(
+            f"{slice_id} {profile}\n"
+        )
+
+    def teardown(self, slice_id: str, profile: str, workspace: Path) -> None:
+        shutil.rmtree(workspace, ignore_errors=True)
+
+
+class TpuSliceProvisioner:
+    """Pool-owned slice lifecycle through the same injectable ``TpuApi``
+    seam the backend uses. The profile key is exactly what the daemon's
+    ``_profile_for`` builds from the job's slice plans —
+    ``"<job>=<accelerator_type>x<num_slices>[,...]"``, one component per
+    TPU job type — and this provisioner creates ONE slice group per
+    component. A TPU ``backend_factory`` then hands
+    ``external_slices(lease.slice)`` to ``TpuVmBackend`` so the
+    coordinator leases instead of creating, and releases instead of
+    deleting."""
+
+    def __init__(self, api, poll_interval_s: float = 2.0,
+                 ready_timeout_s: float = 1800.0) -> None:
+        self.api = api
+        self.poll_interval_s = poll_interval_s
+        self.ready_timeout_s = ready_timeout_s
+
+    @staticmethod
+    def parse_profile(profile: str) -> dict[str, tuple[str, int]]:
+        """``"ps=v4-8x1,worker=v5litepod-16x2"`` →
+        ``{job: (accelerator_type, num_slices)}``."""
+        out: dict[str, tuple[str, int]] = {}
+        for part in profile.split(","):
+            job, sep, shape = part.partition("=")
+            accel, xsep, n = shape.rpartition("x")
+            if not sep or not xsep:
+                raise ValueError(
+                    f"profile component {part!r} is not "
+                    f"job=accelerator_typexN"
+                )
+            out[job] = (accel, int(n))
+        return out
+
+    @staticmethod
+    def slice_group_name(slice_id: str, job: str) -> str:
+        return f"{slice_id}-{job}"
+
+    @classmethod
+    def external_slices(cls, pooled: "PooledSlice") -> dict[str, str]:
+        """The ``TpuVmBackend(external_slices=...)`` mapping for a lease
+        of this pooled slice: {job_name: slice group name}."""
+        return {
+            job: cls.slice_group_name(pooled.slice_id, job)
+            for job in cls.parse_profile(pooled.profile)
+        }
+
+    def provision(self, slice_id: str, profile: str, workspace: Path) -> None:
+        groups = self.parse_profile(profile)
+        for job, (accel, num_slices) in groups.items():
+            self.api.create_slice(
+                self.slice_group_name(slice_id, job), accel, num_slices
+            )
+        deadline = time.monotonic() + self.ready_timeout_s
+        pending = {self.slice_group_name(slice_id, job) for job in groups}
+        while pending:
+            for name in sorted(pending):
+                state = self.api.slice_state(name)
+                if state == "READY":
+                    pending.discard(name)
+                elif state in ("FAILED", "PREEMPTED"):
+                    raise RuntimeError(
+                        f"slice group {name} entered {state} while "
+                        f"provisioning"
+                    )
+            if not pending:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"slice groups {sorted(pending)} not READY after "
+                    f"{self.ready_timeout_s:.0f}s"
+                )
+            time.sleep(self.poll_interval_s)
+        workspace.mkdir(parents=True, exist_ok=True)
+        (workspace / XLA_CACHE_DIRNAME).mkdir(exist_ok=True)
+        (workspace / BOOTSTRAP_MARKER).write_text(f"{slice_id} {profile}\n")
+
+    def teardown(self, slice_id: str, profile: str, workspace: Path) -> None:
+        try:
+            groups = self.parse_profile(profile)
+        except ValueError:
+            groups = {}
+        for job in groups:
+            try:
+                self.api.delete_slice(self.slice_group_name(slice_id, job))
+            except Exception:
+                log.warning("could not delete slice group %s-%s",
+                            slice_id, job, exc_info=True)
+        shutil.rmtree(workspace, ignore_errors=True)
+
+
+@dataclass
+class LeaseResult:
+    slice: PooledSlice
+    warm: bool
+
+
+class SlicePool:
+    """Bounded pool of slices with lease/release semantics.
+
+    * ``lease(profile, job_id)`` — a FREE slice of the profile comes
+      back WARM (provisioning + bootstrap skipped); otherwise a new
+      slice is provisioned COLD if the pool has headroom; otherwise
+      None (the caller decides whether to wait or preempt).
+    * ``release(slice_id)`` — back to FREE, workspace intact: the next
+      lease of the profile is warm.
+    * ``renew(slice_id)`` — lease heartbeat; ``expire_leases()``
+      retires slices whose holder stopped renewing (a crashed runner
+      may still have processes on the slice — its state is suspect, so
+      an expired lease never returns to the warm pool).
+    * ``reap_idle()`` — FREE slices idle past ``idle_timeout_ms`` are
+      torn down (cloud slices bill while warm).
+    """
+
+    def __init__(
+        self,
+        base_dir: str | Path,
+        provisioner: SliceProvisioner | None = None,
+        max_slices: int = 4,
+        lease_timeout_ms: int = 60000,
+        idle_timeout_ms: int = 600000,
+        registry=None,
+        clock_ms: Callable[[], int] | None = None,
+    ) -> None:
+        self.base_dir = Path(base_dir)
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+        self.provisioner = provisioner or LocalSliceProvisioner()
+        self.max_slices = int(max_slices)
+        self.lease_timeout_ms = int(lease_timeout_ms)
+        self.idle_timeout_ms = int(idle_timeout_ms)
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self._lock = threading.Lock()
+        self._slices: dict[str, PooledSlice] = {}
+        if registry is None:
+            from tony_tpu.observability.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+
+    # -- lease / release -----------------------------------------------------
+    def has_headroom(self) -> bool:
+        """Could a lease make progress right now — warm slice, free
+        capacity, or an evictable idle slice? Advisory (racy by nature):
+        ``lease`` is the authoritative, capacity-safe check."""
+        with self._lock:
+            return (
+                len(self._live_locked()) < self.max_slices
+                or any(s.state is SliceState.FREE
+                       for s in self._slices.values())
+            )
+
+    def lease(self, profile: str, job_id: str,
+              warm_only: bool = False) -> LeaseResult | None:
+        """Warm slice if one is FREE for the profile; else (unless
+        ``warm_only`` — the scheduler tick's non-blocking fast path)
+        provision a cold one (counts toward ``max_slices``, evicting an
+        idle mismatched slice when full); else None."""
+        now = self._clock_ms()
+        with self._lock:
+            for s in self._slices.values():
+                if s.state is SliceState.FREE and s.profile == profile:
+                    s.state = SliceState.LEASED
+                    s.lease_job_id = job_id
+                    s.lease_expires_ms = now + self.lease_timeout_ms
+                    s.jobs_served += 1
+                    self.registry.counter(WARM_HITS_COUNTER).inc()
+                    self._update_gauges_locked()
+                    log.info("warm lease: %s (profile %s) -> job %s "
+                             "(%d jobs served)", s.slice_id, profile,
+                             job_id, s.jobs_served)
+                    return LeaseResult(s, warm=True)
+            if warm_only:
+                return None
+            evict: PooledSlice | None = None
+            if len(self._live_locked()) >= self.max_slices:
+                # Full — but a FREE slice of ANOTHER profile (the warm
+                # scan above already missed) is idle capacity: evict the
+                # least-recently-used one to make headroom, else a pool
+                # full of mismatched warm slices starves every
+                # new-profile job until idle-reap (forever with
+                # slice-idle-timeout=0).
+                free = [s for s in self._slices.values()
+                        if s.state is SliceState.FREE]
+                if not free:
+                    return None
+                evict = min(free, key=lambda s: s.last_released_ms)
+                evict.state = SliceState.RETIRED
+                self._slices.pop(evict.slice_id)
+                log.info("evicting idle %s (profile %s) to provision "
+                         "profile %s", evict.slice_id, evict.profile,
+                         profile)
+            slice_id = f"slice-{uuid.uuid4().hex[:8]}"
+            s = PooledSlice(
+                slice_id, profile, self.base_dir / slice_id,
+                state=SliceState.PROVISIONING, created_ms=now,
+                lease_job_id=job_id,
+                lease_expires_ms=now + self.lease_timeout_ms,
+            )
+            self._slices[slice_id] = s
+            self._update_gauges_locked()
+        if evict is not None:
+            self._teardown(evict)
+        # Provision OUTSIDE the lock: a multi-minute queued-resource
+        # create must not block concurrent releases/renewals.
+        t0 = time.monotonic()
+        try:
+            self.provisioner.provision(slice_id, profile, s.workspace)
+        except Exception:
+            with self._lock:
+                s.state = SliceState.RETIRED
+                self._slices.pop(slice_id, None)
+                self._update_gauges_locked()
+            raise
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        with self._lock:
+            s.state = SliceState.LEASED
+            s.jobs_served = 1
+            # Renew from NOW: provisioning may have consumed most of the
+            # original lease window.
+            s.lease_expires_ms = self._clock_ms() + self.lease_timeout_ms
+            self.registry.counter(COLD_PROVISIONS_COUNTER).inc()
+            self.registry.histogram(
+                PROVISION_HISTOGRAM,
+                buckets=(10, 100, 1000, 10000, 60000, 600000),
+            ).observe(dt_ms)
+            self._update_gauges_locked()
+        log.info("cold provision: %s (profile %s, %.0f ms) -> job %s",
+                 slice_id, profile, dt_ms, job_id)
+        return LeaseResult(s, warm=False)
+
+    def release(self, slice_id: str, healthy: bool = True) -> None:
+        """Return a leased slice. ``healthy=False`` (the runner saw the
+        slice itself misbehave, not just the job fail) retires it."""
+        teardown: PooledSlice | None = None
+        with self._lock:
+            s = self._slices.get(slice_id)
+            if s is None or s.state is not SliceState.LEASED:
+                return
+            s.lease_job_id = None
+            s.lease_expires_ms = None
+            if healthy:
+                s.state = SliceState.FREE
+                s.last_released_ms = self._clock_ms()
+            else:
+                s.state = SliceState.RETIRED
+                teardown = self._slices.pop(slice_id)
+            self._update_gauges_locked()
+        if teardown is not None:
+            self._teardown(teardown)
+
+    def renew(self, slice_id: str) -> None:
+        with self._lock:
+            s = self._slices.get(slice_id)
+            if s is not None and s.state is SliceState.LEASED:
+                s.lease_expires_ms = self._clock_ms() + self.lease_timeout_ms
+
+    # -- sweeps --------------------------------------------------------------
+    def expire_leases(self) -> list[PooledSlice]:
+        """Retire slices whose lease ran out — the holder crashed or
+        wedged; whatever it left on the slice makes warm reuse unsafe."""
+        now = self._clock_ms()
+        expired: list[PooledSlice] = []
+        with self._lock:
+            for sid, s in list(self._slices.items()):
+                if (
+                    s.state is SliceState.LEASED
+                    and s.lease_expires_ms is not None
+                    and now > s.lease_expires_ms
+                ):
+                    log.warning("lease on %s (job %s) expired; retiring",
+                                sid, s.lease_job_id)
+                    s.state = SliceState.RETIRED
+                    expired.append(self._slices.pop(sid))
+                    self.registry.counter(LEASE_EXPIRED_COUNTER).inc()
+            if expired:
+                self._update_gauges_locked()
+        for s in expired:
+            self._teardown(s)
+        return expired
+
+    def reap_idle(self) -> list[PooledSlice]:
+        if self.idle_timeout_ms <= 0:
+            return []
+        now = self._clock_ms()
+        reaped: list[PooledSlice] = []
+        with self._lock:
+            for sid, s in list(self._slices.items()):
+                if (
+                    s.state is SliceState.FREE
+                    and now - s.last_released_ms > self.idle_timeout_ms
+                ):
+                    s.state = SliceState.RETIRED
+                    reaped.append(self._slices.pop(sid))
+            if reaped:
+                self._update_gauges_locked()
+        for s in reaped:
+            log.info("reaping idle slice %s (profile %s)", s.slice_id,
+                     s.profile)
+            self._teardown(s)
+        return reaped
+
+    def shutdown(self) -> None:
+        with self._lock:
+            slices = list(self._slices.values())
+            self._slices.clear()
+            self._update_gauges_locked()
+        for s in slices:
+            self._teardown(s)
+
+    # -- views ---------------------------------------------------------------
+    def slices(self) -> list[PooledSlice]:
+        with self._lock:
+            return list(self._slices.values())
+
+    def get(self, slice_id: str) -> PooledSlice | None:
+        with self._lock:
+            return self._slices.get(slice_id)
+
+    def to_json(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [s.to_json() for s in self._slices.values()]
+
+    # -- internals -----------------------------------------------------------
+    def _live_locked(self) -> list[PooledSlice]:
+        return [s for s in self._slices.values()
+                if s.state is not SliceState.RETIRED]
+
+    def _update_gauges_locked(self) -> None:
+        counts = {state: 0 for state in SliceState}
+        for s in self._slices.values():
+            counts[s.state] += 1
+        for state, n in counts.items():
+            self.registry.gauge(
+                POOL_SLICES_GAUGE, labels={"state": state.value.lower()}
+            ).set(n)
+
+    def _teardown(self, s: PooledSlice) -> None:
+        try:
+            self.provisioner.teardown(s.slice_id, s.profile, s.workspace)
+        except Exception:
+            log.warning("teardown of %s failed", s.slice_id, exc_info=True)

@@ -1,0 +1,197 @@
+"""Job queue + state machine for the multi-tenant scheduler.
+
+The layer TonY delegated to YARN's ResourceManager (PAPER.md §L0): many
+submitted jobs, ordered by priority (FIFO within a priority band), with
+per-tenant running-job quotas enforced at pop time. A preempted job
+requeues with its ORIGINAL arrival sequence, so it goes back to the head
+of its band rather than behind everything submitted since — preemption
+defers work, it must not also penalize it.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+
+
+class JobState(enum.Enum):
+    QUEUED = "QUEUED"
+    LAUNCHING = "LAUNCHING"
+    RUNNING = "RUNNING"
+    PREEMPTING = "PREEMPTING"   # kill signalled, coordinator draining
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (JobState.SUCCEEDED, JobState.FAILED, JobState.KILLED)
+
+    @property
+    def active(self) -> bool:
+        """Occupying (or about to occupy) a slice."""
+        return self in (JobState.LAUNCHING, JobState.RUNNING,
+                        JobState.PREEMPTING)
+
+
+@dataclass
+class SchedJob:
+    """One submission as the scheduler tracks it across attempts."""
+
+    job_id: str
+    conf: TonyConfiguration
+    app_dir: str            # staged application dir (frozen conf inside)
+    priority: int = 0
+    tenant: str = "default"
+    submit_ms: int = 0
+    seq: int = 0            # arrival order; preserved across requeues
+    state: JobState = JobState.QUEUED
+    slice_id: str | None = None
+    attempts: int = 0
+    preemptions: int = 0
+    resume_step: int | None = None
+    diagnostics: str = ""
+    app_ids: list[str] = field(default_factory=list)
+    finished_ms: int | None = None
+    # An explicit operator kill that landed while the job was launching
+    # or preempting: the next lifecycle edge must finalize KILLED, never
+    # launch or requeue.
+    kill_requested: bool = False
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "priority": self.priority,
+            "tenant": self.tenant,
+            "submit_ms": self.submit_ms,
+            "state": self.state.value,
+            "slice_id": self.slice_id,
+            "attempts": self.attempts,
+            "preemptions": self.preemptions,
+            "resume_step": self.resume_step,
+            "diagnostics": self.diagnostics,
+            "app_ids": list(self.app_ids),
+            "app_dir": self.app_dir,
+            "finished_ms": self.finished_ms,
+        }
+
+
+class TenantQuotas:
+    """Max concurrently-RUNNING jobs per tenant: a default cap plus
+    per-tenant overrides (``tony.scheduler.tenant-quotas`` =
+    ``"alice=2,bob=1"``). 0 = unlimited."""
+
+    def __init__(self, default: int = 0,
+                 overrides: Mapping[str, int] | None = None) -> None:
+        self.default = int(default)
+        self.overrides = {k: int(v) for k, v in (overrides or {}).items()}
+
+    @classmethod
+    def from_conf(cls, conf: TonyConfiguration) -> "TenantQuotas":
+        overrides: dict[str, int] = {}
+        raw = conf.get_str(keys.K_SCHED_TENANT_QUOTAS, "")
+        for pair in raw.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            tenant, _, n = pair.partition("=")
+            try:
+                overrides[tenant.strip()] = int(n)
+            except ValueError:
+                raise ValueError(
+                    f"{keys.K_SCHED_TENANT_QUOTAS} entry {pair!r} is not "
+                    f"tenant=N"
+                ) from None
+        return cls(conf.get_int(keys.K_SCHED_TENANT_QUOTA, 0), overrides)
+
+    def limit(self, tenant: str) -> int:
+        return self.overrides.get(tenant, self.default)
+
+    def admits(self, tenant: str, running: int) -> bool:
+        limit = self.limit(tenant)
+        return limit <= 0 or running < limit
+
+
+class JobQueue:
+    """Thread-safe priority queue of ``SchedJob``s.
+
+    Ordering: priority DESC, then arrival sequence ASC. The queue holds
+    only QUEUED jobs; callers own the rest of the state machine and hand
+    jobs back via ``requeue`` on preemption."""
+
+    def __init__(self, quotas: TenantQuotas | None = None) -> None:
+        self._lock = threading.Lock()
+        self._queued: list[SchedJob] = []
+        self._seq = 0
+        self.quotas = quotas or TenantQuotas()
+
+    def submit(self, job: SchedJob) -> SchedJob:
+        with self._lock:
+            self._seq += 1
+            job.seq = self._seq
+            if not job.submit_ms:
+                job.submit_ms = int(time.time() * 1000)
+            job.state = JobState.QUEUED
+            self._queued.append(job)
+            self._sort()
+        return job
+
+    def requeue(self, job: SchedJob) -> None:
+        """Put a preempted (or failed-to-launch) job back, keeping its
+        original arrival seq: it re-enters at the head of its priority
+        band."""
+        with self._lock:
+            job.state = JobState.QUEUED
+            job.slice_id = None
+            if job not in self._queued:
+                self._queued.append(job)
+            self._sort()
+
+    def _sort(self) -> None:
+        self._queued.sort(key=lambda j: (-j.priority, j.seq))
+
+    def pop_next(
+        self, running_per_tenant: Mapping[str, int] | None = None,
+        admit: Callable[[SchedJob], bool] | None = None,
+    ) -> SchedJob | None:
+        """Highest-priority queued job whose tenant is under quota (and
+        that ``admit`` accepts, when given); None when nothing is
+        eligible. The popped job transitions to LAUNCHING."""
+        counts = dict(running_per_tenant or {})
+        with self._lock:
+            for i, job in enumerate(self._queued):
+                if not self.quotas.admits(job.tenant,
+                                          counts.get(job.tenant, 0)):
+                    continue
+                if admit is not None and not admit(job):
+                    continue
+                del self._queued[i]
+                job.state = JobState.LAUNCHING
+                return job
+        return None
+
+    def peek(self) -> SchedJob | None:
+        with self._lock:
+            return self._queued[0] if self._queued else None
+
+    def remove(self, job_id: str) -> SchedJob | None:
+        with self._lock:
+            for i, job in enumerate(self._queued):
+                if job.job_id == job_id:
+                    del self._queued[i]
+                    return job
+        return None
+
+    def queued(self) -> list[SchedJob]:
+        with self._lock:
+            return list(self._queued)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queued)

@@ -1,0 +1,716 @@
+"""The scheduler daemon — the layer TonY delegated to YARN's
+ResourceManager (PAPER.md §L0), rebuilt TPU-native.
+
+One persistent ``SchedulerDaemon`` accepts many job submissions (thin
+``tony submit`` clients POST a staged app dir; tests and
+``bench_scheduler`` call ``submit`` in-process), queues them with
+priorities and per-tenant quotas (``scheduler/queue.py``), and
+gang-schedules them onto a POOL of slices (``scheduler/pool.py``)
+instead of provisioning per job:
+
+* **Warm reuse** — a slice released by a finished job goes back FREE
+  with its bootstrap, venv blobs, and XLA compile cache intact; the
+  next compatible job leases it warm (provisioning skipped, staging a
+  content-hash no-op, compiles served from the PR-6 cache). When a
+  job's ``tony.compile.cache-dir`` is unset, the daemon pins it to the
+  leased slice's pool-owned cache dir and REWRITES the frozen conf so
+  executors inherit it.
+* **Preemption → requeue → resume** — a higher-priority submission may
+  preempt the lowest-priority running job: its coordinator is killed
+  gracefully (executors reaped, checkpoint writes completing), the best
+  complete checkpoint step is probed from ``tony.checkpoint.location``,
+  and the job requeues at the head of its priority band to resume from
+  that step via the PR-2 ``TONY_RESUME_STEP`` path instead of
+  restarting from zero.
+
+Each attempt runs a real ``TonyCoordinator`` on a thread of this
+process (the mini-cluster substrate) against a backend built by the
+injectable ``backend_factory`` — local subprocess executors by default;
+a TPU deployment's factory returns a ``TpuVmBackend`` in leased mode
+(``external_slices``) over the pool's ``TpuSliceProvisioner`` slices.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Callable
+
+from tony_tpu import constants
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.app_master import TonyCoordinator
+from tony_tpu.coordinator.backend import LocalProcessBackend
+from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.observability import events as obs_events
+from tony_tpu.observability.metrics import MetricsRegistry
+from tony_tpu.resilience import latest_complete_step
+from tony_tpu.scheduler.pool import (
+    LocalSliceProvisioner,
+    SlicePool,
+    SliceProvisioner,
+)
+from tony_tpu.scheduler.queue import (
+    JobQueue,
+    JobState,
+    SchedJob,
+    TenantQuotas,
+)
+
+log = logging.getLogger(__name__)
+
+STATE_FILE = "scheduler-state.json"
+ADDR_FILE = "scheduler.addr"
+
+# Declared metric names (TONY-M001 lints these module-scope constants).
+QUEUE_DEPTH_GAUGE = "tony_sched_queue_depth"
+RUNNING_JOBS_GAUGE = "tony_sched_running_jobs"
+SUBMITTED_COUNTER = "tony_sched_jobs_submitted_total"
+FINISHED_COUNTER = "tony_sched_jobs_finished_total"
+PREEMPTIONS_COUNTER = "tony_sched_preemptions_total"
+
+_TERMINAL_BY_STATUS = {
+    SessionStatus.SUCCEEDED: JobState.SUCCEEDED,
+    SessionStatus.FAILED: JobState.FAILED,
+    SessionStatus.KILLED: JobState.KILLED,
+}
+
+
+class _JobRunner:
+    """One coordinator attempt on a daemon thread. ``preempt()`` is a
+    graceful coordinator kill: executors get TERM→KILL through the
+    backend, in-flight checkpoint writes finish, history is written —
+    exactly what queued-resource preemption does NOT give a job, which
+    is why the scheduler's own preemption can resume and YARN-style
+    container loss could only restart."""
+
+    def __init__(self, daemon: "SchedulerDaemon", job: SchedJob,
+                 coordinator: TonyCoordinator) -> None:
+        self.daemon = daemon
+        self.job = job
+        self.coordinator = coordinator
+        self.slice_broken = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"job-{job.job_id}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def preempt(self) -> None:
+        self.coordinator.kill()
+
+    def _run(self) -> None:
+        status: SessionStatus | None = None
+        diag = ""
+        try:
+            status = self.coordinator.run()
+            diag = (self.coordinator.session.diagnostics
+                    if self.coordinator.session else "")
+        except Exception as exc:  # coordinator crash — the job FAILED,
+            # but the slice may be fine; only backend-level trouble
+            # marks it broken.
+            log.exception("coordinator for %s crashed", self.job.job_id)
+            diag = f"coordinator crashed: {exc}"
+        finally:
+            try:
+                self.coordinator.backend.stop_all()
+            except Exception:
+                self.slice_broken = True
+                log.warning("backend cleanup for %s failed — retiring its "
+                            "slice", self.job.job_id, exc_info=True)
+        self.daemon._on_runner_done(self, status, diag)
+
+
+class SchedulerDaemon:
+    """See module docstring. Thread-safe; ``start()`` runs the
+    scheduling loop (and the JSON API unless ``serve_http=False``),
+    ``shutdown()`` drains."""
+
+    def __init__(
+        self,
+        base_dir: str | Path,
+        conf: TonyConfiguration | None = None,
+        provisioner: SliceProvisioner | None = None,
+        backend_factory: Callable[..., Any] | None = None,
+        registry: MetricsRegistry | None = None,
+        clock_ms: Callable[[], int] | None = None,
+    ) -> None:
+        self.base_dir = Path(base_dir)
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+        self.conf = conf or TonyConfiguration()
+        self.registry = registry or MetricsRegistry()
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self.tick_s = self.conf.get_int(keys.K_SCHED_TICK_MS, 200) / 1000.0
+        self.preemption_enabled = self.conf.get_bool(
+            keys.K_SCHED_PREEMPTION, True
+        )
+        self.queue = JobQueue(TenantQuotas.from_conf(self.conf))
+        self.pool = SlicePool(
+            self.base_dir / "slices",
+            provisioner=provisioner or LocalSliceProvisioner(
+                self.conf.get_int(keys.K_SCHED_LOCAL_PROVISION_MS, 0)
+            ),
+            max_slices=self.conf.get_int(keys.K_SCHED_MAX_SLICES, 4),
+            lease_timeout_ms=self.conf.get_int(
+                keys.K_SCHED_LEASE_TIMEOUT_MS, 60000
+            ),
+            idle_timeout_ms=self.conf.get_int(
+                keys.K_SCHED_IDLE_TIMEOUT_MS, 600000
+            ),
+            registry=self.registry,
+            clock_ms=clock_ms,
+        )
+        self._backend_factory = backend_factory or self._local_backend
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._jobs: dict[str, SchedJob] = {}
+        self._runners: dict[str, _JobRunner] = {}
+        self._job_seq = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # Publish scheduler-state.json only when something changed: an
+        # idle daemon must not rewrite a byte-identical file 5x/second.
+        self._dirty = True
+        self._thread: threading.Thread | None = None
+        self.http_server = None
+        self.events = obs_events.EventLog(
+            sink=obs_events.jsonl_file_sink(self.base_dir / "events.jsonl")
+        )
+
+    # -- submission ----------------------------------------------------------
+    def submit(
+        self,
+        conf: TonyConfiguration,
+        priority: int | None = None,
+        tenant: str | None = None,
+    ) -> str:
+        """In-process submit: freeze ``conf`` into a daemon-owned app dir
+        and queue it (the staged-app-dir path with the staging done
+        here)."""
+        with self._lock:
+            self._job_seq += 1
+            seq = self._job_seq
+        job_id = f"job_{seq:04d}_{uuid.uuid4().hex[:6]}"
+        app_dir = self.base_dir / "staging" / job_id
+        app_dir.mkdir(parents=True, exist_ok=True)
+        conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+        return self.submit_app_dir(app_dir, priority=priority,
+                                   tenant=tenant, job_id=job_id)
+
+    def submit_app_dir(
+        self,
+        app_dir: str | Path,
+        priority: int | None = None,
+        tenant: str | None = None,
+        job_id: str | None = None,
+    ) -> str:
+        """Queue an ALREADY-staged application dir (what a thin ``tony
+        submit`` client POSTs after ``_stage``): the frozen conf inside
+        is the job."""
+        app_dir = Path(app_dir)
+        final_conf = app_dir / constants.TONY_FINAL_CONF
+        if not final_conf.is_file():
+            raise ValueError(
+                f"{app_dir} has no {constants.TONY_FINAL_CONF} — stage "
+                f"the job before submitting it"
+            )
+        conf = TonyConfiguration.from_final(final_conf)
+        if job_id is None:
+            with self._lock:
+                self._job_seq += 1
+                job_id = f"job_{self._job_seq:04d}_{uuid.uuid4().hex[:6]}"
+        job = SchedJob(
+            job_id=job_id,
+            conf=conf,
+            app_dir=str(app_dir),
+            priority=(priority if priority is not None
+                      else conf.get_int(keys.K_SCHED_PRIORITY, 0)),
+            tenant=(tenant or conf.get_str(keys.K_SCHED_TENANT, "default")
+                    or "default"),
+            submit_ms=self._clock_ms(),
+        )
+        with self._lock:
+            self._jobs[job_id] = job
+            self.queue.submit(job)
+            self._dirty = True
+        self.registry.counter(SUBMITTED_COUNTER).inc()
+        self.events.emit(obs_events.JOB_QUEUED, job_id=job_id,
+                         priority=job.priority, tenant=job.tenant)
+        log.info("queued %s (priority %d, tenant %s)", job_id,
+                 job.priority, job.tenant)
+        self._wake.set()
+        return job_id
+
+    def kill(self, job_id: str) -> bool:
+        """Kill a queued or running job. Returns False for unknown ids
+        and already-terminal jobs."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state.terminal:
+                return False
+            if job.state is JobState.QUEUED and \
+                    self.queue.remove(job_id) is not None:
+                # Actually removed from the queue — safe to finalize.
+                # When remove() misses, the tick thread popped the job
+                # between our state read and now: fall through to the
+                # flag path so the in-flight launch finalizes it.
+                self._finish_job_locked(job, JobState.KILLED,
+                                        "killed while queued")
+                self._publish_state_locked()
+                return True
+            # The flag covers the windows where no runner exists yet
+            # (LAUNCHING inside a long cold provision) or the job is
+            # already PREEMPTING: either way the next lifecycle edge
+            # finalizes KILLED instead of launching or requeueing.
+            job.kill_requested = True
+            runner = self._runners.get(job_id)
+        if runner is not None:
+            runner.preempt()
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, serve_http: bool = True) -> "SchedulerDaemon":
+        if serve_http:
+            from tony_tpu.scheduler.http import SchedulerHttpServer
+
+            self.http_server = SchedulerHttpServer(
+                self, port=self.conf.get_int(keys.K_SCHED_PORT, 0)
+            )
+            port = self.http_server.start()
+            (self.base_dir / ADDR_FILE).write_text(f"127.0.0.1:{port}\n")
+        self._thread = threading.Thread(
+            target=self._loop, name="scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, kill_running: bool = True,
+                 timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if kill_running:
+            with self._lock:
+                runners = list(self._runners.values())
+            for r in runners:
+                r.preempt()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._runners and time.monotonic() < deadline:
+                self._cond.wait(timeout=0.5)
+        if self.http_server is not None:
+            self.http_server.stop()
+        self.pool.shutdown()
+        self._publish_state()
+
+    # -- scheduling loop -----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception:
+                log.exception("scheduler tick failed")
+            self._wake.wait(self.tick_s)
+            self._wake.clear()
+
+    def _tick(self) -> None:
+        # Renew BEFORE expiring: a tick that just spent minutes inside a
+        # blocking provision must not walk straight into expire_leases()
+        # and retire slices whose runners are perfectly healthy — after
+        # the renew pass, expiry can only hit leases whose job is GONE.
+        with self._lock:
+            for job_id in self._runners:
+                job = self._jobs.get(job_id)
+                if job is not None and job.slice_id:
+                    self.pool.renew(job.slice_id)
+        if self.pool.expire_leases():
+            with self._lock:
+                self._dirty = True
+        while not self._stop.is_set():
+            with self._lock:
+                counts = self._running_per_tenant_locked()
+            job = self.queue.pop_next(counts)
+            if job is None:
+                break
+            if job.kill_requested:
+                with self._lock:
+                    self._finish_job_locked(job, JobState.KILLED,
+                                            "killed while queued")
+                continue
+            profile = self._profile_for(job.conf)
+            # Fast path inline: a warm lease is a dict lookup. The COLD
+            # path (a queued-resource create takes minutes) runs on its
+            # own thread so one provision never stalls warm launches,
+            # preemption decisions, expiry sweeps, or state publishes —
+            # the pool's locked capacity accounting (a PROVISIONING
+            # slice counts) keeps concurrent provisions within
+            # max_slices.
+            lease = self.pool.lease(profile, job.job_id, warm_only=True)
+            if lease is not None:
+                self._launch_or_finalize(job, lease)
+                continue
+            if not self.pool.has_headroom():
+                # Pool full. Requeue (original seq — head of its band),
+                # then see whether a lower-priority running job should
+                # make way.
+                self.queue.requeue(job)
+                if self.preemption_enabled:
+                    self._maybe_preempt(job.priority)
+                break
+            threading.Thread(
+                target=self._provision_and_launch, args=(job, profile),
+                name=f"provision-{job.job_id}", daemon=True,
+            ).start()
+        reaped = self.pool.reap_idle()
+        with self._lock:
+            if reaped:
+                self._dirty = True
+            if self._dirty:
+                self._dirty = False
+                self._publish_state_locked()
+
+    def _provision_and_launch(self, job: SchedJob, profile: str) -> None:
+        """Cold path, off the tick thread: blocking provision, then
+        launch (or requeue when the advisory headroom check lost the
+        race to another provision)."""
+        try:
+            lease = self.pool.lease(profile, job.job_id)
+        except Exception as exc:
+            with self._lock:
+                self._finish_job_locked(
+                    job, JobState.FAILED,
+                    f"slice provisioning failed: {exc}",
+                )
+            self._wake.set()
+            return
+        if lease is None:
+            with self._lock:
+                self.queue.requeue(job)
+            self._wake.set()
+            return
+        self._launch_or_finalize(job, lease)
+        self._wake.set()
+
+    def _launch_or_finalize(self, job: SchedJob, lease) -> None:
+        if self._stop.is_set():
+            # A provision that outlived shutdown() must not start a
+            # coordinator nobody will ever reap.
+            self.pool.release(lease.slice.slice_id)
+            with self._lock:
+                self._finish_job_locked(job, JobState.KILLED,
+                                        "scheduler shut down")
+            return
+        if job.kill_requested:
+            # The kill landed during a (possibly minutes-long) cold
+            # provision: the slice is fine, the job is not.
+            self.pool.release(lease.slice.slice_id)
+            with self._lock:
+                self._finish_job_locked(job, JobState.KILLED,
+                                        "killed while launching")
+            return
+        try:
+            self._launch(job, lease)
+        except Exception as exc:
+            self.pool.release(lease.slice.slice_id)
+            with self._lock:
+                self._finish_job_locked(job, JobState.FAILED,
+                                        f"launch failed: {exc}")
+
+    def _running_per_tenant_locked(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for job in self._jobs.values():
+            if job.state.active:
+                counts[job.tenant] = counts.get(job.tenant, 0) + 1
+        return counts
+
+    def _profile_for(self, conf: TonyConfiguration) -> str:
+        """Pool-compatibility key: jobs whose slice ask matches can share
+        a warm slice. TPU jobs key on every per-job-type slice plan;
+        everything else shares the one local profile."""
+        from tony_tpu.coordinator.backend import plan_slices_from_conf
+
+        try:
+            plans = plan_slices_from_conf(conf)
+        except ValueError:
+            # Illegal topology: let the coordinator fail the job with its
+            # usual conf-shaped diagnostics rather than wedging the queue.
+            return "local"
+        if not plans:
+            return "local"
+        return ",".join(
+            f"{job}={p.accelerator_type}x{p.num_slices}"
+            for job, p in sorted(plans.items())
+        )
+
+    def _maybe_preempt(self, priority: int) -> None:
+        """Preempt the weakest strictly-lower-priority running job (the
+        least-senior one among ties: it has the least sunk progress).
+        One preemption in flight at a time: a victim's graceful drain
+        spans many ticks, and re-picking a fresh victim each tick would
+        let one high-priority submit cascade through the whole pool."""
+        with self._lock:
+            if any(j.state is JobState.PREEMPTING
+                   for j in self._jobs.values()):
+                return
+            victims = [
+                j for j in self._jobs.values()
+                if j.state is JobState.RUNNING and j.priority < priority
+            ]
+            if not victims:
+                return
+            victim = min(victims, key=lambda j: (j.priority, -j.seq))
+            victim.state = JobState.PREEMPTING
+            runner = self._runners.get(victim.job_id)
+        log.warning("preempting %s (priority %d) for a priority-%d job",
+                    victim.job_id, victim.priority, priority)
+        self.registry.counter(PREEMPTIONS_COUNTER).inc()
+        if runner is not None:
+            runner.preempt()
+
+    # -- launch / completion -------------------------------------------------
+    def _local_backend(self, conf: TonyConfiguration, app_dir: Path,
+                       app_id: str, lease) -> LocalProcessBackend:
+        workdir = app_dir / "workdir"
+        if (app_dir / constants.TONY_ARCHIVE).is_file() \
+                and not workdir.is_dir():
+            from tony_tpu import utils
+
+            utils.unzip(app_dir / constants.TONY_ARCHIVE, workdir)
+        return LocalProcessBackend(
+            app_dir / "logs",
+            cwd=str(workdir) if workdir.is_dir() else None,
+            lib_path=conf.get_str(keys.K_LIB_PATH) or None,
+        )
+
+    def _launch(self, job: SchedJob, lease) -> None:
+        job.attempts += 1
+        job.slice_id = lease.slice.slice_id
+        app_dir = Path(job.app_dir)
+        app_id = f"{job.job_id}-try{job.attempts}"
+        job.app_ids.append(app_id)
+
+        run_conf = TonyConfiguration(load_defaults=False)
+        run_conf.set_all(job.conf.to_dict())
+        # The scheduler IS the client: no finish-signal will ever come.
+        run_conf.set(keys.K_AM_STOP_GRACE_MS, 0)
+        rewrite = False
+        if not run_conf.get_str(keys.K_COMPILE_CACHE_DIR):
+            # Pin the pool-owned cache dir so THIS slice's warm reuse
+            # serves the next job's compiles; jobs that pinned their own
+            # durable dir keep it (it is at least as warm).
+            run_conf.set(
+                keys.K_COMPILE_CACHE_DIR,
+                str(lease.slice.compile_cache_dir.resolve()),
+            )
+            rewrite = True
+        if rewrite:
+            # Executors read the FROZEN conf, not this process's memory.
+            secure = run_conf.get_bool(keys.K_SECURITY_ENABLED)
+            run_conf.write_final(
+                app_dir / constants.TONY_FINAL_CONF,
+                mode=0o600 if secure else None,
+            )
+        backend = self._backend_factory(run_conf, app_dir, app_id, lease)
+        coordinator = TonyCoordinator(
+            run_conf, app_dir, app_id=app_id, backend=backend,
+            resume_step=job.resume_step,
+        )
+        runner = _JobRunner(self, job, coordinator)
+        with self._lock:
+            job.state = JobState.RUNNING
+            self._runners[job.job_id] = runner
+            self._dirty = True
+            self.registry.gauge(RUNNING_JOBS_GAUGE).set(len(self._runners))
+        self.events.emit(
+            obs_events.SLICE_LEASED, job_id=job.job_id,
+            slice_id=lease.slice.slice_id, warm=lease.warm,
+            profile=lease.slice.profile,
+        )
+        self.events.emit(
+            obs_events.JOB_LAUNCHED, job_id=job.job_id, app_id=app_id,
+            slice_id=lease.slice.slice_id, warm=lease.warm,
+            attempt=job.attempts, resume_step=job.resume_step,
+        )
+        log.info("launched %s as %s on %s (%s)", job.job_id, app_id,
+                 lease.slice.slice_id, "warm" if lease.warm else "cold")
+        runner.start()
+
+    # How many terminal job records the daemon keeps in memory (and in
+    # scheduler-state.json). A persistent daemon over thousands of short
+    # jobs must not grow without bound — older records live on in job
+    # history, which is the system of record for finished jobs.
+    MAX_TERMINAL_JOBS = 512
+
+    def _finish_job_locked(self, job: SchedJob, state: JobState,
+                           why: str) -> None:
+        """Terminal transition (caller holds the lock): state + record
+        keeping + counters + event + waiter wakeup."""
+        job.state = state
+        job.diagnostics = why
+        job.slice_id = None
+        job.finished_ms = self._clock_ms()
+        self._dirty = True
+        self._cond.notify_all()
+        self.registry.counter(
+            FINISHED_COUNTER, labels={"state": state.value.lower()}
+        ).inc()
+        self.events.emit(obs_events.JOB_FINISHED, job_id=job.job_id,
+                         state=state.value, diagnostics=why)
+        terminal = [j for j in self._jobs.values() if j.state.terminal]
+        if len(terminal) > self.MAX_TERMINAL_JOBS:
+            terminal.sort(key=lambda j: j.finished_ms or 0)
+            for old in terminal[:len(terminal) - self.MAX_TERMINAL_JOBS]:
+                del self._jobs[old.job_id]
+        (log.error if state is JobState.FAILED else log.info)(
+            "%s finished: %s%s", job.job_id, state.value,
+            f" ({why})" if why else "",
+        )
+
+    def _on_runner_done(self, runner: _JobRunner,
+                        status: SessionStatus | None, diag: str) -> None:
+        job = runner.job
+        slice_id = job.slice_id
+        with self._lock:
+            self._runners.pop(job.job_id, None)
+            self.registry.gauge(RUNNING_JOBS_GAUGE).set(len(self._runners))
+            preempted = (
+                job.state is JobState.PREEMPTING
+                and not job.kill_requested
+                and not self._stop.is_set()
+            )
+        if slice_id:
+            self.pool.release(slice_id, healthy=not runner.slice_broken)
+            self.events.emit(
+                obs_events.SLICE_RELEASED, job_id=job.job_id,
+                slice_id=slice_id, healthy=not runner.slice_broken,
+            )
+        if preempted:
+            # Resume, don't restart: probe the best complete checkpoint
+            # step the killed attempt left and seed the relaunch with it.
+            ckpt = job.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+            best = latest_complete_step(ckpt) if ckpt else None
+            with self._lock:
+                if best is not None:
+                    job.resume_step = best
+                job.preemptions += 1
+                job.slice_id = None
+                self.queue.requeue(job)
+                self._dirty = True
+                self._cond.notify_all()
+            self.events.emit(
+                obs_events.JOB_PREEMPTED, job_id=job.job_id,
+                resume_step=job.resume_step, preemptions=job.preemptions,
+            )
+            log.warning("%s preempted; requeued (resume_step=%s)",
+                        job.job_id, job.resume_step)
+        else:
+            state = _TERMINAL_BY_STATUS.get(status, JobState.FAILED)
+            if job.kill_requested:
+                # An explicit kill landed mid-run or mid-preemption: the
+                # record must say KILLED, never requeue.
+                state = JobState.KILLED
+            with self._lock:
+                self._finish_job_locked(job, state, diag)
+        with self._lock:
+            self._dirty = False
+            self._publish_state_locked()
+        self._wake.set()
+
+    # -- views ---------------------------------------------------------------
+    def job(self, job_id: str) -> SchedJob | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[SchedJob]:
+        with self._lock:
+            return sorted(self._jobs.values(), key=lambda j: j.seq)
+
+    def wait_job(self, job_id: str, timeout_s: float = 120.0) -> JobState:
+        """Block until ``job_id`` reaches a terminal state."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                job = self._jobs.get(job_id)
+                if job is None:
+                    raise KeyError(f"unknown job {job_id}")
+                if job.state.terminal:
+                    return job.state
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{job_id} still {job.state.value} after "
+                        f"{timeout_s}s"
+                    )
+                self._cond.wait(timeout=min(remaining, 0.5))
+
+    def state_json(self) -> dict[str, Any]:
+        with self._lock:
+            jobs = [j.to_json() for j in
+                    sorted(self._jobs.values(), key=lambda j: j.seq)]
+            queued = [j.job_id for j in self.queue.queued()]
+        depth = len(queued)
+        self.registry.gauge(QUEUE_DEPTH_GAUGE).set(depth)
+        return {
+            "ts_ms": self._clock_ms(),
+            "queue": queued,
+            "queue_depth": depth,
+            "jobs": jobs,
+            "pool": self.pool.to_json(),
+        }
+
+    def _publish_state(self) -> None:
+        with self._lock:
+            self._publish_state_locked()
+
+    def _publish_state_locked(self) -> None:
+        try:
+            state = self.state_json()
+            tmp = self.base_dir / f".{STATE_FILE}.tmp"
+            tmp.write_text(json.dumps(state, indent=2) + "\n")
+            tmp.replace(self.base_dir / STATE_FILE)
+        except OSError:
+            log.warning("could not publish scheduler state", exc_info=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m tony_tpu.scheduler.service --base-dir DIR`` — run the
+    daemon standalone; clients find it via ``<base-dir>/scheduler.addr``
+    (or ``tony.scheduler.address``)."""
+    import argparse
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s scheduler %(name)s: %(message)s",
+    )
+    p = argparse.ArgumentParser(description="tony_tpu scheduler daemon")
+    p.add_argument("--base-dir", default=None,
+                   help="working dir (default: tony.scheduler.base-dir)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--conf", action="append", default=[],
+                   help="key=value override (repeatable)")
+    args = p.parse_args(argv)
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(conf_file=args.conf_file, overrides=args.conf)
+    base_dir = args.base_dir or conf.get_str(keys.K_SCHED_BASE_DIR)
+    if not base_dir:
+        p.error("--base-dir (or tony.scheduler.base-dir) is required")
+    daemon = SchedulerDaemon(base_dir, conf=conf).start()
+    port = daemon.http_server.port if daemon.http_server else "-"
+    log.info("scheduler up at 127.0.0.1:%s (base dir %s)", port, base_dir)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        daemon.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -186,9 +186,21 @@ def measure_io(steps: int, depth: int, registry: MetricsRegistry,
                 wall_ms = (_time.perf_counter() - t0) * 1000
                 snap1 = live.snapshot()
 
-    def dsum(name):
-        return (snap1["histograms"].get(name, {"sum": 0.0})["sum"]
-                - snap0["histograms"].get(name, {"sum": 0.0})["sum"])
+    # Checkpoint save-stall: one save of the live train state. The D2H
+    # snapshot phase (tony_ckpt_snapshot_ms) is the only part the train
+    # loop waits on — the async writer owns serialization + fsync.
+    from tony_tpu.checkpoint import CKPT_SNAPSHOT_HISTOGRAM, CheckpointManager
+
+    with tempfile.TemporaryDirectory() as ckdir:
+        mgr = CheckpointManager(ckdir)
+        mgr.save(0, state)
+        mgr.wait()
+    snap2 = live.snapshot()
+
+    def dsum(name, a=None, b=None):
+        a, b = a or snap0, b or snap1
+        return (b["histograms"].get(name, {"sum": 0.0})["sum"]
+                - a["histograms"].get(name, {"sum": 0.0})["sum"])
 
     rows = [
         ("step_wall", wall_ms / steps),
@@ -197,6 +209,9 @@ def measure_io(steps: int, depth: int, registry: MetricsRegistry,
         ("h2d", dsum("tony_io_h2d_ms") / steps),
         ("stall", dsum("tony_io_queue_wait_ms") / steps),
         ("batch_wait", dsum("tony_io_batch_wait_ms") / steps),
+        # Absolute ms for ONE save, not per-step: the save-stall a loop
+        # pays each time it checkpoints.
+        ("ckpt_snapshot", dsum(CKPT_SNAPSHOT_HISTOGRAM, snap1, snap2)),
     ]
     registry.gauge("profile_io_batch_count").set(batch)
     registry.gauge("profile_io_depth_count").set(depth)
