@@ -611,15 +611,23 @@ def _io_rates(snap0: dict, snap1: dict) -> dict:
         b = snap0["histograms"].get(name, {"count": 0, "sum": 0.0})
         return a["count"] - b["count"], a["sum"] - b["sum"]
 
-    _, read_ms = dh("tony_io_read_ms")
-    _, h2d_ms = dh("tony_io_h2d_ms")
-    n_wait, wait_ms = dh("tony_io_queue_wait_ms")
+    from tony_tpu.io.reader import (
+        IO_BYTES_READ_COUNTER,
+        IO_H2D_BYTES_COUNTER,
+        IO_H2D_MS_HISTOGRAM,
+        IO_QUEUE_WAIT_MS_HISTOGRAM,
+        IO_READ_MS_HISTOGRAM,
+    )
+
+    _, read_ms = dh(IO_READ_MS_HISTOGRAM)
+    _, h2d_ms = dh(IO_H2D_MS_HISTOGRAM)
+    n_wait, wait_ms = dh(IO_QUEUE_WAIT_MS_HISTOGRAM)
     return {
         "read_mb_per_sec": round(
-            dc("tony_io_bytes_read_total") / 1e3 / read_ms, 1
+            dc(IO_BYTES_READ_COUNTER) / 1e3 / read_ms, 1
         ) if read_ms > 0 else 0.0,
         "h2d_mb_per_sec": round(
-            dc("tony_io_h2d_bytes_total") / 1e3 / h2d_ms, 1
+            dc(IO_H2D_BYTES_COUNTER) / 1e3 / h2d_ms, 1
         ) if h2d_ms > 0 else 0.0,
         "queue_wait_ms_mean": round(wait_ms / n_wait, 2) if n_wait else 0.0,
     }

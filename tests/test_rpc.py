@@ -52,8 +52,13 @@ class FakeApp(ApplicationRpc):
     def finish_application(self):
         self.finished.set()
 
-    def task_executor_heartbeat(self, task_id, session_id):
+    def task_executor_heartbeat(self, task_id, session_id, metrics=None,
+                                profile=None):
         self.heartbeats.append(task_id)
+        return None
+
+    def request_profile(self, duration_ms):
+        return {"req_id": f"prof-{duration_ms}"}
 
     def get_application_status(self):
         return {"state": "RUNNING", "diagnostics": ""}

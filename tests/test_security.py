@@ -38,8 +38,12 @@ class _Impl(ApplicationRpc):
     def finish_application(self):
         return None
 
-    def task_executor_heartbeat(self, task_id, session_id):
+    def task_executor_heartbeat(self, task_id, session_id, metrics=None,
+                                profile=None):
         return None
+
+    def request_profile(self, duration_ms):
+        return {"req_id": "prof-test"}
 
     def get_application_status(self):
         return {"state": "RUNNING"}

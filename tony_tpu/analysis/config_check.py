@@ -75,6 +75,9 @@ _MIN_ONE_KEYS = frozenset({
     # place a job.
     keys.K_SCHED_TICK_MS,
     keys.K_SCHED_MAX_SLICES,
+    # A zero-length capture window profiles nothing (0 must be an
+    # explicit CLI omission, not a configured default).
+    keys.K_PROFILE_DURATION_MS,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold

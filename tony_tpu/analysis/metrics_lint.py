@@ -17,6 +17,22 @@ anything executes:
   one module and a gauge in another is flagged — the aggregated
   ``/metrics`` page cannot serve both.
 
+TONY-M002 closes the loop TONY-M001 can't see: a ``tony_*`` metric name
+that only ever appears as a string literal (a registration call, or a
+snapshot-key lookup in bench/profiling tooling) has no single source of
+truth — rename the constant-less literal in one place and every other
+spelling silently reads zeros. The rule:
+
+* every ``tony_*`` name passed literally to a registration call must
+  instead reference a module-scope declared constant (``*_COUNTER`` /
+  ``*_GAUGE`` / ``*_HISTOGRAM``);
+* any other string literal that re-types a declared ``tony_*`` name is
+  flagged — import the constant;
+* every declared ``tony_*`` name must appear verbatim in
+  ``docs/DEPLOY.md`` (the operator-facing metrics reference cannot
+  rot — this is what let render-only names escape TONY-M001 before
+  the declared-constant convention existed).
+
 Run from ``tools/lint_self.py`` over this repo (tier-1), and available
 to ``run_preflight`` consumers as a plain findings producer.
 """
@@ -24,12 +40,14 @@ to ``run_preflight`` consumers as a plain findings producer.
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 
 from tony_tpu.analysis.findings import ERROR, Finding
 from tony_tpu.observability.metrics import validate_metric_name
 
 RULE = "TONY-M001"
+RULE_DECLARED = "TONY-M002"
 
 _REGISTER_ATTRS = {"counter": "counter", "gauge": "gauge",
                    "histogram": "histogram"}
@@ -85,9 +103,18 @@ def _iter_registrations(tree: ast.AST, file: str):
                     yield (kw.arg, "gauge", file, node.lineno)
 
 
-def check_metric_names(paths: "list[str | Path]") -> list[Finding]:
-    """Lint every registration across ``paths`` (files or directories,
-    scanned recursively for ``*.py``)."""
+# A string shaped like one of OUR metric names: the ``tony_`` prefix
+# plus snake_case. The package name (``tony_tpu``) and native symbols
+# (``tony_readahead``) never collide because only names actually
+# DECLARED as metrics (or passed to registration calls) are tested.
+_TONY_METRIC_NAME = re.compile(r"^tony_[a-z0-9_]+$")
+
+
+def _is_tony_metric_name(value: str) -> bool:
+    return bool(_TONY_METRIC_NAME.match(value))
+
+
+def _collect_files(paths: "list[str | Path]") -> list[Path]:
     files: list[Path] = []
     for raw in paths:
         p = Path(raw)
@@ -95,15 +122,127 @@ def check_metric_names(paths: "list[str | Path]") -> list[Finding]:
             files.extend(sorted(p.rglob("*.py")))
         elif p.is_file():
             files.append(p)
+    return files
 
+
+def parse_metric_trees(
+    paths: "list[str | Path]",
+) -> "list[tuple[Path, ast.AST]]":
+    """Walk + parse once; both TONY-M001 and TONY-M002 accept the
+    result, so a caller running both (tools/lint_self.py) pays for one
+    pass over the repo, not two. Unparseable sources are skipped
+    (script_lint owns reporting those)."""
+    trees: list[tuple[Path, ast.AST]] = []
+    for path in _collect_files(paths):
+        try:
+            trees.append(
+                (path, ast.parse(path.read_text(), filename=str(path)))
+            )
+        except (SyntaxError, ValueError, OSError):
+            continue
+    return trees
+
+
+def check_declared_names(
+    paths: "list[str | Path]", docs: "str | Path | None" = None,
+    trees: "list[tuple[Path, ast.AST]] | None" = None,
+) -> list[Finding]:
+    """TONY-M002 (see module docstring): two passes over the tree —
+    collect every module-scope declared metric constant, then flag
+    literal ``tony_*`` registrations, re-typed declared names, and
+    declared names missing from the operator docs."""
+    if trees is None:
+        trees = parse_metric_trees(paths)
+    findings: list[Finding] = []
+    # Pass 1: declared constants (value -> first declaration site), and
+    # the AST nodes of the declaring Constants (exempt from pass 2).
+    declared: dict[str, tuple[str, int]] = {}
+    exempt: set[int] = set()
+    for path, tree in trees:
+        for node in getattr(tree, "body", []):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                continue
+            var = node.targets[0].id
+            if any(var.endswith(s) for s in _DECL_SUFFIX_KINDS):
+                exempt.add(id(node.value))
+                value = node.value.value
+                if _is_tony_metric_name(value):
+                    declared.setdefault(value, (str(path), node.lineno))
+    # Pass 2: literal usages.
+    for path, tree in trees:
+        reg_literals: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if attr in _REGISTER_ATTRS and node.args and isinstance(
+                node.args[0], ast.Constant
+            ) and isinstance(node.args[0].value, str):
+                arg = node.args[0]
+                reg_literals.add(id(arg))
+                if _is_tony_metric_name(arg.value):
+                    findings.append(Finding(
+                        RULE_DECLARED, ERROR,
+                        f"metric {arg.value!r} registered from a string "
+                        f"literal — declare a module-scope "
+                        f"*_{_REGISTER_ATTRS[attr].upper()} name constant "
+                        f"and reference it",
+                        file=str(path), line=arg.lineno,
+                    ))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if id(node) in exempt or id(node) in reg_literals:
+                continue
+            site = declared.get(node.value)
+            if site is not None:
+                findings.append(Finding(
+                    RULE_DECLARED, ERROR,
+                    f"string literal re-types the declared metric name "
+                    f"{node.value!r} (declared at {site[0]}:{site[1]}) — "
+                    f"import and reference the constant",
+                    file=str(path), line=node.lineno,
+                ))
+    # Pass 3: every declared name documented.
+    if docs is not None:
+        try:
+            doc_text = Path(docs).read_text()
+        except OSError:
+            doc_text = ""
+        for value, (file, line) in sorted(declared.items()):
+            if value not in doc_text:
+                findings.append(Finding(
+                    RULE_DECLARED, ERROR,
+                    f"declared metric {value!r} is not documented in "
+                    f"{docs} — every tony_* series an operator can "
+                    f"scrape needs a reference row",
+                    file=file, line=line,
+                ))
+    return findings
+
+
+def check_metric_names(
+    paths: "list[str | Path]",
+    trees: "list[tuple[Path, ast.AST]] | None" = None,
+) -> list[Finding]:
+    """Lint every registration across ``paths`` (files or directories,
+    scanned recursively for ``*.py``)."""
+    if trees is None:
+        trees = parse_metric_trees(paths)
     findings: list[Finding] = []
     # name -> (kind, file, line) of the first registration seen.
     seen: dict[str, tuple[str, str, int]] = {}
-    for path in files:
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except (SyntaxError, ValueError, OSError):
-            continue  # script_lint owns reporting unparseable sources
+    for path, tree in trees:
         for name, kind, file, line in _iter_registrations(tree, str(path)):
             complaint = validate_metric_name(name, kind)
             if complaint:

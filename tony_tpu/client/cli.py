@@ -316,11 +316,23 @@ def _obs_args(argv: list[str], prog: str):
                        help="tail a LIVE job: poll the coordinator's "
                             "/api/events with a cursor, print new events "
                             "as they land, drain the rest when it exits")
+    if prog == "goodput":
+        p.add_argument("--follow", action="store_true",
+                       help="watch a LIVE job: cursor-poll /api/events, "
+                            "fold them through a local goodput ledger, "
+                            "print the breakdown as it evolves")
+    if prog in ("events", "goodput"):
         p.add_argument("--poll-interval", type=float, default=1.0,
                        help="seconds between polls in --follow mode")
         p.add_argument("--max-polls", type=int, default=0,
                        help="stop following after N polls (0 = until the "
                             "coordinator goes away)")
+    if prog == "profile":
+        p.add_argument("--duration-ms", type=int, default=0,
+                       help="capture window per task (0 = the job's "
+                            "tony.profile.duration-ms, default 2000)")
+        p.add_argument("--timeout", type=float, default=30.0,
+                       help="seconds to wait for every task's capture")
     return p.parse_args(argv)
 
 
@@ -359,6 +371,30 @@ def _live_coordinator_get(staging: Path, app_id: str, path: str):
         return None
 
 
+def _live_coordinator_post(staging: Path, app_id: str, path: str,
+                           body: dict):
+    """POST a JSON body to a live coordinator (the /api/profile
+    trigger); None when the job is not live."""
+    import json as _json
+    import urllib.request
+
+    addr_file = staging / app_id / "coordinator.http"
+    if not addr_file.is_file():
+        return None
+    try:
+        addr = addr_file.read_text().strip()
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return _json.loads(resp.read())
+    except (OSError, ValueError):
+        return None
+
+
 def _print_event(e: dict) -> None:
     ts = time.strftime(
         "%H:%M:%S", time.localtime(e.get("ts_ms", 0) / 1000)
@@ -369,6 +405,47 @@ def _print_event(e: dict) -> None:
     )
     task = e.get("task", "")
     print(f"{ts}  {e.get('kind', '?'):22s} {task:14s} {detail}")
+
+
+def _follow_cursor(staging: Path, app_id: str, interval_s: float,
+                   max_polls: int, on_batch, on_reset=None):
+    """The one cursor-poll loop every ``--follow`` mode shares: fetch
+    ``/api/events?cursor=N``, hand each reply's event suffix to
+    ``on_batch``, and detect a coordinator restart via the reply's
+    ``count`` field (count < cursor means a SHORTER log, not "no new
+    events" — reset to zero, via ``on_reset``, and replay). One failed
+    poll is not a dead coordinator: a busy /api thread or a dropped
+    connection mid-tail must not end a multi-hour follow; three
+    consecutive misses (never-live jobs get one) declare it gone.
+    Returns ``(saw_live, cursor, hit_max_polls)``."""
+    cursor = 0
+    polls = 0
+    saw_live = False
+    misses = 0
+    while True:
+        data = _live_coordinator_get(
+            staging, app_id, f"/api/events?cursor={cursor}"
+        )
+        if data is None:
+            misses += 1
+            if misses >= (3 if saw_live else 1):
+                return saw_live, cursor, False
+            time.sleep(interval_s)
+            continue
+        misses = 0
+        saw_live = True
+        count = int(data.get("count", data.get("cursor", cursor)))
+        if count < cursor:
+            cursor = 0
+            if on_reset is not None:
+                on_reset()
+            continue
+        on_batch(data.get("events") or [])
+        cursor = int(data.get("cursor", cursor))
+        polls += 1
+        if max_polls and polls >= max_polls:
+            return saw_live, cursor, True
+        time.sleep(interval_s)
 
 
 def _follow_events(staging: Path, app_id: str, interval_s: float,
@@ -387,33 +464,12 @@ def _follow_events(staging: Path, app_id: str, interval_s: float,
         else:
             _print_event(e)
 
-    cursor = 0
-    polls = 0
-    saw_live = False
-    misses = 0
-    while True:
-        data = _live_coordinator_get(
-            staging, app_id, f"/api/events?cursor={cursor}"
-        )
-        if data is None:
-            # One failed poll is not a dead coordinator: a busy /api
-            # thread or a dropped connection mid-tail must not end a
-            # multi-hour follow. Three consecutive misses (never-live
-            # jobs get one) before declaring it gone.
-            misses += 1
-            if misses >= (3 if saw_live else 1):
-                break
-            time.sleep(interval_s)
-            continue
-        misses = 0
-        saw_live = True
-        for e in data.get("events") or []:
-            show(e)
-        cursor = int(data.get("cursor", cursor))
-        polls += 1
-        if max_polls and polls >= max_polls:
-            return 0
-        time.sleep(interval_s)
+    saw_live, cursor, hit_max = _follow_cursor(
+        staging, app_id, interval_s, max_polls,
+        on_batch=lambda events: [show(e) for e in events],
+    )
+    if hit_max:
+        return 0
     local = staging / app_id / "events.jsonl"
     if local.is_file():
         for e in parse_jsonl(local.read_text())[cursor:]:
@@ -560,6 +616,347 @@ def doctor_cmd(argv: list[str]) -> int:
         }, indent=2))
         return 0
     print(format_report(args.app_id, findings, final=final))
+    return 0
+
+
+def _conf_chips_override(staging: Path, app_id: str) -> int:
+    """The explicit tony.goodput.chips override from the job's frozen
+    conf, when still readable; 0 otherwise."""
+    from tony_tpu.conf.configuration import TonyConfiguration
+
+    final_conf = staging / app_id / constants.TONY_FINAL_CONF
+    if final_conf.is_file():
+        try:
+            conf = TonyConfiguration.from_final(final_conf)
+            return max(conf.get_int(keys.K_GOODPUT_CHIPS, 0), 0)
+        except (OSError, ValueError):
+            pass
+    return 0
+
+
+def _replay_chips(staging: Path, app_id: str, events: list) -> int:
+    """Chip weight for an events-only replay (the coordinator died
+    before writing its terminal record): the explicit conf override
+    when the frozen conf is still readable, else one chip-equivalent
+    per distinct scheduled task — the same local fallback the live
+    coordinator uses. Slice-plan weighting needs the terminal record."""
+    override = _conf_chips_override(staging, app_id)
+    if override > 0:
+        return override
+    tasks = {
+        e.get("task") for e in events
+        if e.get("kind") in ("task_scheduled", "task_registered")
+        and e.get("task")
+    }
+    return max(len(tasks), 1)
+
+
+def _resolve_goodput(staging: Path, history: str, app_id: str):
+    """The goodput fallback chain (the `tony doctor` shape): live
+    /api/goodput → the staging final-status.json terminal record → an
+    events.jsonl replay through the ledger (a coordinator that died
+    before stop still left the timeline) → job history (terminal record,
+    then replay). Returns (breakdown-json, source) or (None, "")."""
+    import json as _json
+
+    from tony_tpu.history.reader import job_events, job_final_status
+    from tony_tpu.observability.events import parse_jsonl
+    from tony_tpu.observability.goodput import GoodputLedger
+
+    live = _live_coordinator_get(staging, app_id, "/api/goodput")
+    if isinstance(live, dict) and live.get("categories"):
+        return live, "live"
+
+    def from_final(final) -> dict | None:
+        g = (final or {}).get("goodput")
+        return g if isinstance(g, dict) and g.get("categories") else None
+
+    def replay(events) -> dict:
+        return GoodputLedger.from_events(
+            events, chips=_replay_chips(staging, app_id, events)
+        ).to_json()
+
+    local_final = staging / app_id / "final-status.json"
+    if local_final.is_file():
+        try:
+            g = from_final(_json.loads(local_final.read_text()))
+            if g is not None:
+                return g, "final"
+        except ValueError:
+            pass
+    local_events = staging / app_id / "events.jsonl"
+    if local_events.is_file():
+        events = parse_jsonl(local_events.read_text())
+        if events:
+            return replay(events), "events-replay"
+    if history:
+        g = from_final(job_final_status(history, app_id))
+        if g is not None:
+            return g, "history"
+        events = job_events(history, app_id)
+        if events:
+            return replay(events), "history-replay"
+    return None, ""
+
+
+def _print_goodput(app_id: str, data: dict, source: str) -> None:
+    cats = data.get("categories") or {}
+    chip_s = data.get("chip_seconds") or {}
+    total = sum(v for v in cats.values() if isinstance(v, (int, float)))
+    print(f"# {app_id} ({source}) — {data.get('chips')} chip(s), "
+          f"wall {data.get('wall_s')} s, "
+          f"goodput ratio {data.get('ratio')}")
+    print(f"{'CATEGORY':20s} {'SECONDS':>10s} {'CHIP-S':>10s} {'SHARE':>7s}")
+    for cat, secs in cats.items():
+        if not secs:
+            continue
+        share = f"{100.0 * secs / total:.1f}%" if total else "-"
+        print(f"{cat:20s} {secs:10.3f} "
+              f"{chip_s.get(cat, 0.0):10.3f} {share:>7s}")
+
+
+def goodput_cmd(argv: list[str]) -> int:
+    """``cli goodput <app_id>``: the job's chip-second accounting — an
+    exclusive breakdown of wall time into queued/provisioning/staging/
+    compile/rendezvous/productive/stalled/wasted_by_failure/preempted/
+    teardown, live from /api/goodput with the `tony doctor` fallback
+    chain behind it. ``--follow`` tails a live job's events through a
+    local ledger."""
+    import json as _json
+
+    args = _obs_args(argv, "goodput")
+    staging, history = _obs_locations(args)
+    if args.follow:
+        return _follow_goodput(staging, history, args)
+    data, source = _resolve_goodput(staging, history, args.app_id)
+    if data is None:
+        print(f"no goodput record found for {args.app_id}",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps({"source": source, **data}, indent=2))
+        return 0
+    _print_goodput(args.app_id, data, source)
+    return 0
+
+
+def _follow_goodput(staging: Path, history: str, args) -> int:
+    """Cursor-poll /api/events (the shared ``_follow_cursor`` loop,
+    restart detection included — the ledger resets and replays when the
+    coordinator came back with a shorter log), folding each suffix
+    through a local ledger and reprinting the breakdown."""
+    import json as _json
+
+    from tony_tpu.observability.goodput import GoodputLedger
+
+    ledgers = [GoodputLedger()]
+    conf_chips = _conf_chips_override(staging, args.app_id)
+    tasks: set = set()
+
+    def on_batch(events) -> None:
+        for e in events:
+            ledgers[0].observe_event(e)
+            if e.get("kind") in ("task_scheduled", "task_registered") \
+                    and e.get("task"):
+                tasks.add(e["task"])
+        # Chip weight, like the replay path: the conf override, else
+        # one per distinct scheduled task — a 32-chip job's streamed
+        # chip_seconds must not silently read as plain seconds.
+        ledgers[0].chips = conf_chips or max(len(tasks), 1)
+        j = ledgers[0].to_json()
+        if args.as_json:
+            print(_json.dumps(j), flush=True)
+        else:
+            cats = ", ".join(
+                f"{c}={v:.1f}s" for c, v in j["categories"].items() if v
+            )
+            print(f"phase={j.get('phase')} wall={j['wall_s']}s "
+                  f"ratio={j['ratio']} [{cats}]", flush=True)
+
+    def on_reset() -> None:
+        ledgers[0] = GoodputLedger()
+        tasks.clear()
+
+    saw_live, _, hit_max = _follow_cursor(
+        staging, args.app_id, args.poll_interval, args.max_polls,
+        on_batch=on_batch, on_reset=on_reset,
+    )
+    if hit_max:
+        return 0
+    # Coordinator gone: print the authoritative terminal record.
+    data, source = _resolve_goodput(staging, history, args.app_id)
+    if data is None:
+        if not saw_live:
+            print(f"no live coordinator (or goodput record) for "
+                  f"{args.app_id}", file=sys.stderr)
+            return 1
+        return 0
+    if args.as_json:
+        print(_json.dumps({"source": source, **data}, indent=2))
+    else:
+        _print_goodput(args.app_id, data, source)
+    return 0
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        v = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
+def _print_profile_summary(task: str, summary: dict) -> None:
+    snap = (summary or {}).get("snapshot") or {}
+    if snap.get("source") == "jax" and snap.get("devices"):
+        for d in snap["devices"]:
+            print(f"{task:16s} device {d.get('id')} "
+                  f"({d.get('platform')}): "
+                  f"in_use {_fmt_bytes(d.get('bytes_in_use'))} "
+                  f"peak {_fmt_bytes(d.get('peak_bytes_in_use'))} "
+                  f"limit {_fmt_bytes(d.get('bytes_limit'))}")
+    else:
+        host = snap.get("host") or {}
+        print(f"{task:16s} host: max_rss "
+              f"{_fmt_bytes(host.get('max_rss_bytes'))}"
+              f"{'' if not summary.get('trace_dir') else '  trace: ' + str(summary['trace_dir'])}")
+    if summary.get("artifact"):
+        print(f"{'':16s} artifact: {summary['artifact']}")
+
+
+def _rpc_request_profile(staging: Path, app_id: str, conf_file,
+                         duration_ms: int):
+    """The authenticated arm path: POST /api/profile is loopback-only,
+    so a CLI running off the coordinator host arms the capture through
+    the client-role ``request_profile`` RPC instead (coordinator.addr
+    from the staging app dir, credentials from the job conf)."""
+    from tony_tpu.conf.configuration import load_job_config
+    from tony_tpu.rpc.client import ApplicationRpcClient
+
+    addr_file = staging / app_id / "coordinator.addr"
+    if not addr_file.is_file():
+        return None
+    try:
+        host, port = addr_file.read_text().strip().rsplit(":", 1)
+    except (OSError, ValueError):
+        return None
+    # Credentials come from the job's FROZEN conf when readable: a
+    # secure job's secret is minted per submission at staging and lives
+    # only there — the user conf would derive the wrong role token.
+    from tony_tpu.conf.configuration import TonyConfiguration
+
+    conf = None
+    frozen = staging / app_id / constants.TONY_FINAL_CONF
+    if frozen.is_file():
+        try:
+            conf = TonyConfiguration.from_final(frozen)
+        except (OSError, ValueError):
+            conf = None
+    if conf is None:
+        conf = load_job_config(conf_file=conf_file)
+    secret = None
+    if conf.get_bool(keys.K_SECURITY_ENABLED):
+        from tony_tpu import security
+
+        secret = security.role_token(
+            conf.get_str(keys.K_SECRET_KEY), security.CLIENT_ROLE
+        )
+    client = ApplicationRpcClient(host, int(port), secret=secret,
+                                  call_retries=1, connect_timeout_s=5.0)
+    try:
+        return client.request_profile(int(duration_ms))
+    except Exception:
+        return None
+    finally:
+        client.close()
+
+
+def profile_cmd(argv: list[str]) -> int:
+    """``cli profile <app_id> [--duration-ms N]``: on-demand distributed
+    capture. Arms the live coordinator — POST /api/profile from the
+    coordinator host, falling back to the client-role request_profile
+    RPC cross-host — which fans the request to every task on the
+    heartbeat channel; executors capture a device-memory snapshot (plus
+    a jax.profiler trace when jax is present), persist the artifact
+    beside their logs, and ship the summary back. For finished jobs,
+    prints the captures persisted to staging or history."""
+    import json as _json
+
+    args = _obs_args(argv, "profile")
+    staging, history = _obs_locations(args)
+    body = {}
+    if args.duration_ms:
+        body["duration_ms"] = args.duration_ms
+    started = _live_coordinator_post(
+        staging, args.app_id, "/api/profile", body
+    )
+    if not (isinstance(started, dict) and started.get("req_id")):
+        started = _rpc_request_profile(
+            staging, args.app_id, args.conf_file, args.duration_ms or 0
+        )
+    if isinstance(started, dict) and started.get("req_id"):
+        deadline = time.monotonic() + args.timeout
+        status = None
+        while time.monotonic() < deadline:
+            status = _live_coordinator_get(
+                staging, args.app_id, "/api/profile"
+            )
+            if isinstance(status, dict) and status.get("done"):
+                break
+            time.sleep(0.3)
+        if not isinstance(status, dict):
+            print("profile request sent but the coordinator went away",
+                  file=sys.stderr)
+            return 1
+        tasks = status.get("tasks") or {}
+        # Exit code contract holds in BOTH output modes: anything short
+        # of a successful capture on every task is nonzero.
+        incomplete = sum(
+            1 for entry in tasks.values()
+            if (entry or {}).get("state") != "captured"
+        )
+        if args.as_json:
+            print(_json.dumps(status, indent=2))
+            return 0 if not incomplete else 1
+        print(f"# {args.app_id} profile {status.get('req_id')} "
+              f"({status.get('duration_ms')} ms window, "
+              f"{'complete' if status.get('done') else 'partial'})")
+        for task in sorted(tasks):
+            entry = tasks[task] or {}
+            if entry.get("state") != "captured":
+                print(f"{task:16s} <{entry.get('state', 'unknown')}>")
+                continue
+            _print_profile_summary(task, entry.get("summary") or {})
+        return 0 if not incomplete else 1
+    # Not live: fall back to persisted captures.
+    from tony_tpu.history.reader import job_profiles
+    from tony_tpu.observability.profiling import find_profiles
+
+    persisted: dict[str, dict] = {}
+    app_dir = staging / args.app_id
+    for path in find_profiles(app_dir / "logs", app_dir):
+        try:
+            doc = _json.loads(path.read_text())
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            persisted[path.name] = doc
+    if not persisted and history:
+        persisted = job_profiles(history, args.app_id) or {}
+    if not persisted:
+        print(f"no live coordinator (and no persisted captures) for "
+              f"{args.app_id}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps(persisted, indent=2))
+        return 0
+    print(f"# {args.app_id} persisted captures")
+    for name, doc in sorted(persisted.items()):
+        _print_profile_summary(doc.get("task", name), doc)
     return 0
 
 
@@ -729,6 +1126,8 @@ SUBMITTERS = {
     "events": events_cmd,
     "metrics": metrics_cmd,
     "doctor": doctor_cmd,
+    "goodput": goodput_cmd,
+    "profile": profile_cmd,
 }
 
 

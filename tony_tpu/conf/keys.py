@@ -156,6 +156,26 @@ K_HEALTH_ALERT_COOLDOWN_MS = HEALTH_PREFIX + "alert-cooldown"
 # summaries / events kept for blackbox-*.json dumps).
 K_HEALTH_FLIGHT_LIMIT = HEALTH_PREFIX + "flight-recorder-limit"
 
+# --- goodput accounting (observability/goodput.py) --------------------------
+# Per-job chip-second ledger: an exclusive breakdown of wall time ×
+# chips into queued/provisioning/staging/compile/rendezvous/productive/
+# stalled/wasted_by_failure/preempted/teardown, served on /api/goodput,
+# /metrics, final-status.json, and `tony goodput <app_id>`.
+GOODPUT_PREFIX = TONY_PREFIX + "goodput."
+K_GOODPUT_ENABLED = GOODPUT_PREFIX + "enabled"
+# Chip weight override (0 = auto: slice-plan chip total, else one per
+# task) — lets heterogeneous deployments pin the billing unit.
+K_GOODPUT_CHIPS = GOODPUT_PREFIX + "chips"
+
+# --- on-demand profiling (observability/profiling.py) -----------------------
+PROFILE_PREFIX = TONY_PREFIX + "profile."
+# Default capture window, ms, when `tony profile` / POST /api/profile
+# omits --duration-ms (bounded at 60s executor-side).
+K_PROFILE_DURATION_MS = PROFILE_PREFIX + "duration-ms"
+# Continuous per-device HBM gauge sampling interval in the USER process
+# (tony_device_hbm_bytes{device=,kind=}); 0 disables.
+K_PROFILE_HBM_INTERVAL_MS = PROFILE_PREFIX + "hbm-interval"
+
 # --- proxy (proxy/server.py) ------------------------------------------------
 PROXY_PREFIX = TONY_PREFIX + "proxy."
 # Per-ATTEMPT upstream connect timeout, ms (attempts retry until the
@@ -337,6 +357,10 @@ DEFAULTS: dict[str, object] = {
     K_HEALTH_IO_STALL_RATIO: 0.5,
     K_HEALTH_ALERT_COOLDOWN_MS: 30000,
     K_HEALTH_FLIGHT_LIMIT: 256,
+    K_GOODPUT_ENABLED: True,
+    K_GOODPUT_CHIPS: 0,
+    K_PROFILE_DURATION_MS: 2000,
+    K_PROFILE_HBM_INTERVAL_MS: 5000,
     K_PROXY_CONNECT_TIMEOUT_MS: 5000,
     K_SERVING_SLOTS: 8,
     K_SERVING_PREFILL_CHUNK: 32,

@@ -89,6 +89,10 @@ TONY_IO_CHUNK_RECORDS = "TONY_IO_CHUNK_RECORDS"
 TONY_COMPILE_CACHE_DIR = "TONY_COMPILE_CACHE_DIR"
 TONY_COMPILE_CACHE_ENABLED = "TONY_COMPILE_CACHE_ENABLED"
 TONY_COMPILE_MIN_ENTRY_SIZE = "TONY_COMPILE_MIN_ENTRY_SIZE"
+# Continuous device-memory telemetry (tony.profile.hbm-interval conf →
+# user-process env → runtime.initialize starts the HBM gauge monitor,
+# observability/profiling.py; "0" disables).
+TONY_PROFILE_HBM_INTERVAL_MS = "TONY_PROFILE_HBM_INTERVAL_MS"
 # Continuous-batching serving engine (tony.serving.* conf → user-process
 # env → examples/lm_serve.py / tony_tpu.serving defaults).
 TONY_SERVING_SLOTS = "TONY_SERVING_SLOTS"
@@ -112,7 +116,7 @@ DOCKER_FORWARD_ENV = (
     TONY_TRACE_ID, TONY_METRICS_FILE,
     TONY_IO_PREFETCH_DEPTH, TONY_IO_READ_WORKERS, TONY_IO_CHUNK_RECORDS,
     TONY_COMPILE_CACHE_DIR, TONY_COMPILE_CACHE_ENABLED,
-    TONY_COMPILE_MIN_ENTRY_SIZE,
+    TONY_COMPILE_MIN_ENTRY_SIZE, TONY_PROFILE_HBM_INTERVAL_MS,
     TONY_SERVING_SLOTS, TONY_SERVING_PREFILL_CHUNK,
     TONY_SERVING_DECODE_WINDOW, TONY_SERVING_MAX_QUEUE, TONY_SERVING_PORT,
 )

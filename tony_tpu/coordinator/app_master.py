@@ -46,6 +46,7 @@ from tony_tpu.history.writer import (
     write_config_file,
     write_events_file,
     write_final_status,
+    write_profile_file,
     write_trace_file,
 )
 from tony_tpu.observability import events as obs_events
@@ -55,6 +56,8 @@ from tony_tpu.observability.aggregator import (
     ObservabilityHttpServer,
 )
 from tony_tpu.observability.flight import FlightRecorder, find_blackboxes
+from tony_tpu.observability.goodput import GoodputLedger
+from tony_tpu.observability.profiling import ProfileBroker, find_profiles
 from tony_tpu.observability.health import (
     ALERTS_COUNTER,
     HealthConfig,
@@ -128,8 +131,12 @@ class _RpcForClient(ApplicationRpc):
     def task_executor_heartbeat(
         self, task_id: str, session_id: str,
         metrics: dict[str, Any] | None = None,
-    ) -> None:
-        self._c.on_heartbeat(task_id, session_id, metrics)
+        profile: dict[str, Any] | None = None,
+    ) -> dict[str, Any] | None:
+        return self._c.on_heartbeat(task_id, session_id, metrics, profile)
+
+    def request_profile(self, duration_ms: int) -> dict[str, Any]:
+        return self._c.start_profile(duration_ms)
 
     def get_application_status(self) -> dict[str, Any]:
         return self._c.application_status()
@@ -155,6 +162,7 @@ class TonyCoordinator:
         self.client_signal_to_finish = threading.Event()
         self._wake = threading.Event()  # interrupts the monitor poll
         self._killed = threading.Event()
+        self._preempted_kill = False  # kill() came from scheduler preemption
         self._fatal = False  # conf-shaped failure: never retried
         self._model_params: str | None = None  # from a preprocess run
         self._tasks_failed = 0  # cumulative across session retries
@@ -196,9 +204,26 @@ class TonyCoordinator:
             emit=self._emit_health_alert,
             registry=self.metrics,
         )
-        self.aggregator = MetricsAggregator(
-            registry=self.metrics, health=self.health
+        # Goodput ledger: the per-job chip-second accountant, fed by
+        # every lifecycle event (the sink below) and by train-step
+        # advances off the heartbeat piggyback. Chips are derived from
+        # the slice plans once a session schedules.
+        self.goodput: GoodputLedger | None = (
+            GoodputLedger() if conf.get_bool(keys.K_GOODPUT_ENABLED, True)
+            else None
         )
+        if self.goodput is not None:
+            # Anchor at started_ms so the category sum equals the
+            # terminal record's wall_ms, not "wall since first event".
+            self.goodput.seed_start(self.started_ms)
+        # On-demand profiling fan-out (request → heartbeat replies →
+        # captured summaries back on the heartbeat's profile arg).
+        self.profile_broker = ProfileBroker()
+        self.aggregator = MetricsAggregator(
+            registry=self.metrics, health=self.health,
+            goodput=self.goodput,
+        )
+        self.aggregator.on_train_progress = self._on_train_progress
         # Crash flight recorder: recent per-task reports + RPC frame
         # summaries + events, dumped as blackbox-*.json on task failure,
         # retry decision, and final status (persisted into history).
@@ -212,6 +237,11 @@ class TonyCoordinator:
 
         def _event_sink(event: dict) -> None:
             self.flight.record_event(event)
+            if self.goodput is not None:
+                try:
+                    self.goodput.observe_event(event)
+                except Exception:
+                    log.warning("goodput event fold failed", exc_info=True)
             jsonl_sink(event)
 
         self.events = obs_events.EventLog(sink=_event_sink)
@@ -246,6 +276,73 @@ class TonyCoordinator:
             max_missed_heartbeats=conf.get_int(keys.K_TASK_MAX_MISSED_HEARTBEATS, 25),
             on_expired=self._on_task_deemed_dead,
         )
+
+    # -- goodput + profiling -------------------------------------------------
+    def _on_train_progress(self, task_id: str, steps: float) -> None:
+        """The ledger surfaced a step advance: stamp it into the
+        lifecycle log (throttled ledger-side) so an events.jsonl replay
+        can attribute productive time without live telemetry."""
+        self.events.emit(
+            obs_events.TRAIN_PROGRESS, task=task_id,
+            session=self.session.session_id if self.session else None,
+            steps=int(steps),
+        )
+
+    def _goodput_chips(self) -> int:
+        """Chip weight for the ledger: explicit conf override, else the
+        slice plans' chip total, else one chip-equivalent per task
+        (local/CPU gangs still account per process)."""
+        override = self.conf.get_int(keys.K_GOODPUT_CHIPS, 0)
+        if override > 0:
+            return override
+        if self.slice_plans:
+            return max(sum(
+                p.num_slices * p.chips_per_slice
+                for p in self.slice_plans.values()
+            ), 1)
+        if self.session is not None:
+            return max(len(self.session.all_tasks()), 1)
+        return 1
+
+    def goodput_json(self) -> dict[str, Any]:
+        """/api/goodput: the live ledger view."""
+        if self.goodput is None:
+            return {"enabled": False}
+        out = self.goodput.to_json()
+        out["enabled"] = True
+        out["app_id"] = self.app_id
+        return out
+
+    def start_profile(self, duration_ms: int | None = None) -> dict[str, Any]:
+        """Arm an on-demand capture for every live task (RPC
+        ``request_profile`` and ``POST /api/profile`` both land here)."""
+        session = self.session
+        tasks = [
+            t.id for t in session.all_tasks()
+            if t.handle is not None and not t.completed()
+        ] if session is not None else []
+        if not tasks:
+            return {"error": "no live tasks to profile"}
+        # Coerce + clamp HERE, not just in the broker: the HTTP body is
+        # caller-supplied, and the reply + profile_requested event must
+        # record the window that will actually run (never a raw string
+        # or an 11-day number the executor would clamp anyway).
+        from tony_tpu.observability.profiling import clamp_duration_ms
+
+        duration = clamp_duration_ms(
+            duration_ms or None,
+            default=self.conf.get_int(keys.K_PROFILE_DURATION_MS, 2000),
+        )
+        req_id = self.profile_broker.start(tasks, duration)
+        self.events.emit(
+            obs_events.PROFILE_REQUESTED,
+            session=session.session_id if session else None,
+            req_id=req_id, duration_ms=duration, tasks=len(tasks),
+        )
+        return {"req_id": req_id, "duration_ms": duration, "tasks": tasks}
+
+    def profile_status(self) -> dict[str, Any]:
+        return self.profile_broker.status()
 
     # -- health analytics + flight recorder ---------------------------------
     def _emit_health_alert(
@@ -318,6 +415,7 @@ class TonyCoordinator:
                 self.http_server = ObservabilityHttpServer(
                     self.aggregator, events=self.events, tracer=self.tracer,
                     logs_dir=self.app_dir / "logs", port=int(http_port),
+                    control=self,
                 )
                 self.http_server.serve_background()
                 (self.app_dir / "coordinator.http").write_text(
@@ -568,6 +666,10 @@ class TonyCoordinator:
             log.info("slice plans: %s", self.slice_plans)
             if hasattr(self.backend, "prepare_slices"):
                 self.backend.prepare_slices(self.slice_plans)
+        if self.goodput is not None:
+            # The chip weight is known once the topology is: conf
+            # override, slice-plan total, or one per task.
+            self.goodput.chips = self._goodput_chips()
         try:
             self._schedule_tasks()
         except ValueError as exc:
@@ -809,9 +911,13 @@ class TonyCoordinator:
     def on_heartbeat(
         self, task_id: str, session_id: str,
         metrics: dict[str, Any] | None = None,
-    ) -> None:
+        profile: dict[str, Any] | None = None,
+    ) -> dict[str, Any] | None:
         """Heartbeat RPC entry: fence stale pings, then feed liveness and
-        the metrics aggregator (the piggybacked snapshot).
+        the metrics aggregator (the piggybacked snapshot). The RETURN
+        value is the coordinator's command channel back to the executor:
+        a pending profile-capture request rides the reply of the ping the
+        executor already sent.
 
         Two fences, both required for retried sessions to be trustworthy:
         a ping carrying a PREVIOUS session id (an executor the backend is
@@ -819,7 +925,8 @@ class TonyCoordinator:
         a ping from a task the monitor already expired or unregistered
         must not silently re-register it into a failed session. The same
         fences guard the aggregator — a dead session's executor must not
-        keep updating the live job's gauges."""
+        keep updating the live job's gauges — and the profile broker: a
+        stale executor neither receives commands nor reports captures."""
         session = self.session
         if session is None or str(session.session_id) != str(session_id):
             log.warning(
@@ -827,7 +934,7 @@ class TonyCoordinator:
                 task_id, session_id,
                 session.session_id if session else "none",
             )
-            return
+            return None
         if not self.liveness.receive_ping(task_id):
             # debug, not warning: executors begin pinging before their
             # registration RPC lands, so a few fenced pings are routine.
@@ -835,13 +942,28 @@ class TonyCoordinator:
                 "dropping heartbeat from %s: not monitored (expired, "
                 "completed, or not yet registered)", task_id,
             )
-            return
+            return None
         self.metrics.counter("heartbeats_received_total").inc()
         self.aggregator.ingest(task_id, metrics)
+        if profile is not None:
+            # The event mirrors what the broker RECORDED: a summary
+            # fenced as stale (superseded request) leaves no event, and
+            # a failed capture is stamped as such — the timeline must
+            # never claim a capture the broker has no record of.
+            recorded = self.profile_broker.record_result(task_id, profile)
+            if recorded is not None:
+                self.events.emit(
+                    obs_events.PROFILE_CAPTURED, task=task_id,
+                    session=session.session_id,
+                    req_id=profile.get("req_id"),
+                    artifact=profile.get("artifact"),
+                    state=recorded,
+                )
         if self._faults.enabled and self._faults.heartbeat_kill(
             task_id, session.session_id
         ):
             self._fault_kill(task_id)
+        return self.profile_broker.command_for(task_id)
 
     def _on_task_deemed_dead(self, task_id: str) -> None:
         """onTaskDeemedDead (TonyApplicationMaster.java:1094-1104). On a TPU
@@ -998,6 +1120,25 @@ class TonyCoordinator:
             "alerts": self.health.alerts(),
         }
         self.events.emit(obs_events.FINAL_STATUS, state=status.value)
+        # Goodput terminal record: close the ledger at the final event,
+        # publish the gauges one last time, and make the breakdown part
+        # of final-status — the history server's Goodput panel, `tony
+        # goodput`'s fallback chain, and the scheduler daemon's
+        # per-tenant accounting all read THIS.
+        if self.goodput is not None:
+            if self._preempted_kill:
+                # A preemption kill reaches this coordinator as a plain
+                # KILLED session, but the relaunch will recompute
+                # everything since the last checkpoint — fold the debt
+                # transfer in before the record freezes, exactly as a
+                # replay seeing job_preempted would.
+                self.goodput.observe_event({
+                    "ts_ms": int(time.time() * 1000),
+                    "kind": "job_preempted",
+                })
+            self.goodput.finalize(int(time.time() * 1000))
+            self.goodput.publish(self.metrics)
+            final["goodput"] = self.goodput.to_json()
         self._dump_blackbox("final-status")
         # A job that died AT the gang barrier leaves the rendezvous span
         # open (_reset only runs between retries) — and that wait is
@@ -1037,13 +1178,29 @@ class TonyCoordinator:
                     write_blackbox_file(job_dir, bb.name, bb.read_text())
                 except OSError:
                     log.warning("could not persist %s", bb, exc_info=True)
+            # On-demand profile captures ride into history beside the
+            # Chrome trace (local backends write them into the job
+            # scratch; remote executors' artifacts stay host-side, but
+            # their summaries already live in the events + broker).
+            for prof in find_profiles(self.app_dir / "logs", self.app_dir):
+                try:
+                    write_profile_file(job_dir, prof.name, prof.read_text())
+                except OSError:
+                    log.warning("could not persist %s", prof, exc_info=True)
         (self.app_dir / "final-status.json").write_text(json.dumps(final) + "\n")
         self._final_published.set()
         grace_s = self.conf.get_int(keys.K_AM_STOP_GRACE_MS, 30000) / 1000.0
         self.client_signal_to_finish.wait(timeout=grace_s)
         return status
 
-    def kill(self) -> None:
+    def kill(self, preempted: bool = False) -> None:
+        """``preempted=True`` is the scheduler daemon's graceful
+        preemption (the job will be requeued and resumed): the goodput
+        ledger must charge un-checkpointed work as recomputation debt,
+        which a plain operator kill (the job is DONE, nothing recomputes)
+        must not."""
+        if preempted:
+            self._preempted_kill = True
         self._killed.set()
         self._wake.set()
 

@@ -24,6 +24,7 @@ from tony_tpu.conf.configuration import TonyConfiguration
 from tony_tpu.observability import metrics as obs_metrics
 from tony_tpu.observability import trace as obs_trace
 from tony_tpu.observability.flight import FlightRecorder
+from tony_tpu.observability.profiling import ExecutorProfiler
 from tony_tpu.resilience.faults import ExecutorFaults, FaultPlan
 from tony_tpu.rpc.client import ApplicationRpcClient
 
@@ -137,6 +138,8 @@ class Heartbeater(threading.Thread):
         on_lost=_die_lost_coordinator,
         metrics_source=None,
         on_send=None,
+        profile_source=None,
+        on_command=None,
     ):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
@@ -147,6 +150,12 @@ class Heartbeater(threading.Thread):
         # heartbeat's optional ``metrics`` arg, so the telemetry plane
         # costs zero extra RPCs. Failures here must never cost a ping.
         self._metrics_source = metrics_source
+        # Profiling round trip on the same channel: ``profile_source``
+        # yields a finished capture summary to ship (one-shot), and
+        # ``on_command`` receives the coordinator's heartbeat-REPLY
+        # payload (a pending capture request). Neither may cost a ping.
+        self._profile_source = profile_source
+        self._on_command = on_command
         self._interval_s = interval_ms / 1000.0
         self._max_failures = max(max_failures, 1)
         self._skip = int(os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
@@ -156,6 +165,7 @@ class Heartbeater(threading.Thread):
         # Flight-recorder tap: called with (ok: bool) after every send
         # attempt. Must never cost a ping.
         self._on_send = on_send
+        self._pending_profile = None
         self.consecutive_failures = 0
         # NOT named _stop: threading.Thread has a private _stop METHOD that
         # join() calls when the thread finishes; shadowing it with an Event
@@ -184,17 +194,32 @@ class Heartbeater(threading.Thread):
                     payload = self._metrics_source()
                 except Exception:
                     log.debug("metrics source failed", exc_info=True)
+            # The capture summary is held locally until a send SUCCEEDS:
+            # the source is one-shot, and a transient ping failure must
+            # not lose the only copy of the result.
+            if self._pending_profile is None and \
+                    self._profile_source is not None:
+                try:
+                    self._pending_profile = self._profile_source()
+                except Exception:
+                    log.debug("profile source failed", exc_info=True)
             try:
+                kwargs = {}
                 if payload is not None:
-                    self._client.task_executor_heartbeat(
-                        self._task_id, self._session_id, metrics=payload
-                    )
-                else:
-                    self._client.task_executor_heartbeat(
-                        self._task_id, self._session_id
-                    )
+                    kwargs["metrics"] = payload
+                if self._pending_profile is not None:
+                    kwargs["profile"] = self._pending_profile
+                reply = self._client.task_executor_heartbeat(
+                    self._task_id, self._session_id, **kwargs
+                )
+                self._pending_profile = None
                 self.consecutive_failures = 0
                 self._note_send(True)
+                if reply is not None and self._on_command is not None:
+                    try:
+                        self._on_command(reply)
+                    except Exception:
+                        log.debug("heartbeat command failed", exc_info=True)
             except Exception:
                 self.consecutive_failures += 1
                 self._note_send(False)
@@ -265,6 +290,17 @@ class TaskExecutor:
         # snapshot here (we export TONY_METRICS_FILE into its env); the
         # heartbeater reads it back and piggybacks it on each ping.
         log_dir = env.get(constants.TONY_LOG_DIR)
+        # On-demand profiling agent: heartbeat replies deliver capture
+        # requests, captures run on a background thread, artifacts land
+        # beside the task logs (where the coordinator persists them to
+        # history), summaries ride the next heartbeat back. The metrics
+        # file doubles as the device seam: the user process's published
+        # HBM gauges give captures real device memory on TPU, where
+        # this supervisor process never loads jax.
+        self.profiler = ExecutorProfiler(
+            self.task_id, out_dir=log_dir, session_id=self.session_id,
+            metrics_source=self._metrics_snapshot,
+        )
         self._metrics_file: Path | None = (
             Path(log_dir) / f".metrics-{self.job_name}-{self.task_index}.json"
             if log_dir else None
@@ -384,6 +420,8 @@ class TaskExecutor:
             on_send=lambda ok: self.flight.record_rpc(
                 "task_executor_heartbeat", ok=ok, task=self.task_id
             ),
+            profile_source=self.profiler.take_result,
+            on_command=self.profiler.handle_command,
         )
         self.heartbeater.start()
         retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
@@ -449,6 +487,12 @@ class TaskExecutor:
             env[constants.TONY_COMPILE_CACHE_DIR] = cache_dir
         env[constants.TONY_COMPILE_MIN_ENTRY_SIZE] = str(
             self.conf.get_int(keys.K_COMPILE_MIN_ENTRY_SIZE, 0)
+        )
+        # Continuous HBM gauges (tony.profile.hbm-interval → user-process
+        # env → runtime.initialize starts the device-memory monitor, so
+        # OOM-adjacent jobs are visible on /metrics before they die).
+        env[constants.TONY_PROFILE_HBM_INTERVAL_MS] = str(
+            self.conf.get_int(keys.K_PROFILE_HBM_INTERVAL_MS, 5000)
         )
         # Serving engine tuning (tony.serving.* conf → user-process env):
         # the serving task type's script reads these as its engine

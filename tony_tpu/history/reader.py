@@ -177,6 +177,36 @@ def job_blackboxes(
     return None
 
 
+def job_profiles(
+    history_location: str | Path, app_id: str
+) -> "dict[str, dict] | None":
+    """One job's persisted on-demand profile captures, name -> parsed
+    summary; None when the job has none. Malformed files are skipped —
+    one torn capture must not hide the others."""
+    for job_dir in find_job_dirs(history_location):
+        if _dir_name(job_dir) != app_id:
+            continue
+        out: dict[str, dict] = {}
+        try:
+            names = _job_files(job_dir)
+        except OSError:
+            return None
+        for name in sorted(names):
+            if not (name.startswith("profile-") and name.endswith(".json")):
+                continue
+            raw = _read_job_file(job_dir, name)
+            if raw is None:
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                out[name] = doc
+        return out or None
+    return None
+
+
 class TtlCache:
     """Tiny TTL cache (CacheWrapper.java:11-40 uses Guava caches so repeat
     page loads don't rescan HDFS; same idea for directory walks)."""

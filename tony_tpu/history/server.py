@@ -185,18 +185,64 @@ class HistoryHandler(BaseHTTPRequestHandler):
             f"<td>{esc(s.get('lease_job_id') or '-')}</td></tr>"
             for s in state.get("pool", [])
         )
+        wait = state.get("queue_wait_ms") or {}
+        wait_line = ""
+        if wait.get("count"):
+            wait_line = (
+                f" &middot; queue wait p50 {esc(str(wait.get('p50_ms')))} ms"
+                f" / p95 {esc(str(wait.get('p95_ms')))} ms"
+                f" over {esc(str(wait.get('count')))} launch(es)"
+            )
         body = (
             f"<p>source: {esc(source)} &middot; queue depth "
-            f"{state.get('queue_depth', 0)}</p>"
+            f"{state.get('queue_depth', 0)}{wait_line}</p>"
             "<h3>Jobs</h3><table><tr><th>job</th><th>state</th>"
             "<th>prio</th><th>tenant</th><th>slice</th><th>try</th>"
             f"<th>preempt</th><th>resume step</th></tr>{job_rows}</table>"
             "<h3>Slice pool</h3><table><tr><th>slice</th><th>state</th>"
             "<th>profile</th><th>jobs served</th><th>lease</th></tr>"
             f"{pool_rows}</table>"
-            "<p><a href='/'>jobs</a></p>"
+            + self._fleet_goodput_section(state, esc)
+            + "<p><a href='/'>jobs</a></p>"
         )
         return _PAGE.format(title="Scheduler", body=body)
+
+    def _fleet_goodput_section(self, state: dict, esc) -> str:
+        """Fleet + per-tenant chip-hour accounting from the daemon's
+        goodput aggregation (scheduler-state.json `goodput`)."""
+        g = state.get("goodput")
+        if not isinstance(g, dict):
+            return ""
+        fleet = g.get("fleet_chip_seconds") or {}
+        tenants = g.get("tenants") or {}
+        if not any(v for v in fleet.values()):
+            return ""
+        cats = [c for c, v in fleet.items() if v]
+        head = "".join(f"<th>{esc(str(c))}</th>" for c in cats)
+
+        def hours(v) -> str:
+            try:
+                return f"{float(v) / 3600.0:.4f}"
+            except (TypeError, ValueError):
+                return "-"
+
+        rows = [
+            "<tr><td>fleet</td>"
+            + "".join(f"<td>{hours(fleet.get(c, 0.0))}</td>" for c in cats)
+            + "</tr>"
+        ]
+        for tenant, acct in sorted(tenants.items()):
+            rows.append(
+                f"<tr><td>{esc(str(tenant))}</td>"
+                + "".join(f"<td>{hours((acct or {}).get(c, 0.0))}</td>"
+                          for c in cats)
+                + "</tr>"
+            )
+        return (
+            f"<h3>Goodput (chip-hours; ratio "
+            f"{esc(str(g.get('ratio')))})</h3>"
+            f"<table><tr><th>tenant</th>{head}</tr>{''.join(rows)}</table>"
+        )
 
     def _job_page(self, app_id: str) -> None:
         """Per-job run report: terminal state, run statistics, slice plans,
@@ -264,6 +310,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
                         f"<td>{esc(t.get('exit_code'))}</td></tr>"
                     )
             parts.append("</table>")
+        parts.extend(self._goodput_section(final, esc))
         parts.extend(self._diagnosis_section(app_id, final, esc))
         parts.extend(self._metrics_section(final, esc))
         parts.extend(self._timeline_section(app_id, esc))
@@ -273,6 +320,37 @@ class HistoryHandler(BaseHTTPRequestHandler):
         self._send_html(
             _PAGE.format(title=esc(app_id), body="".join(parts))
         )
+
+    def _goodput_section(self, final: dict, esc) -> list[str]:
+        """Where the job's chip-seconds went: the persisted ledger
+        breakdown (final-status ``goodput``) as a category table with
+        the headline productive ratio."""
+        g = final.get("goodput")
+        if not isinstance(g, dict):
+            return []
+        cats = g.get("categories")
+        if not isinstance(cats, dict) or not any(cats.values()):
+            return []
+        total = sum(v for v in cats.values() if isinstance(v, (int, float)))
+        parts = [
+            f"<h3>Goodput</h3><p>productive ratio "
+            f"<b>{esc(g.get('ratio'))}</b> &middot; "
+            f"{esc(g.get('chips'))} chip(s) &middot; wall "
+            f"{esc(g.get('wall_s'))} s</p>",
+            "<table><tr><th>category</th><th>seconds</th>"
+            "<th>chip-seconds</th><th>share</th></tr>",
+        ]
+        chip_s = g.get("chip_seconds") or {}
+        for cat, secs in cats.items():
+            if not secs:
+                continue
+            share = f"{100.0 * secs / total:.1f}%" if total else "-"
+            parts.append(
+                f"<tr><td>{esc(cat)}</td><td>{esc(secs)}</td>"
+                f"<td>{esc(chip_s.get(cat))}</td><td>{share}</td></tr>"
+            )
+        parts.append("</table>")
+        return parts
 
     def _diagnosis_section(self, app_id: str, final: dict, esc) -> list[str]:
         """Ranked root-cause findings (``analysis/postmortem``, the same

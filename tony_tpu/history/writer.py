@@ -169,6 +169,13 @@ def write_blackbox_file(job_dir: "Path | str", name: str, data: str) -> None:
     _write_job_file(job_dir, name, data)
 
 
+def write_profile_file(job_dir: "Path | str", name: str, data: str) -> None:
+    """One on-demand profile capture (``profile-*.json``,
+    observability/profiling.py) persisted verbatim; the name carries the
+    producing task, session, and request id."""
+    _write_job_file(job_dir, name, data)
+
+
 def write_trace_file(job_dir: "Path | str", trace_doc: dict) -> None:
     """The job's merged Chrome trace document (observability/trace.py) —
     loadable directly in chrome://tracing / Perfetto."""

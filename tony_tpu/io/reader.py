@@ -94,6 +94,19 @@ _MS_BUCKETS = (
     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
 )
 
+# Declared metric names — the tony_io_* family (TONY-M001/M002 lint
+# these module-scope constants; bench.py and tools/profile_step.py
+# read the same names out of registry snapshots).
+IO_BYTES_READ_COUNTER = "tony_io_bytes_read_total"
+IO_READ_MS_HISTOGRAM = "tony_io_read_ms"
+IO_ASSEMBLE_MS_HISTOGRAM = "tony_io_assemble_ms"
+IO_BATCH_WAIT_MS_HISTOGRAM = "tony_io_batch_wait_ms"
+IO_PREFETCH_QUEUE_DEPTH_GAUGE = "tony_io_prefetch_queue_depth"
+IO_H2D_BYTES_COUNTER = "tony_io_h2d_bytes_total"
+IO_H2D_MS_HISTOGRAM = "tony_io_h2d_ms"
+IO_QUEUE_WAIT_MS_HISTOGRAM = "tony_io_queue_wait_ms"
+IO_H2D_INFLIGHT_DEPTH_GAUGE = "tony_io_h2d_inflight_depth"
+
 
 class _IoMetrics:
     """Lazy handles into the process observability registry. One shared
@@ -109,42 +122,44 @@ class _IoMetrics:
 
         registry = observability.default_registry()
         self.bytes_read = registry.counter(
-            "tony_io_bytes_read_total",
+            IO_BYTES_READ_COUNTER,
             "bytes fetched from storage by the sharded reader",
         )
         self.read_ms = registry.histogram(
-            "tony_io_read_ms", "wall time of one span read (pread/GET)",
+            IO_READ_MS_HISTOGRAM,
+            "wall time of one span read (pread/GET)",
             buckets=_MS_BUCKETS,
         )
         self.assemble_ms = registry.histogram(
-            "tony_io_assemble_ms",
+            IO_ASSEMBLE_MS_HISTOGRAM,
             "host-side batch-assembly copy time (rollover buffer)",
             buckets=_MS_BUCKETS,
         )
         self.batch_wait_ms = registry.histogram(
-            "tony_io_batch_wait_ms",
+            IO_BATCH_WAIT_MS_HISTOGRAM,
             "consumer stall waiting on the reader's prefetch queue",
             buckets=_MS_BUCKETS,
         )
         self.queue_depth = registry.gauge(
-            "tony_io_prefetch_queue_depth",
+            IO_PREFETCH_QUEUE_DEPTH_GAUGE,
             "chunks currently buffered between fetcher and consumer",
         )
         self.h2d_bytes = registry.counter(
-            "tony_io_h2d_bytes_total",
+            IO_H2D_BYTES_COUNTER,
             "bytes handed to jax.device_put by device_prefetch",
         )
         self.h2d_ms = registry.histogram(
-            "tony_io_h2d_ms", "wall time of one jax.device_put dispatch",
+            IO_H2D_MS_HISTOGRAM,
+            "wall time of one jax.device_put dispatch",
             buckets=_MS_BUCKETS,
         )
         self.queue_wait_ms = registry.histogram(
-            "tony_io_queue_wait_ms",
+            IO_QUEUE_WAIT_MS_HISTOGRAM,
             "consumer stall per batch waiting on device_prefetch",
             buckets=_MS_BUCKETS,
         )
         self.h2d_depth = registry.gauge(
-            "tony_io_h2d_inflight_depth",
+            IO_H2D_INFLIGHT_DEPTH_GAUGE,
             "device transfers currently in flight in device_prefetch",
         )
 

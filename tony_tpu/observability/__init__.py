@@ -15,11 +15,17 @@ process in the job:
 * ``trace``      — distributed spans sharing one job trace id
   (``TONY_TRACE_ID`` + RPC metadata), exported as a Chrome trace JSON
   per job; ``with observability.span("load_data"): ...`` in user code.
+* ``goodput``    — the per-job chip-second ledger (exclusive wall-time
+  breakdown into queued/provisioning/…/productive/wasted_by_failure),
+  served on ``/api/goodput`` and ``tony goodput``.
+* ``profiling``  — on-demand distributed capture (heartbeat fan-out)
+  plus the continuous per-device HBM gauge monitor.
 """
 
 from __future__ import annotations
 
 from tony_tpu.observability.events import EventLog
+from tony_tpu.observability.goodput import GoodputLedger
 from tony_tpu.observability.metrics import (
     MetricsRegistry,
     default_registry,
@@ -29,6 +35,7 @@ from tony_tpu.observability.trace import Tracer, default_tracer, span
 
 __all__ = [
     "EventLog",
+    "GoodputLedger",
     "MetricsRegistry",
     "Tracer",
     "default_registry",

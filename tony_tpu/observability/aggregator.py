@@ -43,6 +43,13 @@ from tony_tpu.observability.metrics import (
 log = logging.getLogger(__name__)
 
 HEARTBEAT_COUNTER = "tony_task_heartbeats_total"
+# Rendered at scrape time from the aggregator's last-seen clock: silence
+# is visible on a dashboard without anyone parsing events.jsonl for
+# heartbeat_missed.
+HEARTBEAT_AGE_GAUGE = "tony_task_heartbeat_age_seconds"
+# The train-steps counter the goodput ledger reads out of snapshots
+# (registered by MetricsRegistry.report's step driver, not here).
+_TRAIN_STEPS_KEY = "train_steps_total"
 
 
 def _parse_cursor(query: str) -> int | None:
@@ -103,13 +110,24 @@ class MetricsAggregator:
         self, registry: MetricsRegistry | None = None,
         series_limit: int = 512,
         health=None,
+        goodput=None,
+        clock=time.time,
     ) -> None:
         self.registry = registry or MetricsRegistry()
         self.health = health  # HealthMonitor fed on every ingest
+        # GoodputLedger fed train-step advances on every ingest and
+        # refreshed into the registry before each /metrics render.
+        self.goodput = goodput
+        # Called with (task_id, steps_total) when the ledger wants the
+        # advance surfaced as a train_progress lifecycle event (the
+        # coordinator wires its event log here).
+        self.on_train_progress = None
+        self._clock = clock
         self._series_limit = series_limit
         self._lock = threading.Lock()
         self._latest: dict[str, dict[str, Any]] = {}
         self._heartbeats: dict[str, int] = {}
+        self._last_seen: dict[str, float] = {}  # task -> wall-clock s
         # (task_id, gauge name) -> deque[(ts_ms, value)]
         self._series: dict[tuple[str, str], collections.deque] = {}
 
@@ -119,6 +137,7 @@ class MetricsAggregator:
         snap: dict[str, Any] | None = None
         with self._lock:
             self._heartbeats[task_id] = self._heartbeats.get(task_id, 0) + 1
+            self._last_seen[task_id] = self._clock()
             if isinstance(snapshot, Mapping):
                 # Normalize at the trust boundary: the snapshot comes from
                 # an executor-authenticated RPC peer relaying a
@@ -160,23 +179,56 @@ class MetricsAggregator:
                 self.health.observe(task_id, snap)
             except Exception:  # pragma: no cover - defensive
                 log.warning("health observe failed", exc_info=True)
+        # Goodput: a train_steps_total advance is the productive signal;
+        # surfaced advances become throttled train_progress events so a
+        # later events.jsonl replay attributes productive time too.
+        if self.goodput is not None and snap is not None:
+            try:
+                steps = snap["counters"].get(_TRAIN_STEPS_KEY)
+                # COORDINATOR clock, not the snapshot's ts: the ledger's
+                # timeline is built from coordinator-stamped events, and
+                # an executor with a skewed wall clock must not drag it.
+                if steps is not None and self.goodput.observe_steps(
+                    task_id, steps, ts_ms=int(self._clock() * 1000)
+                ) and self.on_train_progress is not None:
+                    self.on_train_progress(task_id, steps)
+            except Exception:  # pragma: no cover - defensive
+                log.warning("goodput observe failed", exc_info=True)
 
     def reset_tasks(self) -> None:
         with self._lock:
             self._latest.clear()
             self._series.clear()
 
+    def heartbeat_ages(self) -> dict[str, float]:
+        """Seconds since each task's last heartbeat, on the
+        COORDINATOR's clock — computed at render time, so the gauge is
+        current however stale the task's own snapshot is."""
+        now = self._clock()
+        with self._lock:
+            return {
+                t: max(now - seen, 0.0)
+                for t, seen in self._last_seen.items()
+            }
+
     # -- views -------------------------------------------------------------
     def prometheus_text(self) -> str:
+        if self.goodput is not None:
+            # Refresh the goodput gauges so the scrape serves the ledger
+            # as of NOW (the open phase extends to scrape time).
+            self.goodput.publish(self.registry)
         with self._lock:
             latest = {t: dict(s) for t, s in self._latest.items()}
             heartbeats = dict(self._heartbeats)
+        ages = self.heartbeat_ages()
         seen: set[str] = set()
         parts = [render_prometheus(self.registry.snapshot(),
                                    types_seen=seen)]
         for task_id in sorted(heartbeats):
             parts.append(render_prometheus(
-                {"counters": {HEARTBEAT_COUNTER: heartbeats[task_id]}},
+                {"counters": {HEARTBEAT_COUNTER: heartbeats[task_id]},
+                 "gauges": {HEARTBEAT_AGE_GAUGE:
+                            round(ages.get(task_id, 0.0), 3)}},
                 labels={"task": task_id}, types_seen=seen,
             ))
         for task_id in sorted(latest):
@@ -195,10 +247,16 @@ class MetricsAggregator:
         return "".join(p for p in parts if p)
 
     def to_json(self) -> dict[str, Any]:
+        if self.goodput is not None:
+            self.goodput.publish(self.registry)
+        ages = self.heartbeat_ages()
         with self._lock:
             return {
                 "coordinator": self.registry.snapshot(),
                 "heartbeats": dict(self._heartbeats),
+                "heartbeat_age_s": {
+                    t: round(a, 3) for t, a in sorted(ages.items())
+                },
                 "tasks": {t: dict(s) for t, s in self._latest.items()},
                 "series": {
                     f"{task}:{name}": list(points)
@@ -230,6 +288,9 @@ class _ObsHandler(BaseHTTPRequestHandler):
     tracer: trace_mod.Tracer | None = None
     health = None
     logs_dir = None
+    # Goodput/profile seam: an object exposing goodput_json(),
+    # start_profile(duration_ms) and profile_status() — the coordinator.
+    control = None
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         try:
@@ -245,13 +306,31 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 if cursor is None:
                     self._send_json(events)
                 else:
-                    # Tail protocol for `tony events --follow`: the cursor
-                    # is the count already seen; the reply carries only
-                    # the suffix plus the new cursor to resume from.
+                    # Tail protocol for `tony events --follow` and `tony
+                    # goodput --follow`: the cursor is the count already
+                    # seen; the reply carries the suffix, the cursor to
+                    # resume from, AND the current count — a consumer
+                    # whose cursor is beyond the tail (it outran the
+                    # writer, or the coordinator restarted with a
+                    # shorter log) reads count < cursor and resets,
+                    # instead of conflating it with "no new events".
                     self._send_json({
                         "cursor": len(events),
+                        "count": len(events),
                         "events": events[cursor:],
                     })
+            elif path == "/api/goodput":
+                if self.control is None:
+                    self._send_json({"error": "no goodput ledger"},
+                                    status=404)
+                else:
+                    self._send_json(self.control.goodput_json())
+            elif path == "/api/profile":
+                if self.control is None:
+                    self._send_json({"error": "profiling unavailable"},
+                                    status=404)
+                else:
+                    self._send_json(self.control.profile_status())
             elif path == "/api/health":
                 self._send_json(
                     self.health.to_json() if self.health is not None
@@ -268,6 +347,43 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 self.send_error(404)
         except Exception as exc:  # pragma: no cover - defensive
             log.exception("observability request failed")
+            try:
+                self.send_error(500, str(exc))
+            except OSError:
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            path, _, _ = self.path.partition("?")
+            if path != "/api/profile":
+                self.send_error(404)
+                return
+            # The GET views are read-only telemetry; this is the ONE
+            # mutating route on a port that binds all interfaces for
+            # scrapers — and arming capture windows costs every chip.
+            # Loopback only: remote operators go through the
+            # authenticated client-role `request_profile` RPC instead.
+            if self.client_address[0] not in ("127.0.0.1", "::1"):
+                self._send_json(
+                    {"error": "POST /api/profile is loopback-only; use "
+                              "the client-role request_profile RPC"},
+                    status=403,
+                )
+                return
+            if self.control is None:
+                self._send_json({"error": "profiling unavailable"},
+                                status=404)
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, TypeError):
+                body = {}
+            duration = body.get("duration_ms") if isinstance(body, dict) \
+                else None
+            self._send_json(self.control.start_profile(duration))
+        except Exception as exc:  # pragma: no cover - defensive
+            log.exception("observability POST failed")
             try:
                 self.send_error(500, str(exc))
             except OSError:
@@ -305,11 +421,13 @@ class ObservabilityHttpServer:
         logs_dir=None,
         host: str = "0.0.0.0",
         port: int = 0,
+        control=None,
     ) -> None:
         handler = type("BoundObsHandler", (_ObsHandler,), {
             "aggregator": aggregator, "events": events,
             "tracer": tracer, "logs_dir": logs_dir,
             "health": health if health is not None else aggregator.health,
+            "control": control,
         })
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

@@ -40,12 +40,20 @@ RETRY_DECISION = "retry_decision"
 CHECKPOINT_PROGRESS = "checkpoint_progress"
 FINAL_STATUS = "final_status"
 
+# Goodput + profiling (observability/goodput.py, profiling.py): the
+# throttled training-progress marker that lets an events.jsonl replay
+# attribute productive time, and the on-demand capture round trip.
+TRAIN_PROGRESS = "train_progress"
+PROFILE_REQUESTED = "profile_requested"
+PROFILE_CAPTURED = "profile_captured"
+
 # Scheduler-daemon lifecycle (scheduler/service.py): the queue/pool
 # timeline, appended to the scheduler's own events.jsonl.
 JOB_QUEUED = "job_queued"
 JOB_LAUNCHED = "job_launched"
 JOB_PREEMPTED = "job_preempted"
 JOB_FINISHED = "job_finished"
+SLICE_PROVISIONING = "slice_provisioning"
 SLICE_LEASED = "slice_leased"
 SLICE_RELEASED = "slice_released"
 
@@ -70,10 +78,14 @@ KNOWN_KINDS = frozenset({
     RETRY_DECISION,
     CHECKPOINT_PROGRESS,
     FINAL_STATUS,
+    TRAIN_PROGRESS,
+    PROFILE_REQUESTED,
+    PROFILE_CAPTURED,
     JOB_QUEUED,
     JOB_LAUNCHED,
     JOB_PREEMPTED,
     JOB_FINISHED,
+    SLICE_PROVISIONING,
     SLICE_LEASED,
     SLICE_RELEASED,
 })

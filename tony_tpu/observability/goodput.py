@@ -1,0 +1,437 @@
+"""Goodput ledger — account for every chip-second from submit to
+SUCCEEDED.
+
+The telemetry plane already records *what happened* (events.jsonl), *how
+fast* (the metrics registry), and *where the time went inside a step*
+(the job trace). What no layer answered is the question operators
+actually ask a multi-tenant fleet: **what fraction of the chip-time I
+paid for was productive training, and where did the rest go?**
+
+``GoodputLedger`` is a per-job time-accounting state machine. It folds
+the existing lifecycle event stream (``job_queued`` →
+``slice_provisioning``/``slice_leased`` → ``job_submitted``/``job_staged``
+→ ``session_started`` → ``task_registered`` → ``rendezvous_released`` →
+``train_progress``/``checkpoint_progress`` → ``retry_decision``/
+``job_preempted`` → ``final_status``) plus live telemetry (train-step
+advances from heartbeat snapshots, stall health alerts) into an
+**exclusive, gap-free** breakdown of wall time into categories:
+
+======================  ====================================================
+``queued``              waiting in the scheduler queue (or for a slice)
+``provisioning``        slice creation / container launch / retry backoff
+``staging``             app-dir staging, venv localization, coordinator prep
+``compile``             rendezvous released but no training step observed yet
+``rendezvous``          gang-barrier wait (first registration → release)
+``productive``          training steps advancing
+``stalled``             steps stopped advancing while the gang is healthy
+``wasted_by_failure``   work since the last complete checkpoint, re-charged
+                        at each failure (recomputation debt)
+``preempted``           preempted and waiting to be relaunched
+``teardown``            terminal status reached, history being written
+======================  ====================================================
+
+Exclusivity is structural: every elapsed interval is attributed to
+exactly ONE category (the current phase), so the categories always sum
+to the observed wall clock. ``wasted_by_failure`` is the only
+re-attribution: when a session fails, the ``compile`` + ``productive`` +
+``stalled`` seconds accumulated since the last checkpoint mark move into
+``wasted_by_failure`` — that work must be recomputed, so counting it as
+productive would overstate goodput exactly when operators need the truth.
+
+Chip-weighting: ``chips`` scales seconds into chip-seconds (the
+coordinator derives it from the slice plans; local runs fall back to the
+task count). Published as ``tony_goodput_seconds_total{category=...}``
+gauges plus ``tony_goodput_ratio`` on the coordinator's and scheduler's
+``/metrics``, served as JSON on ``/api/goodput``, persisted into
+``final-status.json`` under ``"goodput"``, aggregated per tenant by the
+scheduler daemon (``FleetGoodput``), and rendered by ``tony goodput
+<app_id>`` and the history server's per-job Goodput panel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterable, Mapping
+
+# Declared metric names (TONY-M001/M002 lint these module-scope
+# constants; both are gauges — the wasted_by_failure re-attribution
+# makes per-category totals legitimately non-monotonic).
+GOODPUT_SECONDS_GAUGE = "tony_goodput_seconds_total"
+GOODPUT_RATIO_GAUGE = "tony_goodput_ratio"
+
+CATEGORIES = (
+    "queued",
+    "provisioning",
+    "staging",
+    "compile",
+    "rendezvous",
+    "productive",
+    "stalled",
+    "wasted_by_failure",
+    "preempted",
+    "teardown",
+)
+
+# Categories whose accumulation since the last checkpoint mark is
+# recomputation debt on failure.
+_RECOMPUTE_CATEGORIES = ("compile", "productive", "stalled")
+
+# Lifecycle-event kind -> phase AFTER the event. Kinds not listed leave
+# the phase alone (health_alert and train_progress get special handling).
+_PHASE_AFTER_EVENT: dict[str, str] = {
+    "job_queued": "queued",
+    "slice_provisioning": "provisioning",
+    "slice_leased": "staging",
+    "job_launched": "staging",
+    "job_submitted": "staging",
+    "job_staged": "provisioning",
+    "session_started": "provisioning",
+    "task_scheduled": "provisioning",
+    "task_registered": "rendezvous",
+    "rendezvous_released": "compile",
+    "train_progress": "productive",
+    "job_preempted": "preempted",
+    "final_status": "teardown",
+}
+
+# Throttle for surfacing train progress as a lifecycle event: the first
+# advance of each session always surfaces (it closes the compile
+# window); afterwards at most one event per this many ms — events.jsonl
+# must stay bounded however long the job trains.
+PROGRESS_EVENT_INTERVAL_MS = 10_000
+
+
+class GoodputLedger:
+    """See module docstring. Thread-safe; feed it via ``observe_event``
+    (every lifecycle event), ``observe_steps`` (aggregated
+    train_steps_total per task, from heartbeat snapshots), and
+    ``observe_checkpoint`` (a complete checkpoint landed)."""
+
+    # Health detectors whose alerts mean "the chip is NOT making
+    # progress": the training-progress watchdog and the input-pipeline
+    # stall detector (observability/health.py PROGRESS_STALL/IO_STALL —
+    # name constants duplicated here rather than imported so the ledger
+    # stays loadable without the health plane).
+    STALL_DETECTORS = ("progress_stall", "io_stall")
+
+    def __init__(
+        self,
+        chips: int = 1,
+        clock_ms=None,
+        stalled_detectors: Iterable[str] = STALL_DETECTORS,
+    ) -> None:
+        self.chips = max(int(chips), 1)
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self._stalled_detectors = frozenset(stalled_detectors)
+        self._lock = threading.Lock()
+        self._seconds: dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        self._phase: str | None = None
+        self._first_ms: int | None = None
+        self._last_ms: int | None = None
+        self._finalized = False
+        # Recomputation-debt accounting: seconds accumulated per
+        # recompute category since the last checkpoint mark.
+        self._since_ckpt: dict[str, float] = dict.fromkeys(
+            _RECOMPUTE_CATEGORIES, 0.0
+        )
+        # Step-progress state: per-task train_steps_total, and the
+        # last time progress surfaced as a lifecycle event.
+        self._steps: dict[str, float] = {}
+        self._progress_event_ms: int | None = None
+
+    # -- feeding -----------------------------------------------------------
+    def seed_start(self, ts_ms: int) -> None:
+        """Anchor the ledger at the job's birth (the coordinator's
+        ``started_ms``), before any event lands: the sliver between
+        construction and the first lifecycle event is real wall time
+        and belongs to ``staging`` (coordinator prep), so the category
+        sum matches the terminal record's ``wall_ms`` exactly."""
+        with self._lock:
+            if self._first_ms is None:
+                self._first_ms = self._last_ms = int(ts_ms)
+                self._phase = "staging"
+
+    def _advance_to(self, ts_ms: int) -> None:
+        """Attribute the elapsed interval to the current phase (caller
+        holds the lock). Out-of-order timestamps clamp to zero elapsed —
+        duplicated or reordered events must never make a category sum
+        exceed wall clock."""
+        if self._first_ms is None:
+            self._first_ms = self._last_ms = int(ts_ms)
+            return
+        ts_ms = max(int(ts_ms), self._last_ms)
+        if self._phase is not None:
+            dt = (ts_ms - self._last_ms) / 1000.0
+            if dt > 0:
+                self._seconds[self._phase] += dt
+                if self._phase in self._since_ckpt:
+                    self._since_ckpt[self._phase] += dt
+        self._last_ms = ts_ms
+
+    def observe_event(self, event: Mapping[str, Any]) -> None:
+        """Fold one lifecycle event. Unknown kinds only advance the
+        clock; the transition table above owns phase changes."""
+        kind = event.get("kind")
+        ts = event.get("ts_ms")
+        if not isinstance(kind, str) or not isinstance(ts, (int, float)):
+            return
+        with self._lock:
+            if self._finalized:
+                return
+            self._advance_to(int(ts))
+            if kind == "session_started":
+                # A fresh session recomputes nothing from previous ones
+                # beyond what the failure transfer already charged — and
+                # its processes' step counters restart, so the previous
+                # session's totals must not mask the re-run's advances
+                # (a restart from step 0 counting 1, 2, 3… would never
+                # exceed a stale total of 500 and the whole re-run would
+                # misread as compile).
+                for c in self._since_ckpt:
+                    self._since_ckpt[c] = 0.0
+                self._steps.clear()
+                self._progress_event_ms = None
+                self._phase = "provisioning"
+            elif kind == "checkpoint_progress":
+                for c in self._since_ckpt:
+                    self._since_ckpt[c] = 0.0
+            elif kind == "session_finished":
+                status = str(event.get("status", ""))
+                if status == "FAILED":
+                    self._transfer_wasted()
+                    self._phase = "provisioning"  # backoff / relaunch
+                elif status:  # SUCCEEDED / KILLED
+                    self._phase = "teardown"
+            elif kind == "job_preempted":
+                self._transfer_wasted()
+                self._phase = "preempted"
+            elif kind == "health_alert":
+                if (
+                    event.get("detector") in self._stalled_detectors
+                    and self._phase in ("productive", "compile")
+                ):
+                    self._phase = "stalled"
+            elif kind == "task_registered":
+                # Only the FIRST registration opens the rendezvous wait;
+                # later ones while training (a re-registering task) must
+                # not rewind a productive phase.
+                if self._phase in ("provisioning", "staging", "queued",
+                                   None):
+                    self._phase = "rendezvous"
+            elif kind in _PHASE_AFTER_EVENT:
+                self._phase = _PHASE_AFTER_EVENT[kind]
+
+    def observe_steps(self, task_id: str, steps_total: float,
+                      ts_ms: int | None = None) -> bool:
+        """One task's cumulative ``train_steps_total``. An advance is the
+        productive signal: it closes the ``compile`` window and ends a
+        ``stalled`` episode. Returns True when the caller should surface
+        this advance as a ``train_progress`` lifecycle event (first
+        advance of the session, then throttled) so replays of
+        events.jsonl alone can attribute productive time too."""
+        ts = int(ts_ms if ts_ms is not None else self._clock_ms())
+        with self._lock:
+            if self._finalized:
+                return False
+            prev = self._steps.get(task_id)
+            self._steps[task_id] = float(steps_total)
+            if prev is not None and steps_total <= prev:
+                self._advance_to(ts)
+                return False
+            if prev is None and steps_total <= 0:
+                return False
+            self._advance_to(ts)
+            if self._phase in ("compile", "stalled", "productive"):
+                self._phase = "productive"
+            emit = (
+                self._progress_event_ms is None
+                or ts - self._progress_event_ms
+                >= PROGRESS_EVENT_INTERVAL_MS
+            )
+            if emit:
+                self._progress_event_ms = ts
+            return emit
+
+    def observe_checkpoint(self, ts_ms: int | None = None) -> None:
+        """A complete checkpoint landed: work up to now will never be
+        recomputed."""
+        ts = int(ts_ms if ts_ms is not None else self._clock_ms())
+        with self._lock:
+            if self._finalized:
+                return
+            self._advance_to(ts)
+            for c in self._since_ckpt:
+                self._since_ckpt[c] = 0.0
+
+    def _transfer_wasted(self) -> None:
+        """Move since-checkpoint compile/productive/stalled seconds into
+        ``wasted_by_failure`` (caller holds the lock). Exclusivity is
+        preserved: the seconds change category, never double-count."""
+        for c in _RECOMPUTE_CATEGORIES:
+            amount = self._since_ckpt[c]
+            if amount > 0:
+                self._seconds[c] -= amount
+                self._seconds["wasted_by_failure"] += amount
+                self._since_ckpt[c] = 0.0
+
+    def finalize(self, ts_ms: int | None = None) -> None:
+        """Close the ledger at ``ts_ms`` (default: the last observed
+        event). Further observations are ignored — the terminal record
+        must not keep growing after it is persisted."""
+        with self._lock:
+            if self._finalized:
+                return
+            if ts_ms is not None:
+                self._advance_to(int(ts_ms))
+            self._finalized = True
+
+    # -- views -------------------------------------------------------------
+    def breakdown(self, now_ms: int | None = None) -> dict[str, float]:
+        """Seconds per category, including the still-open phase extended
+        to ``now_ms`` (live views) without mutating the ledger."""
+        with self._lock:
+            out = dict(self._seconds)
+            if (
+                not self._finalized
+                and self._phase is not None
+                and self._last_ms is not None
+            ):
+                now = int(now_ms if now_ms is not None else self._clock_ms())
+                if now > self._last_ms:
+                    out[self._phase] += (now - self._last_ms) / 1000.0
+            return out
+
+    def wall_seconds(self, now_ms: int | None = None) -> float:
+        return sum(self.breakdown(now_ms).values())
+
+    def ratio(self, now_ms: int | None = None) -> float:
+        b = self.breakdown(now_ms)
+        total = sum(b.values())
+        return (b["productive"] / total) if total > 0 else 0.0
+
+    def to_json(self, now_ms: int | None = None) -> dict[str, Any]:
+        b = self.breakdown(now_ms)
+        total = sum(b.values())
+        with self._lock:
+            phase = self._phase
+            first = self._first_ms
+            last = self._last_ms
+        return {
+            "chips": self.chips,
+            "phase": phase,
+            "started_ms": first,
+            "updated_ms": last,
+            "wall_s": round(total, 3),
+            "ratio": round((b["productive"] / total) if total else 0.0, 4),
+            "categories": {c: round(b[c], 3) for c in CATEGORIES},
+            "chip_seconds": {
+                c: round(b[c] * self.chips, 3) for c in CATEGORIES
+            },
+        }
+
+    def publish(self, registry) -> None:
+        """Set the goodput gauges on ``registry`` (chip-seconds per
+        category + the productive ratio)."""
+        b = self.breakdown()
+        for c in CATEGORIES:
+            registry.gauge(
+                GOODPUT_SECONDS_GAUGE,
+                "chip-seconds of job wall time per goodput category",
+                labels={"category": c},
+            ).set(b[c] * self.chips)
+        registry.gauge(
+            GOODPUT_RATIO_GAUGE, "productive fraction of chip time"
+        ).set(self.ratio())
+
+    @classmethod
+    def from_events(
+        cls,
+        events: Iterable[Mapping[str, Any]],
+        chips: int = 1,
+        finalize: bool = True,
+    ) -> "GoodputLedger":
+        """Replay a (possibly torn, duplicated, or reordered)
+        events.jsonl stream. Events are sorted by timestamp first —
+        a reordered log must produce the same breakdown as the ordered
+        one — and the ledger is finalized at the last event, so the
+        categories sum exactly to the log's wall span."""
+        usable = [
+            e for e in events
+            if isinstance(e, Mapping)
+            and isinstance(e.get("ts_ms"), (int, float))
+            and isinstance(e.get("kind"), str)
+        ]
+        usable.sort(key=lambda e: e["ts_ms"])
+        ledger = cls(chips=chips)
+        for e in usable:
+            ledger.observe_event(e)
+        if finalize:
+            ledger.finalize()
+        return ledger
+
+
+class FleetGoodput:
+    """Scheduler-side per-tenant chip-second aggregation: every finished
+    (or preempted) attempt's ledger totals fold in, plus the queue wait
+    the daemon itself measured. Serialized into scheduler-state.json and
+    published as the fleet's goodput gauges on the daemon's /metrics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tenants: dict[str, dict[str, float]] = {}
+
+    def add(
+        self,
+        tenant: str,
+        chip_seconds: Mapping[str, Any] | None,
+        queued_chip_s: float = 0.0,
+    ) -> None:
+        with self._lock:
+            acct = self._tenants.setdefault(
+                tenant, dict.fromkeys(CATEGORIES, 0.0)
+            )
+            for c in CATEGORIES:
+                try:
+                    acct[c] += float((chip_seconds or {}).get(c, 0.0))
+                except (TypeError, ValueError):
+                    continue
+            if queued_chip_s > 0:
+                acct["queued"] += float(queued_chip_s)
+
+    def fleet(self) -> dict[str, float]:
+        with self._lock:
+            out = dict.fromkeys(CATEGORIES, 0.0)
+            for acct in self._tenants.values():
+                for c in CATEGORIES:
+                    out[c] += acct[c]
+            return out
+
+    def to_json(self) -> dict[str, Any]:
+        fleet = self.fleet()
+        total = sum(fleet.values())
+        with self._lock:
+            tenants = {
+                t: {c: round(v, 3) for c, v in acct.items()}
+                for t, acct in sorted(self._tenants.items())
+            }
+        return {
+            "fleet_chip_seconds": {c: round(fleet[c], 3) for c in CATEGORIES},
+            "ratio": round(
+                (fleet["productive"] / total) if total else 0.0, 4
+            ),
+            "tenants": tenants,
+        }
+
+    def publish(self, registry) -> None:
+        fleet = self.fleet()
+        total = sum(fleet.values())
+        for c in CATEGORIES:
+            registry.gauge(
+                GOODPUT_SECONDS_GAUGE,
+                "fleet chip-seconds per goodput category",
+                labels={"category": c},
+            ).set(fleet[c])
+        registry.gauge(
+            GOODPUT_RATIO_GAUGE, "productive fraction of fleet chip time"
+        ).set((fleet["productive"] / total) if total else 0.0)

@@ -343,6 +343,23 @@ def json_safe(obj: Any) -> Any:
     return obj
 
 
+def histogram_quantile(snapshot: Mapping[str, Any], q: float) -> float | None:
+    """Upper-bound estimate of quantile ``q`` from a histogram snapshot
+    (``{"count", "sum", "buckets": [[le, cumulative], ...]}``): the
+    bound of the first bucket whose cumulative count crosses the target
+    rank. Observations past the last bound (the +Inf bucket) fall back
+    to the mean so the readout stays finite. None when empty."""
+    count = int(snapshot.get("count", 0) or 0)
+    if count <= 0:
+        return None
+    target = q * count
+    for bound, cum in snapshot.get("buckets") or []:
+        if cum >= target:
+            return float(bound)
+    total = float(snapshot.get("sum", 0.0) or 0.0)
+    return total / count
+
+
 def load_snapshot_file(path: str | os.PathLike[str]) -> dict[str, Any] | None:
     """Read a published snapshot; None when absent or (transiently)
     malformed — a missing snapshot must never fail a heartbeat."""

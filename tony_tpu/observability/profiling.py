@@ -1,0 +1,499 @@
+"""On-demand distributed profiling — capture a window of every task's
+device state without restarting the job.
+
+The PR-3 trace answers "where did the *control plane* spend its time";
+this module answers "what are the *chips* doing right now". Two pieces:
+
+* **Continuous device-memory telemetry** —
+  ``start_device_memory_monitor`` samples ``jax.local_devices()``
+  ``memory_stats()`` (bytes_in_use / peak_bytes_in_use / bytes_limit)
+  on a daemon thread into ``tony_device_hbm_bytes{device=,kind=}``
+  gauges in the default registry. The snapshot rides the heartbeat
+  piggyback like every other metric, so the coordinator's ``/metrics``
+  shows per-task HBM pressure *before* an OOM-adjacent job dies.
+  Started from ``runtime.initialize()`` when the executor exported
+  ``TONY_PROFILE_HBM_INTERVAL_MS``; a no-op without jax.
+
+* **On-demand capture** — ``POST /api/profile`` (or the
+  ``request_profile`` RPC) makes the coordinator's ``ProfileBroker``
+  fan a capture request out to every live task on the heartbeat
+  channel it already owns: the heartbeat *reply* carries the command
+  (zero new RPCs executor-side), the executor's ``ExecutorProfiler``
+  runs a bounded capture on a background thread — a device-memory
+  snapshot plus, when jax is already loaded in that process, a
+  ``jax.profiler`` trace of the window — writes the artifact into the
+  job scratch dir
+  (``profile-<task>-s<session>-<req>.json`` beside the task logs, where
+  the coordinator's stop() persists it to history alongside the Chrome
+  trace), and ships the summary back on its next heartbeat's optional
+  ``profile`` arg. ``tony profile <app_id> [--duration-ms]`` drives the
+  whole round trip.
+
+Captures degrade, never fail: no jax (or a CPU backend with no
+``memory_stats``) falls back to a host-process snapshot (max RSS), so a
+jax-free mini-cluster still proves the full fan-out/collect path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Iterable, Mapping
+
+log = logging.getLogger(__name__)
+
+# Declared metric name (TONY-M001/M002): continuous HBM gauge family.
+HBM_GAUGE = "tony_device_hbm_bytes"
+
+# memory_stats keys worth publishing, stats-key -> label value.
+_HBM_KINDS = {
+    "bytes_in_use": "bytes_in_use",
+    "peak_bytes_in_use": "peak_bytes_in_use",
+    "bytes_limit": "bytes_limit",
+}
+
+PROFILE_FILE_PREFIX = "profile-"
+# Capture windows are bounded: a typo'd duration must not hold a trace
+# open (and the profiler buffers growing) for an hour.
+MAX_DURATION_MS = 60_000
+DEFAULT_DURATION_MS = 2_000
+
+
+def clamp_duration_ms(duration_ms: Any,
+                      default: int = DEFAULT_DURATION_MS) -> int:
+    try:
+        d = int(duration_ms)
+    except (TypeError, ValueError):
+        return default
+    return max(1, min(d, MAX_DURATION_MS))
+
+
+def _imported_jax():
+    """jax, but ONLY when this process already imported it — the
+    telemetry paths must never pull a multi-second import in
+    themselves."""
+    import sys
+
+    return sys.modules.get("jax")
+
+
+def _loaded_jax():
+    """jax, but ONLY when this process already imported it AND
+    initialized a device backend. The capture path must never bring the
+    runtime up itself: an executor is a lightweight supervisor whose
+    heartbeats a multi-second jax import would stall, device state
+    lives in the USER process anyway (a fresh backend here would see
+    nothing), and initializing an XLA client on a capture thread while
+    the main thread forks user processes is a measured SIGSEGV. A
+    process that actually computes on devices has the backend up;
+    everyone else ships the host fallback."""
+    jax = _imported_jax()
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            return None
+    except Exception:
+        return None
+    return jax
+
+
+def capture_snapshot() -> dict[str, Any]:
+    """Device-memory snapshot: per-device HBM stats via jax when it is
+    ALREADY loaded in this process AND reports memory_stats (TPU/GPU);
+    otherwise a host fallback (max RSS) so the capture path always
+    returns evidence."""
+    snap: dict[str, Any] = {"ts_ms": int(time.time() * 1000)}
+    devices = []
+    try:
+        jax = _loaded_jax()
+        if jax is None:
+            raise ImportError("jax not loaded in this process")
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:  # backend without memory introspection
+                stats = None
+            entry: dict[str, Any] = {
+                "id": int(getattr(d, "id", len(devices))),
+                "platform": str(getattr(d, "platform", "unknown")),
+            }
+            if isinstance(stats, Mapping):
+                for key in _HBM_KINDS:
+                    if key in stats:
+                        entry[key] = int(stats[key])
+            devices.append(entry)
+    except Exception:
+        devices = []
+    if any(len(d) > 2 for d in devices):
+        snap["source"] = "jax"
+        snap["devices"] = devices
+    else:
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        snap["source"] = "host"
+        snap["devices"] = devices
+        # ru_maxrss is KiB on Linux, bytes on macOS; normalize to bytes
+        # assuming Linux (the deployment substrate).
+        snap["host"] = {"max_rss_bytes": int(usage.ru_maxrss) * 1024}
+    return snap
+
+
+def user_process_hbm(metrics_snapshot: Mapping[str, Any] | None,
+                     ) -> dict[str, float]:
+    """The USER process's latest published ``tony_device_hbm_bytes``
+    gauges, lifted out of a metrics snapshot (the file the executor
+    already reads for the heartbeat piggyback). This is how an
+    executor-side capture reports real device memory on TPU: the
+    supervisor process never loads jax, but the continuous HBM monitor
+    in the user process publishes the device truth every few seconds."""
+    if not isinstance(metrics_snapshot, Mapping):
+        return {}
+    gauges = metrics_snapshot.get("gauges")
+    if not isinstance(gauges, Mapping):
+        return {}
+    out: dict[str, float] = {}
+    for key, value in gauges.items():
+        if str(key).startswith(HBM_GAUGE + "{"):
+            try:
+                out[str(key)] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def run_capture(
+    req_id: str,
+    duration_ms: int,
+    out_dir: "str | os.PathLike[str] | None",
+    task_id: str,
+    session_id: str = "0",
+    metrics_source=None,
+) -> dict[str, Any]:
+    """Execute one capture request: memory snapshot, bounded
+    ``jax.profiler`` trace when jax is available, artifact written
+    atomically into ``out_dir``. Returns the summary that rides the
+    heartbeat back to the coordinator. ``metrics_source`` (the
+    executor's heartbeat metrics callable) contributes the user
+    process's published device-HBM gauges — the device truth on
+    platforms where this process itself never loads jax."""
+    duration_ms = clamp_duration_ms(duration_ms)
+    summary: dict[str, Any] = {
+        "req_id": str(req_id),
+        "task": task_id,
+        "ts_ms": int(time.time() * 1000),
+        "duration_ms": duration_ms,
+    }
+    trace_dir = None
+    traced = False
+    if out_dir is not None:
+        trace_dir = Path(out_dir) / f"profile-trace-{_safe(task_id)}-{_safe(req_id)}"
+    try:
+        jax = _loaded_jax()
+        if jax is not None and trace_dir is not None:
+            trace_dir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(trace_dir))
+            try:
+                time.sleep(duration_ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+            traced = True
+    except Exception as exc:
+        # The profiler can be unavailable on a backend even with jax
+        # loaded: the memory snapshot below is still worth shipping.
+        summary["trace_error"] = f"{type(exc).__name__}: {exc}"
+    snap = capture_snapshot()
+    if metrics_source is not None:
+        try:
+            hbm = user_process_hbm(metrics_source())
+        except Exception:
+            hbm = {}
+        if hbm:
+            snap["user_device_hbm_bytes"] = hbm
+    summary["snapshot"] = snap
+    summary["trace_dir"] = str(trace_dir) if traced else None
+    if out_dir is not None:
+        name = (f"{PROFILE_FILE_PREFIX}{_safe(task_id)}"
+                f"-s{_safe(str(session_id))}-{_safe(req_id)}.json")
+        try:
+            out = Path(out_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            tmp = out / f".{name}.tmp"
+            tmp.write_text(json.dumps(summary, sort_keys=True) + "\n")
+            os.replace(tmp, out / name)
+            summary["artifact"] = name
+        except OSError:
+            log.warning("could not persist profile artifact", exc_info=True)
+    return summary
+
+
+def _safe(raw: str) -> str:
+    return "".join(c if c.isalnum() or c in "._" else "_" for c in str(raw))
+
+
+def find_profiles(*dirs: "str | os.PathLike[str] | None") -> list[Path]:
+    """Every persisted ``profile-*.json`` artifact under the given dirs
+    (the coordinator persists these into job history at stop, the way it
+    persists blackboxes)."""
+    out: list[Path] = []
+    for d in dirs:
+        if d is None:
+            continue
+        root = Path(d)
+        if not root.is_dir():
+            continue
+        out.extend(sorted(
+            p for p in root.glob(f"{PROFILE_FILE_PREFIX}*.json")
+            if p.is_file()
+        ))
+    return out
+
+
+class ProfileBroker:
+    """Coordinator-side fan-out state for one capture request at a time.
+
+    ``start()`` arms a request for a set of task ids; ``command_for``
+    hands each task its command exactly once (piggybacked on the
+    heartbeat REPLY); ``record_result`` collects the summaries the
+    executors ship back on the heartbeat's optional ``profile`` arg.
+    A new ``start`` supersedes an unfinished request — the operator
+    asking again IS the retry path."""
+
+    def __init__(self, clock_ms=None) -> None:
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self._lock = threading.Lock()
+        self._req_id: str | None = None
+        self._req_seq = 0
+        self._duration_ms = DEFAULT_DURATION_MS
+        self._started_ms: int | None = None
+        # task -> "pending" | "delivered" | "captured" | "failed"
+        self._state: dict[str, str] = {}
+        self._summaries: dict[str, dict[str, Any]] = {}
+
+    def start(self, tasks: Iterable[str],
+              duration_ms: int | None = None) -> str:
+        with self._lock:
+            self._started_ms = self._clock_ms()
+            # Sequence suffix: two start() calls in the same clock
+            # millisecond must mint DISTINCT ids, or executors that
+            # served the first request would dedupe the second away.
+            self._req_seq += 1
+            self._req_id = f"prof-{self._started_ms}-{self._req_seq}"
+            self._duration_ms = clamp_duration_ms(
+                duration_ms, DEFAULT_DURATION_MS
+            )
+            self._state = {t: "pending" for t in tasks}
+            self._summaries = {}
+            return self._req_id
+
+    def command_for(self, task_id: str) -> dict[str, Any] | None:
+        """The piggyback payload for one task's next heartbeat reply;
+        None once delivered (or when no request is armed)."""
+        with self._lock:
+            if self._req_id is None:
+                return None
+            if self._state.get(task_id) != "pending":
+                return None
+            self._state[task_id] = "delivered"
+            return {
+                "profile": {
+                    "req_id": self._req_id,
+                    "duration_ms": self._duration_ms,
+                }
+            }
+
+    def record_result(self, task_id: str,
+                      summary: Mapping[str, Any] | None) -> "str | None":
+        """Record one task's shipped summary; returns the state it was
+        recorded under ("captured"/"failed") or None when the result
+        was fenced as stale — the caller emits a lifecycle event only
+        for what was actually recorded."""
+        if not isinstance(summary, Mapping):
+            return None
+        with self._lock:
+            if self._req_id is None or \
+                    summary.get("req_id") != self._req_id:
+                return None  # stale result from a superseded request
+            # A summary without a snapshot is the executor saying the
+            # capture DIED — it must read as failed, not as a success
+            # with no evidence (the CLI exits nonzero on it).
+            state = (
+                "captured" if isinstance(summary.get("snapshot"), Mapping)
+                else "failed"
+            )
+            self._state[task_id] = state
+            self._summaries[task_id] = dict(summary)
+            return state
+
+    _TERMINAL_STATES = ("captured", "failed")
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "req_id": self._req_id,
+                "duration_ms": self._duration_ms,
+                "started_ms": self._started_ms,
+                # done = every task reached a terminal state (a FAILED
+                # capture must not hang the CLI's poll forever).
+                "done": bool(self._state) and all(
+                    s in self._TERMINAL_STATES
+                    for s in self._state.values()
+                ),
+                "tasks": {
+                    t: {
+                        "state": state,
+                        "summary": self._summaries.get(t),
+                    }
+                    for t, state in sorted(self._state.items())
+                },
+            }
+
+
+class ExecutorProfiler:
+    """Executor-side capture agent: dedupes request ids, runs each
+    capture on a daemon thread (a trace window must never delay a
+    heartbeat), and hands the finished summary to exactly one heartbeat
+    via ``take_result``."""
+
+    def __init__(self, task_id: str,
+                 out_dir: "str | os.PathLike[str] | None",
+                 session_id: str = "0",
+                 metrics_source=None) -> None:
+        self.task_id = task_id
+        self.out_dir = out_dir
+        self.session_id = session_id
+        # The heartbeat metrics callable: captures lift the user
+        # process's published HBM gauges from it (see user_process_hbm).
+        self.metrics_source = metrics_source
+        self._lock = threading.Lock()
+        self._seen: set[str] = set()
+        self._latest_req: str | None = None
+        self._pending: dict[str, Any] | None = None
+
+    def handle_command(self, reply: Mapping[str, Any] | None) -> bool:
+        """Inspect one heartbeat reply; start a capture when it carries
+        a fresh profile command. Returns True when a capture started."""
+        if not isinstance(reply, Mapping):
+            return False
+        cmd = reply.get("profile")
+        if not isinstance(cmd, Mapping):
+            return False
+        req_id = str(cmd.get("req_id") or "")
+        if not req_id:
+            return False
+        with self._lock:
+            if req_id in self._seen:
+                return False
+            self._seen.add(req_id)
+            self._latest_req = req_id
+        duration_ms = clamp_duration_ms(cmd.get("duration_ms"))
+        threading.Thread(
+            target=self._capture, args=(req_id, duration_ms),
+            name=f"profile-{req_id}", daemon=True,
+        ).start()
+        return True
+
+    def _capture(self, req_id: str, duration_ms: int) -> None:
+        try:
+            summary = run_capture(
+                req_id, duration_ms, self.out_dir, self.task_id,
+                session_id=self.session_id,
+                metrics_source=self.metrics_source,
+            )
+        except Exception:  # capture must never take the executor down
+            log.warning("profile capture failed", exc_info=True)
+            summary = {
+                "req_id": req_id, "task": self.task_id,
+                "ts_ms": int(time.time() * 1000), "error": "capture failed",
+            }
+        with self._lock:
+            # A superseded long capture finishing late must not clobber
+            # the CURRENT request's unshipped summary (the broker would
+            # fence the stale req_id and the fresh result would be lost
+            # forever) — re-arming IS the operator's retry path.
+            if req_id == self._latest_req or self._pending is None:
+                self._pending = summary
+
+    def take_result(self) -> dict[str, Any] | None:
+        """One-shot: the finished summary for the next heartbeat (then
+        cleared — the coordinator records it idempotently anyway)."""
+        with self._lock:
+            result, self._pending = self._pending, None
+            return result
+
+
+_hbm_monitor_started = False
+_hbm_lock = threading.Lock()
+
+
+def start_device_memory_monitor(
+    registry=None, interval_s: float = 5.0,
+) -> "threading.Thread | None":
+    """Publish per-device HBM gauges continuously (daemon thread).
+    No-op (returns None) when jax is unavailable or the backend exposes
+    no memory_stats; idempotent per process."""
+    global _hbm_monitor_started
+    try:
+        # Imported-only (not backend-ready): this runs on the MAIN
+        # thread of the jax process at runtime.initialize(), where
+        # bringing the backend up is the normal course of events.
+        jax = _imported_jax()
+        if jax is None:
+            return None
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    if not devices:
+        return None
+    try:
+        has_stats = isinstance(devices[0].memory_stats(), Mapping)
+    except Exception:
+        has_stats = False
+    if not has_stats:
+        return None
+    with _hbm_lock:
+        if _hbm_monitor_started:
+            return None
+        _hbm_monitor_started = True
+    if registry is None:
+        from tony_tpu.observability.metrics import default_registry
+
+        registry = default_registry()
+
+    def sample() -> None:
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                continue
+            if not isinstance(stats, Mapping):
+                continue
+            for key, kind in _HBM_KINDS.items():
+                if key in stats:
+                    registry.gauge(
+                        HBM_GAUGE, "per-device HBM usage",
+                        labels={"device": str(getattr(d, "id", "?")),
+                                "kind": kind},
+                    ).set(float(stats[key]))
+
+    def loop() -> None:
+        while True:
+            try:
+                sample()
+                registry.flush()
+            except Exception:  # telemetry must never crash the trainer
+                log.debug("hbm sample failed", exc_info=True)
+            time.sleep(max(interval_s, 0.5))
+
+    sample()  # first sample synchronously: gauges exist before step 1
+    t = threading.Thread(target=loop, name="hbm-monitor", daemon=True)
+    t.start()
+    return t

@@ -18,6 +18,9 @@ log = logging.getLogger(__name__)
 
 _BUF = 65536
 
+# Declared metric name (TONY-M001/M002): labeled {direction=up|down}.
+PROXY_BYTES_COUNTER = "tony_proxy_bytes_total"
+
 # Default per-attempt upstream connect timeout, seconds; deployments
 # tune it via ``tony.proxy.connect-timeout`` (ms) — the CLI threads the
 # conf value through ``connect_timeout_s``.
@@ -52,11 +55,11 @@ class ProxyServer:
             obs_metrics.default_registry()
         )
         self._bytes_up = reg.counter(
-            "tony_proxy_bytes_total", "bytes pumped through the tunnel",
+            PROXY_BYTES_COUNTER, "bytes pumped through the tunnel",
             labels={"direction": "up"},
         )
         self._bytes_down = reg.counter(
-            "tony_proxy_bytes_total", "bytes pumped through the tunnel",
+            PROXY_BYTES_COUNTER, "bytes pumped through the tunnel",
             labels={"direction": "down"},
         )
 

@@ -138,13 +138,20 @@ class ApplicationRpcClient(ApplicationRpc):
         task_id: str,
         session_id: str,
         metrics: Mapping[str, Any] | None = None,
-    ) -> None:
-        # The optional arg stays off the wire when absent: pings without
-        # telemetry (and pre-metrics peers) keep the 2-arg frame.
+        profile: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any] | None:
+        # The optional args stay off the wire when absent: pings without
+        # telemetry (and pre-metrics peers) keep the 2-arg frame. The
+        # return value may carry a coordinator command (profile fan-out).
         args: dict[str, Any] = {"task_id": task_id, "session_id": session_id}
         if metrics is not None:
             args["metrics"] = dict(metrics)
+        if profile is not None:
+            args["profile"] = dict(profile)
         return self._call("task_executor_heartbeat", **args)
+
+    def request_profile(self, duration_ms: int) -> dict[str, Any]:
+        return self._call("request_profile", duration_ms=int(duration_ms))
 
     def get_application_status(self) -> dict[str, Any]:
         return self._call("get_application_status")

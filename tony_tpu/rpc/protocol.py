@@ -78,7 +78,8 @@ class ApplicationRpc(abc.ABC):
         task_id: str,
         session_id: str,
         metrics: Mapping[str, Any] | None = None,
-    ) -> None:
+        profile: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any] | None:
         """``session_id`` fences stale pings: an executor from a previous
         (failed, being-torn-down) session must not feed the retried
         session's liveness monitor.
@@ -86,7 +87,22 @@ class ApplicationRpc(abc.ABC):
         ``metrics`` (optional) piggybacks the executor's latest metrics
         snapshot (``observability.metrics`` schema) on the ping it
         already sends — the telemetry plane costs zero extra RPCs. A
-        ping without it is a plain liveness signal."""
+        ping without it is a plain liveness signal.
+
+        ``profile`` (optional) ships a finished on-demand capture
+        summary back (``observability.profiling`` schema). The RETURN
+        value is the other half of the same channel: None for a plain
+        ack, or a command payload (currently ``{"profile": {...}}``)
+        the coordinator wants this executor to act on — fan-out without
+        a coordinator→executor connection."""
+
+    @abc.abstractmethod
+    def request_profile(self, duration_ms: int) -> dict[str, Any]:
+        """Arm an on-demand distributed profile capture: every live
+        task's next heartbeat reply carries the capture command, and
+        results flow back on the heartbeat's ``profile`` arg. Returns
+        ``{"req_id": ...}``. Client-role only (``tony profile`` /
+        ``POST /api/profile`` drive it)."""
 
     @abc.abstractmethod
     def get_application_status(self) -> dict[str, Any]:
@@ -107,7 +123,9 @@ RPC_METHODS: dict[str, tuple[str, ...]] = {
     "register_tensorboard_url": ("spec", "url"),
     "register_execution_result": ("exit_code", "job_name", "job_index", "session_id"),
     "finish_application": (),
-    "task_executor_heartbeat": ("task_id", "session_id", "metrics"),
+    "task_executor_heartbeat": ("task_id", "session_id", "metrics",
+                                "profile"),
+    "request_profile": ("duration_ms",),
     "get_application_status": (),
 }
 
@@ -117,5 +135,5 @@ RPC_METHODS: dict[str, tuple[str, ...]] = {
 # analysis/protocol_check (TONY-P001/P003), so optional args cannot drift
 # into silently-required ones.
 RPC_OPTIONAL_ARGS: dict[str, tuple[str, ...]] = {
-    "task_executor_heartbeat": ("metrics",),
+    "task_executor_heartbeat": ("metrics", "profile"),
 }

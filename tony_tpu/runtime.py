@@ -99,6 +99,20 @@ def initialize(**kwargs) -> TaskContext:
             process_id=ctx.process_id,
             **kwargs,
         )
+    # Continuous device-memory telemetry: per-device HBM gauges sampled
+    # on a daemon thread into the default registry, so the snapshot that
+    # already rides heartbeats shows memory pressure BEFORE an OOM. A
+    # no-op without jax or on backends with no memory introspection.
+    hbm_ms = os.environ.get(constants.TONY_PROFILE_HBM_INTERVAL_MS)
+    if hbm_ms and hbm_ms != "0":
+        from tony_tpu.observability.profiling import (
+            start_device_memory_monitor,
+        )
+
+        try:
+            start_device_memory_monitor(interval_s=int(hbm_ms) / 1000.0)
+        except (ValueError, TypeError):
+            pass
     return ctx
 
 

@@ -14,7 +14,9 @@ Routes::
     POST /api/kill     {"job_id": ...} -> {"ok": bool}
     GET  /api/state    -> {queue, queue_depth, jobs, pool, ts_ms}
     GET  /api/jobs     -> {"jobs": [...]}
-    GET  /api/queue    -> {"queue": [...], "queue_depth": n}
+    GET  /api/queue    -> {"queue": [...], "queue_depth": n,
+                           "queue_wait_ms": {count, p50_ms, p95_ms}}
+    GET  /api/goodput  -> fleet + per-tenant chip-second accounts
     GET  /api/pool     -> {"pool": [...]}
     GET  /api/job/<id> -> one job record
     GET  /metrics      -> Prometheus text
@@ -118,7 +120,10 @@ class SchedulerHttpServer:
                         self._reply(200, {
                             "queue": state["queue"],
                             "queue_depth": state["queue_depth"],
+                            "queue_wait_ms": state["queue_wait_ms"],
                         })
+                    elif self.path == "/api/goodput":
+                        self._reply(200, d.goodput.to_json())
                     elif self.path == "/api/pool":
                         self._reply(200, {"pool": d.pool.to_json()})
                     elif self.path.startswith("/api/job/"):

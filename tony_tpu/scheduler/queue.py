@@ -19,6 +19,17 @@ from typing import Any, Callable, Mapping
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
 
+# Declared metric name (TONY-M001/M002): time-in-queue recorded at pop —
+# the first goodput category users see, served as p50/p95 on /api/queue
+# and the history server's /scheduler panel.
+QUEUE_WAIT_HISTOGRAM = "tony_sched_queue_wait_ms"
+# Queue waits span "instant warm pop" to "parked behind a full pool for
+# most of an hour" — ms-scale buckets with a long tail.
+QUEUE_WAIT_BUCKETS = (
+    10.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 15000.0,
+    60000.0, 300000.0, 1800000.0,
+)
+
 
 class JobState(enum.Enum):
     QUEUED = "QUEUED"
@@ -56,6 +67,17 @@ class SchedJob:
     attempts: int = 0
     preemptions: int = 0
     resume_step: int | None = None
+    # Queue-wait accounting: when the job last ENTERED the queue (set at
+    # submit and every requeue), and the cumulative wait across its
+    # queue episodes — the daemon folds this into the job's goodput
+    # `queued` category when the attempt finishes. An episode that began
+    # with a preemption requeue accrues into ``preempted_wait_total_ms``
+    # instead (that gap is preemption cost, not queue latency — the
+    # goodput table promises `preempted` = preemption → relaunch).
+    queued_ms: int = 0
+    queue_wait_total_ms: int = 0
+    preempted_wait_total_ms: int = 0
+    requeued_by_preemption: bool = False
     diagnostics: str = ""
     app_ids: list[str] = field(default_factory=list)
     finished_ms: int | None = None
@@ -125,11 +147,18 @@ class JobQueue:
     only QUEUED jobs; callers own the rest of the state machine and hand
     jobs back via ``requeue`` on preemption."""
 
-    def __init__(self, quotas: TenantQuotas | None = None) -> None:
+    def __init__(self, quotas: TenantQuotas | None = None,
+                 registry=None, clock_ms: Callable[[], int] | None = None,
+                 ) -> None:
         self._lock = threading.Lock()
         self._queued: list[SchedJob] = []
         self._seq = 0
         self.quotas = quotas or TenantQuotas()
+        # Queue-wait telemetry: time-in-queue observed at pop into
+        # tony_sched_queue_wait_ms (registry optional — unit tests and
+        # embedded queues skip it).
+        self._registry = registry
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
 
     def submit(self, job: SchedJob) -> SchedJob:
         with self._lock:
@@ -138,6 +167,7 @@ class JobQueue:
             if not job.submit_ms:
                 job.submit_ms = int(time.time() * 1000)
             job.state = JobState.QUEUED
+            job.queued_ms = self._clock_ms()
             self._queued.append(job)
             self._sort()
         return job
@@ -149,6 +179,7 @@ class JobQueue:
         with self._lock:
             job.state = JobState.QUEUED
             job.slice_id = None
+            job.queued_ms = self._clock_ms()
             if job not in self._queued:
                 self._queued.append(job)
             self._sort()
@@ -173,6 +204,26 @@ class JobQueue:
                     continue
                 del self._queued[i]
                 job.state = JobState.LAUNCHING
+                # Time-in-queue, measured at pop (a requeued job's wait
+                # counts from its LAST enqueue). A kill-requested job is
+                # popped only to be finalized — its wait is neither a
+                # launch latency (the histogram's contract) nor billable
+                # goodput, so it records nowhere. A preemption-requeue
+                # episode accrues into the preempted account instead.
+                wait = max(self._clock_ms() - (job.queued_ms
+                                               or job.submit_ms), 0)
+                if not job.kill_requested:
+                    if job.requeued_by_preemption:
+                        job.preempted_wait_total_ms += wait
+                    else:
+                        job.queue_wait_total_ms += wait
+                    if self._registry is not None:
+                        self._registry.histogram(
+                            QUEUE_WAIT_HISTOGRAM,
+                            "time a job spent queued before each launch",
+                            buckets=QUEUE_WAIT_BUCKETS,
+                        ).observe(wait)
+                job.requeued_by_preemption = False
                 return job
         return None
 

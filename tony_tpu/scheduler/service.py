@@ -47,7 +47,11 @@ from tony_tpu.coordinator.app_master import TonyCoordinator
 from tony_tpu.coordinator.backend import LocalProcessBackend
 from tony_tpu.coordinator.session import SessionStatus
 from tony_tpu.observability import events as obs_events
-from tony_tpu.observability.metrics import MetricsRegistry
+from tony_tpu.observability.goodput import FleetGoodput
+from tony_tpu.observability.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+)
 from tony_tpu.resilience import latest_complete_step
 from tony_tpu.scheduler.pool import (
     LocalSliceProvisioner,
@@ -55,6 +59,8 @@ from tony_tpu.scheduler.pool import (
     SliceProvisioner,
 )
 from tony_tpu.scheduler.queue import (
+    QUEUE_WAIT_BUCKETS,
+    QUEUE_WAIT_HISTOGRAM,
     JobQueue,
     JobState,
     SchedJob,
@@ -102,6 +108,13 @@ class _JobRunner:
         self._thread.start()
 
     def preempt(self) -> None:
+        # preempted=True: the goodput ledger charges un-checkpointed
+        # work as recomputation debt (the relaunch re-runs it).
+        self.coordinator.kill(preempted=True)
+
+    def kill(self) -> None:
+        # Operator kill / daemon shutdown: the job is DONE — nothing
+        # recomputes, so the ledger takes no debt transfer.
         self.coordinator.kill()
 
     def _run(self) -> None:
@@ -149,7 +162,14 @@ class SchedulerDaemon:
         self.preemption_enabled = self.conf.get_bool(
             keys.K_SCHED_PREEMPTION, True
         )
-        self.queue = JobQueue(TenantQuotas.from_conf(self.conf))
+        self.queue = JobQueue(
+            TenantQuotas.from_conf(self.conf),
+            registry=self.registry, clock_ms=self._clock_ms,
+        )
+        # Fleet goodput: every finished attempt's per-job ledger (read
+        # from its final-status.json) folds into per-tenant chip-second
+        # accounts, plus the queue wait the daemon itself measured.
+        self.goodput = FleetGoodput()
         self.pool = SlicePool(
             self.base_dir / "slices",
             provisioner=provisioner or LocalSliceProvisioner(
@@ -270,7 +290,7 @@ class SchedulerDaemon:
             job.kill_requested = True
             runner = self._runners.get(job_id)
         if runner is not None:
-            runner.preempt()
+            runner.kill()
         return True
 
     # -- lifecycle -----------------------------------------------------------
@@ -297,7 +317,7 @@ class SchedulerDaemon:
             with self._lock:
                 runners = list(self._runners.values())
             for r in runners:
-                r.preempt()
+                r.kill()
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
         deadline = time.monotonic() + timeout_s
@@ -335,8 +355,36 @@ class SchedulerDaemon:
         while not self._stop.is_set():
             with self._lock:
                 counts = self._running_per_tenant_locked()
-            job = self.queue.pop_next(counts)
+            # Admission gate BEFORE the pop: with no headroom at all,
+            # popping would only requeue — and the pop records the
+            # job's time-in-queue (tony_sched_queue_wait_ms), so a
+            # full-pool tick loop must not churn pop/requeue cycles
+            # that pollute the wait histogram with tick-sized samples.
+            # Kill-requested jobs always pop: they need no slice, only
+            # finalization — a full pool must not strand them QUEUED.
+            job = self.queue.pop_next(
+                counts,
+                admit=lambda j: j.kill_requested
+                or self.pool.has_headroom(),
+            )
             if job is None:
+                if self.preemption_enabled:
+                    # Jobs may be waiting behind a full pool: see
+                    # whether a lower-priority running job should make
+                    # way for the strongest quota-eligible waiter. A
+                    # kill-requested waiter is doomed, not waiting — it
+                    # must never cost a running job its slice.
+                    waiting = [
+                        j for j in self.queue.queued()
+                        if not j.kill_requested
+                        and self.queue.quotas.admits(
+                            j.tenant, counts.get(j.tenant, 0)
+                        )
+                    ]
+                    if waiting and not self.pool.has_headroom():
+                        self._maybe_preempt(
+                            max(j.priority for j in waiting)
+                        )
                 break
             if job.kill_requested:
                 with self._lock:
@@ -356,13 +404,15 @@ class SchedulerDaemon:
                 self._launch_or_finalize(job, lease)
                 continue
             if not self.pool.has_headroom():
-                # Pool full. Requeue (original seq — head of its band),
-                # then see whether a lower-priority running job should
-                # make way.
+                # Admission raced another placement to the last slot:
+                # requeue (original seq — head of its band) and retry
+                # next tick.
                 self.queue.requeue(job)
-                if self.preemption_enabled:
-                    self._maybe_preempt(job.priority)
                 break
+            self.events.emit(
+                obs_events.SLICE_PROVISIONING, job_id=job.job_id,
+                profile=profile,
+            )
             threading.Thread(
                 target=self._provision_and_launch, args=(job, profile),
                 name=f"provision-{job.job_id}", daemon=True,
@@ -516,6 +566,14 @@ class SchedulerDaemon:
                 app_dir / constants.TONY_FINAL_CONF,
                 mode=0o600 if secure else None,
             )
+        # The app dir is shared across attempts: drop the PREVIOUS
+        # attempt's terminal record so a coordinator that crashes before
+        # writing its own can never make _accumulate_goodput re-fold the
+        # stale breakdown into the tenant accounts (double count).
+        try:
+            (app_dir / "final-status.json").unlink()
+        except OSError:
+            pass
         backend = self._backend_factory(run_conf, app_dir, app_id, lease)
         coordinator = TonyCoordinator(
             run_conf, app_dir, app_id=app_id, backend=backend,
@@ -572,10 +630,46 @@ class SchedulerDaemon:
             f" ({why})" if why else "",
         )
 
+    def _accumulate_goodput(self, job: SchedJob) -> None:
+        """Fold a finished attempt's ledger (persisted by its
+        coordinator into final-status.json) plus the queue wait the
+        daemon measured into the per-tenant chip-second accounts, and
+        refresh the fleet gauges on /metrics."""
+        chip_seconds = None
+        chips = 1
+        try:
+            final = json.loads(
+                (Path(job.app_dir) / "final-status.json").read_text()
+            )
+            g = final.get("goodput") or {}
+            chip_seconds = g.get("chip_seconds")
+            chips = max(int(g.get("chips", 1) or 1), 1)
+        except (OSError, ValueError, TypeError):
+            pass  # attempt died before stop(): queue wait still counts
+        queued_chip_s = (job.queue_wait_total_ms / 1000.0) * chips
+        job.queue_wait_total_ms = 0
+        if job.preempted_wait_total_ms:
+            # The preempt→relaunch gap the daemon measured lands in the
+            # `preempted` category, not `queued`.
+            chip_seconds = dict(chip_seconds or {})
+            chip_seconds["preempted"] = (
+                float(chip_seconds.get("preempted", 0.0) or 0.0)
+                + (job.preempted_wait_total_ms / 1000.0) * chips
+            )
+            job.preempted_wait_total_ms = 0
+        self.goodput.add(job.tenant, chip_seconds,
+                         queued_chip_s=queued_chip_s)
+        self.goodput.publish(self.registry)
+
     def _on_runner_done(self, runner: _JobRunner,
                         status: SessionStatus | None, diag: str) -> None:
         job = runner.job
         slice_id = job.slice_id
+        try:
+            self._accumulate_goodput(job)
+        except Exception:  # accounting must never wedge the state machine
+            log.warning("goodput accumulation for %s failed", job.job_id,
+                        exc_info=True)
         with self._lock:
             self._runners.pop(job.job_id, None)
             self.registry.gauge(RUNNING_JOBS_GAUGE).set(len(self._runners))
@@ -600,6 +694,10 @@ class SchedulerDaemon:
                     job.resume_step = best
                 job.preemptions += 1
                 job.slice_id = None
+                # The requeue→relaunch gap is preemption cost, not queue
+                # latency: pop_next books this episode's wait into the
+                # preempted account (the goodput `preempted` category).
+                job.requeued_by_preemption = True
                 self.queue.requeue(job)
                 self._dirty = True
                 self._cond.notify_all()
@@ -649,6 +747,23 @@ class SchedulerDaemon:
                     )
                 self._cond.wait(timeout=min(remaining, 0.5))
 
+    def queue_wait_stats(self) -> dict[str, Any]:
+        """p50/p95 time-in-queue from the tony_sched_queue_wait_ms
+        histogram — the first goodput category users see, surfaced on
+        /api/queue and the history server's /scheduler panel."""
+        snap = self.registry.histogram(
+            QUEUE_WAIT_HISTOGRAM,
+            "time a job spent queued before each launch",
+            buckets=QUEUE_WAIT_BUCKETS,
+        ).snapshot()
+        p50 = histogram_quantile(snap, 0.50)
+        p95 = histogram_quantile(snap, 0.95)
+        return {
+            "count": snap["count"],
+            "p50_ms": None if p50 is None else round(p50, 1),
+            "p95_ms": None if p95 is None else round(p95, 1),
+        }
+
     def state_json(self) -> dict[str, Any]:
         with self._lock:
             jobs = [j.to_json() for j in
@@ -660,8 +775,10 @@ class SchedulerDaemon:
             "ts_ms": self._clock_ms(),
             "queue": queued,
             "queue_depth": depth,
+            "queue_wait_ms": self.queue_wait_stats(),
             "jobs": jobs,
             "pool": self.pool.to_json(),
+            "goodput": self.goodput.to_json(),
         }
 
     def _publish_state(self) -> None:

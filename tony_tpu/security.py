@@ -48,6 +48,10 @@ METHOD_ACL: dict[str, frozenset[str]] = {
     "get_task_urls": frozenset({CLIENT_ROLE}),
     "get_application_status": frozenset({CLIENT_ROLE}),
     "finish_application": frozenset({CLIENT_ROLE}),
+    # On-demand profiling is an operator action (it costs a capture
+    # window on every chip); executors only ever ANSWER via the
+    # heartbeat's profile arg, they never initiate.
+    "request_profile": frozenset({CLIENT_ROLE}),
 }
 
 _PLACEHOLDER_SECRETS = ("", "dev")  # never acceptable as live credentials

@@ -56,6 +56,17 @@ _MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 # Rolling window for the tony_serving_tokens_per_sec gauge.
 _RATE_WINDOW_S = 5.0
 
+# Declared metric names — the tony_serving_* family (TONY-M001/M002
+# lint these module-scope constants).
+SERVING_QUEUE_DEPTH_GAUGE = "tony_serving_queue_depth"
+SERVING_ACTIVE_SLOTS_GAUGE = "tony_serving_active_slots"
+SERVING_TOKENS_PER_SEC_GAUGE = "tony_serving_tokens_per_sec"
+SERVING_TTFT_MS_HISTOGRAM = "tony_serving_ttft_ms"
+SERVING_INTER_TOKEN_MS_HISTOGRAM = "tony_serving_inter_token_ms"
+SERVING_REQUESTS_COUNTER = "tony_serving_requests_total"
+SERVING_RETIRED_COUNTER = "tony_serving_retired_total"
+SERVING_GENERATED_TOKENS_COUNTER = "tony_serving_generated_tokens_total"
+
 
 class ServingQueueFull(RuntimeError):
     """Admission backpressure: the bounded request queue is at
@@ -231,33 +242,33 @@ class ServingEngine:
         )
         self._reg = reg
         self._g_queue = reg.gauge(
-            "tony_serving_queue_depth",
+            SERVING_QUEUE_DEPTH_GAUGE,
             "requests admitted-pending (queued, not yet in a slot)",
         )
         self._g_active = reg.gauge(
-            "tony_serving_active_slots", "slots currently decoding"
+            SERVING_ACTIVE_SLOTS_GAUGE, "slots currently decoding"
         )
         self._g_rate = reg.gauge(
-            "tony_serving_tokens_per_sec",
+            SERVING_TOKENS_PER_SEC_GAUGE,
             f"generated tokens/sec over the last {_RATE_WINDOW_S:.0f}s",
         )
         self._h_ttft = reg.histogram(
-            "tony_serving_ttft_ms", "submit -> first token",
+            SERVING_TTFT_MS_HISTOGRAM, "submit -> first token",
             buckets=_MS_BUCKETS,
         )
         self._h_inter = reg.histogram(
-            "tony_serving_inter_token_ms",
+            SERVING_INTER_TOKEN_MS_HISTOGRAM,
             "decode iteration wall (== per-stream inter-token gap)",
             buckets=_MS_BUCKETS,
         )
         self._c_requests = reg.counter(
-            "tony_serving_requests_total", "requests accepted"
+            SERVING_REQUESTS_COUNTER, "requests accepted"
         )
         self._c_retired = reg.counter(
-            "tony_serving_retired_total", "requests completed"
+            SERVING_RETIRED_COUNTER, "requests completed"
         )
         self._c_tokens = reg.counter(
-            "tony_serving_generated_tokens_total", "tokens sampled"
+            SERVING_GENERATED_TOKENS_COUNTER, "tokens sampled"
         )
 
         from tony_tpu.parallel import plan as plan_lib

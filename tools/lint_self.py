@@ -76,14 +76,24 @@ def check_protocol_drift() -> list[str]:
 
 
 def check_metric_names() -> list[str]:
-    """TONY-M001 over every tree that registers metrics: the framework
-    itself, the examples, and the bench/profiling tools — they all land
-    on the same /metrics page, so one registry of names."""
-    from tony_tpu.analysis.metrics_lint import check_metric_names as check
+    """TONY-M001 + TONY-M002 over every tree that registers metrics:
+    the framework itself, the examples, and the bench/profiling tools —
+    they all land on the same /metrics page, so one registry of names,
+    each declared once as a module-scope constant and documented in
+    docs/DEPLOY.md."""
+    from tony_tpu.analysis.metrics_lint import (
+        check_declared_names,
+        check_metric_names as check,
+        parse_metric_trees,
+    )
 
     roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
              REPO / "bench.py"]
-    return [f.render() for f in check(roots)]
+    trees = parse_metric_trees(roots)  # one walk + parse for both rules
+    findings = check(roots, trees=trees) + check_declared_names(
+        roots, docs=REPO / "docs" / "DEPLOY.md", trees=trees
+    )
+    return [f.render() for f in findings]
 
 
 def check_event_drift() -> list[str]:

@@ -35,6 +35,13 @@ import numpy as np
 sys.path.insert(0, "/root/repo")
 from tools.profile_flash import device_kernel_times  # noqa: E402
 
+from tony_tpu.io.reader import (  # noqa: E402
+    IO_ASSEMBLE_MS_HISTOGRAM,
+    IO_BATCH_WAIT_MS_HISTOGRAM,
+    IO_H2D_MS_HISTOGRAM,
+    IO_QUEUE_WAIT_MS_HISTOGRAM,
+    IO_READ_MS_HISTOGRAM,
+)
 from tony_tpu.observability.metrics import (  # noqa: E402
     MetricsRegistry,
     sanitize_metric_name,
@@ -204,11 +211,11 @@ def measure_io(steps: int, depth: int, registry: MetricsRegistry,
 
     rows = [
         ("step_wall", wall_ms / steps),
-        ("read", dsum("tony_io_read_ms") / steps),
-        ("assemble", dsum("tony_io_assemble_ms") / steps),
-        ("h2d", dsum("tony_io_h2d_ms") / steps),
-        ("stall", dsum("tony_io_queue_wait_ms") / steps),
-        ("batch_wait", dsum("tony_io_batch_wait_ms") / steps),
+        ("read", dsum(IO_READ_MS_HISTOGRAM) / steps),
+        ("assemble", dsum(IO_ASSEMBLE_MS_HISTOGRAM) / steps),
+        ("h2d", dsum(IO_H2D_MS_HISTOGRAM) / steps),
+        ("stall", dsum(IO_QUEUE_WAIT_MS_HISTOGRAM) / steps),
+        ("batch_wait", dsum(IO_BATCH_WAIT_MS_HISTOGRAM) / steps),
         # Absolute ms for ONE save, not per-step: the save-stall a loop
         # pays each time it checkpoints.
         ("ckpt_snapshot", dsum(CKPT_SNAPSHOT_HISTOGRAM, snap1, snap2)),
