@@ -29,17 +29,14 @@ BASELINE_STEPS_PER_SEC_PER_CHIP = 100.0  # see BASELINE.md proxy table
 BATCH = 512
 MEASURE = 200
 
-# Peak dense bf16 throughput per chip, for MFU. "TPU v5 lite" = v5e.
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,
-    "TPU v4": 275e12,
-    "cpu": 1e11,  # nominal, so CPU smoke runs produce a number
-}
+# Peak dense bf16 throughput per chip, for MFU — the SAME table the
+# live step anatomy uses (observability/stepstats.py), so a bench MFU
+# and a production job's tony_mfu gauge are one definition, one table.
+from tony_tpu.observability.stepstats import peak_flops_per_chip  # noqa: E402
 
 
 def _peak_flops() -> float:
-    d = jax.devices()[0]
-    return PEAK_FLOPS.get(d.device_kind, PEAK_FLOPS.get(d.platform, 1e11))
+    return peak_flops_per_chip(jax.devices()[0])
 
 
 def best_of_windows(fn, windows: int = 3) -> float:
@@ -137,14 +134,17 @@ def _bench_lm_train(cfg, batch: int, seq: int, measure: int,
         6.0 * n_params * batch * seq
         + 6.0 * cfg.n_layers * batch * seq * seq * cfg.n_heads * cfg.head_dim
     )
-    return {
+    out = {
         "tokens_per_sec_per_chip": round(batch * seq * measure / dt),
-        "mfu": round(flops_per_step * measure / dt / _peak_flops(), 4),
         "params_m": round(n_params / 1e6, 1),
         "batch": batch,
         "seq": seq,
         "step_ms": round(dt / measure * 1000, 2),
     }
+    peak = _peak_flops()
+    if peak:  # unknown accelerator generation: no MFU, not a wrong one
+        out["mfu"] = round(flops_per_step * measure / dt / peak, 4)
+    return out
 
 
 def bench_transformer(batch: int = 8, seq: int = 2048, measure: int = 20,
@@ -1205,6 +1205,17 @@ def run_benches() -> dict:
             ),
             "device": jax.devices()[0].device_kind,
         }
+        # The default-config vs hd128 MFU gap (ROADMAP: 0.53 vs 0.65 —
+        # the half-filled MXU tax): a derived, GATED sub-metric so
+        # closing (or reopening) the gap moves --check, instead of
+        # hiding in a side-by-side read of two rows.
+        t = extras.get("transformer")
+        t128 = extras.get("transformer_hd128")
+        if (isinstance(t, dict) and isinstance(t128, dict)
+                and t.get("mfu") and t128.get("mfu")):
+            extras["mfu_gap"] = {
+                "default_over_hd128_mfu": round(t["mfu"] / t128["mfu"], 4)
+            }
     else:
         # CPU smoke stays seconds, not hours: the 200M transformer and the
         # 8k attention sweeps are TPU-only. The serving engine's micro

@@ -117,12 +117,21 @@ def corpus_batches(args, ctx):
     ``--data``, the synthetic motif corpus is sampled (the offline
     default)."""
     if not args.data:
+        # The synthetic path honors `throttle_io` fault-plan entries the
+        # same way the framework reader does (io/reader.py): the sleep
+        # lands inside next(), where the step anatomy's wrap_batches
+        # measures it as data_wait.
+        from tony_tpu.resilience.faults import io_faults_from_env
+
+        faults = io_faults_from_env()
         corpus = synthetic_tokens(0, n_docs=64, seq=args.seq,
                                   vocab=args.vocab)
         shard = corpus[ctx.process_id::max(ctx.num_processes, 1)]
         rng = np.random.default_rng(ctx.process_id)
         while True:
             idx = rng.integers(0, len(shard), size=(args.batch,))
+            if faults is not None:
+                faults.maybe_throttle()
             yield shard[idx]
         return
     paths = [p for p in args.data.split(",") if p]
@@ -203,8 +212,14 @@ def main(argv=None) -> int:
 
     # Per-process corpus shard via the framework's exactly-once sharding
     # identity (the py4j-reader analogue) — file-backed with --data,
-    # synthetic otherwise.
+    # synthetic otherwise. The step's anatomy recorder wraps the
+    # iterator so host time blocked on input reads as the data_wait
+    # phase (tony_step_phase_ms{phase="data_wait"}) even on the
+    # synthetic path that never touches the tony_io_* telemetry.
     batches = corpus_batches(args, ctx)
+    stats = getattr(step_fn, "stepstats", None)
+    if stats is not None:
+        batches = stats.wrap_batches(batches)
 
     scratch = os.environ.get("TONY_LOG_DIR", ".")
     # NOT wrapped in Path(): --ckpt-dir / TONY_CHECKPOINT_DIR may be a
@@ -251,10 +266,21 @@ def main(argv=None) -> int:
             first = loss if first is None else first
             last = loss
             step = int(state.step)
-            observability.report(
-                step=step, loss=loss, step_time_ms=dt * 1000.0,
-                tokens_per_sec=args.batch * args.seq / dt if dt else 0.0,
-            )
+            report = {
+                "step": step, "loss": loss,
+                "tokens_per_sec": args.batch * args.seq / dt if dt else 0.0,
+            }
+            if stats is None or not stats.enabled \
+                    or not stats.steps_observed:
+                # With step anatomy active, stepstats owns step_time_ms
+                # (the dispatch-to-dispatch wall its phases sum to —
+                # two writers with two wall definitions would fight
+                # over one gauge). Until it has actually published one
+                # (it drops the compile interval, so nothing before the
+                # 3rd dispatch), this fenced wall keeps the gauge fed —
+                # a 2-step smoke job must still report step times.
+                report["step_time_ms"] = dt * 1000.0
+            observability.report(**report)
             if step % 5 == 0 or step == args.steps:
                 print(f"step {step}: loss {loss:.4f}", flush=True)
             if step % args.checkpoint_every == 0:

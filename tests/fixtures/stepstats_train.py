@@ -1,0 +1,73 @@
+"""Jax-free step-anatomy fixture: drives a REAL ``StepStats`` recorder
+around a sleep-based "train step" and a batch iterator that honors the
+fault plan's ``throttle_io`` entries (``io_faults_from_env``), so the
+chaos e2e can flip the dominant phase to ``data_wait`` and collapse the
+MFU deterministically without a jax compile in the loop.
+
+Workload shape: tokens [B, T+1] like a real LM step; the config is
+transformer-shaped so the analytic flops model sizes ``tony_mfu``.
+``peak_flops`` is pinned so the MFU is a stable ratio of the step wall
+whatever host runs the test: normal steps sleep ``FIXTURE_COMPUTE_S``,
+throttled steps additionally wait out the fault plan's delay inside
+``next()`` — exactly where a real starved input pipeline stalls.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+from tony_tpu import observability
+from tony_tpu.observability.stepstats import StepStats
+from tony_tpu.resilience.faults import io_faults_from_env
+
+if not os.environ.get("TONY_METRICS_FILE"):
+    print("TONY_METRICS_FILE not exported", file=sys.stderr)
+    sys.exit(4)
+
+# Publish on every report: the e2e asserts on what rides the very next
+# heartbeat, so the default write throttle only adds latency.
+registry = observability.default_registry()
+registry._publish_min_interval_s = 0.0
+
+
+class Cfg:
+    d_model = 64
+    n_layers = 2
+    vocab_size = 512
+    n_heads = 4
+    head_dim = 16
+    n_kv_heads = 2
+    d_ff = 256
+    dtype = "float32"
+
+
+stats = StepStats(
+    cfg=Cfg(), registry=registry, peak_flops=1e12,
+    enabled=True, calibrate=False,
+)
+
+faults = io_faults_from_env()
+
+
+def batches():
+    while True:
+        if faults is not None:
+            faults.maybe_throttle()
+        yield np.zeros((4, 33), np.int32)  # [B, T+1] = batch 4, seq 32
+
+
+wrapped = stats.wrap_batches(batches())
+
+steps = int(os.environ.get("FIXTURE_STEPS", "90"))
+compute_s = float(os.environ.get("FIXTURE_COMPUTE_S", "0.015"))
+
+for step in range(1, steps + 1):
+    batch = next(wrapped)
+    stats.step_begin(batch.shape)
+    time.sleep(compute_s)  # the "device" work
+    stats.step_end(0.0005)
+    registry.report(step=step, loss=1.0 / step)
+
+time.sleep(float(os.environ.get("LINGER_S", "2.0")))
+sys.exit(0)

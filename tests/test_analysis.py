@@ -401,6 +401,32 @@ class TestMetricsLint:
         ])
         assert findings == [], "\n".join(f.render() for f in findings)
 
+    def test_observability_docs_catalogues(self, tmp_path):
+        """TONY-M002 extension: every step-anatomy phase label value and
+        every health detector name needs a DEPLOY.md row — an
+        incomplete doc is flagged per missing value, the real doc is
+        clean."""
+        from tony_tpu.analysis.metrics_lint import check_observability_docs
+        from tony_tpu.observability.health import DETECTORS
+        from tony_tpu.observability.stepstats import PHASES
+
+        assert check_observability_docs(REPO / "docs" / "DEPLOY.md") == []
+        # a doc missing one phase and one detector gets exactly 2 flags
+        partial = tmp_path / "DEPLOY.md"
+        partial.write_text(" ".join(
+            [f"`{p}`" for p in PHASES if p != "collective"]
+            + [f"`{d}`" for d in DETECTORS if d != "comms_bound"]
+        ))
+        findings = check_observability_docs(partial)
+        assert len(findings) == 2
+        assert all(f.rule_id == "TONY-M002" for f in findings)
+        assert {"collective", "comms_bound"} == {
+            f.message.split("'")[1] for f in findings
+        }
+        # a missing doc flags everything instead of crashing
+        missing = check_observability_docs(tmp_path / "nope.md")
+        assert len(missing) == len(PHASES) + len(DETECTORS)
+
 
 # ---------------------------------------------------------------------------
 # Repo self-drift (tools/lint_self.py) — drift fails tier-1.

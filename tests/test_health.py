@@ -152,6 +152,51 @@ class TestHealthMonitor:
         assert [a["detector"] for a in alerts] == ["io_stall"]
         assert alerts[0]["stall_ratio"] == pytest.approx(0.8)
 
+    def test_mfu_collapse_relative_to_own_median(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, mfu_collapse_ratio=0.5,
+                                    heartbeat_jitter_factor=1000.0)
+        # 6 healthy samples build the rolling median; value is tiny on
+        # purpose — the detector is relative, not an absolute bar.
+        for _ in range(6):
+            clock.advance(0.1)
+            mon.observe("w:0", _snap(gauges={"tony_mfu": 0.01}))
+        assert alerts == []
+        clock.advance(0.1)
+        mon.observe("w:0", _snap(gauges={"tony_mfu": 0.001}))  # 10× drop
+        assert [a["detector"] for a in alerts] == ["mfu_collapse"]
+        assert alerts[0]["task"] == "w:0"
+        assert alerts[0]["mfu"] == pytest.approx(0.001)
+        # a healthy dip (0.6×) never alerts
+        mon2, alerts2 = self._monitor(clock, mfu_collapse_ratio=0.5,
+                                      heartbeat_jitter_factor=1000.0)
+        for _ in range(6):
+            clock.advance(0.1)
+            mon2.observe("w:0", _snap(gauges={"tony_mfu": 0.01}))
+        clock.advance(0.1)
+        mon2.observe("w:0", _snap(gauges={"tony_mfu": 0.006}))
+        assert alerts2 == []
+
+    def test_comms_bound_reads_phase_breakdown(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, comms_bound_ratio=0.5,
+                                    heartbeat_jitter_factor=1000.0)
+        balanced = {
+            'tony_step_phase_ms{phase="compute"}': 70.0,
+            'tony_step_phase_ms{phase="collective"}': 20.0,
+            'tony_step_phase_ms{phase="data_wait"}': 5.0,
+            'tony_step_phase_ms{phase="h2d"}': 3.0,
+            'tony_step_phase_ms{phase="host"}': 2.0,
+        }
+        mon.observe("w:0", _snap(gauges=balanced))
+        assert alerts == []
+        comms_bound = dict(balanced)
+        comms_bound['tony_step_phase_ms{phase="collective"}'] = 200.0
+        clock.advance(0.1)
+        mon.observe("w:0", _snap(gauges=comms_bound))
+        assert [a["detector"] for a in alerts] == ["comms_bound"]
+        assert alerts[0]["share"] == pytest.approx(200.0 / 280.0, abs=0.01)
+
     def test_cooldown_suppresses_repeat_alerts(self):
         clock = FakeClock()
         mon, alerts = self._monitor(clock, heartbeat_jitter_factor=1.0)
@@ -444,6 +489,39 @@ class TestPostmortem:
         assert findings[0].rule_id == "TONY-D002"
         assert findings[0].task == "w:2"
         assert any("900ms" in e for e in findings[0].evidence)
+
+    def test_step_anatomy_rule_reads_alert_and_final_snapshot(self):
+        events = [
+            {"kind": "health_alert", "detector": "mfu_collapse",
+             "task": "worker:0",
+             "reason": "mfu 0.001 collapsed below 0.5× recent median"},
+        ]
+        final = {"state": "SUCCEEDED", "metrics": {"tasks": {"worker:0": {
+            "counters": {},
+            "gauges": {
+                'tony_step_phase_ms{phase="data_wait"}': 150.0,
+                'tony_step_phase_ms{phase="compute"}': 15.0,
+                'tony_step_phase_ms{phase="h2d"}': 0.0,
+                'tony_step_phase_ms{phase="collective"}': 0.0,
+                'tony_step_phase_ms{phase="host"}': 0.5,
+            },
+        }}}}
+        findings = postmortem.diagnose(events=events, final=final)
+        d12 = [f for f in findings if f.rule_id == "TONY-D012"]
+        assert len(d12) == 1 and d12[0].task == "worker:0"
+        # the terminal record corroborates with the dominant phase
+        assert any("dominant phase data_wait" in e for e in d12[0].evidence)
+
+    def test_comms_bound_alert_diagnosed_without_final(self):
+        events = [
+            {"kind": "health_alert", "detector": "comms_bound",
+             "task": "worker:1",
+             "reason": "collective time is 71% of the step"},
+        ]
+        findings = postmortem.diagnose(events=events)
+        d12 = [f for f in findings if f.rule_id == "TONY-D012"]
+        assert len(d12) == 1 and d12[0].task == "worker:1"
+        assert "communication-bound" in d12[0].cause
 
     def test_rendezvous_rule_tolerates_sessionless_events(self):
         """Hand-edited / older-version timelines may lack session ids;

@@ -527,3 +527,506 @@ def test_observability_port_can_be_disabled(tmp_path):
     # The rest of the telemetry plane still runs: events + trace persist.
     assert (coord.app_dir / "events.jsonl").is_file()
     assert (coord.app_dir / "trace.json").is_file()
+
+
+# ---------------------------------------------------------------------------
+# histogram_quantile edge cases (the single-sample clamp)
+# ---------------------------------------------------------------------------
+class TestHistogramQuantileEdgeCases:
+    def test_empty_histogram_is_none(self):
+        assert obs_metrics.histogram_quantile(
+            {"count": 0, "buckets": []}, 0.95
+        ) is None
+        assert obs_metrics.histogram_quantile({}, 0.5) is None
+
+    def test_single_sample_clamps_to_observed_max(self):
+        h = obs_metrics.Histogram("x_ms", buckets=(5.0, 10.0))
+        h.observe(3.0)
+        snap = h.snapshot()
+        assert snap["max"] == 3.0
+        # without the clamp this reads as the 5.0 bucket bound — a p95
+        # over one 3 ms sample must be 3 ms, not 5 ms
+        assert obs_metrics.histogram_quantile(snap, 0.95) == 3.0
+        assert obs_metrics.histogram_quantile(snap, 0.5) == 3.0
+
+    def test_all_in_overflow_bucket_reads_max_not_mean(self):
+        h = obs_metrics.Histogram("x_ms", buckets=(5.0, 10.0))
+        h.observe(50.0)
+        h.observe(70.0)
+        snap = h.snapshot()
+        # both samples are past the last bound: the readout is the
+        # observed max (70), not the mean (60) and not infinite
+        assert obs_metrics.histogram_quantile(snap, 0.95) == 70.0
+
+    def test_snapshot_without_max_keeps_bucket_bound(self):
+        # aggregated/legacy snapshots that carry no "max" keep the
+        # upper-bound behavior (and the mean fallback past the end)
+        snap = {"count": 1, "sum": 3.0, "buckets": [[5.0, 1], [10.0, 1]]}
+        assert obs_metrics.histogram_quantile(snap, 0.95) == 5.0
+        snap = {"count": 2, "sum": 120.0, "buckets": [[5.0, 0], [10.0, 0]]}
+        assert obs_metrics.histogram_quantile(snap, 0.95) == 60.0
+
+    def test_max_rides_through_aggregator_normalization(self):
+        agg = MetricsAggregator()
+        h = obs_metrics.Histogram("x_ms", buckets=(5.0,))
+        h.observe(3.0)
+        agg.ingest("w:0", {"histograms": {"x_ms": h.snapshot()}})
+        norm = agg.to_json()["tasks"]["w:0"]["histograms"]["x_ms"]
+        assert norm["max"] == 3.0
+        assert obs_metrics.histogram_quantile(norm, 0.95) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# stepstats.py — the per-step anatomy recorder
+# ---------------------------------------------------------------------------
+from tony_tpu.observability import stepstats as stepstats_mod  # noqa: E402
+
+
+class _TinyCfg:
+    """Transformer-shaped config for the analytic flops model."""
+    d_model = 64
+    n_layers = 2
+    vocab_size = 512
+    n_heads = 4
+    head_dim = 16
+    n_kv_heads = 2
+    d_ff = 256
+    dtype = "float32"
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class TestStepStats:
+    def _stats(self, reg, clock, **kw):
+        kw.setdefault("cfg", _TinyCfg())
+        kw.setdefault("peak_flops", 1e12)
+        kw.setdefault("calibrate", False)
+        kw.setdefault("enabled", True)
+        return stepstats_mod.StepStats(
+            registry=reg, clock=clock, **kw
+        )
+
+    def test_phases_are_exclusive_and_sum_to_wall(self):
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = self._stats(reg, clock)
+        stats.step_begin((4, 33))       # dispatch 1 = trace + compile
+        clock.advance(5.0)              # a 5 s compile wall...
+        stats.step_begin((4, 33))       # ...dropped, never published
+        stats.step_end(0.002)
+        clock.advance(0.1)              # one 100 ms step
+        stats.step_begin((4, 33))
+        g = reg.snapshot()["gauges"]
+        phases = {
+            p: g[f'tony_step_phase_ms{{phase="{p}"}}']
+            for p in stepstats_mod.PHASES
+        }
+        assert sum(phases.values()) == pytest.approx(100.0, rel=1e-6)
+        assert phases["host"] == pytest.approx(2.0)       # the dispatch
+        assert phases["compute"] == pytest.approx(98.0)   # residual, no plan
+        assert phases["data_wait"] == 0.0 and phases["h2d"] == 0.0
+        # MFU: analytic flops over wall × 1 device × pinned peak
+        flops = stepstats_mod.model_flops_per_step(_TinyCfg(), 4, 32)
+        assert g["tony_mfu"] == pytest.approx(
+            flops / (0.1 * 1e12), abs=1e-5  # gauge rounds to 5 decimals
+        )
+        assert g["tony_model_flops_per_step"] == flops
+        # report() rode along: the straggler detector's gauge is fed
+        assert g["step_time_ms"] == pytest.approx(100.0)
+        assert stats.steps_observed == 1
+
+    def test_wrap_batches_attributes_input_wait(self):
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = self._stats(reg, clock)
+
+        def slow_batches():
+            while True:
+                clock.advance(0.03)    # 30 ms blocked in next()
+                yield (4, 33)
+
+        it = stats.wrap_batches(slow_batches())
+        shape = next(it)
+        stats.step_begin(shape)        # dispatch 1 = compile
+        stats.step_end(0.0)
+        shape = next(it)
+        clock.advance(0.07)
+        stats.step_begin(shape)        # compile interval dropped
+        shape = next(it)               # +30 ms data wait
+        clock.advance(0.07)            # +70 ms "device" work
+        stats.step_begin(shape)
+        g = reg.snapshot()["gauges"]
+        assert g['tony_step_phase_ms{phase="data_wait"}'] == \
+            pytest.approx(30.0, rel=1e-6)
+        assert g['tony_step_phase_ms{phase="compute"}'] == \
+            pytest.approx(70.0, rel=1e-6)
+
+    def test_disabled_recorder_is_inert(self):
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = self._stats(reg, clock, enabled=False)
+        batches = iter([(4, 33)])
+        assert stats.wrap_batches(batches) is batches
+        stats.step_begin((4, 33))
+        clock.advance(0.1)
+        stats.step_begin((4, 33))
+        assert reg.snapshot()["gauges"] == {}
+
+    def test_classifier_workload_gets_phases_but_no_mfu(self):
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = self._stats(reg, clock, cfg=None, tokens_workload=False,
+                            steps_per_call=2)
+        stats.step_begin((8, 28, 28, 1))
+        clock.advance(0.2)              # compile call — dropped
+        stats.step_begin((8, 28, 28, 1))
+        clock.advance(0.2)              # 200 ms call = 2 fused steps
+        stats.step_begin((8, 28, 28, 1))
+        g = reg.snapshot()["gauges"]
+        assert g['tony_step_phase_ms{phase="compute"}'] == \
+            pytest.approx(100.0)        # per-step, not per-call
+        assert "tony_mfu" not in g
+        assert stats.steps_observed == 2
+
+    def test_deferred_sizing_uses_builder_global_shape(self):
+        """size_from_shapes=False: the dispatch hook's (local) shape is
+        ignored — the builder sizes with the assembled GLOBAL batch, the
+        multi-process contract make_train_step relies on (hook sees one
+        process's shard; MFU/calibration must use the global work)."""
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = self._stats(reg, clock, size_from_shapes=False)
+        stats.step_begin((4, 33))       # hook: local shard [4, 33]
+        stats.set_workload(8, 32)       # builder: global batch is 8
+        clock.advance(0.1)
+        stats.step_begin((4, 33))       # compile interval dropped
+        clock.advance(0.1)
+        stats.step_begin((4, 33))
+        g = reg.snapshot()["gauges"]
+        flops = stepstats_mod.model_flops_per_step(_TinyCfg(), 8, 32)
+        assert g["tony_model_flops_per_step"] == flops
+        assert g["tony_mfu"] == pytest.approx(
+            flops / (0.1 * 1e12), abs=1e-5
+        )
+
+    def test_live_calibration_records_and_publishes_residual(
+        self, tmp_path, monkeypatch,
+    ):
+        from tony_tpu.models import TransformerConfig
+        from tony_tpu.parallel import plan as plan_lib
+
+        monkeypatch.setattr(
+            plan_lib, "active_cache_dir", lambda: str(tmp_path)
+        )
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4, head_dim=8,
+            d_ff=64, max_seq=64, dtype="float32", n_kv_heads=2,
+        )
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = stepstats_mod.StepStats(
+            cfg=cfg, plan=plan_lib.Plan(plan_lib.MeshSpec()),
+            registry=reg, clock=clock, peak_flops=1e12,
+            calibrate=True, window=2,
+        )
+        stats.step_begin((4, 17))       # compile
+        for _ in range(4):
+            clock.advance(0.05)
+            stats.step_begin((4, 17))
+        table = plan_lib.load_measurements(cache_dir=str(tmp_path))
+        assert len(table) == 1
+        (bucket,) = table.values()
+        assert bucket == {"dp1.pp1.ep1.sp1.tp1":
+            pytest.approx(50.0, rel=0.01)}
+        g = reg.snapshot()["gauges"]
+        assert g['tony_plan_residual{plan="dp1.pp1.ep1.sp1.tp1"}'] == pytest.approx(1.0)
+
+    def test_calibration_failure_never_raises(self, monkeypatch):
+        from tony_tpu.parallel import plan as plan_lib
+
+        def boom(*a, **kw):
+            raise OSError("cache dir gone")
+
+        monkeypatch.setattr(plan_lib, "record_step_time", boom)
+        reg = obs_metrics.MetricsRegistry()
+        clock = _Clock()
+        stats = stepstats_mod.StepStats(
+            cfg=_TinyCfg(), plan=plan_lib.Plan(plan_lib.MeshSpec()),
+            registry=reg, clock=clock, peak_flops=1e12,
+            calibrate=True, window=1,
+        )
+        stats.step_begin((4, 33))
+        for _ in range(5):
+            clock.advance(0.05)
+            stats.step_begin((4, 33))   # calibration is telemetry: no raise
+        assert stats.steps_observed == 4
+
+    def test_counter_rate_clamps_restart_resets(self):
+        assert stepstats_mod.counter_rate(100.0, 110.0, 2.0) == 5.0
+        # a task restart resets its process-local counters: the reset
+        # must read as zero progress, never a negative rate
+        assert stepstats_mod.counter_rate(100.0, 3.0, 2.0) == 0.0
+        assert stepstats_mod.counter_rate(1.0, 2.0, 0.0) == 0.0
+
+    def test_view_and_format_roundtrip(self):
+        snap = {
+            "counters": {
+                "train_steps_total": 40,
+                'tony_collective_bytes_total{axis="dp"}': 4096.0,
+            },
+            "gauges": {
+                'tony_step_phase_ms{phase="data_wait"}': 60.0,
+                'tony_step_phase_ms{phase="h2d"}': 5.0,
+                'tony_step_phase_ms{phase="compute"}': 30.0,
+                'tony_step_phase_ms{phase="collective"}': 4.0,
+                'tony_step_phase_ms{phase="host"}': 1.0,
+                "tony_mfu": 0.42,
+                'tony_plan_residual{plan="dp2"}': 1.08,
+            },
+        }
+        view = stepstats_mod.stepstats_view({"worker:0": snap,
+                                             "worker:1": {"gauges": {}}})
+        assert list(view["tasks"]) == ["worker:0"]
+        t = view["tasks"]["worker:0"]
+        assert t["dominant_phase"] == "data_wait"
+        assert t["step_time_ms"] == pytest.approx(100.0)
+        assert t["shares"]["data_wait"] == pytest.approx(0.6)
+        assert t["mfu"] == 0.42
+        assert t["collective_bytes"] == {"dp": 4096.0}
+        assert t["residuals"] == {"dp2": 1.08}
+        assert view["fleet"]["dominant_phase"] == "data_wait"
+        assert view["fleet"]["mfu_median"] == pytest.approx(0.42)
+        text = stepstats_mod.format_top("app_1", view, "final")
+        assert "DATA_WAIT" in text and "worker:0" in text
+        assert "0.4200" in text and "data_wait" in text
+
+
+class TestAggregatorStepstats:
+    def test_task_restart_resets_do_not_go_negative(self):
+        """A task that restarts mid-session resets its process-local
+        counters; the gauge series stays a monotonic-ts timeline and
+        stepstats-derived rates clamp at zero instead of amplifying
+        the drop."""
+        agg = MetricsAggregator()
+        agg.ingest("w:0", {"ts_ms": 1_000,
+                           "counters": {"train_steps_total": 100},
+                           "gauges": {"step_time_ms": 5.0}})
+        # restart: counters reset, wall clock moved on
+        agg.ingest("w:0", {"ts_ms": 3_000,
+                           "counters": {"train_steps_total": 3},
+                           "gauges": {"step_time_ms": 7.0}})
+        doc = agg.to_json()
+        series = doc["series"]["w:0:step_time_ms"]
+        assert [ts for ts, _ in series] == sorted(
+            ts for ts, _ in series
+        )
+        first = doc["tasks"]["w:0"]["counters"]["train_steps_total"]
+        assert first == 3  # latest snapshot shows the reset plainly
+        rate = stepstats_mod.counter_rate(100, 3, 2.0)
+        assert rate == 0.0
+
+    def test_stepstats_json_and_api_endpoint(self):
+        agg = MetricsAggregator()
+        agg.ingest("w:0", {"ts_ms": 1, "counters": {}, "gauges": {
+            'tony_step_phase_ms{phase="data_wait"}': 1.0,
+            'tony_step_phase_ms{phase="h2d"}': 0.0,
+            'tony_step_phase_ms{phase="compute"}': 8.0,
+            'tony_step_phase_ms{phase="collective"}': 0.5,
+            'tony_step_phase_ms{phase="host"}': 0.5,
+            "tony_mfu": 0.33,
+        }})
+        view = agg.stepstats_json()
+        assert view["tasks"]["w:0"]["dominant_phase"] == "compute"
+        assert view["fleet"]["mfu_median"] == pytest.approx(0.33)
+
+        server = ObservabilityHttpServer(agg, port=0)
+        server.serve_background()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/stepstats", timeout=5
+            ).read())
+            assert doc["tasks"]["w:0"]["mfu"] == pytest.approx(0.33)
+            assert doc["fleet"]["tasks"] == 1
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# step-anatomy mini-cluster e2e (the PR-10 acceptance scenario)
+# ---------------------------------------------------------------------------
+def test_mini_cluster_stepstats_training_e2e(tmp_path, capsys):
+    """A REAL training job (examples/lm_train.py through make_train_step)
+    publishes its step anatomy end to end: tony_step_phase_ms{phase=}
+    and a nonzero tony_mfu on the coordinator's live /metrics, phases
+    summing to the step wall within 5% in the persisted snapshot, a
+    plan-measurements.json entry recorded by the LIVE job (not bench),
+    and `tony top` rendering the breakdown from job history after the
+    job exits."""
+    import re
+
+    repo = FIXTURES.parent.parent
+    cache_dir = tmp_path / "xla-cache"
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(repo / "examples" / "lm_train.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 150)
+    conf.set(keys.K_COMPILE_CACHE_DIR, str(cache_dir))
+    conf.set(
+        keys.K_TASK_PARAMS,
+        "--steps 160 --d-model 32 --n-layers 2 --n-heads 2 "
+        "--n-kv-heads 1 --vocab 128 --batch 4 --seq 64 "
+        "--checkpoint-every 100000",
+    )
+
+    app_id = "application_mini_anatomy1"
+    app_dir = cluster.staging_dir / app_id
+    app_dir.mkdir(parents=True)
+    conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+    coordinator = TonyCoordinator(
+        conf, app_dir, app_id=app_id,
+        backend=LocalProcessBackend(app_dir / "logs"),
+    )
+    result = []
+    t = threading.Thread(
+        target=lambda: result.append(coordinator.run()), daemon=True
+    )
+    cluster._live.append(coordinator)
+    t.start()
+    live_mfu = None
+    live_phases = False
+    try:
+        deadline = time.monotonic() + 180
+        addr_file = app_dir / "coordinator.http"
+        while not addr_file.is_file() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert addr_file.is_file(), "coordinator.http never advertised"
+        addr = addr_file.read_text().strip()
+        # Scrape /metrics WHILE the job trains: the anatomy gauges ride
+        # the heartbeat piggyback onto the live endpoint.
+        while time.monotonic() < deadline and t.is_alive():
+            try:
+                text = urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5
+                ).read().decode()
+            except OSError:
+                time.sleep(0.05)
+                continue
+            if not live_phases:
+                live_phases = "tony_step_phase_ms" in text
+            m = re.search(r"tony_mfu\{[^}]*\} ([0-9.eE+-]+)", text)
+            if m:
+                live_mfu = float(m.group(1))
+                if live_mfu > 0 and live_phases:
+                    break
+            time.sleep(0.05)
+    finally:
+        t.join(timeout=240)
+    assert result and result[0] is SessionStatus.SUCCEEDED, (
+        coordinator.session.diagnostics if coordinator.session else "no run"
+    )
+    assert live_phases, "tony_step_phase_ms never appeared on live /metrics"
+    assert live_mfu is not None and live_mfu > 0, (
+        f"nonzero tony_mfu never appeared on live /metrics ({live_mfu})"
+    )
+
+    # -- persisted snapshot: exclusive phases summing to the step wall ----
+    from tony_tpu.observability import stepstats as ss
+
+    final = json.loads((app_dir / "final-status.json").read_text())
+    entry = ss.task_stepstats(final["metrics"]["tasks"]["worker:0"])
+    assert entry is not None
+    assert set(entry["phases"]) == set(ss.PHASES)
+    gauges = final["metrics"]["tasks"]["worker:0"]["gauges"]
+    assert sum(entry["phases"].values()) == pytest.approx(
+        gauges["step_time_ms"], rel=0.05
+    )
+    assert gauges["tony_mfu"] > 0
+
+    # -- live calibration: the JOB recorded a measurement, not bench ------
+    from tony_tpu.parallel import plan as plan_lib
+
+    table = plan_lib.load_measurements(cache_dir=str(cache_dir))
+    assert table, "plan-measurements.json not written by the live job"
+    (bucket,) = table.values()
+    assert any(v > 0 for v in bucket.values())
+
+    # -- `tony top` renders the breakdown from job history ----------------
+    from tony_tpu.client import cli
+
+    empty = tmp_path / "empty-staging"
+    empty.mkdir()
+    rc = cli.main([
+        "top", app_id,
+        "--staging-location", str(empty),  # force the history leg
+        "--history-location", str(cluster.history_dir),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "(history)" in out and "worker:0" in out
+    assert "DATA_WAIT" in out and "COLLECTIVE" in out
+
+
+def test_mini_cluster_stepstats_chaos_io_throttle(tmp_path, capsys):
+    """Seeded io-throttle chaos: a `throttle_io` fault-plan entry starves
+    the input pipeline mid-run — the dominant phase flips to data_wait,
+    the mfu_collapse detector fires a health_alert, and `tony doctor`
+    surfaces the TONY-D012 step-anatomy finding."""
+    cluster = MiniTonyCluster(tmp_path)
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "stepstats_train.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 100)
+    conf.set(keys.K_SHELL_ENV,
+             "FIXTURE_STEPS=82,FIXTURE_COMPUTE_S=0.012,LINGER_S=1.0")
+    conf.set(keys.K_FAULT_PLAN, json.dumps({
+        "seed": 3,
+        "faults": [{"action": "throttle_io", "target": "worker:0",
+                    "ms": 150, "after_batches": 68, "count": 100000}],
+    }))
+    status, coord = cluster.run_job(conf)
+    assert status is SessionStatus.SUCCEEDED, (
+        coord.session.diagnostics if coord.session else "no run"
+    )
+
+    # -- the throttle flipped the dominant phase to data_wait -------------
+    from tony_tpu.observability import stepstats as ss
+
+    final = json.loads((coord.app_dir / "final-status.json").read_text())
+    entry = ss.task_stepstats(final["metrics"]["tasks"]["worker:0"])
+    assert entry is not None
+    assert entry["dominant_phase"] == "data_wait", entry
+
+    # -- the detector fired into the lifecycle log ------------------------
+    events = obs_events.parse_jsonl(
+        (coord.app_dir / "events.jsonl").read_text()
+    )
+    alerts = [e for e in events if e["kind"] == "health_alert"]
+    assert any(e.get("detector") == "mfu_collapse" for e in alerts), (
+        [(e.get("detector"), e.get("reason")) for e in alerts]
+    )
+
+    # -- `tony doctor` surfaces the step-anatomy finding ------------------
+    from tony_tpu.client import cli
+
+    rc = cli.main([
+        "doctor", coord.app_id,
+        "--staging-location", str(cluster.staging_dir),
+        "--history-location", str(cluster.history_dir),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "TONY-D012" in out and "MFU collapsed" in out

@@ -223,6 +223,65 @@ class TestPlanner:
 # ---------------------------------------------------------------------------
 
 
+class TestEstimatePhases:
+    def test_decomposition_sums_to_estimate_cost(self):
+        for spec in (MeshSpec(dp=8), MeshSpec(dp=2, tp=2, sp=2),
+                     MeshSpec(dp=4, tp=2)):
+            p = plan_lib.Plan(spec)
+            est = plan_lib.estimate_phases(p, CFG, global_batch=16, seq=16)
+            assert est["compute"] > 0 and est["collective"] >= 0
+            assert plan_lib.estimate_cost(
+                p, CFG, global_batch=16, seq=16
+            ) == pytest.approx(est["compute"] + est["collective"])
+
+    def test_comm_bytes_per_axis(self):
+        p = plan_lib.Plan(MeshSpec(dp=2, tp=2, sp=2))
+        est = plan_lib.estimate_phases(p, CFG, global_batch=16, seq=16)
+        # every active axis > 1 moves bytes; inactive axes are absent
+        assert set(est["comm_bytes"]) == {"dp", "tp", "sp"}
+        assert all(v > 0 for v in est["comm_bytes"].values())
+        single = plan_lib.estimate_phases(
+            plan_lib.Plan(MeshSpec()), CFG, global_batch=16, seq=16
+        )
+        assert single["comm_bytes"] == {} and single["collective"] == 0.0
+
+    def test_illegal_pipeline_reads_infinite_compute(self):
+        p = plan_lib.Plan(MeshSpec(pp=2))  # no microbatches
+        est = plan_lib.estimate_phases(p, CFG, global_batch=16, seq=16)
+        assert est["compute"] == float("inf")
+        assert plan_lib.estimate_cost(p, CFG) == float("inf")
+
+    def test_plan_from_mesh_maps_axis_sizes(self):
+        mesh = build_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices())
+        p = plan_lib.plan_from_mesh(mesh, num_slices=1)
+        assert p.mesh_spec.dp == 4 and p.mesh_spec.tp == 2
+        assert p.num_devices == 8
+
+    def test_calibration_residuals_normalized(self, tmp_path):
+        d = str(tmp_path)
+        plans = plan_lib.candidate_plans(CFG, 8, global_batch=16, seq=16)
+        # perfectly-calibrated measurements: measured == estimate × 2
+        for p in plans[:3]:
+            plan_lib.record_step_time(
+                p, CFG,
+                2.0 * plan_lib.estimate_cost(p, CFG, global_batch=16,
+                                             seq=16),
+                global_batch=16, seq=16, cache_dir=d,
+            )
+        res = plan_lib.calibration_residuals(
+            CFG, 8, global_batch=16, seq=16, cache_dir=d
+        )
+        assert len(res) == 3
+        # all ratios equal ⇒ every residual is exactly 1.0 after the
+        # bucket-mean normalization (the shared ×2 scale divides out)
+        for v in res.values():
+            assert v == pytest.approx(1.0)
+        # an empty bucket yields no residuals, never a crash
+        assert plan_lib.calibration_residuals(
+            CFG, 8, global_batch=99, seq=16, cache_dir=d
+        ) == {}
+
+
 class TestPlanTrainStep:
     def test_plan_supplies_mesh_and_trunk(self):
         import jax.numpy as jnp

@@ -164,6 +164,8 @@ GOOD_PLAN = {
          "count": 5},
         {"action": "blackout_rpc", "after_ms": 2000, "ms": 1500},
         {"action": "fail_checkpoint_write", "step": 10},
+        {"action": "throttle_io", "target": "worker:0", "ms": 50,
+         "after_batches": 4, "count": 100},
     ],
 }
 
@@ -172,8 +174,9 @@ class TestFaultPlanParse:
     def test_good_plan_parses(self):
         plan = FaultPlan.parse(json.dumps(GOOD_PLAN))
         assert plan.seed == 7
-        assert len(plan.specs) == 10
+        assert len(plan.specs) == 11
         assert plan.specs[5].at == "pre_register"  # exit_executor default
+        assert plan.specs[10].after_batches == 4
 
     @pytest.mark.parametrize("mutate,complaint", [
         (lambda p: p.update(seed="x"), "seed must be an integer"),
@@ -215,6 +218,15 @@ class TestFaultPlanParse:
         (lambda p: p["faults"].append(
             {"action": "drop_heartbeats", "target": "worker:0", "count": 0}),
          "must be >= 1"),
+        (lambda p: p["faults"].append(
+            {"action": "throttle_io", "target": "worker:0"}),
+         "missing required field 'ms'"),
+        (lambda p: p["faults"].append(
+            {"action": "throttle_io", "target": "worker:0", "ms": 0}),
+         "must be nonzero for throttle_io"),
+        (lambda p: p["faults"].append(
+            {"action": "throttle_io", "target": "any_non_chief", "ms": 5}),
+         "concrete 'job:index'"),
     ])
     def test_bad_plans_refused_with_pointed_errors(self, mutate, complaint):
         plan = json.loads(json.dumps(GOOD_PLAN))
@@ -240,7 +252,7 @@ class TestFaultPlanParse:
         conf = TonyConfiguration()
         assert FaultPlan.from_conf(conf, env={}) is None
         conf.set(keys.K_FAULT_PLAN, json.dumps(GOOD_PLAN))
-        assert len(FaultPlan.from_conf(conf, env={}).specs) == 10
+        assert len(FaultPlan.from_conf(conf, env={}).specs) == 11
         path = tmp_path / "plan.json"
         path.write_text(json.dumps(GOOD_PLAN))
         conf.set(keys.K_FAULT_PLAN, str(path))
@@ -248,6 +260,30 @@ class TestFaultPlanParse:
         conf.set(keys.K_FAULT_PLAN, str(tmp_path / "missing.json"))
         with pytest.raises(FaultPlanError, match="cannot read plan file"):
             FaultPlan.from_conf(conf, env={})
+
+    def test_io_throttle_batch_semantics(self):
+        """throttle_io fires per BATCH: nothing until after_batches have
+        been served, then `ms` per batch for `count` batches, scoped to
+        the target task and session."""
+        from tony_tpu.resilience.faults import IoFaults
+
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "throttle_io", "target": "worker:0", "ms": 50,
+             "after_batches": 2, "count": 3, "session": 1},
+        ]}))
+        sleeps = []
+        io = IoFaults(plan, "worker:0", session=1, sleep=sleeps.append)
+        for _ in range(8):
+            io.maybe_throttle()
+        # batches 3,4,5 throttled; the count then exhausts
+        assert sleeps == [0.05, 0.05, 0.05]
+        # wrong task / wrong session: inert
+        for task, session in (("worker:1", 1), ("worker:0", 2)):
+            other = []
+            io2 = IoFaults(plan, task, session=session, sleep=other.append)
+            for _ in range(8):
+                io2.maybe_throttle()
+            assert other == [] and not io2.active
 
     def test_legacy_env_aliases(self):
         conf = TonyConfiguration()

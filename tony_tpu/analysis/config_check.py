@@ -87,6 +87,8 @@ _POSITIVE_FLOAT_KEYS = frozenset({
     keys.K_HEALTH_LOSS_SPIKE_FACTOR,
     keys.K_HEALTH_HB_JITTER_FACTOR,
     keys.K_HEALTH_IO_STALL_RATIO,
+    keys.K_HEALTH_MFU_COLLAPSE_RATIO,
+    keys.K_HEALTH_COMMS_BOUND_RATIO,
 })
 
 _TRUE_FALSE = frozenset(

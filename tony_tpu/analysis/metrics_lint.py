@@ -231,6 +231,51 @@ def check_declared_names(
     return findings
 
 
+def check_observability_docs(docs: "str | Path") -> list[Finding]:
+    """TONY-M002 extension: enumerable VALUES operators filter on must
+    be documented, not just the metric names that carry them. Two
+    closed catalogues are checked against the operator docs:
+
+    * every ``tony_step_phase_ms`` phase label value
+      (``observability.stepstats.PHASES``) — a dashboard filter on an
+      undocumented phase is a silent zero;
+    * every health detector name (``observability.health.DETECTORS``)
+      — the ``health_alert`` events and `tony doctor` evidence key off
+      these strings, so an undocumented detector is an alert nobody
+      can look up.
+
+    Imports the live modules (the catalogues ARE the source of truth;
+    re-parsing them out of the AST would just be a second spelling)."""
+    from tony_tpu.observability.health import DETECTORS
+    from tony_tpu.observability.stepstats import PHASES, STEP_PHASE_GAUGE
+
+    try:
+        doc_text = Path(docs).read_text()
+    except OSError:
+        doc_text = ""
+    findings: list[Finding] = []
+    for phase in PHASES:
+        if f"`{phase}`" not in doc_text and f"phase=\"{phase}\"" \
+                not in doc_text:
+            findings.append(Finding(
+                RULE_DECLARED, ERROR,
+                f"step-anatomy phase {phase!r} ({STEP_PHASE_GAUGE} label "
+                f"value) is not documented in {docs} — operators filter "
+                f"on phase values, so each needs a semantics row",
+                file=str(docs), line=0,
+            ))
+    for detector in DETECTORS:
+        if f"`{detector}`" not in doc_text:
+            findings.append(Finding(
+                RULE_DECLARED, ERROR,
+                f"health detector {detector!r} is not documented in "
+                f"{docs} — health_alert events and tony doctor evidence "
+                f"key off this name",
+                file=str(docs), line=0,
+            ))
+    return findings
+
+
 def check_metric_names(
     paths: "list[str | Path]",
     trees: "list[tuple[Path, ast.AST]] | None" = None,

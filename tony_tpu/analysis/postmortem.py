@@ -30,6 +30,8 @@ TONY-D009  executor lost the coordinator (exit 87 — control-plane
            partition)
 TONY-D010  application timeout
 TONY-D011  task exited nonzero with no more specific cause (generic)
+TONY-D012  step anatomy: MFU collapse / communication-bound step (the
+           stepstats detectors — the causal signal behind "it's slow")
 =========  ==============================================================
 """
 
@@ -439,6 +441,57 @@ def _rule_plain_exit(ctx: _Ctx) -> "list[DoctorFinding]":
     return findings
 
 
+def _rule_step_anatomy(ctx: _Ctx) -> "list[DoctorFinding]":
+    """The step-anatomy detectors (observability/stepstats.py feeds
+    them): an mfu_collapse alert names a task whose arithmetic
+    throughput fell off a cliff relative to its own history, and a
+    comms_bound alert names a mesh spending its step on collectives —
+    both corroborated, when the terminal record is available, by the
+    task's dominant phase from the persisted snapshot."""
+    findings = []
+    hints = {
+        "mfu_collapse": (
+            "TONY-D012", 45,
+            "MFU collapsed — the chips kept stepping but arithmetic "
+            "throughput fell off a cliff (check the dominant phase in "
+            "`tony top`: data_wait means a starved input pipeline, "
+            "collective means the mesh outgrew its interconnect)",
+        ),
+        "comms_bound": (
+            "TONY-D012", 40,
+            "the step is communication-bound — collectives dominate "
+            "the wall (reshard: fewer dp replicas per slice, larger "
+            "per-chip batch, or a plan with a cheaper axis split)",
+        ),
+    }
+    seen: set[str] = set()
+    for detector, (rule_id, score, hint) in hints.items():
+        for a in ctx.alerts(detector):
+            task = a.get("task")
+            if task in seen:
+                continue
+            seen.add(task)
+            evidence = [f"health_alert: {a.get('reason')}"]
+            snap = (((ctx.final or {}).get("metrics") or {})
+                    .get("tasks") or {}).get(task)
+            if isinstance(snap, Mapping):
+                from tony_tpu.observability import stepstats
+
+                entry = stepstats.task_stepstats(snap)
+                if entry is not None and entry.get("dominant_phase"):
+                    evidence.append(
+                        f"final-status anatomy: {task} dominant phase "
+                        f"{entry['dominant_phase']} "
+                        f"({entry['shares'][entry['dominant_phase']]:.0%} "
+                        f"of {entry['step_time_ms']} ms)"
+                    )
+            findings.append(DoctorFinding(
+                rule_id, score, f"{task}: {hint}",
+                task=task, evidence=tuple(evidence[:3]),
+            ))
+    return findings
+
+
 def _rule_timeout(ctx: _Ctx) -> "list[DoctorFinding]":
     diag = str((ctx.final or {}).get("diagnostics", ""))
     if "timed out" not in diag:
@@ -462,6 +515,7 @@ _RULES = (
     _rule_loss,
     _rule_straggler,
     _rule_io_stall,
+    _rule_step_anatomy,
 )
 
 

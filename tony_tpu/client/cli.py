@@ -321,7 +321,13 @@ def _obs_args(argv: list[str], prog: str):
                        help="watch a LIVE job: cursor-poll /api/events, "
                             "fold them through a local goodput ledger, "
                             "print the breakdown as it evolves")
-    if prog in ("events", "goodput"):
+    if prog == "top":
+        p.add_argument("--follow", action="store_true",
+                       help="refresh a LIVE job's anatomy table: poll the "
+                            "coordinator's /api/stepstats, reprint on "
+                            "change, fall back to the terminal record "
+                            "when the coordinator exits")
+    if prog in ("events", "goodput", "top"):
         p.add_argument("--poll-interval", type=float, default=1.0,
                        help="seconds between polls in --follow mode")
         p.add_argument("--max-polls", type=int, default=0,
@@ -616,6 +622,120 @@ def doctor_cmd(argv: list[str]) -> int:
         }, indent=2))
         return 0
     print(format_report(args.app_id, findings, final=final))
+    return 0
+
+
+def _resolve_stepstats(staging: Path, history: str, app_id: str):
+    """The step-anatomy fallback chain (the `tony doctor` shape): live
+    coordinator /api/stepstats → the staging final-status.json terminal
+    record's metric snapshots → job history. Returns (view, source) or
+    (None, "") — a job that predates step anatomy (or never drove an
+    instrumented step) resolves to nothing rather than an empty table."""
+    import json as _json
+
+    from tony_tpu.history.reader import job_final_status
+    from tony_tpu.observability import stepstats as stepstats_mod
+
+    live = _live_coordinator_get(staging, app_id, "/api/stepstats")
+    if isinstance(live, dict) and live.get("tasks"):
+        return live, "live"
+
+    def from_final(final) -> dict | None:
+        tasks = ((final or {}).get("metrics") or {}).get("tasks")
+        if not isinstance(tasks, dict):
+            return None
+        view = stepstats_mod.stepstats_view(tasks)
+        return view if view.get("tasks") else None
+
+    local = staging / app_id / "final-status.json"
+    if local.is_file():
+        try:
+            view = from_final(_json.loads(local.read_text()))
+            if view is not None:
+                return view, "final"
+        except ValueError:
+            pass
+    if history:
+        view = from_final(job_final_status(history, app_id))
+        if view is not None:
+            return view, "history"
+    return None, ""
+
+
+def top_cmd(argv: list[str]) -> int:
+    """``cli top <app_id>``: the per-task step anatomy — phase
+    milliseconds (data_wait / h2d / compute / collective / host), the
+    dominant phase, and MFU, live from /api/stepstats with the `tony
+    doctor` fallback chain behind it. ``--follow`` refreshes the table
+    while the job runs and prints the terminal record when it exits."""
+    import json as _json
+
+    from tony_tpu.observability import stepstats as stepstats_mod
+
+    args = _obs_args(argv, "top")
+    staging, history = _obs_locations(args)
+    if args.follow:
+        return _follow_top(staging, history, args)
+    view, source = _resolve_stepstats(staging, history, args.app_id)
+    if view is None:
+        print(f"no step anatomy found for {args.app_id}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps({"source": source, **view}, indent=2))
+        return 0
+    print(stepstats_mod.format_top(args.app_id, view, source))
+    return 0
+
+
+def _follow_top(staging: Path, history: str, args) -> int:
+    """Poll /api/stepstats on a live coordinator and reprint the table
+    as it evolves (one failed poll is not a dead coordinator — same
+    tolerance as the events follower); when the coordinator goes away,
+    print the authoritative terminal record via the fallback chain."""
+    import json as _json
+
+    from tony_tpu.observability import stepstats as stepstats_mod
+
+    saw_live = False
+    misses = 0
+    polls = 0
+    last = None
+    while True:
+        view = _live_coordinator_get(staging, args.app_id, "/api/stepstats")
+        if not isinstance(view, dict):
+            misses += 1
+            if misses >= (3 if saw_live else 1):
+                break
+            time.sleep(args.poll_interval)
+            continue
+        # Any answer from the coordinator means it is ALIVE — a job
+        # still in its first compile serves {"tasks": {}} and must be
+        # awaited, not declared dead after one poll.
+        misses = 0
+        saw_live = True
+        polls += 1
+        if view.get("tasks"):
+            rendered = (
+                _json.dumps({"source": "live", **view}) if args.as_json
+                else stepstats_mod.format_top(args.app_id, view, "live")
+            )
+            if rendered != last:  # refresh, don't spam identical tables
+                print(rendered, flush=True)
+                last = rendered
+        if args.max_polls and polls >= args.max_polls:
+            return 0
+        time.sleep(args.poll_interval)
+    view, source = _resolve_stepstats(staging, history, args.app_id)
+    if view is None:
+        if not saw_live:
+            print(f"no live coordinator (or step anatomy) for "
+                  f"{args.app_id}", file=sys.stderr)
+            return 1
+        return 0
+    if args.as_json:
+        print(_json.dumps({"source": source, **view}, indent=2))
+    else:
+        print(stepstats_mod.format_top(args.app_id, view, source))
     return 0
 
 
@@ -1125,6 +1245,7 @@ SUBMITTERS = {
     "cleanup": cleanup_resources,
     "events": events_cmd,
     "metrics": metrics_cmd,
+    "top": top_cmd,
     "doctor": doctor_cmd,
     "goodput": goodput_cmd,
     "profile": profile_cmd,

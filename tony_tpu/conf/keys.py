@@ -150,6 +150,14 @@ K_HEALTH_LOSS_SPIKE_FACTOR = HEALTH_PREFIX + "loss-spike-factor"
 K_HEALTH_HB_JITTER_FACTOR = HEALTH_PREFIX + "heartbeat-jitter-factor"
 # input-pipeline queue-wait accumulating faster than ratio × wall time.
 K_HEALTH_IO_STALL_RATIO = HEALTH_PREFIX + "io-stall-ratio"
+# tony_mfu below ratio × the task's own recent rolling median => the
+# mfu_collapse detector fires (relative on purpose: absolute MFU varies
+# by orders of magnitude across configs and hardware).
+K_HEALTH_MFU_COLLAPSE_RATIO = HEALTH_PREFIX + "mfu-collapse-ratio"
+# collective share of the step wall (tony_step_phase_ms) above this =>
+# the comms_bound detector fires: the mesh spends its step on
+# collectives, not compute.
+K_HEALTH_COMMS_BOUND_RATIO = HEALTH_PREFIX + "comms-bound-ratio"
 # Per-(detector, task) re-alert suppression window, ms.
 K_HEALTH_ALERT_COOLDOWN_MS = HEALTH_PREFIX + "alert-cooldown"
 # Ring size of the crash flight recorder (recent reports / RPC frame
@@ -166,6 +174,23 @@ K_GOODPUT_ENABLED = GOODPUT_PREFIX + "enabled"
 # Chip weight override (0 = auto: slice-plan chip total, else one per
 # task) — lets heterogeneous deployments pin the billing unit.
 K_GOODPUT_CHIPS = GOODPUT_PREFIX + "chips"
+
+# --- step anatomy (observability/stepstats.py) ------------------------------
+# Per-step phase/collective telemetry + live MFU in the USER process:
+# the instrumented train step publishes tony_step_phase_ms{phase=},
+# tony_mfu, and tony_collective_bytes_total{axis=} into the registry
+# (riding the heartbeat piggyback), and feeds measured step times back
+# into the planner's measurement table. The executor exports these as
+# TONY_STEPSTATS_* env, like tony.io.*.
+STEPSTATS_PREFIX = TONY_PREFIX + "stepstats."
+K_STEPSTATS_ENABLED = STEPSTATS_PREFIX + "enabled"
+# Feed best observed step walls into plan-measurements.json (the PR-6
+# live-calibration loop); disable for jobs whose cache dir is shared
+# with workloads that must not be recalibrated by this one.
+K_STEPSTATS_CALIBRATE = STEPSTATS_PREFIX + "calibrate"
+# Steps between calibration re-records (a record also requires the best
+# wall to actually improve — the table keeps the minimum).
+K_STEPSTATS_WINDOW = STEPSTATS_PREFIX + "window"
 
 # --- on-demand profiling (observability/profiling.py) -----------------------
 PROFILE_PREFIX = TONY_PREFIX + "profile."
@@ -355,10 +380,15 @@ DEFAULTS: dict[str, object] = {
     K_HEALTH_LOSS_SPIKE_FACTOR: 10.0,
     K_HEALTH_HB_JITTER_FACTOR: 5.0,
     K_HEALTH_IO_STALL_RATIO: 0.5,
+    K_HEALTH_MFU_COLLAPSE_RATIO: 0.5,
+    K_HEALTH_COMMS_BOUND_RATIO: 0.5,
     K_HEALTH_ALERT_COOLDOWN_MS: 30000,
     K_HEALTH_FLIGHT_LIMIT: 256,
     K_GOODPUT_ENABLED: True,
     K_GOODPUT_CHIPS: 0,
+    K_STEPSTATS_ENABLED: True,
+    K_STEPSTATS_CALIBRATE: True,
+    K_STEPSTATS_WINDOW: 32,
     K_PROFILE_DURATION_MS: 2000,
     K_PROFILE_HBM_INTERVAL_MS: 5000,
     K_PROXY_CONNECT_TIMEOUT_MS: 5000,

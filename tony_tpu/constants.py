@@ -100,6 +100,12 @@ TONY_SERVING_PREFILL_CHUNK = "TONY_SERVING_PREFILL_CHUNK"
 TONY_SERVING_DECODE_WINDOW = "TONY_SERVING_DECODE_WINDOW"
 TONY_SERVING_MAX_QUEUE = "TONY_SERVING_MAX_QUEUE"
 TONY_SERVING_PORT = "TONY_SERVING_PORT"
+# Step anatomy (tony.stepstats.* conf → user-process env →
+# observability/stepstats.py): per-step phase/MFU telemetry and the
+# live planner-calibration feedback loop.
+TONY_STEPSTATS_ENABLED = "TONY_STEPSTATS_ENABLED"
+TONY_STEPSTATS_CALIBRATE = "TONY_STEPSTATS_CALIBRATE"
+TONY_STEPSTATS_WINDOW = "TONY_STEPSTATS_WINDOW"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -119,6 +125,7 @@ DOCKER_FORWARD_ENV = (
     TONY_COMPILE_MIN_ENTRY_SIZE, TONY_PROFILE_HBM_INTERVAL_MS,
     TONY_SERVING_SLOTS, TONY_SERVING_PREFILL_CHUNK,
     TONY_SERVING_DECODE_WINDOW, TONY_SERVING_MAX_QUEUE, TONY_SERVING_PORT,
+    TONY_STEPSTATS_ENABLED, TONY_STEPSTATS_CALIBRATE, TONY_STEPSTATS_WINDOW,
 )
 
 # The executor's self-termination code after losing the coordinator (N

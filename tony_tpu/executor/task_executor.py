@@ -507,6 +507,20 @@ class TaskExecutor:
         env[constants.TONY_SERVING_DECODE_WINDOW] = str(
             self.conf.get_int(keys.K_SERVING_DECODE_WINDOW, 1)
         )
+        # Step anatomy (tony.stepstats.* conf → user-process env →
+        # observability/stepstats.py): the instrumented train step reads
+        # these at construction, so the per-step phase/MFU telemetry and
+        # the planner's live-calibration feedback are conf switches, not
+        # script changes.
+        env[constants.TONY_STEPSTATS_ENABLED] = str(
+            self.conf.get_bool(keys.K_STEPSTATS_ENABLED, True)
+        ).lower()
+        env[constants.TONY_STEPSTATS_CALIBRATE] = str(
+            self.conf.get_bool(keys.K_STEPSTATS_CALIBRATE, True)
+        ).lower()
+        env[constants.TONY_STEPSTATS_WINDOW] = str(
+            self.conf.get_int(keys.K_STEPSTATS_WINDOW, 32)
+        )
         env[constants.TONY_SERVING_MAX_QUEUE] = str(
             self.conf.get_int(keys.K_SERVING_MAX_QUEUE, 1024)
         )
@@ -516,10 +530,12 @@ class TaskExecutor:
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(
-            s.action == "fail_checkpoint_write" for s in self._fault_plan.specs
+            s.action in ("fail_checkpoint_write", "throttle_io")
+            for s in self._fault_plan.specs
         ):
-            # CheckpointManager runs in the USER process and honors
-            # fail_checkpoint_write faults from this env.
+            # CheckpointManager (fail_checkpoint_write) and the input
+            # pipeline (throttle_io) run in the USER process and honor
+            # these faults from this env.
             env[constants.TONY_FAULT_PLAN] = self._fault_plan.raw
         return env
 

@@ -311,6 +311,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
                     )
             parts.append("</table>")
         parts.extend(self._goodput_section(final, esc))
+        parts.extend(self._stepstats_section(final, esc))
         parts.extend(self._diagnosis_section(app_id, final, esc))
         parts.extend(self._metrics_section(final, esc))
         parts.extend(self._timeline_section(app_id, esc))
@@ -350,6 +351,65 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 f"<td>{esc(chip_s.get(cat))}</td><td>{share}</td></tr>"
             )
         parts.append("</table>")
+        return parts
+
+    def _stepstats_section(self, final: dict, esc) -> list[str]:
+        """Where each task's step milliseconds went: the per-task phase
+        breakdown, dominant phase, MFU, and plan-calibration residuals
+        reconstructed from the terminal record's metric snapshots (the
+        same ``observability/stepstats`` view `tony top` renders)."""
+        from tony_tpu.observability import stepstats as stepstats_mod
+
+        tasks = ((final.get("metrics") or {}).get("tasks")
+                 if isinstance(final.get("metrics"), dict) else None)
+        if not isinstance(tasks, dict):
+            return []
+        view = stepstats_mod.stepstats_view(tasks)
+        if not view.get("tasks"):
+            return []
+        fleet = view.get("fleet") or {}
+        headline = []
+        if "mfu_median" in fleet:
+            headline.append(f"fleet MFU <b>{esc(fleet['mfu_median'])}</b>")
+        if fleet.get("dominant_phase"):
+            headline.append(
+                f"dominant phase <b>{esc(fleet['dominant_phase'])}</b>"
+            )
+        parts = [
+            "<h3>Step anatomy</h3>"
+            + (f"<p>{' &middot; '.join(headline)}</p>" if headline else ""),
+            "<table><tr><th>task</th><th>step ms</th>"
+            + "".join(f"<th>{esc(p)}</th>" for p in stepstats_mod.PHASES)
+            + "<th>dominant</th><th>mfu</th></tr>",
+        ]
+        for task_id in sorted(view["tasks"]):
+            t = view["tasks"][task_id]
+            phases = t.get("phases") or {}
+            mfu = t.get("mfu")
+            parts.append(
+                f"<tr><td>{esc(task_id)}</td>"
+                f"<td>{esc(t.get('step_time_ms'))}</td>"
+                + "".join(f"<td>{esc(phases.get(p, 0.0))}</td>"
+                          for p in stepstats_mod.PHASES)
+                + f"<td>{esc(t.get('dominant_phase') or '-')}</td>"
+                + f"<td>{esc(round(mfu, 4)) if isinstance(mfu, (int, float)) else '-'}</td></tr>"
+            )
+        parts.append("</table>")
+        residuals = {
+            task_id: t["residuals"]
+            for task_id, t in view["tasks"].items() if t.get("residuals")
+        }
+        if residuals:
+            parts.append(
+                "<p>plan calibration (measured/estimated, "
+                "bucket-normalized): "
+                + " &middot; ".join(
+                    f"{esc(task_id)} {esc(plan)}={esc(r)}"
+                    for task_id, plans in sorted(residuals.items())
+                    for plan, r in sorted(plans.items())
+                )
+                + "</p>"
+            )
         return parts
 
     def _diagnosis_section(self, app_id: str, final: dict, esc) -> list[str]:

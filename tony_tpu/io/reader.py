@@ -728,10 +728,19 @@ class ShardedRecordReader:
             ) from self._fetch_exc
 
     def __iter__(self) -> Iterator[Any]:
+        # Chaos seam: a `throttle_io` entry in the job's fault plan
+        # starves this iterator deterministically (the sleep happens
+        # inside next(), so the step anatomy reads it as data_wait —
+        # exactly like a real slow input pipeline).
+        from tony_tpu.resilience.faults import io_faults_from_env
+
+        faults = io_faults_from_env()
         while True:
             batch = self.next_batch()
             if batch is None:
                 return
+            if faults is not None:
+                faults.maybe_throttle()
             yield batch
 
     def close(self) -> None:

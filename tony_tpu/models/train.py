@@ -19,6 +19,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tony_tpu import observability
+from tony_tpu.observability import stepstats as stepstats_mod
 
 from tony_tpu.models.mnist import MnistConfig, mnist_apply, mnist_init
 from tony_tpu.models.transformer import (
@@ -39,22 +40,38 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
-def _instrumented(step_fn):
+def _instrumented(step_fn, stats: "stepstats_mod.StepStats | None" = None):
     """Count dispatches + host-side dispatch time into the process
     registry (telemetry plane). Deliberately measures only the DISPATCH
     (async under jit — no sync is forced here): the loss readback the
-    caller already does is where step wall time gets reported."""
+    caller already does is where step wall time gets reported.
+
+    ``stats`` (observability/stepstats.py) turns the same hook into the
+    per-step anatomy feed: the interval between consecutive dispatches
+    is the completed step's wall (donation-safe — nothing re-reads the
+    donated state), the first batch argument's shape sizes the MFU /
+    collective model, and the dispatch time is the ``host`` phase. The
+    recorder rides the returned step as ``step.stepstats`` so train
+    loops can wire their batch iterator in (``stats.wrap_batches``)."""
     registry = observability.default_registry()
     dispatches = registry.counter("train_step_dispatches_total")
     dispatch_s = registry.histogram("train_step_dispatch_seconds")
 
     def step(*args, **kwargs):
+        if stats is not None:
+            stats.step_begin(
+                getattr(args[1], "shape", None) if len(args) > 1 else None
+            )
         t0 = time.perf_counter()
         out = step_fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
         dispatches.inc()
-        dispatch_s.observe(time.perf_counter() - t0)
+        dispatch_s.observe(dt)
+        if stats is not None:
+            stats.step_end(dt)
         return out
 
+    step.stepstats = stats
     return step
 
 
@@ -292,13 +309,26 @@ def make_train_step(
         ),
     )
 
+    # Step anatomy: every dispatch of this step feeds the phase/MFU/
+    # calibration recorder. Workload sizing comes from the assembled
+    # GLOBAL tokens below, not the dispatch-hook shape — on a
+    # multi-process mesh the hook only sees this process's shard, which
+    # would understate MFU and mis-bucket plan calibration by the
+    # process count.
+    stats = stepstats_mod.StepStats(
+        cfg=cfg, plan=plan, mesh=mesh,
+        microbatches=pipeline_microbatches, size_from_shapes=False,
+    )
+
     def step(state, tokens):
         # Re-shard the host batch explicitly: jit rejects (rather than
         # reshards) committed args whose sharding differs from in_shardings
         # (and multi-process meshes need the local->global assembly).
-        return jit_step(state, _to_global_batch(tokens, batch_sh))
+        tokens = _to_global_batch(tokens, batch_sh)
+        stats.set_workload(tokens.shape[0], max(tokens.shape[1] - 1, 1))
+        return jit_step(state, tokens)
 
-    return jit_init, _instrumented(step)
+    return jit_init, _instrumented(step, stats)
 
 
 def make_classifier_step(
@@ -441,4 +471,10 @@ def make_image_classifier_step(
             _to_global_batch(labels, batch_sh),
         )
 
-    return jit_init, _instrumented(step)
+    # Step anatomy for classifiers: phases + calibration, no MFU (image
+    # shapes don't carry a flops model the way token shapes do).
+    stats = stepstats_mod.StepStats(
+        cfg=config, mesh=mesh, steps_per_call=steps_per_call,
+        tokens_workload=False,
+    )
+    return jit_init, _instrumented(step, stats)

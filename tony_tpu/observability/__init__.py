@@ -20,6 +20,10 @@ process in the job:
   served on ``/api/goodput`` and ``tony goodput``.
 * ``profiling``  — on-demand distributed capture (heartbeat fan-out)
   plus the continuous per-device HBM gauge monitor.
+* ``stepstats``  — per-step anatomy: the exclusive data_wait/h2d/
+  compute/collective/host phase breakdown, live MFU, and the planner
+  cost-model calibration feedback, served on ``/api/stepstats`` and
+  ``tony top``.
 """
 
 from __future__ import annotations
@@ -31,12 +35,14 @@ from tony_tpu.observability.metrics import (
     default_registry,
     report,
 )
+from tony_tpu.observability.stepstats import StepStats
 from tony_tpu.observability.trace import Tracer, default_tracer, span
 
 __all__ = [
     "EventLog",
     "GoodputLedger",
     "MetricsRegistry",
+    "StepStats",
     "Tracer",
     "default_registry",
     "default_tracer",

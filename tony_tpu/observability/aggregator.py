@@ -15,6 +15,9 @@ series of every gauge, and serves:
   --follow`` tailing);
 * ``GET /api/health``   — the streaming health state (straggler
   scores, per-task liveness, recent alerts);
+* ``GET /api/stepstats`` — the step-anatomy view (per-task phase
+  breakdown, MFU, collective bytes, plan-calibration residuals —
+  ``observability/stepstats.py``);
 * ``GET /api/trace``    — the Chrome trace document so far.
 
 The port comes from ``tony.am.http-port`` (0 = ephemeral, "disabled" =
@@ -93,11 +96,21 @@ def _histogram_family(obj: Any) -> dict[str, dict[str, Any]]:
             except (TypeError, ValueError):
                 continue
         try:
-            out[str(name)] = {
+            entry = {
                 "count": int(h.get("count", 0)),
                 "sum": float(h.get("sum", 0.0)),
                 "buckets": buckets,
             }
+            # The observed max rides through normalization so quantile
+            # readouts over AGGREGATED snapshots clamp the same way
+            # in-process ones do (histogram_quantile's single-sample
+            # guard needs it on both sides of the heartbeat).
+            raw_max = h.get("max")
+            if isinstance(raw_max, (int, float)) and not isinstance(
+                raw_max, bool
+            ):
+                entry["max"] = float(raw_max)
+            out[str(name)] = entry
         except (TypeError, ValueError):
             continue
     return out
@@ -130,6 +143,10 @@ class MetricsAggregator:
         self._last_seen: dict[str, float] = {}  # task -> wall-clock s
         # (task_id, gauge name) -> deque[(ts_ms, value)]
         self._series: dict[tuple[str, str], collections.deque] = {}
+        # task -> live steps/sec between its last two snapshots
+        # (stepstats.counter_rate clamps a restarted task's counter
+        # reset to zero rather than a negative rate).
+        self._step_rates: dict[str, float] = {}
 
     def ingest(
         self, task_id: str, snapshot: Mapping[str, Any] | None,
@@ -155,6 +172,20 @@ class MetricsAggregator:
                 }
                 if not isinstance(snap["ts_ms"], (int, float)):
                     snap["ts_ms"] = int(time.time() * 1000)
+                prev = self._latest.get(task_id)
+                if prev is not None \
+                        and "train_steps_total" in snap["counters"]:
+                    from tony_tpu.observability.stepstats import (
+                        counter_rate,
+                    )
+
+                    self._step_rates[task_id] = round(counter_rate(
+                        float(prev.get("counters", {})
+                              .get("train_steps_total", 0.0)),
+                        float(snap["counters"]["train_steps_total"]),
+                        (snap["ts_ms"]
+                         - (prev.get("ts_ms") or snap["ts_ms"])) / 1000.0,
+                    ), 3)
                 self._latest[task_id] = snap
                 ts = snap["ts_ms"]
                 for name, value in snap["gauges"].items():
@@ -264,6 +295,17 @@ class MetricsAggregator:
                 },
             }
 
+    def stepstats_json(self) -> dict[str, Any]:
+        """The ``/api/stepstats`` document: per-task step anatomy
+        (phase breakdown, MFU, collective bytes, plan residuals) plus
+        the fleet roll-up, derived from the latest snapshots."""
+        from tony_tpu.observability import stepstats as stepstats_mod
+
+        with self._lock:
+            latest = {t: dict(s) for t, s in self._latest.items()}
+            rates = dict(self._step_rates)
+        return stepstats_mod.stepstats_view(latest, step_rates=rates)
+
     def summary(self) -> dict[str, Any]:
         """Compact terminal record for final-status.json / history —
         json-safe (final-status must stay parseable however training
@@ -331,6 +373,8 @@ class _ObsHandler(BaseHTTPRequestHandler):
                                     status=404)
                 else:
                     self._send_json(self.control.profile_status())
+            elif path == "/api/stepstats":
+                self._send_json(self.aggregator.stepstats_json())
             elif path == "/api/health":
                 self._send_json(
                     self.health.to_json() if self.health is not None

@@ -54,9 +54,24 @@ LOSS_NAN = "loss_nan"
 LOSS_SPIKE = "loss_spike"
 HEARTBEAT_JITTER = "heartbeat_jitter"
 IO_STALL = "io_stall"
+# Step-anatomy detectors (observability/stepstats.py feeds the gauges):
+# a task whose MFU collapsed below a fraction of its own recent median,
+# and a task whose step is dominated by collective time.
+MFU_COLLAPSE = "mfu_collapse"
+COMMS_BOUND = "comms_bound"
+
+# The complete catalogue — ``tony doctor`` evidence filters and the
+# DEPLOY.md detector table key off these names; tools/lint_self.py
+# fails tier-1 when one goes undocumented.
+DETECTORS = (
+    STRAGGLER, PROGRESS_STALL, LOSS_NAN, LOSS_SPIKE, HEARTBEAT_JITTER,
+    IO_STALL, MFU_COLLAPSE, COMMS_BOUND,
+)
 
 _QUEUE_WAIT_HISTOGRAM = "tony_io_queue_wait_ms"
 _LOSS_WINDOW = 16
+_MFU_WINDOW = 16
+_MFU_MIN_SAMPLES = 6
 
 
 @dataclass(frozen=True)
@@ -69,6 +84,11 @@ class HealthConfig:
     loss_spike_factor: float = 10.0
     heartbeat_jitter_factor: float = 5.0
     io_stall_ratio: float = 0.5
+    # MFU below ratio × the task's own recent median => collapse alert
+    # (relative, so a CPU smoke job's tiny absolute MFU still detects).
+    mfu_collapse_ratio: float = 0.5
+    # collective phase share of the step wall above this => comms-bound.
+    comms_bound_ratio: float = 0.5
     alert_cooldown_ms: int = 30000
     heartbeat_interval_ms: int = 1000
 
@@ -91,6 +111,12 @@ class HealthConfig:
                 keys.K_HEALTH_HB_JITTER_FACTOR, 5.0
             ),
             io_stall_ratio=conf.get_float(keys.K_HEALTH_IO_STALL_RATIO, 0.5),
+            mfu_collapse_ratio=conf.get_float(
+                keys.K_HEALTH_MFU_COLLAPSE_RATIO, 0.5
+            ),
+            comms_bound_ratio=conf.get_float(
+                keys.K_HEALTH_COMMS_BOUND_RATIO, 0.5
+            ),
             alert_cooldown_ms=conf.get_int(
                 keys.K_HEALTH_ALERT_COOLDOWN_MS, 30000
             ),
@@ -119,6 +145,9 @@ class _TaskHealth:
     )
     io_wait_ms: float | None = None
     io_wall_ms: float | None = None
+    mfus: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=_MFU_WINDOW)
+    )
 
 
 def mad_scores(values: Mapping[str, float]) -> dict[str, float]:
@@ -190,6 +219,7 @@ class HealthMonitor:
                 self._check_loss(task_id, state, gauges, now, alerts)
                 self._check_straggler(task_id, state, gauges, now, alerts)
                 self._check_io(task_id, state, histograms, now, alerts)
+                self._check_stepstats(task_id, state, gauges, now, alerts)
         for alert in alerts:
             self._publish(alert)
 
@@ -298,6 +328,49 @@ class HealthMonitor:
                             stall_ratio=round(d_wait / d_wall, 3))
         state.io_wait_ms = wait_ms
         state.io_wall_ms = wall_ms
+
+    def _check_stepstats(self, task_id, state, gauges, now, alerts) -> None:
+        """The step-anatomy detectors, fed by stepstats' gauges riding
+        the same snapshot as everything else.
+
+        mfu_collapse compares the task's MFU to its OWN rolling median
+        (not an absolute bar — a CPU smoke job at 1e-4 MFU collapses the
+        same way a v5e job at 0.6 does); comms_bound reads the phase
+        breakdown directly: when the collective share of the step wall
+        crosses the threshold, scaling further on this mesh buys
+        communication, not compute."""
+        from tony_tpu.observability import stepstats as stepstats_mod
+        from tony_tpu.observability.metrics import parse_labeled_key
+
+        mfu = gauges.get(stepstats_mod.MFU_GAUGE)
+        if mfu is not None and math.isfinite(mfu) and mfu > 0:
+            if len(state.mfus) >= _MFU_MIN_SAMPLES:
+                med = _median(sorted(state.mfus))
+                if med > 0 and mfu < self.config.mfu_collapse_ratio * med:
+                    self._queue(alerts, MFU_COLLAPSE, task_id, now,
+                                f"mfu {mfu:.4g} collapsed below "
+                                f"{self.config.mfu_collapse_ratio:g}× "
+                                f"recent median {med:.4g}",
+                                mfu=round(mfu, 5), median=round(med, 5))
+            state.mfus.append(mfu)
+        phases = {}
+        for key, value in gauges.items():
+            base, labels = parse_labeled_key(str(key))
+            if base == stepstats_mod.STEP_PHASE_GAUGE:
+                phase = labels.get("phase")
+                if phase and math.isfinite(value) and value >= 0:
+                    phases[phase] = value
+        total = sum(phases.values())
+        if total > 0:
+            share = phases.get("collective", 0.0) / total
+            if share > self.config.comms_bound_ratio:
+                self._queue(alerts, COMMS_BOUND, task_id, now,
+                            f"collective time is {share:.0%} of the step "
+                            f"(threshold "
+                            f"{self.config.comms_bound_ratio:.0%}) — the "
+                            f"mesh is communication-bound",
+                            share=round(share, 3),
+                            step_ms=round(total, 2))
 
     # -- alert plumbing ------------------------------------------------------
     def _queue(self, alerts, detector, task_id, now, reason, **data) -> None:

@@ -93,6 +93,19 @@ def split_labeled_key(key: str) -> tuple[str, str]:
     return (base, rest[:-1] if sep and rest.endswith("}") else "")
 
 
+_LABEL_PAIR_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_labeled_key(key: str) -> tuple[str, dict[str, str]]:
+    """(base_name, {label: value}) — ``split_labeled_key`` with the
+    inline-label text parsed into a dict, for consumers that filter
+    snapshot keys by label value (stepstats' phase gauges, the health
+    detectors reading them back)."""
+    base, inline = split_labeled_key(key)
+    return base, {m.group(1): m.group(2)
+                  for m in _LABEL_PAIR_RE.finditer(inline)}
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -155,6 +168,7 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
+        self._max = -math.inf
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -162,6 +176,8 @@ class Histogram:
         with self._lock:
             self._sum += value
             self._count += 1
+            if value > self._max:
+                self._max = value
             for i, bound in enumerate(self.bounds):
                 if value <= bound:
                     self._counts[i] += 1
@@ -175,11 +191,17 @@ class Histogram:
             for bound, n in zip(self.bounds, self._counts):
                 running += n
                 cumulative.append([bound, running])
-            return {
+            snap = {
                 "count": self._count,
                 "sum": self._sum,
                 "buckets": cumulative,
             }
+            if self._count:
+                # The observed max rides along so quantile readouts can
+                # clamp: a single 3 ms sample must read as 3 ms, not as
+                # its bucket's 5 ms upper bound (histogram_quantile).
+                snap["max"] = self._max
+            return snap
 
 
 class MetricsRegistry:
@@ -246,6 +268,15 @@ class MetricsRegistry:
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)
+
+    def peek(self, name: str) -> Counter | Gauge | Histogram | None:
+        """The registered metric under ``name`` (a labeled sample key is
+        a name too), or None — read-side access that never registers:
+        consumers sampling another subsystem's telemetry (stepstats
+        reading the data plane's io histograms) must not create empty
+        series when that subsystem is absent."""
+        with self._lock:
+            return self._metrics.get(name)
 
     # -- the train-loop API ------------------------------------------------
     def report(self, step: int | None = None, **values: float) -> None:
@@ -347,15 +378,28 @@ def histogram_quantile(snapshot: Mapping[str, Any], q: float) -> float | None:
     """Upper-bound estimate of quantile ``q`` from a histogram snapshot
     (``{"count", "sum", "buckets": [[le, cumulative], ...]}``): the
     bound of the first bucket whose cumulative count crosses the target
-    rank. Observations past the last bound (the +Inf bucket) fall back
-    to the mean so the readout stays finite. None when empty."""
+    rank, clamped to the snapshot's observed ``max`` when it carries
+    one — without the clamp a single-sample histogram "interpolates" to
+    its bucket's upper bound (a 3 ms observation reads as 5 ms, and a
+    p95 over one sample overstates by up to a whole bucket).
+    Observations past the last bound (the +Inf bucket) read as the
+    observed max when known, else the mean, so the readout stays
+    finite. None when empty."""
     count = int(snapshot.get("count", 0) or 0)
     if count <= 0:
         return None
+    observed_max: float | None = None
+    raw_max = snapshot.get("max")
+    if isinstance(raw_max, (int, float)) and math.isfinite(raw_max):
+        observed_max = float(raw_max)
     target = q * count
     for bound, cum in snapshot.get("buckets") or []:
         if cum >= target:
-            return float(bound)
+            bound = float(bound)
+            return min(bound, observed_max) if observed_max is not None \
+                else bound
+    if observed_max is not None:
+        return observed_max
     total = float(snapshot.get("sum", 0.0) or 0.0)
     return total / count
 

@@ -607,24 +607,23 @@ _HOP_LATENCY = {"tp": 1.0, "sp": 1.5, "ep": 2.0, "pp": 1.0, "dp": 0.5}
 _HOP_UNIT = 1e6
 
 
-def estimate_cost(
+def estimate_phases(
     plan: Plan,
     cfg,
     *,
     global_batch: int | None = None,
     seq: int | None = None,
-) -> float:
-    """Relative step-time estimate (arbitrary units; only the ORDER of
-    candidates matters — measured step times recalibrate the scale).
-
-    compute: total model flops / devices, inflated by (a) the pipeline
-    bubble (pp-1)/m on the gpipe trunk and (b) an MXU-fill penalty when
-    a tp split drives the per-shard contraction dims under the 128-deep
-    MXU width (the BENCH r05 lesson: hd128 runs 0.65 MFU where the
-    half-filled default runs 0.53 — splits that leave narrow matmuls
-    waste the array even at perfect balance).
-    comm: per-axis byte estimates weighted by ``_COMM_COST``.
-    """
+) -> dict[str, Any]:
+    """The cost model's compute/communication decomposition for one
+    plan: ``{"compute": units, "collective": units, "comm_bytes":
+    {axis: bytes/step}}``. ``estimate_cost`` sums the two unit terms
+    (the planner's ranking); the stepstats layer uses the RATIO
+    (collective / total) to split a measured device residual into
+    compute vs collective phases, and the per-axis byte estimates to
+    drive ``tony_collective_bytes_total{axis=}``. Units are arbitrary
+    but shared, so the share and the bytes are meaningful even before
+    any measurement calibrates the absolute scale. An illegal plan
+    (pipeline axis without microbatching) reads as infinite compute."""
     s = plan.mesh_spec
     d_model = getattr(cfg, "d_model", 512)
     d_ff = getattr(cfg, "d_ff", 4 * d_model)
@@ -657,34 +656,67 @@ def estimate_cost(
         m = plan.microbatches
         compute *= (m + s.pp - 1) / m
     elif s.pp > 1:
-        return math.inf  # pipeline axis without microbatching: illegal
+        compute = math.inf  # pipeline axis without microbatching: illegal
 
-    # Communication volumes (bytes-ish; constants folded into weights).
+    # Communication volumes per axis, in ELEMENTS (weights fold the
+    # per-byte cost differences); ``elems`` feeds both the weighted
+    # cost term and the bytes estimate stepstats reports.
     act = batch * seq * d_model / max(s.dp * s.ep * s.sp, 1)
-    comm = 0.0
+    elems: dict[str, float] = {}
     if s.tp > 1:  # 4 (ag + rs) pairs per layer on the megatron split
-        comm += _COMM_COST["tp"] * 4 * n_layers * act * (s.tp - 1) / s.tp
+        elems["tp"] = 4 * n_layers * act * (s.tp - 1) / s.tp
     if s.sp > 1:  # ring K/V pass per layer
         kv = batch * seq * n_kv * head_dim / max(s.dp * s.ep, 1)
-        comm += _COMM_COST["sp"] * 2 * n_layers * kv * (s.sp - 1) / s.sp
+        elems["sp"] = 2 * n_layers * kv * (s.sp - 1) / s.sp
     if s.ep > 1:  # token all_to_all both ways per layer
-        comm += _COMM_COST["ep"] * 2 * n_layers * act * (s.ep - 1) / s.ep
+        elems["ep"] = 2 * n_layers * act * (s.ep - 1) / s.ep
     if s.pp > 1:
         # Stage-boundary activations: each microbatch carries act/m and
         # crosses pp-1 boundaries — total volume is m-independent (m
         # shows up as bubble relief above and per-hop launches below).
-        comm += _COMM_COST["pp"] * act * (s.pp - 1)
+        elems["pp"] = act * (s.pp - 1)
     if s.dp > 1:  # gradient psum over the sharded params
-        w = _COMM_COST["dp"] * (
-            _DCN_PENALTY if plan.num_slices > 1 else 1.0
-        )
-        comm += w * 2 * n_params * (s.dp - 1) / s.dp
+        elems["dp"] = 2 * n_params * (s.dp - 1) / s.dp
+    comm = sum(
+        _COMM_COST[ax] * (
+            _DCN_PENALTY if ax == "dp" and plan.num_slices > 1 else 1.0
+        ) * v
+        for ax, v in elems.items()
+    )
     # Fixed launch overhead: (axis_size - 1) hops per collective round.
     hops = sum(
         _HOP_LATENCY[ax] * (getattr(s, ax) - 1) * n_layers
         for ax in ("tp", "sp", "ep", "pp")
     ) + _HOP_LATENCY["dp"] * (s.dp - 1)
-    return compute + comm * _ELEM_UNIT + hops * _HOP_UNIT
+    elem_bytes = 2.0 if "16" in str(getattr(cfg, "dtype", "")) else 4.0
+    return {
+        "compute": compute,
+        "collective": comm * _ELEM_UNIT + hops * _HOP_UNIT,
+        "comm_bytes": {ax: v * elem_bytes for ax, v in elems.items()},
+    }
+
+
+def estimate_cost(
+    plan: Plan,
+    cfg,
+    *,
+    global_batch: int | None = None,
+    seq: int | None = None,
+) -> float:
+    """Relative step-time estimate (arbitrary units; only the ORDER of
+    candidates matters — measured step times recalibrate the scale).
+
+    compute: total model flops / devices, inflated by (a) the pipeline
+    bubble (pp-1)/m on the gpipe trunk and (b) an MXU-fill penalty when
+    a tp split drives the per-shard contraction dims under the 128-deep
+    MXU width (the BENCH r05 lesson: hd128 runs 0.65 MFU where the
+    half-filled default runs 0.53 — splits that leave narrow matmuls
+    waste the array even at perfect balance).
+    comm: per-axis byte estimates weighted by ``_COMM_COST`` (see
+    ``estimate_phases`` for the decomposition itself).
+    """
+    est = estimate_phases(plan, cfg, global_batch=global_batch, seq=seq)
+    return est["compute"] + est["collective"]
 
 
 # ---------------------------------------------------------------------------
@@ -810,3 +842,63 @@ def plan_for(
         return measured[k] if k in measured else est[k] * scale
 
     return min(plans, key=cost)
+
+
+def plan_from_mesh(mesh, *, microbatches: int | None = None,
+                   num_slices: int = 1, **kwargs) -> Plan:
+    """The Plan implied by an already-built mesh — for callers that
+    constructed their mesh by hand (``make_train_step(cfg, mesh)``, the
+    common example-script path) but still want plan-keyed telemetry and
+    live calibration: axis sizes come straight from the mesh shape,
+    unknown axis names replicate into dp=1 semantics (they size 1 on
+    the 5-axis meshes this framework builds)."""
+    shape = dict(mesh.shape)
+    spec = MeshSpec(**{ax: int(shape.get(ax, 1)) for ax in AXES})
+    return Plan(spec, num_slices=num_slices, microbatches=microbatches,
+                **kwargs)
+
+
+def calibration_residuals(
+    cfg,
+    num_devices: int,
+    *,
+    num_slices: int = 1,
+    global_batch: int | None = None,
+    seq: int | None = None,
+    cache_dir: str | None = None,
+) -> dict[str, float]:
+    """Per-plan calibration residuals for one measurement bucket:
+    ``measured/estimated`` normalized by the bucket's mean ratio (the
+    same scale ``plan_for`` recalibrates unmeasured candidates with).
+    A residual of 1.0 means the cost model ranks this plan exactly as
+    the fleet's calibration predicts; spread across plans is model
+    error, drift over time on ONE plan is the hardware or the input
+    pipeline changing under the job. Served per task as
+    ``tony_plan_residual{plan=}`` and aggregated on /api/stepstats."""
+    measured = load_measurements(cache_dir=cache_dir).get(
+        _model_bucket(cfg, num_devices, global_batch, seq), {}
+    )
+    if not measured:
+        return {}
+    try:
+        plans = candidate_plans(
+            cfg, num_devices, num_slices=num_slices,
+            global_batch=global_batch, seq=seq,
+        )
+    except Exception:
+        return {}
+    est = {
+        p.key(): estimate_cost(p, cfg, global_batch=global_batch, seq=seq)
+        for p in plans
+    }
+    ratios = {
+        k: measured[k] / est[k]
+        for k in measured
+        if k in est and math.isfinite(est[k]) and est[k] > 0
+    }
+    if not ratios:
+        return {}
+    scale = sum(ratios.values()) / len(ratios)
+    if scale <= 0:
+        return {}
+    return {k: r / scale for k, r in ratios.items()}

@@ -20,7 +20,9 @@ Plan shape (inline JSON in the conf value, or a path to a JSON file)::
         {"action": "drop_heartbeats", "target": "worker:0", "count": 10},
         {"action": "delay_heartbeats", "target": "worker:0", "ms": 250, "count": 5},
         {"action": "blackout_rpc", "target": "worker:0", "after_ms": 2000, "ms": 1500},
-        {"action": "fail_checkpoint_write", "step": 10, "count": 1}
+        {"action": "fail_checkpoint_write", "step": 10, "count": 1},
+        {"action": "throttle_io", "target": "worker:0", "ms": 50,
+         "after_batches": 4, "count": 100}
       ]
     }
 
@@ -53,6 +55,11 @@ blackout_rpc           every RPC from the target executor raises for the
 fail_checkpoint_write  ``CheckpointManager.save`` raises at ``step``
                        (reads the plan from ``TONY_FAULT_PLAN`` in the
                        user process)
+throttle_io            the input pipeline sleeps ``ms`` before each of the
+                       next ``count`` batches once ``after_batches`` have
+                       been served (starved-input simulation — flips the
+                       step anatomy's dominant phase to ``data_wait``;
+                       reads ``TONY_FAULT_PLAN`` in the user process)
 =====================  =====================================================
 
 The legacy ``TEST_AM_CRASH`` / ``TEST_WORKER_TERMINATION`` env vars remain
@@ -81,6 +88,7 @@ DROP_HEARTBEATS = "drop_heartbeats"
 DELAY_HEARTBEATS = "delay_heartbeats"
 BLACKOUT_RPC = "blackout_rpc"
 FAIL_CHECKPOINT_WRITE = "fail_checkpoint_write"
+THROTTLE_IO = "throttle_io"
 
 COORDINATOR_PHASES = ("prepare", "schedule", "monitor")
 
@@ -99,6 +107,10 @@ _FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
     DELAY_HEARTBEATS: (frozenset({"target", "ms"}), frozenset()),
     BLACKOUT_RPC: (frozenset({"ms"}), frozenset({"target", "after_ms"})),
     FAIL_CHECKPOINT_WRITE: (frozenset({"step"}), frozenset({"target"})),
+    THROTTLE_IO: (
+        frozenset({"ms"}),
+        frozenset({"target", "after_batches"}),
+    ),
 }
 _COMMON_FIELDS = frozenset({"action", "session", "count"})
 
@@ -126,6 +138,7 @@ class FaultSpec:
     after_ms: int | None = None
     after_heartbeats: int | None = None
     step: int | None = None
+    after_batches: int = 0
 
     def in_session(self, session: int) -> bool:
         return self.session is None or self.session == session
@@ -181,6 +194,9 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
     step = obj.get("step")
     if step is not None:
         step = _positive_int(step, f"{where}.step", errors, 0)
+    after_batches = _positive_int(
+        obj.get("after_batches", 0), f"{where}.after_batches", errors, 0
+    )
 
     target = obj.get("target")
     if target is not None:
@@ -240,16 +256,22 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
                 f"{where}: exit_executor needs a concrete 'job:index' "
                 f"target"
             )
-    if action in (DROP_HEARTBEATS, DELAY_HEARTBEATS, FAIL_CHECKPOINT_WRITE):
+    if action in (DROP_HEARTBEATS, DELAY_HEARTBEATS, FAIL_CHECKPOINT_WRITE,
+                  THROTTLE_IO):
         if target == ANY_NON_CHIEF:
             errors.append(
                 f"{where}: {action} needs a concrete 'job:index' target"
             )
+    if action == THROTTLE_IO and ms == 0:
+        errors.append(
+            f"{where}.ms must be nonzero for throttle_io (a 0 ms "
+            f"throttle tests nothing)"
+        )
 
     return FaultSpec(
         action=action, target=target, at=at, phase=phase, session=session,
         count=count, code=code, ms=ms, after_ms=after_ms,
-        after_heartbeats=after_hb, step=step,
+        after_heartbeats=after_hb, step=step, after_batches=after_batches,
     )
 
 
@@ -516,21 +538,84 @@ class CheckpointFaults:
             )
 
 
-def checkpoint_faults_from_env() -> CheckpointFaults | None:
-    """Lazy singleton over ``TONY_FAULT_PLAN`` — called from
-    ``CheckpointManager.save`` on every write, so the env parse happens
-    once per process."""
-    global _ckpt_faults
-    if _ckpt_faults is not False:
-        return _ckpt_faults
+class IoFaults:
+    """``throttle_io`` applied batch-by-batch in the user process: the
+    input pipeline calls ``maybe_throttle()`` once per batch served and
+    this sleeps the configured delay for the next ``count`` batches once
+    ``after_batches`` have gone by — a deterministic starved-input
+    pipeline, injected where real input stalls happen (so the step
+    anatomy attributes it to ``data_wait`` like any real stall)."""
+
+    def __init__(self, plan: FaultPlan, task_id: str | None,
+                 session: int = 1, sleep=time.sleep) -> None:
+        self._specs = [
+            (i, s) for i, s in enumerate(plan.specs)
+            if s.action == THROTTLE_IO
+            and (s.target is None or s.target == task_id)
+            and s.in_session(session)
+        ]
+        self._sleep = sleep
+        self._served = 0
+        self._fired: dict[int, int] = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    def maybe_throttle(self) -> None:
+        self._served += 1
+        delay_ms = 0
+        for idx, spec in self._specs:
+            if self._served <= spec.after_batches:
+                continue
+            if self._fired.get(idx, 0) >= spec.count:
+                continue
+            self._fired[idx] = self._fired.get(idx, 0) + 1
+            delay_ms = max(delay_ms, spec.ms)
+        if delay_ms:
+            self._sleep(delay_ms / 1000.0)
+
+
+_io_faults: "IoFaults | None | bool" = False  # False = not loaded
+
+
+def io_faults_from_env() -> IoFaults | None:
+    """Lazy singleton over ``TONY_FAULT_PLAN`` for ``throttle_io`` —
+    called from the batch-serving paths (io/reader.py's batch iterator
+    and the examples' synthetic corpora), so chaos plans can starve the
+    input side of any train loop without touching the script."""
+    global _io_faults
+    if _io_faults is not False:
+        return _io_faults
+    plan, task_id, session = _user_process_plan()
+    _io_faults = (
+        IoFaults(plan, task_id, session)
+        if plan is not None and any(
+            s.action == THROTTLE_IO for s in plan.specs
+        ) else None
+    )
+    return _io_faults
+
+
+_env_plan: "tuple[FaultPlan | None, str | None, int] | None" = None
+
+
+def _user_process_plan() -> "tuple[FaultPlan | None, str | None, int]":
+    """Parse ``TONY_FAULT_PLAN`` plus the task identity env — the shared
+    entry for every user-process fault consumer. Parsed once per
+    process (the env is immutable for the process lifetime): a
+    malformed plan logs its warning once, not once per consumer."""
     import os
 
     from tony_tpu import constants
 
+    global _env_plan
+    if _env_plan is not None:
+        return _env_plan
     raw = os.environ.get(constants.TONY_FAULT_PLAN)
     if not raw:
-        _ckpt_faults = None
-        return None
+        _env_plan = (None, None, 1)
+        return _env_plan
     task_id = None
     if constants.JOB_NAME in os.environ and constants.TASK_INDEX in os.environ:
         task_id = (f"{os.environ[constants.JOB_NAME]}:"
@@ -540,10 +625,24 @@ def checkpoint_faults_from_env() -> CheckpointFaults | None:
     except ValueError:
         session = 1
     try:
-        _ckpt_faults = CheckpointFaults(FaultPlan.parse(raw), task_id,
-                                        session)
+        _env_plan = (FaultPlan.parse(raw), task_id, session)
     except FaultPlanError:
         log.warning("ignoring unparseable %s", constants.TONY_FAULT_PLAN,
                     exc_info=True)
-        _ckpt_faults = None
+        _env_plan = (None, None, session)
+    return _env_plan
+
+
+def checkpoint_faults_from_env() -> CheckpointFaults | None:
+    """Lazy singleton over ``TONY_FAULT_PLAN`` — called from
+    ``CheckpointManager.save`` on every write, so the env parse happens
+    once per process."""
+    global _ckpt_faults
+    if _ckpt_faults is not False:
+        return _ckpt_faults
+    plan, task_id, session = _user_process_plan()
+    _ckpt_faults = (
+        CheckpointFaults(plan, task_id, session)
+        if plan is not None else None
+    )
     return _ckpt_faults

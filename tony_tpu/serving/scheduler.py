@@ -21,7 +21,11 @@ wall. Telemetry goes through the PR-3 observability registry —
 ``tony_serving_{queue_depth,active_slots,ttft_ms,inter_token_ms,
 tokens_per_sec}`` plus request/token counters — so a tony-launched
 serving task's numbers ride heartbeats onto the coordinator's
-``/metrics`` and the health detectors see serving load.
+``/metrics`` and the health detectors see serving load. The two
+dispatches also record sampled ``serving_decode_window`` /
+``serving_prefill_chunks`` trace spans (dense through warmup, then
+decimated), so the serving engine shows up in the job's Chrome trace
+beside the coordinator and training waterfalls.
 
 Greedy parity contract (pinned by tests/test_serving.py): a request
 decoded through the slot engine yields token-for-token the same output
@@ -32,6 +36,7 @@ step is the same math at per-slot positions.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
 import logging
@@ -46,6 +51,7 @@ log = logging.getLogger(__name__)
 from tony_tpu.models.decode import _decode_weights_jit
 from tony_tpu.models.transformer import TransformerConfig
 from tony_tpu.observability import metrics as obs_metrics
+from tony_tpu.observability import trace as obs_trace
 from tony_tpu.serving import engine as _engine
 
 # ms-scale buckets for the serving latency histograms (the registry
@@ -223,6 +229,7 @@ class ServingEngine:
         self._iter = 0
         self._decode_calls = 0
         self._pf_draws = 0
+        self._spans_taken: dict[str, int] = {}
         # Engine-local tallies: the registry counters below may be the
         # process-wide default registry (shared by every engine in the
         # process), so stats()/tokens_generated must not read them back.
@@ -434,6 +441,23 @@ class ServingEngine:
                     req.error = f"engine loop failed: {exc}"
                     req._done.set()
 
+    # Trace sampling for the engine's dispatch spans: the serving loop
+    # is the hottest dispatch path in the framework and the Tracer
+    # buffers spans in memory for the job-trace merge, so the first
+    # iterations record densely (compile + ramp — the part a waterfall
+    # reader wants) and the steady state is decimated; a week-long
+    # engine cannot grow the trace without bound.
+    _SPAN_DENSE = 64
+    _SPAN_EVERY = 256
+
+    def _dispatch_span(self, name: str, **attrs):
+        n = self._spans_taken.get(name, 0)
+        self._spans_taken[name] = n + 1
+        if n < self._SPAN_DENSE or n % self._SPAN_EVERY == 0:
+            return obs_trace.default_tracer().span(name, iteration=n,
+                                                   **attrs)
+        return contextlib.nullcontext()
+
     # -- the iteration -----------------------------------------------------
     def step(self) -> bool:
         """One engine iteration (admit -> prefill chunk(s) -> decode
@@ -455,13 +479,19 @@ class ServingEngine:
             # [2**30, 2**31): modular so a long-lived engine can neither
             # overflow int32 nor cross domains (keys repeat only after
             # 2**30 draws of the same kind — billions of tokens).
-            self._k, self._v, window = self._decode(
-                self.params, self._k, self._v, self._pos, wpos,
-                self._last, self._temp, self._base_key,
-                np.int32((self._decode_calls * w) % 2**30),
-            )
-            self._decode_calls += 1
-            toks = np.asarray(window)  # device sync: the iteration fence
+            # Span covers dispatch AND the readback sync — the wall the
+            # chip actually spent on this window, visible in the job's
+            # Chrome trace beside the training/coordinator spans.
+            with self._dispatch_span("serving_decode_window",
+                                     slots=int(self._active.sum()),
+                                     window=w):
+                self._k, self._v, window = self._decode(
+                    self.params, self._k, self._v, self._pos, wpos,
+                    self._last, self._temp, self._base_key,
+                    np.int32((self._decode_calls * w) % 2**30),
+                )
+                self._decode_calls += 1
+                toks = np.asarray(window)  # device sync: iteration fence
             wall_ms = (time.perf_counter() - t0) * 1000.0
             # Recorded PER TOKEN (wall / window): with a deep window the
             # client sees bursts, but the sustained per-stream gap is
@@ -556,12 +586,14 @@ class ServingEngine:
             # offset) so no prefill sample can ever share a decode
             # step's key.
             self._pf_draws += 1
-            self._k, self._v, first_toks, _ = self._prefill(
-                self.params, self._k, self._v, toks, slots_a, starts,
-                n_valids, temps, self._base_key,
-                np.int32(2**30 + self._pf_draws % 2**30),
-            )
-            firsts = np.asarray(first_toks)  # device sync
+            with self._dispatch_span("serving_prefill_chunks", batch=n,
+                                     chunk=self.prefill_chunk):
+                self._k, self._v, first_toks, _ = self._prefill(
+                    self.params, self._k, self._v, toks, slots_a, starts,
+                    n_valids, temps, self._base_key,
+                    np.int32(2**30 + self._pf_draws % 2**30),
+                )
+                firsts = np.asarray(first_toks)  # device sync
             now = time.perf_counter()
             for i, (req, slot) in enumerate(entries):
                 if not finals[i]:

@@ -10,7 +10,9 @@ Three registries that must never drift are checked:
 * metric names — every statically-visible registration in the
   framework, examples, and tools passes TONY-M001
   (``analysis/metrics_lint``): snake_case, unit-suffixed, one kind per
-  name across the whole tree;
+  name across the whole tree; TONY-M002 additionally pins declared
+  ``tony_*`` names, the ``tony_step_phase_ms`` phase label values, and
+  the health detector catalogue to docs/DEPLOY.md;
 * the event catalogue — every lifecycle event kind emitted anywhere is
   registered in ``observability.events.KNOWN_KINDS`` and documented in
   docs/DEPLOY.md (TONY-E001, ``analysis/events_lint``).
@@ -84,14 +86,21 @@ def check_metric_names() -> list[str]:
     from tony_tpu.analysis.metrics_lint import (
         check_declared_names,
         check_metric_names as check,
+        check_observability_docs,
         parse_metric_trees,
     )
 
     roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
              REPO / "bench.py"]
     trees = parse_metric_trees(roots)  # one walk + parse for both rules
-    findings = check(roots, trees=trees) + check_declared_names(
-        roots, docs=REPO / "docs" / "DEPLOY.md", trees=trees
+    findings = (
+        check(roots, trees=trees)
+        + check_declared_names(
+            roots, docs=REPO / "docs" / "DEPLOY.md", trees=trees
+        )
+        # TONY-M002 extension: step-anatomy phase label values and
+        # health detector names must have DEPLOY.md rows too.
+        + check_observability_docs(REPO / "docs" / "DEPLOY.md")
     )
     return [f.render() for f in findings]
 
