@@ -252,11 +252,19 @@ def main(argv=None) -> int:
             print(f"already at step {int(state.step)} >= {args.steps}; "
                   f"nothing to do", flush=True)
             return 0
+        # `degrade_task` fault-plan entries make THIS process a
+        # deterministic mid-training straggler (incarnation 0 only — a
+        # replacement after a healing eviction runs clean).
+        from tony_tpu.resilience.faults import step_faults_from_env
+
+        step_faults = step_faults_from_env()
         while int(state.step) < args.steps:
             tokens = next(batches)
             t0 = time.perf_counter()
             state, metrics = step_fn(state, tokens)
             loss = float(metrics["loss"])
+            if step_faults is not None:
+                step_faults.maybe_degrade(int(state.step))
             # The float() above is the readback fence, so this wall time
             # covers the whole step. report() publishes the snapshot to
             # TONY_METRICS_FILE (when tony launched us), where the

@@ -1,0 +1,126 @@
+"""Jax-free self-healing chaos fixture.
+
+Every worker runs a paced train loop reporting step/loss/step_time_ms
+through ``observability.report`` (so the coordinator's MAD straggler
+scorer and the ``kill_task after_steps`` trigger see real telemetry);
+the CHIEF additionally writes one complete checkpoint per step through
+the real ``CheckpointManager`` so the coordinator's resume probe is
+exact. A ``degrade_task`` fault-plan entry makes any worker a
+deterministic straggler (incarnation 0 only — an evicted-and-replaced
+copy runs clean), and the process honors the healing env contract:
+
+* ``TONY_RESUME_STEP`` — start there instead of step 0 (a resync'd
+  survivor or a freshly launched replacement both resume);
+* ``TONY_TASK_INCARNATION`` — echoed into the start line so tests can
+  grep which copy ran;
+* ``TONY_RESHARD_PLAN`` — printed (plan key + process count) so the
+  elastic-shrink e2e can assert the survivors actually received the
+  coordinator's replanned sharding.
+
+Gang-finish barrier: real SPMD training is lock-step — the job is done
+when the SLOWEST worker is done, because every step synchronizes on
+collectives. These workers step independently, and the session's chief
+semantics would otherwise end the job (and the straggler's drag) the
+moment the clean chief finished. So each non-chief drops a
+``done-s<session>-<dense index>`` marker in the shared log dir when it
+reaches the target, and the chief exits only once every peer's marker
+exists — a straggler stretches the job wall exactly like it would
+stretch a synchronized train loop.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from tony_tpu import observability
+from tony_tpu.checkpoint import CheckpointManager
+from tony_tpu.resilience.faults import step_faults_from_env
+
+if not os.environ.get("TONY_METRICS_FILE"):
+    print("TONY_METRICS_FILE not exported", file=sys.stderr)
+    sys.exit(4)
+
+# Publish on every report: the healing loop acts on what rides the very
+# next heartbeat, so the default write throttle only adds latency.
+registry = observability.default_registry()
+registry._publish_min_interval_s = 0.0
+
+job = os.environ.get("JOB_NAME", "worker")
+task_index = int(os.environ.get("TASK_INDEX", "0"))
+task_num = int(os.environ.get("TASK_NUM", "1"))
+incarnation = int(os.environ.get("TONY_TASK_INCARNATION", "0") or 0)
+target = int(os.environ.get("HEAL_TARGET", "30"))
+cadence_s = float(os.environ.get("HEAL_CADENCE_S", "0.1"))
+chief = job == "worker" and task_index == 0
+
+ckpt_dir = os.environ.get("TONY_CHECKPOINT_DIR")
+mgr = (
+    CheckpointManager(ckpt_dir, process_id=0, num_processes=1)
+    if chief and ckpt_dir else None
+)
+
+start = 0
+resume_env = os.environ.get("TONY_RESUME_STEP")
+if resume_env:
+    start = int(resume_env)
+elif mgr is not None:
+    restored = mgr.restore_resumable({"step": np.array(0), "w": np.zeros(2)})
+    if restored is not None:
+        start = int(restored["step"])
+
+print(
+    f"heal-train start task={job}:{task_index} num={task_num} "
+    f"incarnation={incarnation} start={start}",
+    flush=True,
+)
+reshard = os.environ.get("TONY_RESHARD_PLAN")
+if reshard:
+    note = json.loads(reshard)
+    print(
+        f"reshard note: plan={note.get('plan')} "
+        f"num_processes={note.get('num_processes')} "
+        f"resume_step={note.get('resume_step')}",
+        flush=True,
+    )
+
+faults = step_faults_from_env()
+for step in range(start + 1, target + 1):
+    t0 = time.perf_counter()
+    time.sleep(cadence_s)
+    if faults is not None:
+        faults.maybe_degrade(step)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    registry.report(step=step, loss=1.0 / step, step_time_ms=wall_ms)
+    if mgr is not None:
+        mgr.save(step, {"step": np.array(step), "w": np.zeros(2) + step},
+                 blocking=True)
+    print(f"step {step}", flush=True)
+
+sync_dir = os.environ.get("HEAL_SYNC_DIR") or os.environ.get("TONY_LOG_DIR")
+session = os.environ.get("SESSION_ID", "0")
+if sync_dir:
+    if not chief:
+        marker = os.path.join(sync_dir, f"done-s{session}-{task_index}")
+        with open(marker, "w") as f:
+            f.write(str(target))
+    else:
+        # Lock-step finish: the chief (whose exit decides the session)
+        # waits for every peer of THIS session's dense gang view.
+        deadline = time.monotonic() + float(
+            os.environ.get("HEAL_SYNC_TIMEOUT_S", "180")
+        )
+        want = [os.path.join(sync_dir, f"done-s{session}-{i}")
+                for i in range(1, task_num)]
+        while not all(os.path.exists(p) for p in want):
+            if time.monotonic() > deadline:
+                print(f"gang-finish barrier timed out waiting for "
+                      f"{[p for p in want if not os.path.exists(p)]}",
+                      file=sys.stderr, flush=True)
+                sys.exit(3)
+            time.sleep(0.1)
+
+print(f"done at {target}", flush=True)
+sys.exit(0)

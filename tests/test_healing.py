@@ -1,0 +1,1323 @@
+"""Self-healing actuation tests (coordinator/healing.py): the policy
+config, infra-exit classification, the session's gang-patch surgery
+(incarnation fencing + generation-gated barrier), liveness/aggregator
+incarnation fencing, MAD straggler scoring under gang-size change, the
+``degrade_task`` / ``kill_task after_steps`` chaos actions, the goodput
+ledger's ``healing`` category, the HealingController state machine
+against a fake coordinator, doctor rule TONY-D013 — plus the two slow
+chaos acceptance e2e runs (evict-and-replace beating the non-healing
+baseline on wall AND wasted chip-seconds; elastic shrink to n−1 under a
+planner-chosen sharding)."""
+
+import json
+import re
+import sys
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.analysis import postmortem
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.coordinator.healing import (
+    HealConfig,
+    HealingController,
+    choose_shrink_plan,
+    is_infra_exit,
+)
+from tony_tpu.coordinator.liveness import LivenessMonitor
+from tony_tpu.coordinator.session import SessionStatus, TaskStatus, TonySession
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.observability import events as obs_events
+from tony_tpu.observability.aggregator import MetricsAggregator
+from tony_tpu.observability.goodput import CATEGORIES, GoodputLedger
+from tony_tpu.observability.health import HealthConfig, HealthMonitor
+from tony_tpu.observability.metrics import MetricsRegistry
+from tony_tpu.resilience.faults import (
+    DEGRADE_TASK,
+    FaultInjector,
+    FaultPlan,
+    FaultPlanError,
+    StepFaults,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _conf(workers=3):
+    conf = TonyConfiguration()
+    conf.set(keys.instances_key("worker"), workers)
+    conf.set(keys.instances_key("ps"), 0)
+    return conf
+
+
+def _session(workers=3, register=True):
+    session = TonySession(_conf(workers), session_id=1)
+    session.status = SessionStatus.RUNNING
+    if register:
+        for i in range(workers):
+            session.register_task(f"worker:{i}", f"h{i}:500{i}")
+            session.get_task_by_id(f"worker:{i}").handle = object()
+    return session
+
+
+def _snap(gauges=None, counters=None, histograms=None):
+    return {
+        "ts_ms": int(time.time() * 1000),
+        "gauges": gauges or {},
+        "counters": counters or {},
+        "histograms": histograms or {},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Policy config + infra-exit classification
+# ---------------------------------------------------------------------------
+class TestHealConfig:
+    def test_defaults_disabled(self):
+        cfg = HealConfig.from_conf(TonyConfiguration())
+        assert cfg.enabled is False
+        assert cfg.max_evictions == 2
+        assert cfg.min_shrink_fraction == 0.5
+        assert cfg.speculative is False
+
+    def test_reads_conf_keys(self):
+        conf = TonyConfiguration()
+        conf.set(keys.K_HEAL_ENABLED, "true")
+        conf.set(keys.K_HEAL_CONFIRM_WINDOW_MS, 500)
+        conf.set(keys.K_HEAL_MAX_EVICTIONS, 7)
+        conf.set(keys.K_HEAL_MIN_SHRINK_FRACTION, 0.25)
+        conf.set(keys.K_HEAL_SPECULATIVE, "true")
+        conf.set(keys.K_HEALTH_STRAGGLER_THRESHOLD, 2.5)
+        cfg = HealConfig.from_conf(conf)
+        assert cfg.enabled and cfg.speculative
+        assert cfg.confirm_window_ms == 500
+        assert cfg.max_evictions == 7
+        assert cfg.min_shrink_fraction == 0.25
+        assert cfg.straggler_threshold == 2.5
+
+    def test_every_heal_key_has_registered_default(self):
+        for key in (keys.K_HEAL_ENABLED, keys.K_HEAL_CONFIRM_WINDOW_MS,
+                    keys.K_HEAL_MAX_EVICTIONS,
+                    keys.K_HEAL_MIN_SHRINK_FRACTION,
+                    keys.K_HEAL_SPECULATIVE,
+                    keys.K_HEAL_SPECULATIVE_DELAY_MS):
+            assert key in keys.DEFAULTS, key
+
+
+class TestIsInfraExit:
+    @pytest.mark.parametrize("code,reason,expected", [
+        (-9, None, True),            # Popen signal death
+        (-15, None, True),
+        (137, None, True),           # 128+SIGKILL shell convention
+        (143, None, True),           # 128+SIGTERM
+        (0, "preempted", True),      # backend-reported preemption
+        (1, None, False),            # plain user bug
+        (2, None, False),
+        (126, None, False),          # not executable
+        (127, None, False),          # not found
+        (255, None, False),          # 255-128=127 is not a nameable signal
+    ])
+    def test_table(self, code, reason, expected):
+        assert is_infra_exit(code, reason) is expected
+
+
+class TestChooseShrinkPlan:
+    def test_pins_dp_to_survivor_devices(self):
+        plan = choose_shrink_plan(2)
+        assert plan is not None
+        assert plan.mesh_spec.dp == 2
+        assert plan.key() == "dp2.pp1.ep1.sp1.tp1"
+
+    def test_single_device_still_plans(self):
+        plan = choose_shrink_plan(1)
+        assert plan is not None and plan.mesh_spec.dp == 1
+
+
+# ---------------------------------------------------------------------------
+# Session gang patches: incarnation fencing + generation-gated barrier
+# ---------------------------------------------------------------------------
+class TestSessionGangPatch:
+    def test_evict_reopens_registration_under_bumped_incarnation(self):
+        session = _session()
+        task = session.evict_task("worker:1")
+        assert task.incarnation == 1
+        assert task.host_port is None
+        assert task.status is TaskStatus.SCHEDULED
+
+    def test_stale_incarnation_registration_dropped(self):
+        session = _session()
+        session.evict_task("worker:1")
+        # the zombie copy (incarnation 0) re-dials in: dropped
+        assert not session.register_task("worker:1", "zombie:1", 0)
+        assert session.get_task_by_id("worker:1").host_port is None
+        # the replacement (incarnation 1) takes the identity
+        assert session.register_task("worker:1", "new:1", 1)
+        assert session.get_task_by_id("worker:1").host_port == "new:1"
+
+    def test_higher_incarnation_adopted_first_to_register_wins(self):
+        # speculation: the task never registered; the backup copy
+        # (incarnation 1) dials in first and takes the identity
+        session = _session(register=False)
+        assert session.register_task("worker:2", "backup:9", 1)
+        task = session.get_task_by_id("worker:2")
+        assert task.incarnation == 1
+        assert task.host_port == "backup:9"
+        # the original (incarnation 0) is now the zombie
+        assert not session.register_task("worker:2", "orig:9", 0)
+        assert task.host_port == "backup:9"
+
+    def test_begin_patch_witholds_spec_until_everyone_reregisters(self):
+        session = _session()
+        assert session.cluster_spec() is not None
+        generation = session.begin_patch()
+        assert generation == 1
+        assert session.cluster_spec() is None  # barrier re-armed
+        # survivors re-register one by one; spec returns only when ALL
+        # live tasks have confirmed the new generation
+        for i in range(3):
+            assert session.cluster_spec() is None
+            assert session.register_task(f"worker:{i}", f"h{i}:500{i}")
+        spec = session.cluster_spec()
+        assert spec == {"worker": ["h0:5000", "h1:5001", "h2:5002"]}
+
+    def test_remove_task_renumbers_dense_but_keeps_ids(self):
+        session = _session()
+        removed = session.remove_task("worker:1")
+        assert removed is not None and removed.id == "worker:1"
+        assert [t.id for t in session.removed] == ["worker:1"]
+        # survivors keep their ORIGINAL ids/indices...
+        assert session.get_task_by_id("worker:2") is not None
+        assert session.get_task("worker", 2).id == "worker:2"
+        assert session.get_task("worker", 1) is None
+        # ...but the runtime view is dense
+        assert session.runtime_assignment("worker:0") == (0, 2)
+        assert session.runtime_assignment("worker:2") == (1, 2)
+        session.begin_patch()
+        for tid, hp in (("worker:0", "h0:5000"), ("worker:2", "h2:5002")):
+            session.register_task(tid, hp)
+        assert session.cluster_spec() == {"worker": ["h0:5000", "h2:5002"]}
+
+    def test_cannot_remove_last_task(self):
+        session = _session(workers=1)
+        assert session.remove_task("worker:0") is None
+
+    def test_generation_echo_fences_superseded_confirms(self):
+        # a survivor's registration confirms the generation it was told
+        # about; if a second patch folded in mid-flight, the stale echo
+        # must NOT read as confirming the newer patch
+        session = _session()
+        session.begin_patch()   # gen 1 (eviction)
+        session.begin_patch()   # gen 2 (folded shrink renumber)
+        assert session.register_task("worker:0", "h0:5000", 0,
+                                     generation=1)
+        assert session.get_task_by_id("worker:0").generation == 1
+        for i in (1, 2):
+            session.register_task(f"worker:{i}", f"h{i}:500{i}", 0,
+                                  generation=2)
+        assert session.cluster_spec() is None  # worker:0 still owes gen 2
+        session.register_task("worker:0", "h0:5000", 0, generation=2)
+        assert session.cluster_spec() is not None
+        # an echo AHEAD of the gang (can't legitimately happen) clamps
+        session.begin_patch()   # gen 3
+        session.register_task("worker:0", "h0:5000", 0, generation=99)
+        assert session.get_task_by_id("worker:0").generation == 3
+
+    def test_settled_identity_rejects_late_loser_registration(self):
+        # the original copy won the speculation race (REGISTERED at
+        # incarnation 0); the dying backup's in-flight registration
+        # (incarnation 1) must not hijack the settled identity — it
+        # would overwrite the live address and fence the winner out
+        session = _session()
+        assert not session.register_task("worker:2", "loser:9", 1)
+        task = session.get_task_by_id("worker:2")
+        assert task.incarnation == 0
+        assert task.host_port == "h2:5002"
+        # the winner's own traffic still passes the fence
+        assert session.register_task("worker:2", "h2:5002", 0) is False
+        assert task.host_port == "h2:5002"
+
+    def test_completed_task_exempt_from_patched_barrier(self):
+        # a worker that already FINISHED can never re-register into a
+        # patched generation — it must not park the barrier forever
+        session = _session()
+        session.on_task_completed("worker", 2, 0)
+        session.begin_patch()
+        for i in range(2):
+            session.register_task(f"worker:{i}", f"h{i}:500{i}")
+        spec = session.cluster_spec()
+        assert spec == {"worker": ["h0:5000", "h1:5001", "h2:5002"]}
+
+
+class TestLivenessIncarnationFence:
+    def _monitor(self):
+        return LivenessMonitor(
+            heartbeat_interval_ms=100, max_missed_heartbeats=5,
+            on_expired=lambda tid: None,
+        )
+
+    def test_stale_incarnation_ping_fenced(self):
+        mon = self._monitor()
+        mon.register("worker:1", incarnation=1)
+        assert not mon.receive_ping("worker:1", incarnation=0)
+        assert mon.receive_ping("worker:1", incarnation=1)
+
+    def test_default_incarnation_compatible(self):
+        mon = self._monitor()
+        mon.register("worker:0")
+        assert mon.receive_ping("worker:0")
+
+    def test_unregister_clears_incarnation(self):
+        mon = self._monitor()
+        mon.register("worker:1", incarnation=3)
+        mon.unregister("worker:1")
+        assert not mon.receive_ping("worker:1", incarnation=3)
+
+
+class TestAggregatorIncarnationReset:
+    def test_reset_task_drops_series_and_latest(self):
+        agg = MetricsAggregator()
+        agg.ingest("worker:1", _snap(gauges={"step_time_ms": 80.0},
+                                     counters={"train_steps_total": 9}))
+        agg.ingest("worker:2", _snap(gauges={"step_time_ms": 5.0}))
+        agg.reset_task("worker:1")
+        assert "worker:1" not in agg.to_json()["tasks"]
+        assert "worker:2" in agg.to_json()["tasks"]
+        # the replacement's first snapshot starts a fresh series
+        agg.ingest("worker:1", _snap(gauges={"step_time_ms": 5.0}))
+        assert agg.to_json()["tasks"]["worker:1"]["gauges"][
+            "step_time_ms"] == 5.0
+
+    def test_latest_counter_feeds_step_triggered_faults(self):
+        agg = MetricsAggregator()
+        agg.ingest("worker:0", _snap(counters={"train_steps_total": 4}))
+        agg.ingest("worker:1", _snap(counters={"train_steps_total": 7}))
+        agg.ingest("worker:2", _snap(gauges={"loss": 1.0}))
+        assert agg.latest_counter("train_steps_total") == {
+            "worker:0": 4.0, "worker:1": 7.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# MAD straggler scoring under gang-size change (satellite)
+# ---------------------------------------------------------------------------
+class TestHealthGangChange:
+    def _monitor(self, clock, **overrides):
+        overrides.setdefault("heartbeat_jitter_factor", 1000.0)
+        cfg = HealthConfig(
+            heartbeat_interval_ms=100, alert_cooldown_ms=10_000,
+            **overrides,
+        )
+        alerts = []
+        return HealthMonitor(cfg, emit=lambda **kw: alerts.append(kw),
+                             clock=clock), alerts
+
+    def test_score_stable_when_nonoutlier_removed(self):
+        clock = FakeClock()
+        mon, _ = self._monitor(clock)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 5.0),
+                        ("w:3", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        before = mon.straggler_scores()["w:3"]
+        mon.remove_task("w:1")  # elastic shrink takes a healthy task
+        for tid, st in (("w:0", 5.0), ("w:2", 5.0), ("w:3", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        after = mon.straggler_scores()
+        assert "w:1" not in after
+        assert after["w:3"] > 3.0, "outlier must survive the n→n−1 rescore"
+        assert after["w:3"] == pytest.approx(before, rel=0.5)
+
+    def test_removing_the_outlier_clears_the_fleet(self):
+        clock = FakeClock()
+        mon, _ = self._monitor(clock)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 5.0),
+                        ("w:3", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        mon.remove_task("w:3")
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 5.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert all(s == 0.0 for s in mon.straggler_scores().values())
+
+    def test_replacement_rejoin_resets_cooldown(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert [a["task"] for a in alerts
+                if a["detector"] == "straggler"] == ["w:2"]
+        # still inside the 10s cooldown: the same task cannot re-alert
+        mon.observe("w:2", _snap(gauges={"step_time_ms": 90.0}))
+        assert len([a for a in alerts if a["detector"] == "straggler"]) == 1
+        # eviction removes the task; its REPLACEMENT (same id, new
+        # machine) rejoins and its first genuine anomaly must not be
+        # swallowed by the evicted copy's cooldown window
+        mon.reset_task("w:2")
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 85.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert len([a for a in alerts if a["detector"] == "straggler"]) == 2
+
+    def test_no_self_alert_storm_mid_patch(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock, stall_timeout_ms=1000)
+        for tid in ("w:0", "w:1", "w:2"):
+            mon.observe(tid, _snap(gauges={"step_time_ms": 5.0},
+                                   counters={"train_steps_total": 50}))
+        mon.begin_patch()
+        # mid-patch the survivors' user processes are parked on purpose:
+        # stale step walls + frozen counters must not read as a fleet
+        # incident, however long the surgery takes
+        clock.advance(30.0)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 400.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st},
+                                   counters={"train_steps_total": 50}))
+        assert [a for a in alerts
+                if a["detector"] in ("straggler", "progress_stall")] == []
+        mon.end_patch()
+        # post-patch the restarted processes' counters BEGIN BELOW the
+        # stale totals — a rebaseline, not a stall, not a straggler
+        for tid in ("w:0", "w:1", "w:2"):
+            mon.observe(tid, _snap(gauges={"step_time_ms": 5.0},
+                                   counters={"train_steps_total": 2}))
+        clock.advance(0.5)
+        for tid in ("w:0", "w:1", "w:2"):
+            mon.observe(tid, _snap(gauges={"step_time_ms": 5.0},
+                                   counters={"train_steps_total": 3}))
+        assert [a for a in alerts
+                if a["detector"] in ("straggler", "progress_stall")] == []
+
+    def test_end_patch_clears_stored_straggler_scores(self):
+        # straggler_scores() feeds the confirm window every tick: a
+        # stale pre-patch score must not survive the re-baseline and
+        # confirm-evict a healthy restarted survivor
+        clock = FakeClock()
+        mon, _ = self._monitor(clock)
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert mon.straggler_scores()["w:2"] > 3.0
+        mon.begin_patch()
+        mon.end_patch()
+        assert all(s == 0.0 for s in mon.straggler_scores().values())
+
+    def test_patch_depth_nests(self):
+        clock = FakeClock()
+        mon, alerts = self._monitor(clock)
+        mon.begin_patch()
+        mon.end_patch()
+        for tid, st in (("w:0", 5.0), ("w:1", 5.0), ("w:2", 80.0)):
+            mon.observe(tid, _snap(gauges={"step_time_ms": st}))
+        assert [a["task"] for a in alerts
+                if a["detector"] == "straggler"] == ["w:2"]
+
+
+# ---------------------------------------------------------------------------
+# Fault actions: degrade_task + kill_task after_steps (satellite)
+# ---------------------------------------------------------------------------
+class TestDegradeAndStepKillFaults:
+    def test_parse_degrade_task(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "degrade_task", "target": "worker:2", "ms": 400,
+             "after_steps": 2, "count": 100},
+        ]}))
+        (spec,) = plan.specs
+        assert spec.action == DEGRADE_TASK
+        assert spec.after_steps == 2 and spec.ms == 400
+
+    def test_degrade_requires_nonzero_ms(self):
+        with pytest.raises(FaultPlanError, match="ms must be nonzero"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "degrade_task", "target": "worker:1", "ms": 0},
+            ]}))
+
+    def test_degrade_after_steps_zero_means_from_first_step(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "degrade_task", "target": "worker:1", "ms": 10,
+             "after_steps": 0},
+        ]}))
+        assert plan.specs[0].after_steps == 0
+
+    def test_degrade_rejects_any_non_chief(self):
+        with pytest.raises(FaultPlanError, match="concrete"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "degrade_task", "target": "any_non_chief",
+                 "ms": 10},
+            ]}))
+
+    def test_kill_after_steps_parses_and_is_exclusive(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "kill_task", "target": "worker:1", "after_steps": 5},
+        ]}))
+        assert plan.specs[0].after_steps == 5
+        with pytest.raises(FaultPlanError, match="exactly one trigger"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "kill_task", "target": "worker:1",
+                 "after_steps": 5, "after_ms": 100},
+            ]}))
+
+    def test_kill_after_steps_zero_rejected(self):
+        # train_steps_total starts advancing at 1: a 0 trigger would
+        # never fire (degrade_task's 0 floor is deliberate, see parse)
+        with pytest.raises(FaultPlanError, match="after_steps"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "kill_task", "target": "worker:1",
+                 "after_steps": 0},
+            ]}))
+
+    def test_step_kills_fire_once_at_threshold(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "kill_task", "target": "worker:1", "after_steps": 5},
+        ]}))
+        inj = FaultInjector(plan)
+        assert inj.step_kills(1, {"worker:1": 3.0}) == []
+        assert inj.step_kills(1, {"worker:2": 50.0}) == []  # wrong task
+        assert inj.step_kills(1, {"worker:1": 5.0}) == ["worker:1"]
+        assert inj.step_kills(1, {"worker:1": 6.0}) == []  # one-shot
+
+    def test_step_faults_sleep_window(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "degrade_task", "target": "worker:1", "ms": 50,
+             "after_steps": 2, "count": 3},
+        ]}))
+        sleeps = []
+        faults = StepFaults(plan, "worker:1", sleep=sleeps.append)
+        for step in range(1, 8):
+            faults.maybe_degrade(step)
+        # steps 3,4,5 degraded (after_steps=2, count=3), then exhausted
+        assert sleeps == [0.05, 0.05, 0.05]
+
+    def test_step_faults_scope(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "degrade_task", "target": "worker:1", "ms": 50},
+        ]}))
+        assert not StepFaults(plan, "worker:2").active  # other task
+        assert not StepFaults(plan, "worker:1", incarnation=1).active
+        assert StepFaults(plan, "worker:1").active
+
+    def test_step_faults_from_env_respects_incarnation(self, monkeypatch):
+        from tony_tpu.resilience import faults as faults_mod
+
+        plan = json.dumps({"faults": [
+            {"action": "degrade_task", "target": "worker:1", "ms": 50},
+        ]})
+        monkeypatch.setenv(constants.TONY_FAULT_PLAN, plan)
+        monkeypatch.setenv(constants.JOB_NAME, "worker")
+        monkeypatch.setenv(constants.TASK_INDEX, "1")
+        monkeypatch.setenv(constants.TONY_TASK_INCARNATION, "1")
+        # both process-lifetime caches must reset: the plan parse
+        # (_env_plan, shared with io/checkpoint faults) and this
+        # consumer's own singleton
+        monkeypatch.setattr(faults_mod, "_env_plan", None)
+        monkeypatch.setattr(faults_mod, "_step_faults", False)
+        assert faults_mod.step_faults_from_env() is None
+        # the original incarnation 0 IS degraded
+        monkeypatch.setenv(constants.TONY_TASK_INCARNATION, "0")
+        monkeypatch.setattr(faults_mod, "_env_plan", None)
+        monkeypatch.setattr(faults_mod, "_step_faults", False)
+        assert faults_mod.step_faults_from_env() is not None
+        monkeypatch.setattr(faults_mod, "_env_plan", None)
+        monkeypatch.setattr(faults_mod, "_step_faults", False)
+
+
+# ---------------------------------------------------------------------------
+# Goodput: the dedicated healing category
+# ---------------------------------------------------------------------------
+class TestGoodputHealingCategory:
+    def _healed_run(self):
+        return [
+            {"ts_ms": 0, "kind": "job_submitted"},
+            {"ts_ms": 1_000, "kind": "job_staged"},
+            {"ts_ms": 2_000, "kind": "session_started", "session": 1},
+            {"ts_ms": 2_500, "kind": "task_scheduled", "task": "worker:0"},
+            {"ts_ms": 3_000, "kind": "task_registered", "task": "worker:0"},
+            {"ts_ms": 5_000, "kind": "rendezvous_released"},
+            {"ts_ms": 6_000, "kind": "train_progress", "task": "worker:0",
+             "steps": 1},
+            {"ts_ms": 10_000, "kind": "task_evicted", "task": "worker:1"},
+            # mid-patch plumbing must STAY healing, not flip the phase —
+            # including the survivors' re-registrations into the patched
+            # generation and the replacement's own registration
+            {"ts_ms": 10_200, "kind": "task_registered", "task": "worker:0"},
+            {"ts_ms": 10_500, "kind": "task_scheduled", "task": "worker:1"},
+            {"ts_ms": 10_800, "kind": "task_registered", "task": "worker:1"},
+            {"ts_ms": 11_000, "kind": "task_replaced", "task": "worker:1"},
+            {"ts_ms": 11_500, "kind": "rendezvous_released"},
+            {"ts_ms": 13_000, "kind": "train_progress", "task": "worker:0",
+             "steps": 9},
+            {"ts_ms": 16_000, "kind": "session_finished", "session": 1,
+             "status": "SUCCEEDED"},
+            {"ts_ms": 17_000, "kind": "final_status", "state": "SUCCEEDED"},
+        ]
+
+    def test_healing_category_registered(self):
+        assert "healing" in CATEGORIES
+
+    def test_eviction_to_first_progress_is_healing(self):
+        j = GoodputLedger.from_events(self._healed_run()).to_json()
+        assert j["categories"]["healing"] == pytest.approx(3.0)
+        assert j["categories"]["productive"] == pytest.approx(7.0)
+        assert j["categories"]["wasted_by_failure"] == pytest.approx(0.0)
+        assert sum(j["categories"].values()) == pytest.approx(17.0)
+
+    def test_elastic_reshard_bills_healing_too(self):
+        evs = self._healed_run()
+        evs[7] = {"ts_ms": 10_000, "kind": "elastic_reshard",
+                  "task": "worker:1", "survivors": 2}
+        assert evs[7]["kind"] == "elastic_reshard"
+        # no replacement (or its launch/registration) on the shrink path
+        evs = [e for e in evs
+               if not (e["kind"] in ("task_replaced", "task_scheduled")
+                       and e["ts_ms"] > 10_000)
+               and not (e["kind"] == "task_registered"
+                        and e.get("task") == "worker:1")]
+        j = GoodputLedger.from_events(evs).to_json()
+        assert j["categories"]["healing"] == pytest.approx(3.0)
+        assert sum(j["categories"].values()) == pytest.approx(17.0)
+
+    def test_heal_events_registered_kinds(self):
+        for kind in (obs_events.TASK_EVICTED, obs_events.TASK_REPLACED,
+                     obs_events.ELASTIC_RESHARD,
+                     obs_events.SPECULATIVE_LAUNCHED):
+            assert kind in obs_events.KNOWN_KINDS
+
+
+# ---------------------------------------------------------------------------
+# HealingController against a fake coordinator
+# ---------------------------------------------------------------------------
+class FakeBackend:
+    def __init__(self):
+        self.launched = []  # (task_id, env, handle)
+        self.hard_killed = []
+        self.reasons = {}
+
+    def launch(self, task, env):
+        handle = SimpleNamespace(task_id=task.id)
+        self.launched.append((task.id, dict(env), handle))
+        return handle
+
+    def kill(self, handle):
+        self.hard_killed.append(handle)
+
+    def kill_hard(self, handle):
+        self.hard_killed.append(handle)
+
+    def exit_reason(self, handle):
+        return self.reasons.get(id(handle))
+
+
+class FakeHealth:
+    def __init__(self):
+        self.scores = {}
+        self.patch_calls = []
+        self.reset_tasks = []
+        self.removed_tasks = []
+
+    def straggler_scores(self):
+        return dict(self.scores)
+
+    def begin_patch(self):
+        self.patch_calls.append("begin")
+
+    def end_patch(self):
+        self.patch_calls.append("end")
+
+    def reset_task(self, tid):
+        self.reset_tasks.append(tid)
+
+    def remove_task(self, tid):
+        self.removed_tasks.append(tid)
+
+
+class FakeCoordinator:
+    def __init__(self, workers=3):
+        self.session = _session(workers)
+        self.backend = FakeBackend()
+        self.metrics = MetricsRegistry()
+        self.events = SimpleNamespace(
+            emitted=[],
+            emit=lambda kind, **kw: self.events.emitted.append(
+                {"kind": kind, **kw}
+            ),
+        )
+        self.health = FakeHealth()
+        self.liveness = SimpleNamespace(
+            unregistered=[],
+            unregister=lambda tid: self.liveness.unregistered.append(tid),
+        )
+        self.aggregator = SimpleNamespace(
+            reset=[],
+            reset_task=lambda tid: self.aggregator.reset.append(tid),
+        )
+        self.slice_plans = {}
+        self.spare_pool = None
+        self.spare_profile = None
+        self.app_id = "application_test"
+        self._released = True
+        self._resume_step = None
+        self.failed_silent = []
+        self.checkpoint_step = 7
+        self.wakes = 0
+
+    def rendezvous_released(self):
+        return self._released
+
+    def reset_rendezvous(self):
+        self._released = False
+
+    def wake_monitor(self):
+        self.wakes += 1
+
+    def probe_checkpoint_step(self):
+        return self.checkpoint_step
+
+    def set_resume_step(self, step):
+        if step is not None:
+            self._resume_step = step
+
+    def task_launch_env(self, task):
+        env = {"TASK": task.id}
+        if task.incarnation:
+            env[constants.TONY_TASK_INCARNATION] = str(task.incarnation)
+        if self._resume_step is not None:
+            env[constants.TONY_RESUME_STEP] = str(self._resume_step)
+        return env
+
+    def fail_task_silent(self, task_id):
+        self.failed_silent.append(task_id)
+
+
+def _controller(coordinator, clock=None, **cfg):
+    cfg.setdefault("enabled", True)
+    return HealingController(
+        coordinator, HealConfig(**cfg), clock=clock or FakeClock(),
+    )
+
+
+class TestEvictAndReplace:
+    def test_full_surgery(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:1")
+        old_handle = task.handle
+        assert hc.evict_and_replace(task, cause="straggler confirmed",
+                                    score=9.0)
+        # the straggler's container is put down hard, the barrier is
+        # re-armed, and the replacement launches under incarnation 1
+        # with the checkpoint resume step in its env
+        assert c.backend.hard_killed == [old_handle]
+        assert not c.rendezvous_released()
+        assert c._resume_step == 7
+        (tid, env, handle) = c.backend.launched[-1]
+        assert tid == "worker:1"
+        assert env[constants.TONY_TASK_INCARNATION] == "1"
+        assert env[constants.TONY_RESUME_STEP] == "7"
+        assert task.handle is handle
+        assert c.liveness.unregistered == ["worker:1"]
+        assert c.aggregator.reset == ["worker:1"]
+        assert c.health.reset_tasks == ["worker:1"]
+        assert c.health.patch_calls == ["begin"]
+        kinds = [e["kind"] for e in c.events.emitted]
+        assert kinds == [obs_events.TASK_EVICTED, obs_events.TASK_SCHEDULED]
+        evicted = c.events.emitted[0]
+        assert evicted["task"] == "worker:1"
+        assert evicted["score"] == 9.0
+        assert evicted["resume_step"] == 7
+
+    def test_replacement_registration_completes_the_patch(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:1")
+        assert hc.evict_and_replace(task, cause="x")
+        # survivors owe a resync (stale generation); the replacement
+        # does not (it has never registered into this generation)
+        cmd = hc.command_for("worker:0")
+        assert cmd["resync"]["generation"] == 1
+        assert cmd["resync"]["task_index"] == 0
+        assert cmd["resync"]["task_num"] == 3
+        assert cmd["resync"]["resume_step"] == 7
+        # everyone re-registers; the coordinator's release hook fires
+        for i in range(3):
+            c.session.register_task(
+                f"worker:{i}", f"h{i}:1", 1 if i == 1 else 0,
+            )
+        assert c.session.cluster_spec() is not None
+        hc.on_task_registered(c.session.get_task_by_id("worker:1"))
+        hc.on_rendezvous_released()
+        assert c.health.patch_calls == ["begin", "end"]
+        assert hc.stats()["replacements"] == 1
+        replaced = [e for e in c.events.emitted
+                    if e["kind"] == obs_events.TASK_REPLACED]
+        assert len(replaced) == 1 and replaced[0]["incarnation"] == 1
+        # post-patch: no more resync orders
+        assert hc.command_for("worker:0") is None
+
+    def test_failed_relaunch_falls_back_to_shrink(self):
+        # the documented "no substrate to relaunch on" path: a launch
+        # exception mid-patch must not escape the monitor thread — it
+        # folds into an elastic shrink of the same patch
+        c = FakeCoordinator()
+        c.backend.launch = lambda task, env: (_ for _ in ()).throw(
+            OSError("no substrate")
+        )
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:2")
+        assert hc.on_task_exit(task, task.handle, -9)
+        assert hc.stats()["reshards"] == 1
+        assert [t.id for t in c.session.removed] == ["worker:2"]
+        assert c.failed_silent == []
+
+    def test_failed_relaunch_of_chief_fails_the_session(self):
+        c = FakeCoordinator()
+        c.backend.launch = lambda task, env: (_ for _ in ()).throw(
+            OSError("no substrate")
+        )
+        hc = _controller(c)
+        chief = c.session.get_task_by_id("worker:0")
+        # consumed (the verdict is delivered via fail_task_silent — the
+        # chief cannot be shrunk away)
+        assert hc.on_task_exit(chief, chief.handle, -9)
+        assert c.failed_silent == ["worker:0"]
+
+    def test_failed_speculative_launch_is_non_fatal(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        session = TonySession(_conf(3), session_id=1)
+        session.status = SessionStatus.RUNNING
+        for i in range(3):
+            session.get_task_by_id(f"worker:{i}").handle = object()
+        for i in range(2):
+            session.register_task(f"worker:{i}", f"h{i}:1")
+        c.session = session
+        c._released = False
+        c.backend.launch = lambda task, env: (_ for _ in ()).throw(
+            OSError("no substrate")
+        )
+        hc = _controller(c, clock=clock, speculative=True,
+                         speculative_delay_ms=0)
+        clock.advance(1.0)
+        hc.tick()  # must not raise
+        assert hc.stats()["speculative_launches"] == 0
+
+    def test_budget_exhausted_declines(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=0)
+        task = c.session.get_task_by_id("worker:1")
+        assert not hc.evict_and_replace(task, cause="x")
+        assert c.backend.launched == []
+
+    def test_disabled_controller_is_inert(self):
+        c = FakeCoordinator()
+        hc = _controller(c, enabled=False)
+        task = c.session.get_task_by_id("worker:1")
+        task.handle, dead = object(), task.handle
+        assert not hc.on_task_exit(task, task.handle, -9)
+        assert not hc.note_heartbeat_expiry("worker:1")
+        hc.tick()  # no-op, no crash
+        assert c.events.emitted == []
+
+
+class TestOnTaskExit:
+    def test_expected_exit_consumed_once(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:1")
+        old = task.handle
+        hc.evict_and_replace(task, cause="x", score=1.0)
+        # the evicted copy's death must not read as a session failure
+        assert hc.on_task_exit(task, old, -9)
+
+    def test_infra_exit_heals(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:2")
+        assert hc.on_task_exit(task, task.handle, -9)
+        assert hc.stats()["evictions"] == 1
+        (tid, env, _) = c.backend.launched[-1]
+        assert tid == "worker:2"
+
+    def test_user_bug_exit_declined(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:2")
+        assert not hc.on_task_exit(task, task.handle, 1)
+        assert c.backend.launched == []
+
+    def test_preempted_reason_heals_even_exit_zero(self):
+        c = FakeCoordinator()
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:2")
+        c.backend.reasons[id(task.handle)] = "preempted"
+        assert hc.on_task_exit(task, task.handle, 0)
+        assert hc.stats()["evictions"] == 1
+
+    def test_pre_barrier_death_stays_on_retry_path(self):
+        c = FakeCoordinator()
+        c._released = False
+        hc = _controller(c)
+        task = c.session.get_task_by_id("worker:2")
+        assert not hc.on_task_exit(task, task.handle, -9)
+
+    def test_mid_patch_loss_folds_into_active_surgery(self):
+        """The serialization contract: a second infra loss while a patch
+        is in flight is QUEUED (not dropped to session retry), then
+        folded into the armed patch on the next tick — the barrier then
+        waits for both replacements."""
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=4)
+        straggler = c.session.get_task_by_id("worker:1")
+        hc.evict_and_replace(straggler, cause="straggler confirmed")
+        victim = c.session.get_task_by_id("worker:2")
+        dead = victim.handle
+        assert hc.on_task_exit(victim, dead, -9)  # queued, consumed
+        assert hc.stats()["evictions"] == 1  # not yet healed
+        # the dead handle re-polls the same code every monitor pass;
+        # the queue must not grow
+        assert hc.on_task_exit(victim, dead, -9)
+        hc.tick()
+        assert hc.stats()["evictions"] == 2
+        launched = [t for t, _, _ in c.backend.launched]
+        assert launched == ["worker:1", "worker:2"]
+        # ONE patch episode: detectors suspended once, resumed once
+        assert c.health.patch_calls == ["begin"]
+        for i in range(3):
+            c.session.register_task(
+                f"worker:{i}", f"h{i}:1", 1 if i in (1, 2) else 0,
+            )
+        assert c.session.cluster_spec() is not None
+        hc.on_rendezvous_released()
+        assert c.health.patch_calls == ["begin", "end"]
+
+    def test_mid_patch_loss_shrinks_when_budget_spent(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=1, min_shrink_fraction=0.5)
+        straggler = c.session.get_task_by_id("worker:1")
+        hc.evict_and_replace(straggler, cause="straggler confirmed")
+        victim = c.session.get_task_by_id("worker:2")
+        assert hc.on_task_exit(victim, victim.handle, -9)
+        hc.tick()
+        assert hc.stats()["reshards"] == 1
+        assert [t.id for t in c.session.removed] == ["worker:2"]
+        # the fold bumped the generation AGAIN: survivors that already
+        # re-registered must resync once more with the dense indices
+        assert c.session.gang_generation == 2
+
+
+class TestElasticShrink:
+    def test_shrink_emits_replanned_note(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=0)
+        task = c.session.get_task_by_id("worker:2")
+        dead = task.handle
+        assert hc.on_task_exit(task, dead, -9)
+        assert hc.stats()["reshards"] == 1
+        assert [t.id for t in c.session.removed] == ["worker:2"]
+        (event,) = [e for e in c.events.emitted
+                    if e["kind"] == obs_events.ELASTIC_RESHARD]
+        assert event["survivors"] == 2
+        assert event["plan"] == "dp2.pp1.ep1.sp1.tp1"
+        assert event["resume_step"] == 7
+        # survivors' resync orders carry the reshard note + dense view
+        cmd = hc.command_for("worker:1")
+        note = json.loads(cmd["resync"]["reshard"])
+        assert note["num_processes"] == 2
+        assert note["plan"] == "dp2.pp1.ep1.sp1.tp1"
+        assert cmd["resync"]["task_index"] == 1
+        assert cmd["resync"]["task_num"] == 2
+
+    def test_chief_is_never_shrunk_away(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=0)
+        chief = c.session.get_task_by_id("worker:0")
+        assert not hc.on_task_exit(chief, chief.handle, -9)
+        assert c.session.removed == []
+
+    def test_min_shrink_fraction_floors_the_gang(self):
+        c = FakeCoordinator(workers=2)
+        hc = _controller(c, max_evictions=0, min_shrink_fraction=0.9)
+        task = c.session.get_task_by_id("worker:1")
+        # 1/2 survivors < 0.9 floor: the loss goes to session retry
+        assert not hc.on_task_exit(task, task.handle, -9)
+
+    def test_heartbeat_expiry_queues_then_heals(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=0)
+        assert hc.note_heartbeat_expiry("worker:1")
+        assert c.wakes == 1
+        hc.tick()
+        assert hc.stats()["reshards"] == 1
+        # the silent container is reaped before the survivors re-gang
+        assert len(c.backend.hard_killed) == 1
+
+    def test_heartbeat_expiry_declined_fails_task(self):
+        c = FakeCoordinator()
+        hc = _controller(c, max_evictions=0, min_shrink_fraction=1.0)
+        assert hc.note_heartbeat_expiry("worker:1")
+        hc.tick()
+        # healing could not absorb it: the deferred liveness verdict
+        # lands as the session-level failure it would have been
+        assert c.failed_silent == ["worker:1"]
+
+
+class TestStragglerConfirmWindow:
+    def test_confirm_window_gates_eviction(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        hc = _controller(c, clock=clock, confirm_window_ms=2000,
+                         straggler_threshold=3.0)
+        c.health.scores = {"worker:1": 8.0}
+        hc.tick()  # score crossed: confirmation window opens
+        assert hc.stats()["evictions"] == 0
+        clock.advance(1.0)
+        hc.tick()  # 1s < 2s window
+        assert hc.stats()["evictions"] == 0
+        clock.advance(1.5)
+        hc.tick()  # window elapsed: evict
+        assert hc.stats()["evictions"] == 1
+        (event,) = [e for e in c.events.emitted
+                    if e["kind"] == obs_events.TASK_EVICTED]
+        assert event["cause"] == "straggler confirmed"
+        assert event["score"] == 8.0
+
+    def test_score_recovery_clears_confirmation(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        hc = _controller(c, clock=clock, confirm_window_ms=2000)
+        c.health.scores = {"worker:1": 8.0}
+        hc.tick()
+        clock.advance(1.0)
+        c.health.scores = {"worker:1": 0.5}  # recovered
+        hc.tick()
+        clock.advance(2.0)
+        c.health.scores = {"worker:1": 8.0}  # crossed again: fresh window
+        hc.tick()
+        assert hc.stats()["evictions"] == 0
+
+    def test_session_restart_resets_confirmations_not_budget(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        hc = _controller(c, clock=clock, confirm_window_ms=0,
+                         max_evictions=1)
+        c.health.scores = {"worker:1": 8.0}
+        hc.tick()
+        assert hc.stats()["evictions"] == 1
+        hc.on_session_start()
+        c.health.scores = {"worker:2": 8.0}
+        clock.advance(10.0)
+        hc.tick()
+        # the per-job budget survives the session restart
+        assert hc.stats()["evictions"] == 1
+
+
+class TestSpeculativeReexecution:
+    def _stalled_gang(self, c):
+        """2 of 3 registered; worker:2 launched but never registered."""
+        session = TonySession(_conf(3), session_id=1)
+        session.status = SessionStatus.RUNNING
+        for i in range(3):
+            session.get_task_by_id(f"worker:{i}").handle = object()
+        for i in range(2):
+            session.register_task(f"worker:{i}", f"h{i}:1")
+        c.session = session
+        c._released = False
+        return session
+
+    def _speculated(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        session = self._stalled_gang(c)
+        hc = _controller(c, clock=clock, speculative=True,
+                         speculative_delay_ms=5000)
+        hc.tick()
+        assert c.backend.launched == []  # inside the delay
+        clock.advance(6.0)
+        hc.tick()
+        (tid, env, backup) = c.backend.launched[-1]
+        assert tid == "worker:2"
+        assert env[constants.TONY_TASK_INCARNATION] == "1"
+        assert hc.stats()["speculative_launches"] == 1
+        (event,) = [e for e in c.events.emitted
+                    if e["kind"] == obs_events.SPECULATIVE_LAUNCHED]
+        assert event["incarnation"] == 1
+        hc.tick()
+        assert len(c.backend.launched) == 1  # no duplicate backups
+        return c, hc, session, backup
+
+    def test_backup_launches_after_delay(self):
+        self._speculated()
+
+    def test_backup_wins_race(self):
+        c, hc, session, backup = self._speculated()
+        original = session.get_task_by_id("worker:2").handle
+        assert session.register_task("worker:2", "backup:9", 1)
+        task = session.get_task_by_id("worker:2")
+        hc.on_task_registered(task)
+        assert task.handle is backup
+        assert c.backend.hard_killed == [original]
+        # the loser's exit is expected, not a failure
+        assert hc.on_task_exit(task, original, -9)
+
+    def test_original_wins_race(self):
+        c, hc, session, backup = self._speculated()
+        original = session.get_task_by_id("worker:2").handle
+        assert session.register_task("worker:2", "orig:9", 0)
+        task = session.get_task_by_id("worker:2")
+        hc.on_task_registered(task)
+        assert task.handle is original
+        assert c.backend.hard_killed == [backup]
+
+    def test_speculation_needs_majority_registered(self):
+        clock = FakeClock()
+        c = FakeCoordinator()
+        session = TonySession(_conf(3), session_id=1)
+        session.status = SessionStatus.RUNNING
+        for i in range(3):
+            session.get_task_by_id(f"worker:{i}").handle = object()
+        session.register_task("worker:0", "h0:1")  # 1 of 3 < majority
+        c.session = session
+        c._released = False
+        hc = _controller(c, clock=clock, speculative=True,
+                         speculative_delay_ms=0)
+        clock.advance(1.0)
+        hc.tick()
+        assert c.backend.launched == []
+
+
+# ---------------------------------------------------------------------------
+# Doctor: TONY-D013
+# ---------------------------------------------------------------------------
+class TestDoctorD013:
+    def test_evicted_and_replaced_informational_on_success(self):
+        events = [
+            {"ts_ms": 1, "kind": "task_evicted", "task": "worker:1",
+             "cause": "straggler confirmed", "resume_step": 7},
+            {"ts_ms": 2, "kind": "task_replaced", "task": "worker:1",
+             "incarnation": 1},
+        ]
+        final = {"state": "SUCCEEDED",
+                 "healing": {"evictions": 1, "replacements": 1}}
+        findings = postmortem.diagnose(events=events, final=final)
+        (f,) = [x for x in findings if x.rule_id == "TONY-D013"]
+        assert f.task == "worker:1"
+        assert "replaced in-session" in f.cause
+        assert "resumed from step 7" in f.cause
+
+    def test_elastic_reshape_names_plan_and_survivors(self):
+        events = [
+            {"ts_ms": 1, "kind": "elastic_reshard", "task": "worker:2",
+             "cause": "signal", "survivors": 2,
+             "plan": "dp2.pp1.ep1.sp1.tp1", "resume_step": 4},
+        ]
+        findings = postmortem.diagnose(
+            events=events, final={"state": "SUCCEEDED"},
+        )
+        (f,) = [x for x in findings if x.rule_id == "TONY-D013"]
+        assert "elastically reshaped" in f.cause
+        assert "2 survivor(s)" in f.cause
+        assert "dp2.pp1.ep1.sp1.tp1" in f.cause
+
+    def test_final_status_fallback_when_events_pruned(self):
+        final = {"state": "FAILED",
+                 "healing": {"evictions": 2, "replacements": 1,
+                             "reshards": 0}}
+        findings = postmortem.diagnose(events=[], final=final)
+        (f,) = [x for x in findings if x.rule_id == "TONY-D013"]
+        assert "2 eviction(s)" in f.cause
+
+    def test_failed_job_ranks_surgery_higher(self):
+        events = [
+            {"ts_ms": 1, "kind": "task_evicted", "task": "worker:1",
+             "cause": "signal"},
+        ]
+        ok = postmortem.diagnose(events=events,
+                                 final={"state": "SUCCEEDED"})
+        bad = postmortem.diagnose(events=events, final={"state": "FAILED"})
+        score_ok = next(f.score for f in ok if f.rule_id == "TONY-D013")
+        score_bad = next(f.score for f in bad if f.rule_id == "TONY-D013")
+        assert score_bad > score_ok
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance e2e (slow)
+# ---------------------------------------------------------------------------
+def _heal_job_conf(cluster, ckpt_dir, heal_enabled, tmp_marker=None):
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "heal_train.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 3)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 150)
+    conf.set(keys.K_CHECKPOINT_LOCATION, str(ckpt_dir))
+    conf.set(keys.K_SHELL_ENV, "HEAL_TARGET=40,HEAL_CADENCE_S=0.25")
+    conf.set(keys.K_HEALTH_STRAGGLER_THRESHOLD, 2.5)
+    # the baseline must survive the injected kill via the PR-2 whole-
+    # session retry path (that IS the comparison)
+    conf.set(keys.K_AM_RETRY_COUNT, 2)
+    conf.set(keys.K_AM_RETRY_BACKOFF_BASE_MS, 200)
+    conf.set(keys.K_AM_RETRY_BACKOFF_MAX_MS, 1000)
+    conf.set(keys.K_HEAL_ENABLED, "true" if heal_enabled else "false")
+    conf.set(keys.K_HEAL_CONFIRM_WINDOW_MS, 2000)
+    conf.set(keys.K_HEAL_MAX_EVICTIONS, 2)
+    return conf
+
+
+@pytest.mark.slow
+def test_chaos_heal_evict_and_replace_beats_non_healing_baseline(tmp_path):
+    """THE acceptance chaos run. One seeded plan makes worker:1 a
+    deterministic mid-training straggler (degrade_task) and kills
+    worker:2 once its reported steps cross 4 (kill_task after_steps — a
+    mid-training hardware loss). With healing ON the job must SUCCEED in
+    ONE session (both anomalies evicted-and-replaced in-session, the
+    replacement incarnations running clean), beat the healing-disabled
+    baseline's wall, and show strictly less wasted_by_failure + stalled
+    chip time on the goodput ledger than the baseline (which pays a
+    whole-session restart for the kill and drags the straggler to the
+    end)."""
+    plan = json.dumps({"seed": 11, "faults": [
+        {"action": "degrade_task", "target": "worker:1", "ms": 800,
+         "after_steps": 2, "count": 1000},
+        # after_steps 6, not lower: the chief must have committed its
+        # first checkpoint(s) before the kill lands, or the replacement
+        # legitimately starts at 0 and the resume assertion below races
+        # (the chief's early steps carry blocking saves and can lag the
+        # victim's by a second-plus on a loaded box)
+        {"action": "kill_task", "target": "worker:2", "after_steps": 6,
+         "session": 1},
+    ]})
+
+    walls, ledgers = {}, {}
+    for mode, heal in (("healed", True), ("baseline", False)):
+        cluster = MiniTonyCluster(tmp_path / mode)
+        ckpt = tmp_path / f"ckpt-{mode}"
+        conf = _heal_job_conf(cluster, ckpt, heal_enabled=heal)
+        conf.set(keys.K_FAULT_PLAN, plan)
+        with cluster:
+            status, coord = cluster.run_job(conf, timeout_s=420)
+        assert status is SessionStatus.SUCCEEDED, (
+            f"{mode}: {coord.session.diagnostics if coord.session else '?'}"
+        )
+        final = json.loads(
+            (coord.app_dir / "final-status.json").read_text()
+        )
+        walls[mode] = final["stats"]["wall_ms"]
+        ledgers[mode] = final["goodput"]["categories"]
+
+        events = obs_events.parse_jsonl(
+            (coord.app_dir / "events.jsonl").read_text()
+        )
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["kind"], []).append(e)
+        if not heal:
+            assert final["stats"]["sessions_run"] == 2, (
+                "baseline must pay the whole-session restart"
+            )
+            assert "task_evicted" not in by_kind
+            continue
+
+        # -- healed run: both anomalies fixed inside ONE session --------
+        assert final["stats"]["sessions_run"] == 1, (
+            "healing must never fall back to a whole-session restart"
+        )
+        assert final["healing"]["evictions"] == 2
+        assert final["healing"]["replacements"] == 2
+        evicted = {e["task"] for e in by_kind["task_evicted"]}
+        assert evicted == {"worker:1", "worker:2"}
+        assert {e["task"] for e in by_kind["task_replaced"]} == evicted
+        straggler_evts = [e for e in by_kind["task_evicted"]
+                          if e["task"] == "worker:1"]
+        assert straggler_evts[0]["cause"] == "straggler confirmed"
+        # replacements ran as incarnation 1 and the straggler's
+        # replacement ran CLEAN (degrade_task is incarnation-0 scoped),
+        # resuming from a checkpoint instead of step 0
+        for victim in ("worker-1", "worker-2"):
+            log_text = (coord.app_dir / "logs" / f"{victim}.log").read_text()
+            m = re.search(r"incarnation=1 start=(\d+)", log_text)
+            assert m, f"{victim} replacement never started: {log_text[-2000:]}"
+            assert int(m.group(1)) > 0, "replacement must resume, not recompute"
+        # the healing episodes are ledger-visible
+        assert ledgers["healed"]["healing"] > 0
+        # doctor reads the surgery off the artifacts
+        findings = postmortem.diagnose(events=events, final=final)
+        d013 = [f for f in findings if f.rule_id == "TONY-D013"]
+        assert {f.task for f in d013} == {"worker:1", "worker:2"}
+
+    assert walls["healed"] < walls["baseline"], walls
+    healed_waste = (ledgers["healed"]["wasted_by_failure"]
+                    + ledgers["healed"]["stalled"])
+    baseline_waste = (ledgers["baseline"]["wasted_by_failure"]
+                      + ledgers["baseline"]["stalled"])
+    assert healed_waste < baseline_waste, (ledgers["healed"],
+                                           ledgers["baseline"])
+    assert ledgers["baseline"]["wasted_by_failure"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_elastic_shrink_to_n_minus_1(tmp_path):
+    """The no-spare path: worker:1 dies mid-training with the eviction
+    budget at 0 — the gang must continue on n−1 under a planner-chosen
+    sharding (dp pinned to the surviving devices), the survivors must
+    receive the reshard note + dense runtime view + checkpoint resume
+    step, and the job must SUCCEED in one session with the removed task
+    in its terminal record."""
+    cluster = MiniTonyCluster(tmp_path)
+    ckpt = tmp_path / "ckpt"
+    conf = _heal_job_conf(cluster, ckpt, heal_enabled=True)
+    conf.set(keys.K_HEAL_MAX_EVICTIONS, 0)  # "no spare": never replace
+    conf.set(keys.K_FAULT_PLAN, json.dumps({"seed": 13, "faults": [
+        {"action": "kill_task", "target": "worker:1", "after_steps": 6,
+         "session": 1},
+    ]}))
+    with cluster:
+        status, coord = cluster.run_job(conf, timeout_s=300)
+    assert status is SessionStatus.SUCCEEDED, (
+        coord.session.diagnostics if coord.session else "?"
+    )
+    final = json.loads((coord.app_dir / "final-status.json").read_text())
+    assert final["stats"]["sessions_run"] == 1
+    assert final["healing"]["reshards"] == 1
+    assert final["healing"]["evictions"] == 0
+    assert final["healing"]["removed_tasks"] == ["worker:1"]
+    removed_rows = [t for t in final["tasks"] if t.get("removed")]
+    assert [t["id"] for t in removed_rows] == ["worker:1"]
+
+    events = obs_events.parse_jsonl(
+        (coord.app_dir / "events.jsonl").read_text()
+    )
+    (reshard,) = [e for e in events if e["kind"] == "elastic_reshard"]
+    assert reshard["task"] == "worker:1"
+    assert reshard["survivors"] == 2
+    assert reshard["plan"] == "dp2.pp1.ep1.sp1.tp1"
+    assert reshard["resume_step"] is not None
+
+    # the surviving non-chief (original id worker:2) restarted its user
+    # process against the DENSE 2-process view, received the replanned
+    # sharding note, and resumed from the checkpoint step
+    survivor_log = (coord.app_dir / "logs" / "worker-2.log").read_text()
+    assert "reshard note: plan=dp2.pp1.ep1.sp1.tp1 num_processes=2" \
+        in survivor_log
+    m = re.search(r"task=worker:1 num=2 incarnation=0 start=(\d+)",
+                  survivor_log)
+    assert m, f"survivor never resynced: {survivor_log[-2000:]}"
+    assert int(m.group(1)) > 0
+    chief_log = (coord.app_dir / "logs" / "worker-0.log").read_text()
+    m = re.search(r"task=worker:0 num=2 incarnation=0 start=(\d+)",
+                  chief_log)
+    assert m and int(m.group(1)) > 0
+
+    # ledger + doctor read the reshape
+    assert final["goodput"]["categories"]["healing"] > 0
+    findings = postmortem.diagnose(events=events, final=final)
+    (f,) = [x for x in findings if x.rule_id == "TONY-D013"]
+    assert "elastically reshaped" in f.cause

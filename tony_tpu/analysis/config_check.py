@@ -89,6 +89,9 @@ _POSITIVE_FLOAT_KEYS = frozenset({
     keys.K_HEALTH_IO_STALL_RATIO,
     keys.K_HEALTH_MFU_COLLAPSE_RATIO,
     keys.K_HEALTH_COMMS_BOUND_RATIO,
+    # A zero (or nan — the finite check above) shrink floor would let
+    # elastic shrink walk a gang down to nothing one loss at a time.
+    keys.K_HEAL_MIN_SHRINK_FRACTION,
 })
 
 _TRUE_FALSE = frozenset(

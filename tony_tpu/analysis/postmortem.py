@@ -32,6 +32,10 @@ TONY-D010  application timeout
 TONY-D011  task exited nonzero with no more specific cause (generic)
 TONY-D012  step anatomy: MFU collapse / communication-bound step (the
            stepstats detectors — the causal signal behind "it's slow")
+TONY-D013  self-healing actuation: a task was evicted and replaced
+           mid-job, or the job elastically reshaped to the surviving
+           topology (coordinator/healing.py — explains mid-run gang
+           surgery and the goodput ledger's ``healing`` seconds)
 =========  ==============================================================
 """
 
@@ -40,10 +44,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping
 
-_SIGNAMES = {
+# The one signal table: coordinator/healing.py's is_infra_exit keys off
+# signal_of() too, so "which exit codes mean signal death" can never
+# drift between the postmortem and the healing loop.
+SIGNAMES = {
     1: "SIGHUP", 2: "SIGINT", 6: "SIGABRT", 9: "SIGKILL",
     11: "SIGSEGV", 15: "SIGTERM",
 }
+_SIGNAMES = SIGNAMES
 
 # Exit codes with dedicated meanings (mirrors resilience/classifier.py).
 _EXIT_LOST_COORDINATOR = 87
@@ -133,7 +141,7 @@ class _Ctx:
         return out
 
 
-def _signal_of(code: int) -> "int | None":
+def signal_of(code: int) -> "int | None":
     """The signal behind a task exit code, or None for a plain exit.
     Negative codes are Popen-reported signal deaths; the 128+N shell
     convention (how `bash -c` and the executor's own 128+signum exit
@@ -141,9 +149,12 @@ def _signal_of(code: int) -> "int | None":
     name — sys.exit(255) must not be diagnosed as 'signal 127'."""
     if code < 0:
         return -code
-    if code > 128 and (code - 128) in _SIGNAMES:
+    if code > 128 and (code - 128) in SIGNAMES:
         return code - 128
     return None
+
+
+_signal_of = signal_of
 
 
 def _mentions_task(text: str, task: "str | None") -> bool:
@@ -492,6 +503,66 @@ def _rule_step_anatomy(ctx: _Ctx) -> "list[DoctorFinding]":
     return findings
 
 
+def _rule_self_healing(ctx: _Ctx) -> "list[DoctorFinding]":
+    """TONY-D013 — the coordinator healed the gang mid-job: a confirmed
+    straggler (or a lost host) was evicted and replaced without a
+    session restart, or the job elastically reshaped itself to the
+    surviving topology. Informational when the job succeeded (the
+    healing WORKED — the finding explains the mid-run wall bump the
+    goodput ledger books as ``healing``); higher-scored when the job
+    still failed, because the surgery trail is then the first thing a
+    postmortem should read."""
+    findings = []
+    failed = str((ctx.final or {}).get("state", "")) == "FAILED"
+    healing = (ctx.final or {}).get("healing")
+    stats = healing if isinstance(healing, Mapping) else {}
+    evicted = ctx.events_of("task_evicted")
+    replaced = {e.get("task") for e in ctx.events_of("task_replaced")}
+    for e in evicted:
+        task = e.get("task")
+        got_replacement = task in replaced
+        cause = e.get("cause", "?")
+        score = (60 if failed else 30) + (0 if got_replacement else 5)
+        outcome = (
+            "evicted and replaced in-session (no whole-session restart)"
+            if got_replacement
+            else "evicted; its replacement never registered"
+        )
+        findings.append(DoctorFinding(
+            "TONY-D013", score,
+            f"{task} was {outcome} — cause: {cause}"
+            + (f", resumed from step {e['resume_step']}"
+               if e.get("resume_step") is not None else ""),
+            task=task,
+            evidence=(_fmt_event(e),),
+        ))
+    for e in ctx.events_of("elastic_reshard"):
+        task = e.get("task")
+        findings.append(DoctorFinding(
+            "TONY-D013", 65 if failed else 35,
+            f"the job elastically reshaped: {task} was lost "
+            f"({e.get('cause', '?')}) and the gang continued on "
+            f"{e.get('survivors', '?')} survivor(s) under plan "
+            f"{e.get('plan', '?')}"
+            + (f", resumed from step {e['resume_step']}"
+               if e.get("resume_step") is not None else ""),
+            task=task,
+            evidence=(_fmt_event(e),),
+        ))
+    if not findings and (stats.get("evictions") or stats.get("reshards")):
+        # Events are gone (history pruned to final-status): the terminal
+        # record's healing stats still tell the story.
+        findings.append(DoctorFinding(
+            "TONY-D013", 60 if failed else 25,
+            f"the coordinator healed this job mid-run: "
+            f"{stats.get('evictions', 0)} eviction(s), "
+            f"{stats.get('replacements', 0)} replacement(s), "
+            f"{stats.get('reshards', 0)} elastic reshard(s)",
+            evidence=(f"final-status healing: {dict(stats)}",),
+        ))
+    return findings
+
+
 def _rule_timeout(ctx: _Ctx) -> "list[DoctorFinding]":
     diag = str((ctx.final or {}).get("diagnostics", ""))
     if "timed out" not in diag:
@@ -516,6 +587,7 @@ _RULES = (
     _rule_straggler,
     _rule_io_stall,
     _rule_step_anatomy,
+    _rule_self_healing,
 )
 
 
@@ -564,6 +636,18 @@ def format_report(
         if wall is not None:
             head += f", {wall / 1000.0:.1f}s wall"
     lines.append(head)
+    healing = (final or {}).get("healing") or {}
+    if isinstance(healing, Mapping) and (
+        healing.get("evictions") or healing.get("reshards")
+        or healing.get("speculative_launches")
+    ):
+        lines.append(
+            f"self-healed in-session: {healing.get('evictions', 0)} "
+            f"eviction(s), {healing.get('replacements', 0)} "
+            f"replacement(s), {healing.get('reshards', 0)} elastic "
+            f"reshard(s), {healing.get('speculative_launches', 0)} "
+            f"speculative launch(es)"
+        )
     if not findings:
         lines.append("no adverse findings — the artifacts look healthy")
         return "\n".join(lines)

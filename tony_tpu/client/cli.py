@@ -838,8 +838,8 @@ def _print_goodput(app_id: str, data: dict, source: str) -> None:
 def goodput_cmd(argv: list[str]) -> int:
     """``cli goodput <app_id>``: the job's chip-second accounting — an
     exclusive breakdown of wall time into queued/provisioning/staging/
-    compile/rendezvous/productive/stalled/wasted_by_failure/preempted/
-    teardown, live from /api/goodput with the `tony doctor` fallback
+    compile/rendezvous/productive/stalled/healing/wasted_by_failure/
+    preempted/teardown, live from /api/goodput with the `tony doctor` fallback
     chain behind it. ``--follow`` tails a live job's events through a
     local ledger."""
     import json as _json

@@ -164,6 +164,34 @@ K_HEALTH_ALERT_COOLDOWN_MS = HEALTH_PREFIX + "alert-cooldown"
 # summaries / events kept for blackbox-*.json dumps).
 K_HEALTH_FLIGHT_LIMIT = HEALTH_PREFIX + "flight-recorder-limit"
 
+# --- self-healing actuation (coordinator/healing.py) ------------------------
+# The loop that ACTS on the health plane's telemetry instead of only
+# alerting: evict-and-replace a confirmed straggler mid-job (partial
+# rendezvous patch, resume from the last complete checkpoint — never a
+# whole-session restart), elastically shrink the gang to the surviving
+# topology on hardware loss when no replacement is possible, and
+# speculatively launch a backup copy of a slow-to-register task.
+HEAL_PREFIX = TONY_PREFIX + "heal."
+K_HEAL_ENABLED = HEAL_PREFIX + "enabled"
+# A straggler alert must persist this long (score continuously above
+# tony.health.straggler-threshold) before the coordinator evicts — one
+# noisy sample must never cost a gang a re-rendezvous. 0 = evict on the
+# first confirmed score.
+K_HEAL_CONFIRM_WINDOW_MS = HEAL_PREFIX + "confirm-window"
+# Evict-and-replace budget per job (0 = never replace; hardware losses
+# then go straight to elastic shrink or the session retry path).
+K_HEAL_MAX_EVICTIONS = HEAL_PREFIX + "max-evictions"
+# Elastic shrink floor: the gang may shrink only while
+# survivors / original >= this fraction (and never below 1 task, and
+# never by removing the chief).
+K_HEAL_MIN_SHRINK_FRACTION = HEAL_PREFIX + "min-shrink-fraction"
+# Speculative re-execution (TonY's MapReduce heritage, TPU-native): when
+# most of the gang has registered but one task is still missing past the
+# delay, launch a backup copy — whichever copy registers first wins and
+# the loser is killed.
+K_HEAL_SPECULATIVE = HEAL_PREFIX + "speculative"
+K_HEAL_SPECULATIVE_DELAY_MS = HEAL_PREFIX + "speculative-delay"
+
 # --- goodput accounting (observability/goodput.py) --------------------------
 # Per-job chip-second ledger: an exclusive breakdown of wall time ×
 # chips into queued/provisioning/staging/compile/rendezvous/productive/
@@ -384,6 +412,12 @@ DEFAULTS: dict[str, object] = {
     K_HEALTH_COMMS_BOUND_RATIO: 0.5,
     K_HEALTH_ALERT_COOLDOWN_MS: 30000,
     K_HEALTH_FLIGHT_LIMIT: 256,
+    K_HEAL_ENABLED: False,
+    K_HEAL_CONFIRM_WINDOW_MS: 10000,
+    K_HEAL_MAX_EVICTIONS: 2,
+    K_HEAL_MIN_SHRINK_FRACTION: 0.5,
+    K_HEAL_SPECULATIVE: False,
+    K_HEAL_SPECULATIVE_DELAY_MS: 30000,
     K_GOODPUT_ENABLED: True,
     K_GOODPUT_CHIPS: 0,
     K_STEPSTATS_ENABLED: True,

@@ -106,6 +106,19 @@ TONY_SERVING_PORT = "TONY_SERVING_PORT"
 TONY_STEPSTATS_ENABLED = "TONY_STEPSTATS_ENABLED"
 TONY_STEPSTATS_CALIBRATE = "TONY_STEPSTATS_CALIBRATE"
 TONY_STEPSTATS_WINDOW = "TONY_STEPSTATS_WINDOW"
+# Self-healing actuation (coordinator/healing.py): the incarnation of a
+# task instance — 0 at first launch, bumped each time the coordinator
+# evicts and replaces the task mid-job so stale executors/registrations/
+# heartbeats fence out — and the JSON reshard note an elastically-shrunk
+# gang's user processes receive (the coordinator's candidate_plans pick
+# for the surviving topology: plan key + mesh axes + process count).
+TONY_TASK_INCARNATION = "TONY_TASK_INCARNATION"
+TONY_RESHARD_PLAN = "TONY_RESHARD_PLAN"
+# The gang generation a (re)launched executor should CONFIRM when it
+# registers: registrations echo it so a fold bumping the generation
+# between a resync order and its registration cannot mark the task
+# confirmed for a patch whose payload it never received.
+TONY_GANG_GENERATION = "TONY_GANG_GENERATION"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -126,6 +139,7 @@ DOCKER_FORWARD_ENV = (
     TONY_SERVING_SLOTS, TONY_SERVING_PREFILL_CHUNK,
     TONY_SERVING_DECODE_WINDOW, TONY_SERVING_MAX_QUEUE, TONY_SERVING_PORT,
     TONY_STEPSTATS_ENABLED, TONY_STEPSTATS_CALIBRATE, TONY_STEPSTATS_WINDOW,
+    TONY_TASK_INCARNATION, TONY_RESHARD_PLAN, TONY_GANG_GENERATION,
 )
 
 # The executor's self-termination code after losing the coordinator (N

@@ -32,6 +32,7 @@ from tony_tpu.coordinator.backend import (
     SlicePlan,
     plan_slices_from_conf,
 )
+from tony_tpu.coordinator.healing import HealConfig, HealingController
 from tony_tpu.coordinator.liveness import LivenessMonitor
 from tony_tpu.coordinator.session import (
     SessionStatus,
@@ -93,8 +94,12 @@ class _RpcForClient(ApplicationRpc):
     def get_cluster_spec(self) -> dict[str, list[str]] | None:
         return self._c.session.cluster_spec() if self._c.session else None
 
-    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
-        return self._c.on_register_worker_spec(worker, spec)
+    def register_worker_spec(
+        self, worker: str, spec: str, incarnation: int = 0,
+        generation: int = 0,
+    ) -> dict[str, list[str]] | None:
+        return self._c.on_register_worker_spec(worker, spec, incarnation,
+                                               generation)
 
     def register_tensorboard_url(self, spec: str, url: str) -> str | None:
         self._c.tensorboard_url = url
@@ -132,8 +137,10 @@ class _RpcForClient(ApplicationRpc):
         self, task_id: str, session_id: str,
         metrics: dict[str, Any] | None = None,
         profile: dict[str, Any] | None = None,
+        incarnation: int = 0,
     ) -> dict[str, Any] | None:
-        return self._c.on_heartbeat(task_id, session_id, metrics, profile)
+        return self._c.on_heartbeat(task_id, session_id, metrics, profile,
+                                    incarnation)
 
     def request_profile(self, duration_ms: int) -> dict[str, Any]:
         return self._c.start_profile(duration_ms)
@@ -150,6 +157,8 @@ class TonyCoordinator:
         app_id: str | None = None,
         backend: ContainerBackend | None = None,
         resume_step: int | None = None,
+        spare_pool=None,
+        spare_profile: str | None = None,
     ) -> None:
         self.conf = conf
         self.app_dir = Path(app_dir)
@@ -276,6 +285,16 @@ class TonyCoordinator:
             max_missed_heartbeats=conf.get_int(keys.K_TASK_MAX_MISSED_HEARTBEATS, 25),
             on_expired=self._on_task_deemed_dead,
         )
+        # Self-healing actuation (coordinator/healing.py): the loop that
+        # ACTS on the health plane mid-session — evict-and-replace a
+        # confirmed straggler, elastically shrink on hardware loss,
+        # speculatively re-execute a slow-to-register task. Inert unless
+        # tony.heal.enabled. ``spare_pool``/``spare_profile`` are the
+        # scheduler daemon's warm-slice seam: replacements lease from
+        # the pool the job already runs on.
+        self.spare_pool = spare_pool
+        self.spare_profile = spare_profile
+        self.healing = HealingController(self, HealConfig.from_conf(conf))
 
     # -- goodput + profiling -------------------------------------------------
     def _on_train_progress(self, task_id: str, steps: float) -> None:
@@ -606,6 +625,10 @@ class TonyCoordinator:
         self._session_seq += 1
         self.session = TonySession(self.conf, session_id=self._session_seq)
         self.session.status = SessionStatus.RUNNING
+        # A (re)started session is a fresh gang for the healing loop:
+        # confirmation windows, speculative backups, and patch state
+        # reset (the per-job eviction budget deliberately survives).
+        self.healing.on_session_start()
         self._session_span = self.tracer.begin(
             "session", session=self._session_seq
         )
@@ -852,12 +875,28 @@ class TonyCoordinator:
         return env
 
     # -- rendezvous + fault injection hooks --------------------------------
-    def on_register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+    def on_register_worker_spec(
+        self, worker: str, spec: str, incarnation: int = 0,
+        generation: int = 0,
+    ) -> dict[str, list[str]] | None:
         session = self.session
         if session is None:
             return None
-        if session.register_task(worker, spec):
-            self.liveness.register(worker)
+        registered = session.register_task(worker, spec, incarnation,
+                                           generation)
+        task = session.get_task_by_id(worker)
+        if task is not None and incarnation != task.incarnation:
+            # Fenced registration (a zombie of an evicted copy, or a
+            # speculative loser's late dial-in): the caller does NOT own
+            # this identity — it must never be handed the cluster spec,
+            # or a kill the backend failed to land would leave it
+            # running a duplicate user process against the same
+            # checkpoint directory as the real copy.
+            return None
+        if registered:
+            self.liveness.register(
+                worker, task.incarnation if task is not None else 0
+            )
             log.info("registered %s at %s", worker, spec)
             # The RPC metadata trace id confirms env->executor propagation
             # (it should equal this job's id; a mismatch is worth seeing).
@@ -866,7 +905,11 @@ class TonyCoordinator:
                 session=session.session_id, addr=spec,
                 trace_id=obs_trace.current_rpc_trace(),
             )
-        task = session.get_task_by_id(worker)
+            if task is not None:
+                # Resolve speculation races / pending replacements (the
+                # healing controller emits task_replaced and kills the
+                # losing copy).
+                self.healing.on_task_registered(task)
         if task is not None and self._faults.enabled:
             # Fault injection: kill tasks at the rendezvous barrier — a
             # concrete target dies when IT registers; any_non_chief picks a
@@ -884,14 +927,64 @@ class TonyCoordinator:
                 self._fault_kill(victim)
         spec_out = session.cluster_spec()
         if spec_out is not None and not self._rendezvous_released:
+            # First release, OR a healing patch's re-release (the patch
+            # called reset_rendezvous; every live task has re-confirmed
+            # the bumped gang generation) — both are barrier openings
+            # the timeline and the healing controller must see.
             self._rendezvous_released = True
             if self._rendezvous_span is not None:
                 self._rendezvous_span.end()
                 self._rendezvous_span = None
             self.events.emit(obs_events.RENDEZVOUS_RELEASED,
                              session=session.session_id,
-                             tasks=len(session.all_tasks()))
+                             tasks=len(session.all_tasks()),
+                             generation=session.gang_generation)
+            self.healing.on_rendezvous_released()
         return spec_out
+
+    # -- self-healing seams (coordinator/healing.py calls these) -----------
+    def rendezvous_released(self) -> bool:
+        return self._rendezvous_released
+
+    def reset_rendezvous(self) -> None:
+        """A gang patch re-armed the barrier: the cluster spec is
+        withheld (session.cluster_spec gates on the bumped generation)
+        and the next full registration set re-releases."""
+        self._rendezvous_released = False
+
+    def wake_monitor(self) -> None:
+        self._wake.set()
+
+    def probe_checkpoint_step(self) -> int | None:
+        return self._probe_checkpoint_step()
+
+    def set_resume_step(self, step: int | None) -> None:
+        """Seed TONY_RESUME_STEP for replacement launches and resync
+        commands; None keeps whatever was already seeded."""
+        if step is not None:
+            self._resume_step = step
+
+    def task_launch_env(self, task: TonyTask) -> dict[str, str]:
+        """The launch env for a (re)launched task container, incarnation
+        + gang generation included — what evict-and-replace and
+        speculative re-execution hand the backend. The generation is
+        echoed back on the replacement's registration so it confirms
+        THIS patch, not whatever patch is current by the time its RPC
+        lands."""
+        env = self._task_env(task)
+        if task.incarnation:
+            env[constants.TONY_TASK_INCARNATION] = str(task.incarnation)
+        if self.session is not None and self.session.gang_generation:
+            env[constants.TONY_GANG_GENERATION] = str(
+                self.session.gang_generation
+            )
+        return env
+
+    def fail_task_silent(self, task_id: str) -> None:
+        """Deliver the liveness verdict the healing controller deferred
+        (queued heartbeat expiry that healing then declined to absorb):
+        identical to the direct _on_task_deemed_dead path."""
+        self._deemed_dead(task_id)
 
     def _fault_kill(self, task_id: str) -> None:
         """Kill a task's container the way preemption would: SIGKILL, no
@@ -912,6 +1005,7 @@ class TonyCoordinator:
         self, task_id: str, session_id: str,
         metrics: dict[str, Any] | None = None,
         profile: dict[str, Any] | None = None,
+        incarnation: int = 0,
     ) -> dict[str, Any] | None:
         """Heartbeat RPC entry: fence stale pings, then feed liveness and
         the metrics aggregator (the piggybacked snapshot). The RETURN
@@ -935,12 +1029,17 @@ class TonyCoordinator:
                 session.session_id if session else "none",
             )
             return None
-        if not self.liveness.receive_ping(task_id):
+        if not self.liveness.receive_ping(task_id, incarnation):
             # debug, not warning: executors begin pinging before their
             # registration RPC lands, so a few fenced pings are routine.
+            # The incarnation fence lands here too: an evicted copy (or
+            # a speculative loser) still pinging its reused task id must
+            # not refresh the replacement's liveness clock, feed the
+            # aggregator, or receive commands.
             log.debug(
-                "dropping heartbeat from %s: not monitored (expired, "
-                "completed, or not yet registered)", task_id,
+                "dropping heartbeat from %s (incarnation %d): not "
+                "monitored (expired, completed, superseded, or not yet "
+                "registered)", task_id, incarnation,
             )
             return None
         self.metrics.counter("heartbeats_received_total").inc()
@@ -963,12 +1062,27 @@ class TonyCoordinator:
             task_id, session.session_id
         ):
             self._fault_kill(task_id)
-        return self.profile_broker.command_for(task_id)
+        command = self.profile_broker.command_for(task_id)
+        resync = self.healing.command_for(task_id)
+        if resync is not None:
+            # Merge the healing half of the command channel: a survivor
+            # mid-patch may owe BOTH a resync and a profile capture.
+            command = {**(command or {}), **resync}
+        return command
 
     def _on_task_deemed_dead(self, task_id: str) -> None:
         """onTaskDeemedDead (TonyApplicationMaster.java:1094-1104). On a TPU
         slice a hung host wedges everyone's collectives, so the whole session
-        fails (and retries slice-wide) rather than killing one task."""
+        fails (and retries slice-wide) rather than killing one task —
+        UNLESS self-healing can absorb the loss: then the verdict is
+        deferred to the monitor tick, which either replaces the silent
+        task / shrinks the gang around it, or fails the session after
+        all (fail_task_silent)."""
+        if self.healing.note_heartbeat_expiry(task_id):
+            return
+        self._deemed_dead(task_id)
+
+    def _deemed_dead(self, task_id: str) -> None:
         self._hb_missed.add(task_id)
         self.events.emit(
             obs_events.HEARTBEAT_MISSED, task=task_id,
@@ -1007,11 +1121,26 @@ class TonyCoordinator:
                     session.session_id, elapsed_ms
                 ):
                     self._fault_kill(victim)
+                # Step-triggered kills (kill_task after_steps): the
+                # deterministic mid-training hardware loss, clocked off
+                # the train_steps_total riding the heartbeat piggyback.
+                for victim in self._faults.step_kills(
+                    session.session_id,
+                    self.aggregator.latest_counter("train_steps_total"),
+                ):
+                    self._fault_kill(victim)
             for task in session.all_tasks():
                 if task.handle is None or task.completed():
                     continue
-                code = self.backend.poll(task.handle)
+                handle = task.handle
+                code = self.backend.poll(handle)
                 if code is not None:
+                    if self.healing.on_task_exit(task, handle, code):
+                        # Healing consumed the exit: an expected death
+                        # (evicted copy, speculative loser) or an infra
+                        # loss it replaced / shrunk around — NOT a task
+                        # completion, NOT a session failure.
+                        continue
                     self.liveness.unregister(task.id)
                     if code != 0:
                         self._tasks_failed += 1
@@ -1022,6 +1151,10 @@ class TonyCoordinator:
                         session=session.session_id, exit_code=code,
                     )
                     session.on_task_completed(task.job_name, task.index, code)
+            # The healing control loop: speculative launches at the
+            # barrier, straggler confirmation windows, queued
+            # heartbeat-expiry losses.
+            self.healing.tick()
             self._wake.wait(interval_s)
             self._wake.clear()
         # Stop whatever is still running (failed/killed sessions leave
@@ -1079,12 +1212,19 @@ class TonyCoordinator:
         """stop (TonyApplicationMaster.java:621-637): write history, publish
         the terminal state, then wait (bounded) for the client's
         finishApplication signal."""
+        self.healing.release_spares()
         final = self.application_status()
         final["state"] = status.value  # unmasked: this IS the terminal record
         if self.session is not None:
             final["tasks"] = [
                 {"id": t.id, "exit_code": t.exit_code}
                 for t in self.session.all_tasks()
+            ] + [
+                # Elastically-removed tasks stay in the terminal record
+                # (marked): "this job finished on n−1" must be readable
+                # from final-status alone.
+                {"id": t.id, "exit_code": t.exit_code, "removed": True}
+                for t in self.session.removed
             ]
         if self.slice_plans:
             final["slices"] = {j: asdict(p) for j, p in self.slice_plans.items()}
@@ -1112,6 +1252,12 @@ class TonyCoordinator:
         final["metrics"] = self.aggregator.summary()
         final["tensorboard_url"] = self.tensorboard_url
         final["trace_id"] = self.tracer.trace_id
+        if self.healing.enabled:
+            # Self-healing terminal record: evictions / replacements /
+            # reshards / speculative launches + the removed-task ids —
+            # what `tony doctor`'s TONY-D013 and the history panel read
+            # when events.jsonl is gone.
+            final["healing"] = self.healing.stats()
         # Health terminal record: totals + the alert ring, so `tony
         # doctor` can diagnose from final-status alone when events.jsonl
         # is gone.

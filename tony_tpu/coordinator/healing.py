@@ -1,0 +1,771 @@
+"""Self-healing actuation — the loop that ACTS on the health plane.
+
+Five PRs of telemetry (straggler MAD scoring, progress/io stall,
+mfu_collapse, comms_bound, the goodput ledger) end in an alert; recovery
+has stayed a whole-session teardown the ledger books as
+``wasted_by_failure``. This controller closes the loop inside ONE
+session, reviving the reference's MapReduce-heritage speculative
+re-execution in TPU-native form (PAPER.md capability 5 names failure
+detection + whole-session retry as TonY's ceiling):
+
+* **Evict-and-replace** — when a straggler alert persists past
+  ``tony.heal.confirm-window``, the coordinator kills that one task's
+  container, bumps the task's *incarnation* (the fencing counter that
+  keeps the dead copy's registrations/heartbeats out), leases a warm
+  spare from the scheduler's slice pool when one is wired (or relaunches
+  on the same backend when unpooled), and re-arms a PARTIAL rendezvous:
+  the session's gang generation bumps, survivors are told over the
+  heartbeat-reply command channel to park their user processes and
+  re-register, and the barrier re-releases once the replacement's
+  host:port has patched the gang spec. Every process then resumes from
+  the last complete checkpoint (``TONY_RESUME_STEP``) — never a
+  whole-session restart.
+* **Elastic shrink** — on hardware loss (backend-reported preemption, a
+  signal-killed container, heartbeat expiry) when replacement is not
+  possible (eviction budget spent, or no substrate to relaunch on), the
+  gang continues on n−1: the lost task is removed from the session, the
+  sharding for the surviving topology is re-chosen through the planner
+  (``parallel.plan.candidate_plans(require=...)`` — the PR-6 "reshard
+  this program for the new topology" oracle; user processes rebuilding
+  a mesh can feed it to ``plan_from_mesh`` for plan-keyed telemetry),
+  and the survivors restart their user processes against the dense n−1
+  cluster spec with the replanned ``TONY_RESHARD_PLAN`` note and the
+  checkpoint resume step.
+* **Speculative re-execution** — at the gang barrier, when most of the
+  gang has registered but one task is still missing past
+  ``tony.heal.speculative-delay``, a backup copy launches with a bumped
+  incarnation; whichever copy registers first wins the task identity
+  and the loser is killed.
+
+Everything is policy-gated behind ``tony.heal.*`` keys, emits
+``task_evicted`` / ``task_replaced`` / ``elastic_reshard`` /
+``speculative_launched`` lifecycle events, counts into the
+``tony_heal_*`` metrics, and bills its wall time to the goodput ledger's
+dedicated ``healing`` category — so "self-healing pays for itself" is a
+measured chip-second claim, not a slogan.
+
+Threading: ``tick`` and ``on_task_exit`` run on the coordinator's
+monitor thread (which also owns the backend poll loop, so eviction's
+kill-and-relaunch has no poll race); ``on_task_registered`` and
+``command_for`` run on RPC handler threads; ``note_heartbeat_expiry``
+runs on the liveness thread and only QUEUES work for the next tick.
+One lock guards all controller state, and one patch is in flight at a
+time — a second LOSS mid-surgery is queued and FOLDED into the active
+patch on the next tick (the dead task could never re-register, so
+waiting for the barrier would park the gang forever), while straggler
+confirmation and speculation simply pause until the barrier re-releases.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tony_tpu.observability import events as obs_events
+
+log = logging.getLogger(__name__)
+
+# Declared metric names (TONY-M001/M002 lint these module-scope
+# constants; all documented in docs/DEPLOY.md "Self-healing").
+HEAL_EVICTIONS_COUNTER = "tony_heal_evictions_total"
+HEAL_REPLACEMENTS_COUNTER = "tony_heal_replacements_total"
+HEAL_RESHARDS_COUNTER = "tony_heal_reshards_total"
+HEAL_SPECULATIVE_COUNTER = "tony_heal_speculative_total"
+
+def is_infra_exit(code: int, reason: str | None = None) -> bool:
+    """Would a human read this container exit as infrastructure loss?
+    Built on the postmortem's one signal table
+    (``analysis.postmortem.signal_of``, so detector and actuator can
+    never drift): backend-reported preemption, a Popen-reported signal
+    death, or a 128+N exit for a nameable signal. Plain nonzero exits
+    (user bugs, import errors) are NOT healable — replacing the task
+    would just crash the same way on a new host."""
+    from tony_tpu.analysis.postmortem import signal_of
+
+    if reason == "preempted":
+        return True
+    return signal_of(code) is not None
+
+
+@dataclass(frozen=True)
+class HealConfig:
+    """Policy, one field per ``tony.heal.*`` key (plus the straggler
+    threshold shared with the health plane — the detector and the
+    actuator must agree on what a straggler is)."""
+
+    enabled: bool = False
+    confirm_window_ms: int = 10000
+    max_evictions: int = 2
+    min_shrink_fraction: float = 0.5
+    speculative: bool = False
+    speculative_delay_ms: int = 30000
+    straggler_threshold: float = 3.0
+
+    @classmethod
+    def from_conf(cls, conf) -> "HealConfig":
+        from tony_tpu.conf import keys
+
+        return cls(
+            enabled=conf.get_bool(keys.K_HEAL_ENABLED, False),
+            confirm_window_ms=conf.get_int(
+                keys.K_HEAL_CONFIRM_WINDOW_MS, 10000
+            ),
+            max_evictions=conf.get_int(keys.K_HEAL_MAX_EVICTIONS, 2),
+            min_shrink_fraction=conf.get_float(
+                keys.K_HEAL_MIN_SHRINK_FRACTION, 0.5
+            ),
+            speculative=conf.get_bool(keys.K_HEAL_SPECULATIVE, False),
+            speculative_delay_ms=conf.get_int(
+                keys.K_HEAL_SPECULATIVE_DELAY_MS, 30000
+            ),
+            straggler_threshold=conf.get_float(
+                keys.K_HEALTH_STRAGGLER_THRESHOLD, 3.0
+            ),
+        )
+
+
+def choose_shrink_plan(num_devices: int, num_slices: int = 1):
+    """The planner's pick for the surviving topology — the PR-6 oracle
+    applied to "the gang just lost a host". Pins dp to the device count
+    (data parallelism is the one axis a topology-agnostic coordinator
+    can always re-shard: the model config lives in the user process,
+    which re-derives its own plan — via ``plan_for`` or
+    ``plan_from_mesh`` on its rebuilt mesh — with this note as the
+    advisory key). Returns None when the planner has no legal plan."""
+    from tony_tpu.parallel.plan import shrink_plans
+
+    try:
+        plans = shrink_plans(
+            num_devices, num_slices=num_slices,
+            require={"dp": max(num_devices, 1)},
+        )
+    except Exception:
+        log.warning("shrink replan failed", exc_info=True)
+        return None
+    return plans[0] if plans else None
+
+
+class HealingController:
+    """See module docstring. One instance per coordinator; inert (every
+    hook returns fast) unless ``tony.heal.enabled``."""
+
+    def __init__(
+        self,
+        coordinator,
+        config: HealConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._c = coordinator
+        self.config = config or HealConfig()
+        self._clock = clock
+        self._lock = threading.RLock()
+        # Straggler confirmation: task -> monotonic time its score first
+        # crossed the threshold (cleared when it drops back under).
+        self._confirm_since: dict[str, float] = {}
+        # Speculative backups in flight: task id -> (incarnation, handle).
+        self._backups: dict[str, tuple[int, object]] = {}
+        # Replacements awaiting registration: task id -> incarnation.
+        self._pending_replacements: dict[str, int] = {}
+        # Handles whose death the controller caused (evicted copies,
+        # speculative losers) — the monitor loop must not read them as
+        # session failures. Keyed by object identity, holding a STRONG
+        # reference: an abandoned handle may never be polled again, and
+        # without the reference CPython could recycle its id() for a
+        # later handle whose real exit would then be silently swallowed.
+        self._expected_exits: dict[int, Any] = {}
+        # Losses waiting for the monitor tick: (task_id, exit_code,
+        # cause). Heartbeat expiries land here from the liveness thread,
+        # and infra exits observed while ANOTHER patch is in flight wait
+        # here too — one surgery at a time, nothing falls through to a
+        # whole-session restart just because it arrived mid-surgery.
+        self._pending_losses: list[tuple[str, int | None, str]] = []
+        # One patch in flight at a time.
+        self._patch_active = False
+        self._session_started = self._clock()
+        # Reshard note (JSON) for resync commands after an elastic
+        # shrink, and the heal-lease records to release at stop.
+        self._reshard_note: str | None = None
+        self._spare_leases: list[Any] = []
+        # Tallies for final-status stats + the tony_heal_* counters.
+        self._evictions = 0
+        self._replacements = 0
+        self._reshards = 0
+        self._speculative = 0
+
+    # -- lifecycle hooks (coordinator threads) -------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    def on_session_start(self) -> None:
+        """A (re)started session is a fresh gang: confirmation windows,
+        backups, and patch state reset. The eviction budget does NOT —
+        it bounds surgery per job, however many sessions it takes."""
+        with self._lock:
+            self._confirm_since.clear()
+            self._backups.clear()
+            self._pending_replacements.clear()
+            self._expected_exits.clear()
+            self._pending_losses.clear()
+            self._patch_active = False
+            self._reshard_note = None
+            self._session_started = self._clock()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "evictions": self._evictions,
+                "replacements": self._replacements,
+                "reshards": self._reshards,
+                "speculative_launches": self._speculative,
+                "removed_tasks": sorted(
+                    t.id for t in (self._c.session.removed
+                                   if self._c.session else [])
+                ),
+            }
+
+    def release_spares(self) -> None:
+        """Return any heal-leased spare slices to the pool (coordinator
+        stop path)."""
+        pool = getattr(self._c, "spare_pool", None)
+        with self._lock:
+            leases, self._spare_leases = self._spare_leases, []
+        for lease in leases:
+            try:
+                pool.release(lease.slice.slice_id)
+            except Exception:
+                log.warning("could not release heal spare", exc_info=True)
+
+    # -- monitor-thread entry points -----------------------------------------
+    def tick(self) -> None:
+        """One pass of the control loop, from the coordinator's monitor
+        thread: speculative launches at the barrier, straggler
+        confirmation windows, and queued heartbeat-expiry losses."""
+        if not self.enabled:
+            return
+        session = self._c.session
+        if session is None or session.training_finished():
+            return
+        now = self._clock()
+        # Queued losses first: a new episode when idle, FOLDED into the
+        # in-flight patch otherwise (a mid-surgery death would park the
+        # re-armed barrier forever — the dead task can never re-register
+        # — so the surgery must absorb it before the gang can release).
+        self._process_pending_losses()
+        if self._patch_active:
+            return  # one surgery at a time; detectors are suspended too
+        if not self._c.rendezvous_released():
+            self._maybe_speculate(session, now)
+            return
+        self._confirm_stragglers(session, now)
+
+    def on_task_exit(self, task, handle, code: int) -> bool:
+        """Monitor thread observed ``task``'s container exit ``code`` on
+        ``handle``. Returns True when healing consumed the exit (an
+        expected death, or a loss it replaced/shrunk around) — the
+        caller must then NOT record a failure or complete the task."""
+        with self._lock:
+            if id(handle) in self._expected_exits:
+                del self._expected_exits[id(handle)]
+                return True
+            if handle is not task.handle:
+                # A stale handle (swapped out by a speculation win
+                # between the monitor's read and its poll): the live
+                # copy owns the identity now.
+                return True
+        if not self.enabled:
+            return False
+        session = self._c.session
+        if session is None or session.training_finished():
+            return False
+        reason_fn = getattr(self._c.backend, "exit_reason", None)
+        reason = reason_fn(handle) if reason_fn is not None else None
+        if not is_infra_exit(code, reason):
+            return False  # a program bug: classification + retry own it
+        cause = reason or "signal"
+        with self._lock:
+            if self._patch_active:
+                # A second loss while a patch is in flight: it WAITS for
+                # the barrier to re-release (one surgery at a time), then
+                # the next tick heals it too — a mid-surgery cascade must
+                # not fall through to a whole-session restart. The dead
+                # handle keeps polling the same code every monitor pass,
+                # so queue the task at most once.
+                if not any(t == task.id for t, _, _ in
+                           self._pending_losses):
+                    self._pending_losses.append((task.id, code, cause))
+                return True
+        if not self._c.rendezvous_released():
+            # Pre-barrier deaths stay on the session-retry path —
+            # patching a gang that never formed compounds failure modes.
+            return False
+        return self._heal_loss(task, code=code, cause=cause)
+
+    def note_heartbeat_expiry(self, task_id: str) -> bool:
+        """Liveness thread: ``task_id`` went silent. When healing could
+        plausibly absorb the loss, queue it for the next monitor tick
+        and return True (the caller skips the immediate session
+        failure); the tick either heals or fails the session then."""
+        if not self.enabled:
+            return False
+        session = self._c.session
+        if session is None or session.training_finished():
+            return False
+        if not self._c.rendezvous_released() and not self._patch_active:
+            # Initial gang formation: a task going silent before the
+            # first barrier release is a setup failure, not healable. A
+            # RE-ARMED barrier (patch in flight) is different — a
+            # survivor dying mid-surgery queues like any other loss.
+            return False
+        with self._lock:
+            task = session.get_task_by_id(task_id)
+            if task is None or task.completed() \
+                    or task_id in self._pending_replacements:
+                return False
+            if not any(t == task_id for t, _, _ in self._pending_losses):
+                self._pending_losses.append(
+                    (task_id, None, "heartbeat expiry")
+                )
+        self._c.wake_monitor()
+        return True
+
+    # -- RPC-thread entry points ---------------------------------------------
+    def on_task_registered(self, task) -> None:
+        """A registration landed (possibly a replacement or a
+        speculative copy). Resolves the first-to-register race and
+        emits ``task_replaced`` when a pending replacement joins."""
+        if not self.enabled:
+            return
+        loser = None
+        replaced = False
+        with self._lock:
+            backup = self._backups.pop(task.id, None)
+            if backup is not None:
+                inc, backup_handle = backup
+                if task.incarnation == inc:
+                    # The backup won the race: it owns the identity;
+                    # the original copy is the loser.
+                    loser, task.handle = task.handle, backup_handle
+                else:
+                    loser = backup_handle
+                if loser is not None:
+                    self._expected_exits[id(loser)] = loser
+            if self._pending_replacements.get(task.id) == task.incarnation:
+                del self._pending_replacements[task.id]
+                self._replacements += 1
+                replaced = True
+        if loser is not None:
+            log.warning("speculation resolved for %s: incarnation %d won",
+                        task.id, task.incarnation)
+            self._kill_handle(loser)
+        if replaced:
+            self._c.metrics.counter(HEAL_REPLACEMENTS_COUNTER).inc()
+            self._c.events.emit(
+                obs_events.TASK_REPLACED, task=task.id,
+                session=self._session_id(),
+                incarnation=task.incarnation,
+            )
+
+    def on_rendezvous_released(self) -> None:
+        """The (re-armed) barrier released: the patch, if one was in
+        flight, is complete — detectors resume."""
+        with self._lock:
+            was_patching, self._patch_active = self._patch_active, False
+            self._confirm_since.clear()
+        if was_patching:
+            self._c.health.end_patch()
+
+    def command_for(self, task_id: str) -> dict[str, Any] | None:
+        """The resync half of the heartbeat-reply command channel: a
+        survivor still registered under a PREVIOUS gang generation is
+        told to park its user process and re-register. Sent every ping
+        until the executor confirms by re-registering (it dedupes by
+        generation), so a lost reply costs one interval, not the
+        patch."""
+        if not self.enabled:
+            return None
+        session = self._c.session
+        if session is None or session.gang_generation == 0:
+            return None
+        from tony_tpu.coordinator.session import TaskStatus
+
+        task = session.get_task_by_id(task_id)
+        if task is None or task.status is not TaskStatus.REGISTERED \
+                or task.generation == session.gang_generation:
+            return None
+        assignment = session.runtime_assignment(task_id)
+        if assignment is None:
+            return None
+        index, num = assignment
+        payload: dict[str, Any] = {
+            "generation": session.gang_generation,
+            "task_index": index,
+            "task_num": num,
+        }
+        resume = getattr(self._c, "_resume_step", None)
+        if resume is not None:
+            payload["resume_step"] = int(resume)
+        with self._lock:
+            if self._reshard_note is not None:
+                payload["reshard"] = self._reshard_note
+        return {"resync": payload}
+
+    # -- the surgeries -------------------------------------------------------
+    def evict_and_replace(
+        self, task, cause: str, exit_code: int | None = None,
+        score: float | None = None, fold: bool = False,
+    ) -> bool:
+        """Kill ``task``'s container (unless it already died), bump its
+        incarnation, relaunch it (warm spare when pooled), and re-arm a
+        partial rendezvous for the survivors. Monitor thread only.
+
+        ``fold=True`` joins an ALREADY-armed patch instead of starting a
+        new one (a second loss queued mid-surgery): the current barrier
+        simply waits for this replacement too — no extra generation
+        bump, no double detector suspension."""
+        session = self._c.session
+        if session is None:
+            return False
+        with self._lock:
+            if (self._patch_active and not fold) or self._evictions >= \
+                    self.config.max_evictions:
+                return False
+            self._patch_active = True
+            self._evictions += 1
+        old_handle = task.handle
+        # Evict FIRST: if the task completed between the caller's check
+        # and here (register_execution_result on an RPC thread), the
+        # rollback must not leave a bumped generation behind — that
+        # would resync the whole gang for a patch that never happened.
+        evicted = session.evict_task(task.id)
+        if evicted is None:
+            with self._lock:
+                self._evictions -= 1
+                if not fold:
+                    self._patch_active = False
+            return False
+        if fold:
+            best = getattr(self._c, "_resume_step", None)
+        else:
+            best = self._c.probe_checkpoint_step()
+            self._c.set_resume_step(best)
+            self._c.health.begin_patch()
+            session.begin_patch()
+        self._c.liveness.unregister(task.id)
+        self._c.aggregator.reset_task(task.id)
+        self._c.health.reset_task(task.id)
+        self._c.reset_rendezvous()
+        self._c.metrics.counter(HEAL_EVICTIONS_COUNTER).inc()
+        self._c.events.emit(
+            obs_events.TASK_EVICTED, task=task.id,
+            session=self._session_id(), cause=cause,
+            incarnation=task.incarnation - 1,
+            exit_code=exit_code, resume_step=best,
+            **({"score": round(score, 2)} if score is not None else {}),
+        )
+        log.warning("healing: evicting %s (%s); replacement is "
+                    "incarnation %d", task.id, cause, task.incarnation)
+        if exit_code is None and old_handle is not None:
+            # The straggler is alive: put it down hard — it must not get
+            # to deregister or keep pinging while its replacement boots.
+            with self._lock:
+                self._expected_exits[id(old_handle)] = old_handle
+            self._kill_handle(old_handle)
+        env = self._c.task_launch_env(task)
+        lease = self._lease_spare()
+        if lease is not None:
+            from tony_tpu import constants
+
+            env[constants.TONY_COMPILE_CACHE_DIR] = str(
+                lease.slice.compile_cache_dir
+            )
+        try:
+            task.handle = self._c.backend.launch(task, env)
+        except Exception:
+            # A failed relaunch must not escape the monitor thread (the
+            # coordinator would die with no terminal record): fall
+            # through to elastic shrink — the documented "no substrate
+            # to relaunch on" path — folded into this same patch, and
+            # deliver the session-failure verdict only when that
+            # declines too.
+            log.warning("healing: replacement launch for %s failed",
+                        task.id, exc_info=True)
+            task.handle = None
+            if self.shrink(task, cause=f"{cause}; relaunch failed",
+                           exit_code=exit_code, fold=True):
+                return True
+            self._c.fail_task_silent(task.id)
+            return True
+        task_url = getattr(self._c.backend, "task_url", None)
+        if task_url is not None:
+            task.url = task_url(task)
+        with self._lock:
+            self._pending_replacements[task.id] = task.incarnation
+        self._c.events.emit(
+            obs_events.TASK_SCHEDULED, task=task.id,
+            session=self._session_id(),
+        )
+        return True
+
+    def shrink(self, task, cause: str, exit_code: int | None = None,
+               fold: bool = False) -> bool:
+        """Remove ``task`` from the gang and continue on the surviving
+        topology under a replanned sharding. Monitor thread only.
+
+        ``fold=True`` absorbs the loss into an already-armed patch. The
+        generation still bumps (survivor indices renumber, so everyone
+        — including survivors that already re-registered into the
+        current patch — must resync once more), but the detector
+        suspension is not double-entered."""
+        session = self._c.session
+        if session is None or not self._can_shrink(session, task):
+            return False
+        with self._lock:
+            if self._patch_active and not fold:
+                return False
+            self._patch_active = True
+        old_handle = task.handle
+        removed = session.remove_task(task.id)
+        if removed is None:
+            with self._lock:
+                if not fold:
+                    self._patch_active = False
+            return False
+        if fold:
+            best = getattr(self._c, "_resume_step", None)
+        else:
+            best = self._c.probe_checkpoint_step()
+            self._c.set_resume_step(best)
+            self._c.health.begin_patch()
+        survivors = len(session.tasks.get(task.job_name, ()))
+        plan = choose_shrink_plan(
+            survivors * self._devices_per_task(task.job_name)
+        )
+        note = {
+            "num_processes": survivors,
+            "plan": plan.key() if plan is not None else None,
+            "mesh": plan.describe()["mesh"] if plan is not None else None,
+            "resume_step": best,
+        }
+        with self._lock:
+            self._reshard_note = json.dumps(note)
+            self._reshards += 1
+        # The note MUST be in place before the generation bump: the
+        # instant begin_patch lands, any survivor's next heartbeat gets
+        # a resync order, and the executor applies only the FIRST order
+        # per generation — an early one without the reshard payload
+        # would win and the replanned sharding would never arrive.
+        session.begin_patch()
+        self._c.liveness.unregister(task.id)
+        self._c.aggregator.reset_task(task.id)
+        self._c.health.remove_task(task.id)
+        self._c.reset_rendezvous()
+        self._c.metrics.counter(HEAL_RESHARDS_COUNTER).inc()
+        self._c.events.emit(
+            obs_events.ELASTIC_RESHARD, task=task.id,
+            session=self._session_id(), cause=cause, exit_code=exit_code,
+            survivors=survivors, plan=note["plan"], resume_step=best,
+        )
+        log.warning(
+            "healing: elastic shrink — %s lost (%s); continuing on %d "
+            "survivor(s) under plan %s, resuming from step %s",
+            task.id, cause, survivors, note["plan"], best,
+        )
+        if exit_code is None and old_handle is not None:
+            # Heartbeat-expiry path: the silent container may still hold
+            # its slice — reap it before the survivors re-rendezvous.
+            with self._lock:
+                self._expected_exits[id(old_handle)] = old_handle
+            self._kill_handle(old_handle)
+        return True
+
+    # -- internals -----------------------------------------------------------
+    def _heal_loss(self, task, code: int | None, cause: str,
+                   fold: bool = False) -> bool:
+        """Replacement first (budget permitting), elastic shrink second;
+        False sends the loss to the classification + session-retry
+        path."""
+        with self._lock:
+            can_replace = self._evictions < self.config.max_evictions
+        if can_replace and self.evict_and_replace(
+            task, cause=cause, exit_code=code, fold=fold,
+        ):
+            return True
+        return self.shrink(task, cause=cause, exit_code=code, fold=fold)
+
+    def _can_shrink(self, session, task) -> bool:
+        from tony_tpu.coordinator.session import TaskStatus
+
+        if session.is_chief(task.job_name, task.index):
+            return False  # the chief carries success semantics + jax rank 0
+        if task.status not in (TaskStatus.REGISTERED, TaskStatus.SCHEDULED):
+            return False
+        live = session.tasks.get(task.job_name, [])
+        if task not in live:
+            return False
+        survivors = len(live) - 1
+        original = survivors + 1 + sum(
+            1 for t in session.removed if t.job_name == task.job_name
+        )
+        if survivors < 1:
+            return False
+        return survivors / original >= self.config.min_shrink_fraction
+
+    def _devices_per_task(self, job_name: str) -> int:
+        plan = (self._c.slice_plans or {}).get(job_name)
+        if plan is None:
+            return 1
+        return max(plan.chips_per_slice // max(plan.hosts_per_slice, 1), 1)
+
+    def _process_pending_losses(self) -> None:
+        with self._lock:
+            pending, self._pending_losses = self._pending_losses, []
+        session = self._c.session
+        for task_id, code, cause in pending:
+            task = session.get_task_by_id(task_id) if session else None
+            if task is None or task.completed():
+                continue
+            with self._lock:
+                # Each drained loss folds into whatever patch is in
+                # flight by then (the previous drained item may just
+                # have opened one).
+                fold = self._patch_active
+                if self._pending_replacements.get(task_id) is not None \
+                        and code is None:
+                    # Expiry verdict on a task already being replaced
+                    # (its replacement just hasn't registered yet) — the
+                    # surgery in flight already covers it.
+                    continue
+            if not self._heal_loss(task, code=code, cause=cause,
+                                   fold=fold):
+                # Healing declined after all: deliver the verdict the
+                # liveness monitor would have (session-level failure).
+                self._c.fail_task_silent(task_id)
+                return
+
+    def _confirm_stragglers(self, session, now: float) -> None:
+        scores = self._c.health.straggler_scores()
+        threshold = self.config.straggler_threshold
+        with self._lock:
+            for task_id, score in scores.items():
+                if score > threshold:
+                    self._confirm_since.setdefault(task_id, now)
+                else:
+                    self._confirm_since.pop(task_id, None)
+            due = [
+                (tid, scores.get(tid, 0.0))
+                for tid, since in self._confirm_since.items()
+                if (now - since) * 1000.0 >= self.config.confirm_window_ms
+            ]
+        for task_id, score in due:
+            task = session.get_task_by_id(task_id)
+            with self._lock:
+                self._confirm_since.pop(task_id, None)
+            if task is None or task.completed():
+                continue
+            self.evict_and_replace(
+                task, cause="straggler confirmed", score=score,
+            )
+            return  # one eviction per tick; the patch gate covers the rest
+
+    def _maybe_speculate(self, session, now: float) -> None:
+        if not self.config.speculative:
+            return
+        # Reap crashed backups first: nobody else polls a backup's
+        # handle (the monitor loop polls task.handle — the original), so
+        # a backup dying pre-registration would otherwise sit in
+        # _backups forever, blocking any further speculative relaunch
+        # for its task.
+        with self._lock:
+            backups = list(self._backups.items())
+        for task_id, (incarnation, handle) in backups:
+            try:
+                code = self._c.backend.poll(handle)
+            except Exception:
+                continue
+            if code is None:
+                continue
+            with self._lock:
+                if self._backups.get(task_id) == (incarnation, handle):
+                    del self._backups[task_id]
+            log.warning(
+                "healing: speculative backup for %s (incarnation %d) "
+                "died with %s before registering; it may be relaunched",
+                task_id, incarnation, code,
+            )
+        tasks = session.all_tasks()
+        registered = [t for t in tasks if t.host_port is not None]
+        if not tasks or len(registered) * 2 < len(tasks):
+            return  # most of the gang must vouch the job CAN register
+        if (now - self._session_started) * 1000.0 \
+                < self.config.speculative_delay_ms:
+            return
+        from tony_tpu import constants
+
+        for task in tasks:
+            if task.host_port is not None or task.handle is None:
+                continue
+            with self._lock:
+                if task.id in self._backups:
+                    continue
+                incarnation = task.incarnation + 1
+            env = self._c.task_launch_env(task)
+            env[constants.TONY_TASK_INCARNATION] = str(incarnation)
+            try:
+                backup = self._c.backend.launch(task, env)
+            except Exception:
+                # Speculation is an optimization: a failed backup launch
+                # must neither crash the monitor thread nor block the
+                # original copy from registering late.
+                log.warning("healing: speculative launch for %s failed",
+                            task.id, exc_info=True)
+                continue
+            with self._lock:
+                self._backups[task.id] = (incarnation, backup)
+                self._speculative += 1
+            self._c.metrics.counter(HEAL_SPECULATIVE_COUNTER).inc()
+            self._c.events.emit(
+                obs_events.SPECULATIVE_LAUNCHED, task=task.id,
+                session=self._session_id(), incarnation=incarnation,
+            )
+            log.warning(
+                "healing: speculative backup for %s (incarnation %d) — "
+                "first to register wins", task.id, incarnation,
+            )
+
+    def _lease_spare(self):
+        """A warm spare from the scheduler's pool, when the daemon wired
+        one in (``spare_pool``/``spare_profile`` on the coordinator).
+        warm_only: a replacement must not wait minutes for a cold
+        provision while the whole gang is parked at the barrier."""
+        pool = getattr(self._c, "spare_pool", None)
+        profile = getattr(self._c, "spare_profile", None)
+        if pool is None or not profile:
+            return None
+        try:
+            lease = pool.lease(
+                profile, f"{self._c.app_id}-heal", warm_only=True
+            )
+        except Exception:
+            log.warning("spare lease failed", exc_info=True)
+            return None
+        if lease is not None:
+            with self._lock:
+                self._spare_leases.append(lease)
+        return lease
+
+    def _kill_handle(self, handle) -> None:
+        kill = getattr(self._c.backend, "kill_hard", None) \
+            or self._c.backend.kill
+        try:
+            kill(handle)
+        except Exception:
+            log.warning("healing kill failed", exc_info=True)
+
+    def _session_id(self):
+        return self._c.session.session_id if self._c.session else None

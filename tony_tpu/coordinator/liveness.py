@@ -31,6 +31,9 @@ class LivenessMonitor:
         self._check_interval_s = max(heartbeat_interval_ms / 1000.0, 0.05)
         self._on_expired = on_expired
         self._last_seen: dict[str, float] = {}
+        # task -> the incarnation whose pings are current (see
+        # receive_ping; replacements re-register with a bumped value).
+        self._incarnations: dict[str, int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -46,24 +49,34 @@ class LivenessMonitor:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
-    def register(self, task_id: str) -> None:
+    def register(self, task_id: str, incarnation: int = 0) -> None:
         with self._lock:
             self._last_seen[task_id] = time.monotonic()
+            self._incarnations[task_id] = incarnation
 
     def unregister(self, task_id: str) -> None:
         with self._lock:
             self._last_seen.pop(task_id, None)
+            self._incarnations.pop(task_id, None)
 
-    def receive_ping(self, task_id: str) -> bool:
+    def receive_ping(self, task_id: str, incarnation: int = 0) -> bool:
         """Record a ping for a MONITORED task; returns False for anything
         else. Fenced deliberately: a late ping from a task this monitor
         already expired (or that completed and was unregistered, or that
         never registered at all) must not silently re-register it — the
         session-level failure decision was already made on its silence,
         and a zombie re-appearing in a failed session's monitor would mask
-        the very partition that failed it."""
+        the very partition that failed it.
+
+        Incarnation-fenced too (self-healing): a replacement executor
+        REUSES its task id, so a dying evicted copy (or a speculative
+        loser) still pinging must not refresh the replacement's clock —
+        the monitor would never notice the replacement itself going
+        silent. Only the registered incarnation's pings count."""
         with self._lock:
             if task_id not in self._last_seen:
+                return False
+            if incarnation != self._incarnations.get(task_id, 0):
                 return False
             self._last_seen[task_id] = time.monotonic()
             return True
@@ -72,6 +85,7 @@ class LivenessMonitor:
         """Drop all monitored tasks (session retry re-registers everyone)."""
         with self._lock:
             self._last_seen.clear()
+            self._incarnations.clear()
 
     def _run(self) -> None:
         while not self._stop.wait(self._check_interval_s):
@@ -83,6 +97,7 @@ class LivenessMonitor:
                 ]
                 for tid in expired:
                     del self._last_seen[tid]
+                    self._incarnations.pop(tid, None)
             for tid in expired:
                 log.error("task %s missed heartbeats for %.1fs — deemed dead",
                           tid, self._expiry_s)

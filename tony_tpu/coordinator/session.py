@@ -55,6 +55,18 @@ class TonyTask:
     exit_code: int | None = None
     url: str | None = None
     handle: object = None  # backend-specific container handle
+    # Self-healing identity fencing (coordinator/healing.py): a task id
+    # like ``worker:1`` is reused by its evicted-and-replaced copy, so
+    # the instance carries an incarnation counter — bumped at each
+    # eviction (or adopted from the first speculative copy to register)
+    # and echoed by executors on registration/heartbeat, so the dead
+    # incarnation's traffic can never conflate with its replacement's.
+    incarnation: int = 0
+    # The gang generation this task last registered under: a patched
+    # gang (eviction / elastic shrink) re-arms the barrier by bumping
+    # the session's generation, and the spec is served only once every
+    # live task has CONFIRMED the new generation by re-registering.
+    generation: int = 0
 
     @property
     def id(self) -> str:
@@ -78,16 +90,25 @@ class TonySession:
         }
         self.chief_name = conf.get_str(keys.K_CHIEF_NAME, "worker")
         self.chief_index = int(conf.get_str(keys.K_CHIEF_INDEX, "0"))
+        # Gang patching (self-healing): bumped by begin_patch; the
+        # cluster spec is withheld until every live task re-registers at
+        # the current generation. Elastically-removed tasks move to
+        # ``removed`` so the terminal record still names them.
+        self.gang_generation = 0
+        self.removed: list[TonyTask] = []
 
     # -- lookups -----------------------------------------------------------
     def all_tasks(self) -> list[TonyTask]:
         return [t for tasks in self.tasks.values() for t in tasks]
 
     def get_task(self, job_name: str, index: int) -> TonyTask | None:
-        tasks = self.tasks.get(job_name)
-        if tasks is None or not 0 <= index < len(tasks):
-            return None
-        return tasks[index]
+        # By ORIGINAL task index, not list position: an elastically-
+        # shrunk job's list is dense but its survivors keep their ids
+        # (worker:2 stays worker:2 after worker:1 is removed).
+        for t in self.tasks.get(job_name, ()):
+            if t.index == index:
+                return t
+        return None
 
     def get_task_by_id(self, task_id: str) -> TonyTask | None:
         job, sep, idx = task_id.partition(":")
@@ -104,22 +125,71 @@ class TonySession:
         return len(self.all_tasks())
 
     # -- rendezvous --------------------------------------------------------
-    def register_task(self, task_id: str, host_port: str) -> bool:
-        """Record an executor's host:port. Returns True if newly registered."""
+    def register_task(self, task_id: str, host_port: str,
+                      incarnation: int = 0,
+                      generation: int | None = None) -> bool:
+        """Record an executor's host:port. Returns True if newly
+        registered (or re-registered into a patched gang generation).
+
+        Incarnation fencing: a registration carrying an incarnation
+        BELOW the task's current one is a zombie — the evicted copy (or
+        a speculative loser) re-dialing in — and is dropped without
+        touching the gang spec. A HIGHER incarnation is a replacement
+        or speculative backup winning the race to register: it adopts
+        the task identity (first-to-register wins; the healing
+        controller kills the loser's container).
+
+        ``generation`` is the gang generation the executor is
+        CONFIRMING (echoed from its resync order / launch env); the
+        task is stamped with that value, never ahead of it, so a fold
+        bumping the gang mid-flight leaves this task still owing a
+        resync for the newer patch. ``None`` (direct in-process
+        callers) keeps the legacy stamp-current behavior."""
         with self._lock:
             task = self.get_task_by_id(task_id)
             if task is None:
                 log.warning("registration from unknown task %s", task_id)
                 return False
-            fresh = task.status is not TaskStatus.REGISTERED
+            if incarnation < task.incarnation:
+                log.warning(
+                    "dropping stale registration from %s incarnation %d "
+                    "(current is %d)", task_id, incarnation,
+                    task.incarnation,
+                )
+                return False
+            if incarnation > task.incarnation:
+                if task.status is TaskStatus.REGISTERED:
+                    # The identity is already settled (the original copy
+                    # won a speculation race, or a replacement already
+                    # joined): a LATE higher-incarnation registration is
+                    # the dying loser's in-flight RPC, not a takeover —
+                    # adopting it would overwrite the live address and
+                    # fence the winner's own traffic as a zombie's.
+                    log.warning(
+                        "dropping late registration from %s incarnation "
+                        "%d: the identity is settled at incarnation %d",
+                        task_id, incarnation, task.incarnation,
+                    )
+                    return False
+                task.incarnation = incarnation
+            fresh = (
+                task.status is not TaskStatus.REGISTERED
+                or task.generation != self.gang_generation
+            )
             task.host_port = host_port
+            task.generation = (
+                self.gang_generation if generation is None
+                else min(int(generation), self.gang_generation)
+            )
             if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
                 task.status = TaskStatus.REGISTERED
             return fresh
 
     def cluster_spec(self) -> dict[str, list[str]] | None:
         """The gang barrier (TonyApplicationMaster.java:771-806): None until
-        every task has registered, then {job: [host:port by index]}."""
+        every task has registered — at the CURRENT gang generation, so a
+        healing patch re-arms the barrier for everyone — then
+        {job: [host:port, dense by surviving order]}."""
         with self._lock:
             spec: dict[str, list[str]] = {}
             for job, tasks in self.tasks.items():
@@ -127,9 +197,70 @@ class TonySession:
                 for t in tasks:
                     if t.host_port is None:
                         return None
+                    if t.generation != self.gang_generation \
+                            and not t.completed():
+                        # A COMPLETED task can never re-register into a
+                        # patched generation — exempting it keeps a
+                        # post-completion gang patch from parking the
+                        # barrier forever (its last address stays in the
+                        # spec for index consistency).
+                        return None
                     addrs.append(t.host_port)
                 spec[job] = addrs
             return spec
+
+    # -- self-healing gang patches (coordinator/healing.py) ----------------
+    def begin_patch(self) -> int:
+        """Re-arm the gang barrier: every live task must re-register
+        (confirming the new generation) before the spec is served again
+        — the partial rendezvous that lets one replacement (or a
+        shrunken survivor set) join without a whole-session restart."""
+        with self._lock:
+            self.gang_generation += 1
+            return self.gang_generation
+
+    def evict_task(self, task_id: str) -> TonyTask | None:
+        """Re-open registration for ``task_id`` under a bumped
+        incarnation: its replacement (same id, incarnation + 1) must
+        register before the patched barrier releases. Returns the task,
+        or None when it is unknown or already completed."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None or task.completed():
+                return None
+            task.incarnation += 1
+            task.host_port = None
+            task.status = TaskStatus.SCHEDULED
+            task.exit_code = None
+            return task
+
+    def remove_task(self, task_id: str) -> TonyTask | None:
+        """Elastic shrink: drop ``task_id`` from the gang. Survivors
+        keep their ids; the per-job list becomes dense, so the cluster
+        spec and the runtime assignments renumber automatically. The
+        removed task lands in ``removed`` for the terminal record."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                return None
+            tasks = self.tasks.get(task.job_name, [])
+            if task not in tasks or len(tasks) <= 1:
+                return None
+            tasks.remove(task)
+            self.removed.append(task)
+            return task
+
+    def runtime_assignment(self, task_id: str) -> tuple[int, int] | None:
+        """(dense index, instance count) for the task's job type — what
+        its USER process must be told after a shrink (the executor keeps
+        its original id for registration/liveness; the runtime env needs
+        the dense view the cluster spec is ordered by)."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                return None
+            tasks = self.tasks.get(task.job_name, [])
+            return tasks.index(task), len(tasks)
 
     # -- completion accounting (TonySession.onTaskCompleted:269-293,
     #    updateSessionStatus:298-342) -------------------------------------

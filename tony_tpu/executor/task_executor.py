@@ -140,11 +140,16 @@ class Heartbeater(threading.Thread):
         on_send=None,
         profile_source=None,
         on_command=None,
+        incarnation: int = 0,
     ):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
         self._session_id = session_id
+        # Self-healing identity fencing: a replacement executor reuses
+        # its task id, so pings carry the incarnation the coordinator
+        # launched this copy under (0 stays off the wire).
+        self._incarnation = incarnation
         # Telemetry piggyback: a callable returning the latest metrics
         # snapshot (or None). Called per ping; the snapshot rides the
         # heartbeat's optional ``metrics`` arg, so the telemetry plane
@@ -209,6 +214,10 @@ class Heartbeater(threading.Thread):
                     kwargs["metrics"] = payload
                 if self._pending_profile is not None:
                     kwargs["profile"] = self._pending_profile
+                if self._incarnation:
+                    # 0 stays off the wire (and off pre-healing fakes),
+                    # mirroring the RPC stub's optional-arg contract.
+                    kwargs["incarnation"] = self._incarnation
                 reply = self._client.task_executor_heartbeat(
                     self._task_id, self._session_id, **kwargs
                 )
@@ -246,6 +255,42 @@ class TaskExecutor:
         self.task_index = int(env[constants.TASK_INDEX])
         self.task_num = int(env[constants.TASK_NUM])
         self.session_id = env.get(constants.SESSION_ID, "0")
+        # Self-healing: the incarnation the coordinator launched this
+        # copy under (0 = original; an evicted-and-replaced or
+        # speculative copy carries a bumped value and every
+        # registration/heartbeat echoes it, so the dead copy's traffic
+        # fences out). The resync state below is the survivor half: a
+        # heartbeat-reply ``resync`` command parks the user process and
+        # re-registers into the patched gang.
+        try:
+            self.incarnation = int(
+                env.get(constants.TONY_TASK_INCARNATION, "0") or 0
+            )
+        except ValueError:
+            self.incarnation = 0
+        # The gang generation this executor's registrations CONFIRM:
+        # seeded from the launch env (a replacement launched into patch
+        # N must confirm N, not whatever is current when its RPC lands),
+        # advanced by each applied resync order. All resync state below
+        # is guarded by _resync_lock — payload store + event set must be
+        # atomic against _take_resync, or a re-sent order interleaving
+        # with the consume could leave the event set with no payload and
+        # the main loop would exit without relaunching the user process.
+        try:
+            self._confirm_generation = int(
+                env.get(constants.TONY_GANG_GENERATION, "0") or 0
+            )
+        except ValueError:
+            self._confirm_generation = 0
+        self._resync_event = threading.Event()
+        self._resync_lock = threading.Lock()
+        self._resync_payload: dict | None = None
+        self._resync_done_generation = 0
+        # A resync that superseded the INITIAL registration (a second
+        # patch folded in while this — typically replacement — executor
+        # was still polling the barrier): its runtime overrides must
+        # apply to the very first user-process launch.
+        self._startup_resync: dict | None = None
         self.am_host, _, am_port = env[constants.TONY_AM_ADDRESS].rpartition(":")
         self.am_port = int(am_port)
         self.conf = TonyConfiguration.from_final(env[constants.TONY_CONF_PATH])
@@ -421,35 +466,163 @@ class TaskExecutor:
                 "task_executor_heartbeat", ok=ok, task=self.task_id
             ),
             profile_source=self.profiler.take_result,
-            on_command=self.profiler.handle_command,
+            on_command=self._on_heartbeat_command,
+            incarnation=self.incarnation,
         )
         self.heartbeater.start()
+        while True:
+            spec = self._poll_register(abort_on_newer_resync=True)
+            if spec is not None:
+                return spec
+            resync = self._take_resync()
+            if resync is None:
+                raise TimeoutError("timed out waiting for the gang barrier")
+            # A second patch folded in while this executor was still
+            # polling its initial registration (the barrier now wants a
+            # NEWER generation confirmed — re-registering the old one
+            # would park the whole gang). _take_resync advanced the
+            # confirm generation; re-register for the new patch and
+            # carry its runtime overrides into the first launch.
+            log.warning(
+                "initial registration superseded by gang generation %s; "
+                "re-registering", resync.get("generation"),
+            )
+            self._startup_resync = resync
+
+    def _poll_register(
+        self, abort_on_newer_resync: bool = False,
+    ) -> dict[str, list[str]] | None:
+        """Register (or RE-register, after a healing resync) and poll
+        until the gang barrier — possibly a patched generation's re-armed
+        one — releases the cluster spec. Registrations echo the
+        generation being confirmed, so the coordinator can tell a
+        confirm for THIS patch from a stale one.
+
+        ``abort_on_newer_resync``: while polling a patched barrier, a
+        SECOND patch may fold in (the order lands on the heartbeat
+        thread) — this poll can then never succeed (the server wants the
+        newer generation confirmed), so return None early and let the
+        exec loop take the newer payload."""
         retry_s = self.conf.get_int(keys.K_TASK_REGISTRATION_RETRY_MS, 500) / 1000.0
         timeout_ms = self.conf.get_int(keys.K_TASK_REGISTRATION_TIMEOUT_MS, 0)
-        spec = utils.poll_till_non_null(
-            lambda: self.client.register_worker_spec(
-                self.task_id, f"{self.host}:{self.port}"
-            ),
-            interval_s=retry_s,
-            timeout_s=timeout_ms / 1000.0 if timeout_ms else None,
+        deadline = (
+            time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
         )
-        if spec is None:
-            raise TimeoutError("timed out waiting for the gang barrier")
-        return spec
+        while True:
+            spec = self.client.register_worker_spec(
+                self.task_id, f"{self.host}:{self.port}",
+                incarnation=self.incarnation,
+                generation=self._confirm_generation,
+            )
+            if spec is not None:
+                return spec
+            if abort_on_newer_resync:
+                with self._resync_lock:
+                    if self._resync_payload is not None:
+                        return None  # superseded mid-poll
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(retry_s)
+
+    # -- self-healing resync (the survivor half of a gang patch) ------------
+    def _on_heartbeat_command(self, reply) -> None:
+        """Heartbeat-reply command dispatch: the profile half goes to the
+        profiler; a ``resync`` order (this task is registered under a
+        STALE gang generation — the coordinator patched the gang) parks
+        the user process so the main thread can re-register. The
+        coordinator re-sends the order every ping until this executor
+        re-registers, so acting on repeats must be idempotent."""
+        self.profiler.handle_command(reply)
+        resync = reply.get("resync") if isinstance(reply, dict) else None
+        if not isinstance(resync, dict):
+            return
+        try:
+            generation = int(resync.get("generation", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        with self._resync_lock:
+            if generation <= self._resync_done_generation:
+                return  # this patch was already applied
+            fresh = not self._resync_event.is_set()
+            # Payload store + event set are one atomic region (see
+            # __init__): _take_resync consumes both under this lock.
+            self._resync_payload = dict(resync)
+            self._resync_event.set()
+        if fresh:
+            log.warning(
+                "healing resync ordered (gang generation %d): parking "
+                "the user process to re-register", generation,
+            )
+        # Park: the kill is a no-op when the process is already down,
+        # so re-sent orders (and the order landing between exec loops)
+        # stay harmless.
+        _kill_user_process_group()
+
+    def _resync_env(self, cluster_spec: dict[str, list[str]],
+                    resync: dict) -> dict[str, str]:
+        """The user-process env for a resync'd (or resync-superseded
+        initial) launch: the dense runtime view the order carried, the
+        checkpoint resume step, and the coordinator's replanned sharding
+        note (the user process feeds it to plan_from_mesh / its own plan
+        selection on the rebuilt mesh)."""
+        env = self.build_task_env(
+            cluster_spec,
+            runtime_index=resync.get("task_index"),
+            runtime_num=resync.get("task_num"),
+        )
+        if resync.get("resume_step") is not None:
+            env[constants.TONY_RESUME_STEP] = str(resync["resume_step"])
+        if resync.get("reshard"):
+            env[constants.TONY_RESHARD_PLAN] = str(resync["reshard"])
+        return env
+
+    def _take_resync(self) -> dict | None:
+        """Consume a pending resync order (main thread, between user
+        process runs); None when the last run ended for real reasons.
+        Consume + event clear + generation advance are one atomic
+        region against ``_on_heartbeat_command``."""
+        with self._resync_lock:
+            if not self._resync_event.is_set():
+                return None
+            payload, self._resync_payload = self._resync_payload, None
+            self._resync_event.clear()
+            if payload is not None:
+                try:
+                    generation = int(payload.get("generation", 0) or 0)
+                except (TypeError, ValueError):
+                    generation = 0
+                self._resync_done_generation = max(
+                    self._resync_done_generation, generation,
+                )
+                self._confirm_generation = max(
+                    self._confirm_generation, generation,
+                )
+        return payload
 
     # -- env assembly -------------------------------------------------------
-    def build_task_env(self, cluster_spec: dict[str, list[str]]) -> dict[str, str]:
+    def build_task_env(
+        self, cluster_spec: dict[str, list[str]],
+        runtime_index: int | None = None,
+        runtime_num: int | None = None,
+    ) -> dict[str, str]:
         from tony_tpu.executor.runtimes import get_runtime
 
+        # After an elastic shrink the cluster spec is DENSE over the
+        # survivors: this executor keeps its original id for
+        # registration/liveness, but the runtime env (process id, task
+        # index/num the user process sees) must use the dense view the
+        # resync order carried. Unpatched runs pass neither override.
+        index = self.task_index if runtime_index is None else runtime_index
+        num = self.task_num if runtime_num is None else runtime_num
         framework = self.conf.get_str(keys.K_FRAMEWORK, "jax")
         env = get_runtime(framework).build_env(
-            cluster_spec, self.job_name, self.task_index, self.conf
+            cluster_spec, self.job_name, index, self.conf
         )
         env.update(
             {
                 constants.JOB_NAME: self.job_name,
-                constants.TASK_INDEX: str(self.task_index),
-                constants.TASK_NUM: str(self.task_num),
+                constants.TASK_INDEX: str(index),
+                constants.TASK_NUM: str(num),
                 constants.SESSION_ID: self.session_id,
             }
         )
@@ -530,12 +703,14 @@ class TaskExecutor:
         # user-supplied extra env (--shell_env analogue)
         env.update(utils.parse_key_values(self.conf.get_str(keys.K_SHELL_ENV)))
         if self._fault_plan is not None and self._fault_plan.raw and any(
-            s.action in ("fail_checkpoint_write", "throttle_io")
+            s.action in ("fail_checkpoint_write", "throttle_io",
+                         "degrade_task")
             for s in self._fault_plan.specs
         ):
-            # CheckpointManager (fail_checkpoint_write) and the input
-            # pipeline (throttle_io) run in the USER process and honor
-            # these faults from this env.
+            # CheckpointManager (fail_checkpoint_write), the input
+            # pipeline (throttle_io), and the train loop (degrade_task)
+            # run in the USER process and honor these faults from this
+            # env.
             env[constants.TONY_FAULT_PLAN] = self._fault_plan.raw
         return env
 
@@ -602,21 +777,65 @@ class TaskExecutor:
             # port for jax.profiler.start_server; the user script opts in
             # via tony_tpu.profiling.maybe_start_profiler_server().
             self.profiler_port = utils.reserve_port()
-        env = self.build_task_env(cluster_spec)
+        if self._startup_resync is not None:
+            env = self._resync_env(cluster_spec, self._startup_resync)
+        else:
+            env = self.build_task_env(cluster_spec)
         command = self.build_task_command()
         timeout_ms = (
             self.conf.get_int(keys.K_WORKER_TIMEOUT, 0)
             if self.job_name == constants.WORKER_JOB_NAME
             else 0
         )
-        log.info("executing: %s", command)
-        with self.tracer.span("user_process", task=self.task_id) as up_span:
-            rc = utils.execute_shell(
-                command, timeout_ms=timeout_ms, extra_env=env,
-                on_start=_register_user_proc,
-            )
-            up_span.set(exit_code=rc)
-        log.info("user process exited with %d", rc)
+        while True:
+            if not self._resync_event.is_set():
+                log.info("executing: %s", command)
+                with self.tracer.span("user_process",
+                                      task=self.task_id) as up_span:
+                    rc = utils.execute_shell(
+                        command, timeout_ms=timeout_ms, extra_env=env,
+                        on_start=_register_user_proc,
+                    )
+                    up_span.set(exit_code=rc)
+                log.info("user process exited with %d", rc)
+            else:
+                # The resync order landed before the user process even
+                # started (or between runs): skip straight to the
+                # re-registration — the stale cluster spec must not run.
+                rc = 0
+            resync = self._take_resync()
+            if resync is None:
+                break
+            # Survivor half of a gang patch: the user process was parked
+            # on purpose (its SIGKILL exit is not a failure); re-register
+            # into the patched generation, then relaunch against the new
+            # (possibly shrunken + resharded) cluster spec, resuming from
+            # the coordinator's checkpoint step.
+            if self._metrics_file is not None:
+                # The parked process's last snapshot is stale by design;
+                # it must not ride the patched gang's first heartbeats.
+                try:
+                    self._metrics_file.unlink()
+                except OSError:
+                    pass
+            with self.tracer.span("resync", task=self.task_id,
+                                  generation=resync.get("generation")):
+                cluster_spec = self._poll_register(
+                    abort_on_newer_resync=True
+                )
+            if cluster_spec is None:
+                with self._resync_lock:
+                    superseded = self._resync_event.is_set()
+                if superseded:
+                    # A second patch folded in mid-poll: loop back and
+                    # take its payload instead of the stale one.
+                    continue
+                log.error("patched gang barrier never released")
+                rc = 1
+                break
+            log.info("re-registered into patched gang; spec: %s",
+                     cluster_spec)
+            env = self._resync_env(cluster_spec, resync)
         if rc != 0:
             # The postmortem wants what THIS host saw just before the
             # failure: the last published reports and heartbeat outcomes.

@@ -311,6 +311,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
                     )
             parts.append("</table>")
         parts.extend(self._goodput_section(final, esc))
+        parts.extend(self._healing_section(app_id, final, esc))
         parts.extend(self._stepstats_section(final, esc))
         parts.extend(self._diagnosis_section(app_id, final, esc))
         parts.extend(self._metrics_section(final, esc))
@@ -351,6 +352,56 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 f"<td>{esc(chip_s.get(cat))}</td><td>{share}</td></tr>"
             )
         parts.append("</table>")
+        return parts
+
+    def _healing_section(self, app_id: str, final: dict, esc) -> list[str]:
+        """Mid-job gang surgery (coordinator/healing.py): the terminal
+        record's healing tallies plus the eviction / replacement /
+        reshard timeline rows — why this job's gang changed shape
+        without a session restart."""
+        healing = final.get("healing")
+        if not isinstance(healing, dict) or not any(
+            healing.get(k) for k in ("evictions", "replacements",
+                                     "reshards", "speculative_launches")
+        ):
+            return []
+        parts = [
+            "<h3>Self-healing</h3>"
+            f"<p>{esc(healing.get('evictions', 0))} eviction(s) &middot; "
+            f"{esc(healing.get('replacements', 0))} replacement(s) "
+            f"&middot; {esc(healing.get('reshards', 0))} elastic "
+            f"reshard(s) &middot; "
+            f"{esc(healing.get('speculative_launches', 0))} speculative "
+            f"launch(es)</p>",
+        ]
+        removed = healing.get("removed_tasks") or []
+        if removed:
+            parts.append(
+                f"<p>removed tasks: {esc(', '.join(map(str, removed)))}"
+                f"</p>"
+            )
+        rows = [
+            e for e in (self._events(app_id) or [])
+            if e.get("kind") in ("task_evicted", "task_replaced",
+                                 "elastic_reshard", "speculative_launched")
+        ]
+        if rows:
+            parts.append("<table><tr><th>event</th><th>task</th>"
+                         "<th>cause</th><th>detail</th></tr>")
+            for e in rows[:16]:
+                detail = ", ".join(
+                    f"{k}={e[k]}"
+                    for k in ("incarnation", "survivors", "plan",
+                              "resume_step", "score")
+                    if e.get(k) is not None
+                )
+                parts.append(
+                    f"<tr><td>{esc(e.get('kind'))}</td>"
+                    f"<td>{esc(e.get('task') or '')}</td>"
+                    f"<td>{esc(e.get('cause') or '')}</td>"
+                    f"<td>{esc(detail)}</td></tr>"
+                )
+            parts.append("</table>")
         return parts
 
     def _stepstats_section(self, final: dict, esc) -> list[str]:

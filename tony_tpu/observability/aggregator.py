@@ -230,6 +230,34 @@ class MetricsAggregator:
         with self._lock:
             self._latest.clear()
             self._series.clear()
+            self._step_rates.clear()
+
+    def reset_task(self, task_id: str) -> None:
+        """One task was evicted and replaced (self-healing): drop ITS
+        latest snapshot, gauge series, and step rate so the replacement
+        — which reuses the task id, and therefore the ``task`` metric
+        label — never joins onto the evicted incarnation's points (the
+        straggler's old step times would poison the replacement's
+        baseline and every dashboard join on the label). The heartbeat
+        total survives: it is cumulative for the task id across
+        incarnations, like it is across sessions."""
+        with self._lock:
+            self._latest.pop(task_id, None)
+            self._step_rates.pop(task_id, None)
+            for key in [k for k in self._series if k[0] == task_id]:
+                del self._series[key]
+
+    def latest_counter(self, name: str) -> dict[str, float]:
+        """Per-task latest value of one counter off the heartbeat
+        piggyback — the monitor loop reads ``train_steps_total`` here to
+        drive step-triggered fault injection (kill_task after_steps)."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for task_id, snap in self._latest.items():
+                value = (snap.get("counters") or {}).get(name)
+                if value is not None:
+                    out[task_id] = float(value)
+            return out
 
     def heartbeat_ages(self) -> dict[str, float]:
         """Seconds since each task's last heartbeat, on the

@@ -47,6 +47,18 @@ TRAIN_PROGRESS = "train_progress"
 PROFILE_REQUESTED = "profile_requested"
 PROFILE_CAPTURED = "profile_captured"
 
+# Self-healing actuation (coordinator/healing.py): the coordinator
+# acted on its own telemetry mid-job — a confirmed straggler's container
+# was killed (`task_evicted`), its replacement registered into the
+# patched gang (`task_replaced`), the gang shrank to the surviving
+# topology under a replanned sharding (`elastic_reshard`), or a backup
+# copy of a slow-to-register task was launched speculatively
+# (`speculative_launched`; whichever copy registers first wins).
+TASK_EVICTED = "task_evicted"
+TASK_REPLACED = "task_replaced"
+ELASTIC_RESHARD = "elastic_reshard"
+SPECULATIVE_LAUNCHED = "speculative_launched"
+
 # Scheduler-daemon lifecycle (scheduler/service.py): the queue/pool
 # timeline, appended to the scheduler's own events.jsonl.
 JOB_QUEUED = "job_queued"
@@ -81,6 +93,10 @@ KNOWN_KINDS = frozenset({
     TRAIN_PROGRESS,
     PROFILE_REQUESTED,
     PROFILE_CAPTURED,
+    TASK_EVICTED,
+    TASK_REPLACED,
+    ELASTIC_RESHARD,
+    SPECULATIVE_LAUNCHED,
     JOB_QUEUED,
     JOB_LAUNCHED,
     JOB_PREEMPTED,

@@ -24,6 +24,11 @@ advances from heartbeat snapshots, stall health alerts) into an
 ``rendezvous``          gang-barrier wait (first registration → release)
 ``productive``          training steps advancing
 ``stalled``             steps stopped advancing while the gang is healthy
+``healing``             the coordinator is actively healing the gang — a
+                        straggler eviction's partial re-rendezvous, or an
+                        elastic shrink's replan + restart — measured from
+                        the eviction/reshard event to the first post-patch
+                        step advance
 ``wasted_by_failure``   work since the last complete checkpoint, re-charged
                         at each failure (recomputation debt)
 ``preempted``           preempted and waiting to be relaunched
@@ -68,6 +73,7 @@ CATEGORIES = (
     "rendezvous",
     "productive",
     "stalled",
+    "healing",
     "wasted_by_failure",
     "preempted",
     "teardown",
@@ -93,6 +99,13 @@ _PHASE_AFTER_EVENT: dict[str, str] = {
     "train_progress": "productive",
     "job_preempted": "preempted",
     "final_status": "teardown",
+    # Self-healing actuation: the interval between a mid-job eviction /
+    # elastic shrink and the first post-patch step advance is healing
+    # cost, charged to its own category so the ledger can show what
+    # acting on telemetry costs (vs what NOT acting would have wasted).
+    "task_evicted": "healing",
+    "task_replaced": "healing",
+    "elastic_reshard": "healing",
 }
 
 # Throttle for surfacing train progress as a lifecycle event: the first
@@ -220,7 +233,17 @@ class GoodputLedger:
                                    None):
                     self._phase = "rendezvous"
             elif kind in _PHASE_AFTER_EVENT:
-                self._phase = _PHASE_AFTER_EVENT[kind]
+                if self._phase == "healing" and kind in (
+                    "task_scheduled", "rendezvous_released",
+                ):
+                    # Mid-patch plumbing events (the replacement's launch,
+                    # the re-armed barrier re-releasing) stay inside the
+                    # healing episode; it ends when steps ADVANCE again
+                    # (train_progress / observe_steps) — the partial
+                    # re-rendezvous and any recompile are healing cost.
+                    pass
+                else:
+                    self._phase = _PHASE_AFTER_EVENT[kind]
 
     def observe_steps(self, task_id: str, steps_total: float,
                       ts_ms: int | None = None) -> bool:
@@ -242,7 +265,8 @@ class GoodputLedger:
             if prev is None and steps_total <= 0:
                 return False
             self._advance_to(ts)
-            if self._phase in ("compile", "stalled", "productive"):
+            if self._phase in ("compile", "stalled", "productive",
+                               "healing"):
                 self._phase = "productive"
             emit = (
                 self._progress_event_ms is None

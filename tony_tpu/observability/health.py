@@ -196,6 +196,12 @@ class HealthMonitor:
         self._alerts_total = 0
         # (detector, task) -> monotonic time of the last emitted alert.
         self._last_alert: dict[tuple[str, str], float] = {}
+        # Gang-patch suppression depth (self-healing): while a patch is
+        # in flight the survivors' user processes are down on purpose —
+        # their step_time/steps gauges are STALE, so the straggler and
+        # progress-stall detectors must not read the coordinator's own
+        # surgery as a fleet-wide incident (the self-alert storm).
+        self._patching = 0
 
     # -- ingest --------------------------------------------------------------
     def observe(
@@ -215,11 +221,19 @@ class HealthMonitor:
                 gauges = snapshot.get("gauges") or {}
                 counters = snapshot.get("counters") or {}
                 histograms = snapshot.get("histograms") or {}
-                self._check_progress(task_id, state, counters, now, alerts)
                 self._check_loss(task_id, state, gauges, now, alerts)
-                self._check_straggler(task_id, state, gauges, now, alerts)
-                self._check_io(task_id, state, histograms, now, alerts)
-                self._check_stepstats(task_id, state, gauges, now, alerts)
+                if not self._patching:
+                    # Mid-patch the survivors' user processes are down
+                    # on purpose: their progress/step-time/io gauges are
+                    # stale, and scoring them would read the healing
+                    # surgery itself as a fleet incident.
+                    self._check_progress(task_id, state, counters, now,
+                                         alerts)
+                    self._check_straggler(task_id, state, gauges, now,
+                                          alerts)
+                    self._check_io(task_id, state, histograms, now, alerts)
+                    self._check_stepstats(task_id, state, gauges, now,
+                                          alerts)
         for alert in alerts:
             self._publish(alert)
 
@@ -230,6 +244,57 @@ class HealthMonitor:
         with self._lock:
             self._tasks.clear()
             self._last_alert.clear()
+            self._patching = 0
+
+    def remove_task(self, task_id: str) -> None:
+        """One task left the gang for good (evicted, or elastically
+        shrunk away): drop its streaming state so the MAD baseline is
+        computed over the n−1 survivors, and clear its per-(detector,
+        task) cooldowns — a REPLACEMENT rejoining under the same id
+        starts with a clean slate, and its first genuine anomaly must
+        not be swallowed by the evicted copy's cooldown window."""
+        with self._lock:
+            self._tasks.pop(task_id, None)
+            for key in [k for k in self._last_alert if k[1] == task_id]:
+                del self._last_alert[key]
+
+    # Alias with the replacement's perspective: same state surgery, the
+    # caller just means "this id is about to be a different machine".
+    reset_task = remove_task
+
+    def begin_patch(self) -> None:
+        """A gang patch started: suspend the relative detectors
+        (straggler, progress stall, io stall, step anatomy) until
+        ``end_patch`` — survivors' gauges are stale by design while
+        their user processes restart. Heartbeat jitter and loss checks
+        stay live: the executors themselves must keep pinging."""
+        with self._lock:
+            self._patching += 1
+
+    def end_patch(self) -> None:
+        with self._lock:
+            self._patching = max(self._patching - 1, 0)
+            if self._patching == 0:
+                # Re-baseline the relative detectors: the patched gang's
+                # user processes restarted, so their step counters and
+                # walls begin a new life — pre-patch values must not
+                # seed post-patch deltas (a restarted counter reading
+                # below the stale total is not a stall, and a stale
+                # step wall is not a straggler baseline).
+                now = self._clock()
+                for s in self._tasks.values():
+                    s.steps = None
+                    s.last_progress = now
+                    s.stalled = False
+                    s.step_time_ms = None
+                    s.io_wait_ms = None
+                    s.io_wall_ms = None
+                    # The stored score too: straggler_scores() feeds the
+                    # healing confirm window every monitor tick, and a
+                    # stale pre-patch score surviving the restart could
+                    # confirm (and evict) a now-healthy survivor before
+                    # it publishes a single fresh step wall.
+                    s.straggler_score = 0.0
 
     # -- detectors (all called with the lock held) ---------------------------
     def _check_jitter(self, task_id, state, now, alerts) -> None:

@@ -48,6 +48,7 @@ import os
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
+from types import SimpleNamespace
 from typing import Any, Iterable, Mapping
 
 from tony_tpu.parallel.mesh import AXES, MeshSpec, build_mesh
@@ -842,6 +843,36 @@ def plan_for(
         return measured[k] if k in measured else est[k] * scale
 
     return min(plans, key=cost)
+
+
+def shrink_plans(
+    num_devices: int,
+    *,
+    num_slices: int = 1,
+    cfg=None,
+    require: Mapping[str, int] | None = None,
+    max_candidates: int = 8,
+) -> list[Plan]:
+    """Candidate plans for a SHRUNKEN topology — the elastic-shrink
+    oracle (``coordinator/healing.py``): the gang just lost a host and
+    the coordinator must pick a sharding for the n−1 survivors without
+    knowing the model config (that lives in the user process, which
+    re-derives its own plan — ``plan_for`` or ``plan_from_mesh`` on its
+    rebuilt mesh — with the chosen plan's key as the advisory note).
+
+    ``cfg=None`` plans topology-only: every model-shape legality check
+    degrades to its permissive default (tp|1-head etc.), so pin what you
+    know via ``require`` — the coordinator pins ``{"dp": n}`` since data
+    parallelism is the one axis a model-blind replan can always reshard.
+    Candidates come back cost-ranked like ``candidate_plans`` (they ARE
+    ``candidate_plans``, over a null config)."""
+    return candidate_plans(
+        cfg if cfg is not None else SimpleNamespace(),
+        max(num_devices, 1),
+        num_slices=max(num_slices, 1),
+        require=require,
+        max_candidates=max_candidates,
+    )
 
 
 def plan_from_mesh(mesh, *, microbatches: int | None = None,

@@ -20,9 +20,12 @@ Plan shape (inline JSON in the conf value, or a path to a JSON file)::
         {"action": "drop_heartbeats", "target": "worker:0", "count": 10},
         {"action": "delay_heartbeats", "target": "worker:0", "ms": 250, "count": 5},
         {"action": "blackout_rpc", "target": "worker:0", "after_ms": 2000, "ms": 1500},
+        {"action": "kill_task", "target": "worker:1", "after_steps": 5},
         {"action": "fail_checkpoint_write", "step": 10, "count": 1},
         {"action": "throttle_io", "target": "worker:0", "ms": 50,
-         "after_batches": 4, "count": 100}
+         "after_batches": 4, "count": 100},
+        {"action": "degrade_task", "target": "worker:2", "ms": 400,
+         "after_steps": 2, "count": 100}
       ]
     }
 
@@ -41,8 +44,11 @@ crash_coordinator      coordinator, entering phase ``prepare`` / ``schedule``
                        / ``monitor`` (``os._exit``; the AM-death test)
 kill_task              coordinator kills the task's container: when the
                        target (or, for ``any_non_chief``, the chief)
-                       registers; after the target's N-th heartbeat; or
-                       T ms into the session's monitor loop
+                       registers; after the target's N-th heartbeat;
+                       T ms into the session's monitor loop; or once the
+                       target's reported ``train_steps_total`` reaches
+                       ``after_steps`` (a deterministic mid-training
+                       hardware loss — the self-healing chaos probe)
 exit_executor          the executor itself exits ``code`` before
                        registering (``at: pre_register``) — a deterministic
                        setup failure, the USER_PERMANENT probe
@@ -60,6 +66,14 @@ throttle_io            the input pipeline sleeps ``ms`` before each of the
                        been served (starved-input simulation — flips the
                        step anatomy's dominant phase to ``data_wait``;
                        reads ``TONY_FAULT_PLAN`` in the user process)
+degrade_task           the target's train loop sleeps ``ms`` on each of
+                       the next ``count`` steps once ``after_steps`` have
+                       run (a deterministic mid-training straggler: the
+                       MAD scorer sees a real slow-side outlier). Reads
+                       ``TONY_FAULT_PLAN`` in the user process; applies
+                       to incarnation 0 only — it models a sick HOST, so
+                       an evicted-and-replaced copy of the task runs
+                       clean, exactly like a replacement on new hardware
 =====================  =====================================================
 
 The legacy ``TEST_AM_CRASH`` / ``TEST_WORKER_TERMINATION`` env vars remain
@@ -89,6 +103,7 @@ DELAY_HEARTBEATS = "delay_heartbeats"
 BLACKOUT_RPC = "blackout_rpc"
 FAIL_CHECKPOINT_WRITE = "fail_checkpoint_write"
 THROTTLE_IO = "throttle_io"
+DEGRADE_TASK = "degrade_task"
 
 COORDINATOR_PHASES = ("prepare", "schedule", "monitor")
 
@@ -100,7 +115,7 @@ _FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
     CRASH_COORDINATOR: (frozenset({"phase"}), frozenset({"code"})),
     KILL_TASK: (
         frozenset({"target"}),
-        frozenset({"at", "after_heartbeats", "after_ms"}),
+        frozenset({"at", "after_heartbeats", "after_ms", "after_steps"}),
     ),
     EXIT_EXECUTOR: (frozenset({"target"}), frozenset({"at", "code"})),
     DROP_HEARTBEATS: (frozenset({"target"}), frozenset()),
@@ -110,6 +125,10 @@ _FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
     THROTTLE_IO: (
         frozenset({"ms"}),
         frozenset({"target", "after_batches"}),
+    ),
+    DEGRADE_TASK: (
+        frozenset({"target", "ms"}),
+        frozenset({"after_steps"}),
     ),
 }
 _COMMON_FIELDS = frozenset({"action", "session", "count"})
@@ -137,6 +156,7 @@ class FaultSpec:
     ms: int = 0
     after_ms: int | None = None
     after_heartbeats: int | None = None
+    after_steps: int | None = None
     step: int | None = None
     after_batches: int = 0
 
@@ -191,6 +211,15 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
         after_hb = _positive_int(
             after_hb, f"{where}.after_heartbeats", errors, 1
         )
+    after_steps = obj.get("after_steps")
+    if after_steps is not None:
+        # Floor depends on the action: a kill at "0 steps observed" can
+        # never trigger (the counter starts advancing at 1), while
+        # degrade_task's after_steps=0 means "slow from the first step".
+        after_steps = _positive_int(
+            after_steps, f"{where}.after_steps", errors,
+            1 if action == KILL_TASK else 0,
+        )
     step = obj.get("step")
     if step is not None:
         step = _positive_int(step, f"{where}.step", errors, 0)
@@ -218,12 +247,14 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
         )
     if action == KILL_TASK:
         triggers = [
-            t for t in (at, after_hb, after_ms) if t is not None
+            t for t in (at, after_hb, after_ms, after_steps)
+            if t is not None
         ]
         if len(triggers) != 1:
             errors.append(
                 f"{where} (kill_task): exactly one trigger required — "
-                f"at='rendezvous', after_heartbeats, or after_ms"
+                f"at='rendezvous', after_heartbeats, after_ms, or "
+                f"after_steps"
             )
         if at is not None and at != "rendezvous":
             errors.append(
@@ -232,8 +263,8 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
         if target == ANY_NON_CHIEF and at is None:
             errors.append(
                 f"{where}: target {ANY_NON_CHIEF!r} is only legal with "
-                f"at='rendezvous' (timed/heartbeat kills need a concrete "
-                f"task)"
+                f"at='rendezvous' (timed/heartbeat/step kills need a "
+                f"concrete task)"
             )
     if action == EXIT_EXECUTOR:
         if at is None:
@@ -257,21 +288,22 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
                 f"target"
             )
     if action in (DROP_HEARTBEATS, DELAY_HEARTBEATS, FAIL_CHECKPOINT_WRITE,
-                  THROTTLE_IO):
+                  THROTTLE_IO, DEGRADE_TASK):
         if target == ANY_NON_CHIEF:
             errors.append(
                 f"{where}: {action} needs a concrete 'job:index' target"
             )
-    if action == THROTTLE_IO and ms == 0:
+    if action in (THROTTLE_IO, DEGRADE_TASK) and ms == 0:
         errors.append(
-            f"{where}.ms must be nonzero for throttle_io (a 0 ms "
-            f"throttle tests nothing)"
+            f"{where}.ms must be nonzero for {action} (a 0 ms "
+            f"slowdown tests nothing)"
         )
 
     return FaultSpec(
         action=action, target=target, at=at, phase=phase, session=session,
         count=count, code=code, ms=ms, after_ms=after_ms,
-        after_heartbeats=after_hb, step=step, after_batches=after_batches,
+        after_heartbeats=after_hb, after_steps=after_steps, step=step,
+        after_batches=after_batches,
     )
 
 
@@ -503,6 +535,25 @@ class FaultInjector:
                 victims.append(spec.target)
         return victims
 
+    def step_kills(
+        self, session: int, steps_by_task: Mapping[str, float],
+    ) -> list[str]:
+        """Targets whose reported ``train_steps_total`` (off the
+        heartbeat piggyback, read from the aggregator by the monitor
+        loop) has reached ``after_steps`` this session — the
+        deterministic mid-training hardware-loss probe: unlike
+        ``after_ms`` the kill lands at a KNOWN step, so a chaos run can
+        assert exactly which checkpoint the healed gang resumes from."""
+        victims = []
+        for idx, spec in self._active(KILL_TASK, session):
+            if spec.after_steps is None or spec.target is None:
+                continue
+            steps = steps_by_task.get(spec.target)
+            if steps is not None and steps >= spec.after_steps \
+                    and self._take(idx, spec):
+                victims.append(spec.target)
+        return victims
+
 
 # ---------------------------------------------------------------------------
 # User-process (checkpoint) faults — read from TONY_FAULT_PLAN, which the
@@ -576,7 +627,82 @@ class IoFaults:
             self._sleep(delay_ms / 1000.0)
 
 
+class StepFaults:
+    """``degrade_task`` applied step-by-step in the user process: the
+    train loop calls ``maybe_degrade(step)`` once per step and this
+    sleeps the configured delay for the next ``count`` steps past
+    ``after_steps`` — a deterministic mid-training straggler, injected
+    where real fail-slow hosts hurt (the fleet's MAD scorer sees a
+    genuine slow-side step_time_ms outlier).
+
+    Incarnation-scoped on purpose: the fault models a SICK HOST, so it
+    applies only to incarnation 0 of its target — an evicted-and-
+    replaced copy (TONY_TASK_INCARNATION > 0) runs clean, exactly like
+    a replacement landing on healthy hardware. Without this the healing
+    loop could never win: the replacement would inherit the slowdown."""
+
+    def __init__(self, plan: FaultPlan, task_id: str | None,
+                 session: int = 1, incarnation: int = 0,
+                 sleep=time.sleep) -> None:
+        self._specs = [
+            (i, s) for i, s in enumerate(plan.specs)
+            if s.action == DEGRADE_TASK
+            and (s.target is None or s.target == task_id)
+            and s.in_session(session)
+        ] if incarnation == 0 else []
+        self._sleep = sleep
+        self._fired: dict[int, int] = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    def maybe_degrade(self, step: int) -> None:
+        delay_ms = 0
+        for idx, spec in self._specs:
+            if step <= (spec.after_steps or 0):
+                continue
+            if self._fired.get(idx, 0) >= spec.count:
+                continue
+            self._fired[idx] = self._fired.get(idx, 0) + 1
+            delay_ms = max(delay_ms, spec.ms)
+        if delay_ms:
+            self._sleep(delay_ms / 1000.0)
+
+
 _io_faults: "IoFaults | None | bool" = False  # False = not loaded
+_step_faults: "StepFaults | None | bool" = False  # False = not loaded
+
+
+def step_faults_from_env() -> StepFaults | None:
+    """Lazy singleton over ``TONY_FAULT_PLAN`` for ``degrade_task`` —
+    called from train-loop step paths (examples/lm_train.py and the
+    chaos fixtures), so a plan can make any task a deterministic
+    straggler without touching the script. Returns None (no per-step
+    overhead) when the plan carries no degrade entries or this process
+    is a replacement incarnation."""
+    global _step_faults
+    if _step_faults is not False:
+        return _step_faults
+    import os
+
+    from tony_tpu import constants
+
+    plan, task_id, session = _user_process_plan()
+    try:
+        incarnation = int(
+            os.environ.get(constants.TONY_TASK_INCARNATION, "0") or 0
+        )
+    except ValueError:
+        incarnation = 0
+    faults = (
+        StepFaults(plan, task_id, session, incarnation=incarnation)
+        if plan is not None and any(
+            s.action == DEGRADE_TASK for s in plan.specs
+        ) else None
+    )
+    _step_faults = faults if faults is not None and faults.active else None
+    return _step_faults
 
 
 def io_faults_from_env() -> IoFaults | None:

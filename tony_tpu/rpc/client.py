@@ -113,8 +113,18 @@ class ApplicationRpcClient(ApplicationRpc):
     def get_cluster_spec(self) -> dict[str, list[str]] | None:
         return self._call("get_cluster_spec")
 
-    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
-        return self._call("register_worker_spec", worker=worker, spec=spec)
+    def register_worker_spec(
+        self, worker: str, spec: str, incarnation: int = 0,
+        generation: int = 0,
+    ) -> dict[str, list[str]] | None:
+        # Incarnation/generation 0 (every unhealed gang) stays off the
+        # wire so pre-healing peers keep seeing the 2-arg frame.
+        args: dict[str, Any] = {"worker": worker, "spec": spec}
+        if incarnation:
+            args["incarnation"] = int(incarnation)
+        if generation:
+            args["generation"] = int(generation)
+        return self._call("register_worker_spec", **args)
 
     def register_tensorboard_url(self, spec: str, url: str) -> str | None:
         return self._call("register_tensorboard_url", spec=spec, url=url)
@@ -139,15 +149,19 @@ class ApplicationRpcClient(ApplicationRpc):
         session_id: str,
         metrics: Mapping[str, Any] | None = None,
         profile: Mapping[str, Any] | None = None,
+        incarnation: int = 0,
     ) -> dict[str, Any] | None:
         # The optional args stay off the wire when absent: pings without
         # telemetry (and pre-metrics peers) keep the 2-arg frame. The
-        # return value may carry a coordinator command (profile fan-out).
+        # return value may carry a coordinator command (profile fan-out /
+        # healed-gang resync).
         args: dict[str, Any] = {"task_id": task_id, "session_id": session_id}
         if metrics is not None:
             args["metrics"] = dict(metrics)
         if profile is not None:
             args["profile"] = dict(profile)
+        if incarnation:
+            args["incarnation"] = int(incarnation)
         return self._call("task_executor_heartbeat", **args)
 
     def request_profile(self, duration_ms: int) -> dict[str, Any]:

@@ -52,10 +52,27 @@ class ApplicationRpc(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def register_worker_spec(self, worker: str, spec: str) -> dict[str, list[str]] | None:
+    def register_worker_spec(
+        self, worker: str, spec: str, incarnation: int = 0,
+        generation: int = 0,
+    ) -> dict[str, list[str]] | None:
         """Rendezvous barrier: returns None until every requested task has
         registered, then the full cluster spec
-        (TonyApplicationMaster.java:771-806)."""
+        (TonyApplicationMaster.java:771-806).
+
+        ``incarnation`` (optional) fences healed gangs: an
+        evicted-and-replaced task's replacement registers the SAME task
+        id with a bumped incarnation, so a zombie copy of the old
+        executor re-dialing in can never re-take the identity (and the
+        first of two speculative copies to register wins it).
+
+        ``generation`` (optional) is the gang generation this
+        registration CONFIRMS (from the resync order, or the launch
+        env for replacements). The coordinator stamps the echoed value
+        — not its current one — so a second patch folding in between
+        the order and this registration cannot read a stale confirm as
+        current: the survivor stays owing a resync and receives the
+        newer payload instead of running the superseded one."""
 
     @abc.abstractmethod
     def register_tensorboard_url(self, spec: str, url: str) -> str | None:
@@ -79,6 +96,7 @@ class ApplicationRpc(abc.ABC):
         session_id: str,
         metrics: Mapping[str, Any] | None = None,
         profile: Mapping[str, Any] | None = None,
+        incarnation: int = 0,
     ) -> dict[str, Any] | None:
         """``session_id`` fences stale pings: an executor from a previous
         (failed, being-torn-down) session must not feed the retried
@@ -92,9 +110,16 @@ class ApplicationRpc(abc.ABC):
         ``profile`` (optional) ships a finished on-demand capture
         summary back (``observability.profiling`` schema). The RETURN
         value is the other half of the same channel: None for a plain
-        ack, or a command payload (currently ``{"profile": {...}}``)
-        the coordinator wants this executor to act on — fan-out without
-        a coordinator→executor connection."""
+        ack, or a command payload (``{"profile": {...}}`` and/or
+        ``{"resync": {...}}`` — an armed capture request, or a healed
+        gang's re-rendezvous order) the coordinator wants this executor
+        to act on — fan-out without a coordinator→executor connection.
+
+        ``incarnation`` (optional) fences healed gangs the same way
+        ``session_id`` fences retried sessions: after an eviction the
+        replacement reuses the task id, so only pings carrying the
+        CURRENT incarnation may feed liveness, the aggregator, and the
+        flight recorder — and only they receive commands."""
 
     @abc.abstractmethod
     def request_profile(self, duration_ms: int) -> dict[str, Any]:
@@ -119,12 +144,13 @@ class ApplicationRpc(abc.ABC):
 RPC_METHODS: dict[str, tuple[str, ...]] = {
     "get_task_urls": (),
     "get_cluster_spec": (),
-    "register_worker_spec": ("worker", "spec"),
+    "register_worker_spec": ("worker", "spec", "incarnation",
+                             "generation"),
     "register_tensorboard_url": ("spec", "url"),
     "register_execution_result": ("exit_code", "job_name", "job_index", "session_id"),
     "finish_application": (),
     "task_executor_heartbeat": ("task_id", "session_id", "metrics",
-                                "profile"),
+                                "profile", "incarnation"),
     "request_profile": ("duration_ms",),
     "get_application_status": (),
 }
@@ -135,5 +161,6 @@ RPC_METHODS: dict[str, tuple[str, ...]] = {
 # analysis/protocol_check (TONY-P001/P003), so optional args cannot drift
 # into silently-required ones.
 RPC_OPTIONAL_ARGS: dict[str, tuple[str, ...]] = {
-    "task_executor_heartbeat": ("metrics", "profile"),
+    "register_worker_spec": ("incarnation", "generation"),
+    "task_executor_heartbeat": ("metrics", "profile", "incarnation"),
 }

@@ -578,6 +578,12 @@ class SchedulerDaemon:
         coordinator = TonyCoordinator(
             run_conf, app_dir, app_id=app_id, backend=backend,
             resume_step=job.resume_step,
+            # Self-healing seam: a coordinator evicting a straggler
+            # mid-job leases its replacement's slice from the SAME pool
+            # (warm_only — a parked gang must never wait out a cold
+            # provision), keyed by this job's profile.
+            spare_pool=self.pool,
+            spare_profile=lease.slice.profile,
         )
         runner = _JobRunner(self, job, coordinator)
         with self._lock:
