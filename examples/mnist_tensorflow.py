@@ -68,7 +68,7 @@ def main() -> int:
         )
         # join() never returns — the coordinator reaps ps processes after
         # the chief finishes (ps is untracked in completion accounting).
-        server.join()
+        server.join()  # tony: noqa[TONY-T006] — ps serves until the coordinator reaps it; never returns by design
         raise AssertionError("tf.distribute.Server.join() returned")
     cluster = dict(tf_config.get("cluster", {}))
     if "ps" in cluster:

@@ -1,8 +1,16 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh so every
 sharding/parallelism test runs without TPU hardware (the tony-mini idea from
-the reference test strategy — SURVEY.md §4 — applied to devices)."""
+the reference test strategy — SURVEY.md §4 — applied to devices), and arm
+the runtime sync sanitizer so every e2e doubles as a race probe."""
 
 import os
+
+# Sync sanitizer ON for the whole tier-1 suite (opt-out with =0): every
+# control-plane lock the suite exercises feeds the process-global
+# lock-order graph, and the autouse fixture below fails the test during
+# which an inversion was observed. setdefault BEFORE any tony_tpu
+# import — the factories read the flag at lock-creation time.
+os.environ.setdefault("TONY_SYNC_SANITIZER", "1")
 
 # Forced (not setdefault): the ambient environment pins JAX_PLATFORMS to the
 # real TPU and a sitecustomize imports jax at interpreter startup, so both
@@ -26,3 +34,33 @@ except AttributeError:
     # Older jax (< 0.5) has no jax_num_cpu_devices option; the
     # xla_force_host_platform_device_count flag above covers it.
     pass
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _sync_sanitizer_gate():
+    """Fail the test during which the sanitizer observed a lock-order
+    inversion in the PROCESS-GLOBAL tracker (tests seeding deliberate
+    inversions use private ``SyncTracker`` instances, which this gate
+    never reads). Long-hold violations are hygiene telemetry, not
+    failures — CPU-contended CI must not flake on hold times."""
+    from tony_tpu.analysis import sync_sanitizer as _sync
+
+    if not _sync.enabled():
+        yield
+        return
+    tracker = _sync.tracker()
+    mark = tracker.mark()
+    yield
+    inversions = tracker.violations_since(
+        mark, kind=_sync.LOCK_ORDER_INVERSION
+    )
+    if inversions:
+        import json
+
+        pytest.fail(
+            "sync sanitizer observed lock-order inversion(s):\n"
+            + json.dumps(inversions, indent=2),
+            pytrace=False,
+        )

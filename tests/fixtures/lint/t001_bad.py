@@ -1,0 +1,26 @@
+"""TONY-T001 fixture: lock-order cycle + self-deadlock."""
+import threading
+
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def reverse(self):
+        with self._b:
+            with self._a:
+                pass
+
+    def outer(self):
+        with self._a:
+            self.helper()
+
+    def helper(self):
+        with self._a:
+            pass

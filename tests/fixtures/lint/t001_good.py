@@ -1,0 +1,27 @@
+"""TONY-T001 fixture: one global order, RLock re-entry."""
+import threading
+
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._r = threading.RLock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def reentrant(self):
+        with self._r:
+            self.helper()
+
+    def helper(self):
+        with self._r:
+            pass

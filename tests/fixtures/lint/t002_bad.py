@@ -1,0 +1,26 @@
+"""TONY-T002 fixture: blocking work inside critical sections."""
+import json
+import pathlib
+import threading
+import time
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def publish(self, path):
+        with self._lock:
+            pathlib.Path(path).write_text(json.dumps(self._state))
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(1.0)
+
+    def indirect(self):
+        with self._lock:
+            self._slow()
+
+    def _slow(self):
+        time.sleep(0.5)

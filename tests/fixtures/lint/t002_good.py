@@ -1,0 +1,19 @@
+"""TONY-T002 fixture: snapshot under the lock, I/O outside."""
+import json
+import pathlib
+import threading
+import time
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def publish(self, path):
+        with self._lock:
+            snapshot = dict(self._state)
+        pathlib.Path(path).write_text(json.dumps(snapshot))
+
+    def backoff(self):
+        time.sleep(1.0)

@@ -1,0 +1,15 @@
+"""TONY-T003 fixture: two thread entrypoints, no common lock."""
+import threading
+
+
+class Worker:
+    def __init__(self, pool):
+        self.count = 0
+        threading.Thread(target=self._run, daemon=True).start()
+        pool.submit(self._drain)
+
+    def _run(self):
+        self.count += 1
+
+    def _drain(self):
+        self.count = 0

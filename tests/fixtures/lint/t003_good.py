@@ -1,0 +1,18 @@
+"""TONY-T003 fixture: every mutation under one lock."""
+import threading
+
+
+class Worker:
+    def __init__(self, pool):
+        self.count = 0
+        self._lock = threading.Lock()
+        threading.Thread(target=self._run, daemon=True).start()
+        pool.submit(self._drain)
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def _drain(self):
+        with self._lock:
+            self.count = 0

@@ -1,0 +1,17 @@
+"""TONY-T004 fixture: guarded attr, bare check-then-act."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = None
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def ensure(self):
+        if self._value is None:
+            self._value = object()
+        return self._value

@@ -1,0 +1,18 @@
+"""TONY-T004 fixture: the test-and-set holds the lock."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = None
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def ensure(self):
+        with self._lock:
+            if self._value is None:
+                self._value = object()
+            return self._value

@@ -1,0 +1,8 @@
+"""TONY-T005 fixture: non-daemon background thread."""
+import threading
+
+
+def start(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

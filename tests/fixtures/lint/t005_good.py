@@ -1,0 +1,15 @@
+"""TONY-T005 fixture: daemon flag present (kwarg or attr)."""
+import threading
+
+
+def start(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def start_attr(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True
+    t.start()
+    return t

@@ -1,0 +1,6 @@
+"""TONY-T006 fixture: join without a timeout."""
+import threading
+
+
+def wait_for(t: threading.Thread):
+    t.join()

@@ -1,0 +1,7 @@
+"""TONY-T006 fixture: bounded join; str/path joins untouched."""
+import os.path
+
+
+def wait_for(t, parts):
+    t.join(timeout=5)
+    return os.path.join(*parts) + ",".join(parts)

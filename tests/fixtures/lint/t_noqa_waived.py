@@ -1,0 +1,16 @@
+"""Waiver fixture: both rule-id spellings suppress TONY-T002."""
+import threading
+import time
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def short_form(self):
+        with self._lock:
+            time.sleep(1.0)  # tony: noqa[T002] — deliberate: fixture
+
+    def long_form(self):
+        with self._lock:
+            time.sleep(1.0)  # tony: noqa[TONY-T002] — deliberate: fixture

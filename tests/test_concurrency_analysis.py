@@ -1,0 +1,222 @@
+"""TONY-T concurrency-discipline lint: each rule against its bad/good
+fixture pair, waiver syntax, docs drift, and the pass's own plumbing
+(held-context propagation, the ``_locked``-helper exemption)."""
+
+from pathlib import Path
+
+from tony_tpu.analysis.concurrency import (
+    ALL_RULES,
+    RULE_BLOCKING,
+    RULE_CHECK_ACT,
+    RULE_DAEMON,
+    RULE_JOIN,
+    RULE_ORDER,
+    RULE_UNGUARDED,
+    check_concurrency,
+    check_rule_docs,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIX = REPO / "tests" / "fixtures" / "lint"
+
+
+def rule_ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def run(name):
+    return check_concurrency([FIX / name])
+
+
+class TestLockOrder:
+    def test_cycle_detected(self):
+        findings = [f for f in run("t001_bad.py") if f.rule_id == RULE_ORDER]
+        assert findings, "lock-order cycle not detected"
+        messages = " | ".join(f.message for f in findings)
+        assert "cycle" in messages
+        # The self-deadlock special case: helper re-acquiring the
+        # non-reentrant lock its caller holds.
+        assert "re-acquire" in messages or "re-acquired" in messages
+
+    def test_consistent_order_and_rlock_reentry_clean(self):
+        assert [f for f in run("t001_good.py")
+                if f.rule_id == RULE_ORDER] == []
+
+
+class TestBlockingUnderLock:
+    def test_direct_and_transitive_blocking_flagged(self):
+        findings = [f for f in run("t002_bad.py")
+                    if f.rule_id == RULE_BLOCKING]
+        # write_text under the lock, sleep under the lock, and the
+        # sleep reached through the _slow() helper.
+        assert len(findings) == 3
+        joined = " | ".join(f.message for f in findings)
+        assert "write_text" in joined
+        assert "time.sleep" in joined
+        assert "_slow" in joined
+
+    def test_snapshot_then_write_outside_clean(self):
+        assert [f for f in run("t002_good.py")
+                if f.rule_id == RULE_BLOCKING] == []
+
+    def test_with_item_expression_scanned(self, tmp_path):
+        """``with open(...)`` nested inside a lock's with-block: the
+        context expression itself is a blocking call under the lock."""
+        (tmp_path / "w.py").write_text(
+            "import threading\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n\n"
+            "    def f(self, path, data):\n"
+            "        with self._lock:\n"
+            "            with open(path, 'w') as fh:\n"
+            "                fh.write(data)\n"
+        )
+        findings = check_concurrency([tmp_path])
+        assert [f.rule_id for f in findings] == [RULE_BLOCKING]
+        assert "open" in findings[0].message
+
+
+class TestSharedState:
+    def test_two_entrypoints_unguarded_flagged(self):
+        findings = [f for f in run("t003_bad.py")
+                    if f.rule_id == RULE_UNGUARDED]
+        assert len(findings) == 1
+        assert "self.count" in findings[0].message
+        assert "Worker._drain" in findings[0].message
+        assert "Worker._run" in findings[0].message
+
+    def test_common_lock_clean(self):
+        assert [f for f in run("t003_good.py")
+                if f.rule_id == RULE_UNGUARDED] == []
+
+
+class TestCheckThenAct:
+    def test_bare_test_and_set_flagged(self):
+        findings = [f for f in run("t004_bad.py")
+                    if f.rule_id == RULE_CHECK_ACT]
+        assert len(findings) == 1
+        assert "_value" in findings[0].message
+
+    def test_locked_test_and_set_clean(self):
+        assert [f for f in run("t004_good.py")
+                if f.rule_id == RULE_CHECK_ACT] == []
+
+    def test_locked_helper_idiom_exempt(self, tmp_path):
+        """A helper whose every call site holds the lock runs in the
+        caller's critical section — no TONY-T004."""
+        (tmp_path / "helper.py").write_text(
+            "import threading\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._v = None\n\n"
+            "    def api(self):\n"
+            "        with self._lock:\n"
+            "            self._ensure_locked()\n\n"
+            "    def _ensure_locked(self):\n"
+            "        if self._v is None:\n"
+            "            self._v = object()\n"
+        )
+        assert check_concurrency([tmp_path]) == []
+
+
+class TestHygiene:
+    def test_non_daemon_thread_flagged(self):
+        findings = [f for f in run("t005_bad.py")
+                    if f.rule_id == RULE_DAEMON]
+        assert len(findings) == 1
+        assert findings[0].severity == "warning"
+
+    def test_daemon_kwarg_and_attr_clean(self):
+        assert [f for f in run("t005_good.py")
+                if f.rule_id == RULE_DAEMON] == []
+
+    def test_join_without_timeout_flagged(self):
+        findings = [f for f in run("t006_bad.py")
+                    if f.rule_id == RULE_JOIN]
+        assert len(findings) == 1
+
+    def test_bounded_join_and_str_join_clean(self):
+        assert [f for f in run("t006_good.py")
+                if f.rule_id == RULE_JOIN] == []
+
+
+class TestWaivers:
+    def test_both_spellings_suppress(self):
+        assert run("t_noqa_waived.py") == []
+
+    def test_unrelated_rule_id_does_not_suppress(self, tmp_path):
+        (tmp_path / "w.py").write_text(
+            "import threading\nimport time\n\n\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)  # tony: noqa[T001]\n"
+        )
+        findings = check_concurrency([tmp_path])
+        assert rule_ids(findings) == [RULE_BLOCKING]
+
+
+class TestDocsDrift:
+    def test_real_docs_have_every_rule(self):
+        assert check_rule_docs(REPO / "docs" / "DEPLOY.md") == []
+
+    def test_missing_rule_rows_flagged(self, tmp_path):
+        partial = tmp_path / "DEPLOY.md"
+        partial.write_text(" ".join(r for r in ALL_RULES
+                                    if r != "TONY-T003"))
+        findings = check_rule_docs(partial)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "TONY-T003"
+        # a missing doc flags every rule instead of crashing
+        assert len(check_rule_docs(tmp_path / "nope.md")) == len(ALL_RULES)
+
+
+class TestPlumbing:
+    def test_condition_alias_shares_token(self, tmp_path):
+        """``Condition(self._lock)`` is the SAME lock — nesting the
+        condition inside the lock is re-entry, not an ordering edge."""
+        (tmp_path / "cond.py").write_text(
+            "import threading\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._cond = threading.Condition(self._lock)\n\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with self._cond:\n"
+            "                pass\n"
+        )
+        assert check_concurrency([tmp_path]) == []
+
+    def test_sanitizer_factories_count_as_locks(self, tmp_path):
+        """Locks created through sync_sanitizer factories carry the
+        same static identity as stdlib ones."""
+        (tmp_path / "f.py").write_text(
+            "import time\n"
+            "from tony_tpu.analysis import sync_sanitizer as _sync\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = _sync.make_lock('s')\n\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n"
+        )
+        assert rule_ids(check_concurrency([tmp_path])) == [RULE_BLOCKING]
+
+    def test_module_level_lock_tracked(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import threading\nimport time\n\n"
+            "_mu = threading.Lock()\n\n\n"
+            "def f():\n"
+            "    with _mu:\n"
+            "        time.sleep(1)\n"
+        )
+        assert rule_ids(check_concurrency([tmp_path])) == [RULE_BLOCKING]
+
+    def test_unparseable_file_skipped(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def broken(:\n")
+        assert check_concurrency([tmp_path]) == []

@@ -168,3 +168,64 @@ def test_concurrent_heartbeaters(served):
     for t in threads:
         t.join()
     assert len(app.heartbeats) == 40
+
+
+def test_raising_observer_is_swallowed_and_counted():
+    """The dispatch observer's threading contract (see
+    ApplicationRpcServer.__init__): an observer exception must never
+    kill a dispatch — the RPC reply still goes out, the failure is
+    counted, and subsequent dispatches keep observing."""
+    app = FakeApp()
+    seen = []
+
+    def observer(method, ok, args):
+        seen.append((method, ok))
+        raise RuntimeError("observer boom")
+
+    server = ApplicationRpcServer(
+        app, host="127.0.0.1", port_range=(20000, 25000),
+        observer=observer,
+    )
+    server.start()
+    try:
+        c = _client(server)
+        # Over the real wire: the reply arrives despite the raise.
+        assert c.get_task_urls()[0] == TaskUrl("worker", 0, "http://logs/0")
+        c.task_executor_heartbeat("w:0", "1")
+        # A direct (in-process) dispatch counts the same way; the
+        # ok=False observer path is pinned by the next test.
+        r = server.dispatch({"method": "task_executor_heartbeat",
+                             "args": {"task_id": "w:0",
+                                      "session_id": "1"}})
+        assert r["ok"] is True
+        assert server.observer_failures == 3
+        assert [m for m, _ in seen] == [
+            "get_task_urls", "task_executor_heartbeat",
+            "task_executor_heartbeat",
+        ]
+        assert all(ok for _, ok in seen)
+    finally:
+        server.stop()
+
+
+def test_observer_sees_handler_failures_too():
+    """ok=False dispatches (impl raised) still reach the observer, and
+    a raising observer there is swallowed the same way."""
+    class Exploding(FakeApp):
+        def finish_application(self):
+            raise RuntimeError("impl failed")
+
+    def observer(method, ok, args):
+        raise RuntimeError("observer boom")
+
+    server = ApplicationRpcServer(
+        Exploding(), host="127.0.0.1", port_range=(20000, 25000),
+        observer=observer,
+    )
+    server.start()
+    try:
+        r = server.dispatch({"method": "finish_application", "args": {}})
+        assert r["ok"] is False and "impl failed" in r["error"]
+        assert server.observer_failures == 1
+    finally:
+        server.stop()

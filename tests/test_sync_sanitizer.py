@@ -1,0 +1,260 @@
+"""Runtime sync sanitizer: seeded inversions MUST be detected, clean
+discipline MUST stay silent (no false positives on RLock re-entry,
+ordered nesting, same-name instances, or Condition.wait), and the
+violation report must be flight-recorder compatible.
+
+Every test seeds a PRIVATE ``SyncTracker`` — the suite-wide gate in
+conftest reads only the process-global tracker, so deliberate
+inversions here can never fail another test.
+"""
+
+import json
+import threading
+import time
+
+from tony_tpu.analysis import sync_sanitizer as ss
+
+
+def tracked(tracker, *names, rlock=False):
+    make = ss.make_rlock if rlock else ss.make_lock
+    return [make(n, tracker_=tracker) for n in names]
+
+
+class TestSeededDetection:
+    def test_single_thread_inversion_detected(self):
+        t = ss.SyncTracker()
+        a, b = tracked(t, "a", "b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        inv = t.violations(ss.LOCK_ORDER_INVERSION)
+        assert len(inv) == 1
+        assert inv[0]["locks"] == ["a", "b"]
+        # Both acquisition stacks ride the violation.
+        assert inv[0]["stack"] and inv[0]["reverse_stack"]
+        assert "deadlock" in inv[0]["detail"]
+
+    def test_cross_thread_inversion_detected(self):
+        t = ss.SyncTracker()
+        a, b = tracked(t, "cross.a", "cross.b")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=forward, daemon=True)
+        th.start()
+        th.join(timeout=5)
+        with b:
+            with a:
+                pass
+        assert len(t.violations(ss.LOCK_ORDER_INVERSION)) == 1
+
+    def test_inversion_reported_once_per_pair(self):
+        t = ss.SyncTracker()
+        a, b = tracked(t, "a", "b")
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert len(t.violations(ss.LOCK_ORDER_INVERSION)) == 1
+
+    def test_long_hold_detected(self):
+        t = ss.SyncTracker(long_hold_ms=10)
+        (h,) = tracked(t, "slow")
+        with h:
+            time.sleep(0.05)
+        holds = t.violations(ss.LONG_HOLD)
+        assert len(holds) == 1
+        assert holds[0]["locks"] == ["slow"]
+        # Hold-time hygiene is telemetry, never an inversion.
+        assert t.violations(ss.LOCK_ORDER_INVERSION) == []
+
+
+class TestCleanRuns:
+    def test_ordered_nesting_silent(self):
+        t = ss.SyncTracker()
+        a, b, c = tracked(t, "a", "b", "c")
+        for _ in range(3):
+            with a:
+                with b:
+                    with c:
+                        pass
+        assert t.violations() == []
+        assert ("a", "b") in t.edges() and ("b", "c") in t.edges()
+
+    def test_rlock_reentry_silent(self):
+        t = ss.SyncTracker()
+        (r,) = tracked(t, "r", rlock=True)
+        (x,) = tracked(t, "x")
+        with r:
+            with r:
+                with x:
+                    pass
+            with r:
+                pass
+        assert t.violations() == []
+
+    def test_same_name_instances_no_edge(self):
+        """Two EventLog-style instances share one graph node: nesting
+        one inside the other is not an ordering fact."""
+        t = ss.SyncTracker()
+        log1 = ss.make_lock("events.EventLog._lock", tracker_=t)
+        log2 = ss.make_lock("events.EventLog._lock", tracker_=t)
+        with log1:
+            with log2:
+                pass
+        with log2:
+            with log1:
+                pass
+        assert t.violations() == []
+        assert t.edges() == []
+
+    def test_condition_wait_window_not_held(self):
+        """A waiter parked in Condition.wait holds nothing — locks
+        taken by other threads meanwhile add no edges against it, and
+        notify/wakeup round-trips stay silent."""
+        t = ss.SyncTracker()
+        cond = ss.make_condition("c", tracker_=t)
+        (other,) = tracked(t, "other")
+        woke = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                woke.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        with other:
+            pass
+        with cond:
+            cond.notify_all()
+        th.join(timeout=5)
+        assert woke.is_set()
+        assert t.violations() == []
+
+    def test_condition_on_rlock_reentrant_wait(self):
+        """The scheduler idiom: Condition(RLock) waited on while the
+        lock is held re-entrantly — _release_save must drop the whole
+        hold and _acquire_restore must put it back."""
+        t = ss.SyncTracker()
+        lock = ss.make_rlock("svc", tracker_=t)
+        cond = ss.make_condition("svc.cond", lock=lock, tracker_=t)
+        done = threading.Event()
+
+        def waiter():
+            with lock:
+                with cond:   # re-entrant: cond IS lock
+                    cond.wait(timeout=5)
+            done.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        th.join(timeout=5)
+        assert done.is_set()
+        assert t.violations() == []
+
+
+class TestReporting:
+    def test_mark_and_violations_since(self):
+        t = ss.SyncTracker()
+        a, b = tracked(t, "a", "b")
+        with a:
+            with b:
+                pass
+        mark = t.mark()
+        assert t.violations_since(mark) == []
+        with b:
+            with a:
+                pass
+        since = t.violations_since(mark, kind=ss.LOCK_ORDER_INVERSION)
+        assert len(since) == 1
+
+    def test_report_and_flight_compatible_dump(self, tmp_path):
+        t = ss.SyncTracker(long_hold_ms=5)
+        a, b = tracked(t, "a", "b")
+        with a:
+            with b:
+                time.sleep(0.02)
+        with b:
+            with a:
+                pass
+        doc = t.report()
+        assert doc["proc"] == "sync-sanitizer"
+        assert set(doc["locks"]) == {"a", "b"}
+        assert ["a", "b"] in doc["edges"]
+        kinds = {e["kind"] for e in doc["events"]}
+        assert kinds == {ss.LOCK_ORDER_INVERSION, ss.LONG_HOLD}
+
+        path = t.dump(tmp_path, reason="test")
+        assert path is not None
+        on_disk = json.loads((tmp_path / path.split("/")[-1]).read_text())
+        assert on_disk["reason"] == "test"
+        # The blackbox reader treats it as any other flight dump.
+        from tony_tpu.observability.flight import load_blackboxes
+
+        boxes = load_blackboxes(tmp_path)
+        assert len(boxes) == 1
+        (name,) = boxes
+        assert name.startswith("blackbox-sync-sanitizer-")
+
+    def test_reset(self):
+        t = ss.SyncTracker()
+        a, b = tracked(t, "a", "b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        t.reset()
+        assert t.violations() == [] and t.edges() == []
+
+
+class TestFactories:
+    def test_disabled_returns_plain_primitives(self, monkeypatch):
+        monkeypatch.setenv(ss.ENV_FLAG, "0")
+        assert not ss.enabled()
+        assert not isinstance(ss.make_lock("x"), ss.SanitizedLock)
+        assert not isinstance(ss.make_rlock("x"), ss.SanitizedLock)
+        cond = ss.make_condition("x")
+        assert isinstance(cond, threading.Condition)
+        with cond:
+            pass
+
+    def test_enabled_wraps(self, monkeypatch):
+        monkeypatch.setenv(ss.ENV_FLAG, "1")
+        lock = ss.make_lock("tests.enabled_wraps")
+        assert isinstance(lock, ss.SanitizedLock)
+        assert lock.acquire(timeout=1)
+        assert lock.locked()
+        lock.release()
+        assert not lock.locked()
+
+    def test_try_acquire_failure_not_tracked(self):
+        t = ss.SyncTracker()
+        (a,) = tracked(t, "a")
+        a.acquire()
+        got = []
+
+        def contender():
+            got.append(a.acquire(blocking=False))
+
+        th = threading.Thread(target=contender, daemon=True)
+        th.start()
+        th.join(timeout=5)
+        assert got == [False]
+        a.release()
+        assert t.violations() == []

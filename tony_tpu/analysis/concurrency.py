@@ -1,0 +1,998 @@
+"""TONY-T: concurrency-discipline lint over the control plane.
+
+The control plane is a dozen cooperating threads (Heartbeater, liveness
+monitor, healing surgery, scheduler tick + provisioners, serving loop,
+profile broker, HTTP handlers), and nearly every hand-caught bug in the
+repo's history is a race between two of them. This pass makes the
+*discipline* machine-checked in tier-1 instead of reviewer-caught:
+
+=========  =======  ======================================================
+TONY-T001  error    lock-order cycle: the static lock-ordering graph
+                    (built from ``with self._lock:`` nesting plus calls
+                    made while holding a lock) contains a cycle — two
+                    threads taking the edges in opposite order deadlock.
+                    A self-edge on a non-reentrant ``Lock`` (re-acquired
+                    while already held) is the single-thread deadlock
+                    special case.
+TONY-T002  error    known-blocking call under a lock: RPC/socket traffic,
+                    ``subprocess`` waits, ``time.sleep``,
+                    ``jax.device_put``/``device_get``/
+                    ``block_until_ready``, and file I/O reached (possibly
+                    transitively) while a lock is held — every other
+                    thread needing that lock stalls behind the I/O.
+TONY-T003  error    shared instance attribute mutated from ≥ 2 inferred
+                    thread entrypoints (``Thread(target=...)``,
+                    ``ThreadPoolExecutor.submit``, ``do_GET``/``do_POST``/
+                    ``handle`` HTTP handlers, RPC dispatch handlers) with
+                    no common guarding lock across the mutation sites.
+TONY-T004  error    non-atomic check-then-act: an attribute that is
+                    lock-guarded elsewhere is tested and then mutated in
+                    the same function without holding any lock.
+TONY-T005  warning  ``threading.Thread(...)`` without ``daemon=True`` (a
+                    forgotten non-daemon thread wedges interpreter exit —
+                    every long-lived control-plane thread here is daemon
+                    by convention, with explicit joins on the paths that
+                    must drain).
+TONY-T006  warning  ``.join()`` with no timeout: a wedged peer thread
+                    hangs shutdown forever; every join in the control
+                    plane carries a timeout.
+=========  =======  ======================================================
+
+A finding on line L is waived by an inline ``# tony: noqa[TONY-T002]``
+(or the short form ``# tony: noqa[T002]``) comment on that line; the
+repo convention is that every waiver carries a trailing justification.
+Run from ``tools/lint_self.py`` (tier-1 fails on unwaived findings) and
+``tony lint --concurrency``. The runtime companion is
+``analysis/sync_sanitizer.py``: this pass proves the *order discipline*
+statically, the sanitizer watches the orders actually taken.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tony_tpu.analysis.findings import ERROR, WARNING, Finding
+from tony_tpu.analysis.script_lint import _Aliases, _noqa_map
+
+RULE_ORDER = "TONY-T001"
+RULE_BLOCKING = "TONY-T002"
+RULE_UNGUARDED = "TONY-T003"
+RULE_CHECK_ACT = "TONY-T004"
+RULE_DAEMON = "TONY-T005"
+RULE_JOIN = "TONY-T006"
+
+ALL_RULES = (RULE_ORDER, RULE_BLOCKING, RULE_UNGUARDED, RULE_CHECK_ACT,
+             RULE_DAEMON, RULE_JOIN)
+
+# Lock constructors: the stdlib ones plus the sync_sanitizer factories
+# the control plane actually uses (``make_*`` return plain stdlib locks
+# when the sanitizer is off, instrumented wrappers when it is on — the
+# static identity is the same either way).
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "cond",
+    "multiprocessing.Lock": "lock",
+}
+_FACTORY_SUFFIXES = {
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "cond",
+}
+
+# TONY-T002: dotted-call prefixes/names that block the calling thread on
+# I/O or a peer process. ``pat.`` prefixes match the whole namespace.
+_BLOCKING_CALLS = (
+    "subprocess.", "os.system", "os.popen", "os.waitpid",
+    "time.sleep",
+    "socket.create_connection", "socket.getaddrinfo",
+    "requests.", "urllib.request.",
+    "jax.device_put", "jax.device_get",
+    "shutil.copy", "shutil.copytree", "shutil.rmtree",
+)
+# Method names that block whatever object they hang off: socket traffic,
+# process waits, device syncs, filesystem round trips. ``wait`` is NOT
+# here — ``Condition.wait`` under its own lock is the correct idiom and
+# ``Event.wait`` is how monitor loops sleep.
+_BLOCKING_ATTRS = frozenset({
+    "block_until_ready", "communicate", "check_output", "check_call",
+    "sendall", "recv", "recv_into", "connect", "accept",
+    "read_text", "write_text", "read_bytes", "write_bytes",
+    "urlopen",
+})
+_BLOCKING_BUILTINS = frozenset({"open"})
+
+# Attribute types that are themselves synchronization primitives or
+# thread-safe by contract: mutations of these are not TONY-T003 races.
+_SYNC_TYPES = frozenset({
+    "Event", "Lock", "RLock", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue",
+})
+
+# Container-mutating method names (``self._x.append(...)`` mutates
+# ``_x`` just as surely as ``self._x = ...``).
+_MUTATING_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "remove", "discard", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault",
+})
+
+# Methods HTTP/socketserver handler classes run on per-request threads.
+_HANDLER_METHODS = ("do_GET", "do_POST", "do_PUT", "do_DELETE", "handle")
+_HANDLER_BASES = ("BaseHTTPRequestHandler", "BaseRequestHandler",
+                  "StreamRequestHandler")
+
+
+def _rpc_handler_methods() -> frozenset:
+    """Protocol methods dispatched onto per-connection RPC threads —
+    classes implementing ``ApplicationRpc`` get these as entrypoints."""
+    try:
+        from tony_tpu.rpc.protocol import RPC_METHODS
+
+        return frozenset(RPC_METHODS)
+    except Exception:  # pragma: no cover - protocol table unavailable
+        return frozenset()
+
+
+class _LockToken:
+    """Identity of one lock in the whole-program graph."""
+
+    __slots__ = ("key", "kind", "file", "line")
+
+    def __init__(self, key: str, kind: str, file: str, line: int) -> None:
+        self.key = key        # "ClassName.attr" or "module:name"
+        self.kind = kind      # lock | rlock | cond
+        self.file = file
+        self.line = line
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "rlock"
+
+
+class _ClassInfo:
+    __slots__ = ("name", "file", "bases", "methods", "locks",
+                 "cond_alias", "attr_types", "tree")
+
+    def __init__(self, name: str, file: str) -> None:
+        self.name = name
+        self.file = file
+        self.bases: list[str] = []
+        self.methods: dict[str, ast.FunctionDef] = {}
+        self.locks: dict[str, _LockToken] = {}
+        # Condition built ON another attr's lock: both names are one
+        # token (acquiring the condition acquires that lock).
+        self.cond_alias: dict[str, str] = {}
+        self.attr_types: dict[str, str] = {}
+        self.tree: ast.ClassDef | None = None
+
+
+class _ModuleInfo:
+    __slots__ = ("file", "aliases", "locks", "functions", "classes")
+
+    def __init__(self, file: str, aliases: _Aliases) -> None:
+        self.file = file
+        self.aliases = aliases
+        self.locks: dict[str, _LockToken] = {}      # module-level names
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.classes: dict[str, _ClassInfo] = {}
+
+
+def _attr_chain(node: ast.AST) -> "list[str] | None":
+    """["self", "_lock"] for ``self._lock``; None for anything deeper
+    or non-name-rooted."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _lock_ctor_kind(call: ast.Call, aliases: _Aliases) -> "str | None":
+    name = aliases.resolve(call.func)
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _FACTORY_SUFFIXES:
+        return _FACTORY_SUFFIXES[tail]
+    return None
+
+
+class _Index:
+    """Whole-program symbol index: classes, their locks, attribute
+    types, module-level locks and functions."""
+
+    def __init__(self, trees: "list[tuple[Path, ast.AST]]") -> None:
+        self.modules: list[_ModuleInfo] = []
+        # simple class name -> [_ClassInfo]; only unambiguous (len==1)
+        # names participate in cross-class call resolution.
+        self.classes: dict[str, list[_ClassInfo]] = {}
+        self.rpc_methods = _rpc_handler_methods()
+        for path, tree in trees:
+            self._index_module(str(path), tree)
+
+    # -- construction ------------------------------------------------------
+    def _index_module(self, file: str, tree: ast.AST) -> None:
+        aliases = _Aliases(tree)
+        mod = _ModuleInfo(file, aliases)
+        self.modules.append(mod)
+        for node in getattr(tree, "body", []):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                kind = _lock_ctor_kind(node.value, aliases)
+                if kind:
+                    name = node.targets[0].id
+                    mod.locks[name] = _LockToken(
+                        f"{Path(file).stem}:{name}", kind, file, node.lineno,
+                    )
+            elif isinstance(node, ast.FunctionDef):
+                mod.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(mod, node)
+
+    def _index_class(self, mod: _ModuleInfo, node: ast.ClassDef) -> None:
+        info = _ClassInfo(node.name, mod.file)
+        info.tree = node
+        info.bases = [mod.aliases.resolve(b) for b in node.bases]
+        mod.classes[node.name] = info
+        self.classes.setdefault(node.name, []).append(info)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(item, ast.FunctionDef):
+                    info.methods[item.name] = item
+            elif isinstance(item, ast.AnnAssign) and isinstance(
+                item.target, ast.Name
+            ):
+                # Class-level annotation names the attr's type (the
+                # bound-handler idiom: ``aggregator: MetricsAggregator``).
+                ann = item.annotation
+                tname = mod.aliases.resolve(ann) if isinstance(
+                    ann, (ast.Name, ast.Attribute)
+                ) else ""
+                if tname:
+                    info.attr_types[item.target.id] = tname.rsplit(".", 1)[-1]
+        for meth in info.methods.values():
+            self._scan_self_assignments(mod, info, meth)
+
+    def _scan_self_assignments(
+        self, mod: _ModuleInfo, info: _ClassInfo, meth: ast.FunctionDef,
+    ) -> None:
+        for node in ast.walk(meth):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            chain = _attr_chain(node.targets[0])
+            if not (chain and len(chain) == 2 and chain[0] == "self"):
+                continue
+            attr = chain[1]
+            value = node.value
+            if isinstance(value, ast.Call):
+                kind = _lock_ctor_kind(value, mod.aliases)
+                if kind:
+                    if kind == "cond" and value.args:
+                        inner = _attr_chain(value.args[0])
+                        if inner and len(inner) == 2 and inner[0] == "self":
+                            # Condition sharing an existing lock attr.
+                            info.cond_alias[attr] = inner[1]
+                            continue
+                    info.locks.setdefault(attr, _LockToken(
+                        f"{info.name}.{attr}", kind, mod.file, node.lineno,
+                    ))
+                    continue
+                ctor = mod.aliases.resolve(value.func)
+                if ctor:
+                    info.attr_types.setdefault(
+                        attr, ctor.rsplit(".", 1)[-1]
+                    )
+
+    # -- resolution --------------------------------------------------------
+    def class_by_name(self, name: str) -> "_ClassInfo | None":
+        hits = self.classes.get(name)
+        return hits[0] if hits and len(hits) == 1 else None
+
+    def resolve_lock(self, mod: _ModuleInfo, cls: "_ClassInfo | None",
+                     expr: ast.AST) -> "_LockToken | None":
+        """The lock token a ``with <expr>:`` acquires, if any."""
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            return mod.locks.get(chain[0])
+        if cls is not None and len(chain) == 2 and chain[0] == "self":
+            attr = cls.cond_alias.get(chain[1], chain[1])
+            return cls.locks.get(attr)
+        return None
+
+    def resolve_call(
+        self, mod: _ModuleInfo, cls: "_ClassInfo | None", call: ast.Call,
+    ) -> "tuple[_ClassInfo | None, ast.FunctionDef] | None":
+        """(owning class, FunctionDef) of a call we can see the body of:
+        ``self.meth()``, ``self._attr.meth()`` with an inferred attr
+        type, ``module_function()``, or ``KnownClass.meth`` via an
+        unambiguous class name."""
+        func = call.func
+        chain = _attr_chain(func)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            fn = mod.functions.get(chain[0])
+            return (None, fn) if fn is not None else None
+        if cls is not None and chain[0] == "self":
+            if len(chain) == 2:
+                target = cls.methods.get(chain[1])
+                return (cls, target) if target is not None else None
+            if len(chain) == 3:
+                type_name = cls.attr_types.get(chain[1])
+                owner = self.class_by_name(type_name) if type_name else None
+                if owner is not None:
+                    target = owner.methods.get(chain[2])
+                    if target is not None:
+                        return (owner, target)
+        return None
+
+
+class _FuncFacts:
+    """Fixpoint facts for one function: the lock tokens it may acquire
+    anywhere inside, and the blocking primitive it may reach (dotted
+    name, or None)."""
+
+    __slots__ = ("acquires", "blocking")
+
+    def __init__(self) -> None:
+        self.acquires: set[str] = set()       # token keys
+        self.blocking: "str | None" = None
+
+
+class ConcurrencyAnalyzer:
+    def __init__(self, trees: "list[tuple[Path, ast.AST]]") -> None:
+        self.index = _Index(trees)
+        self.findings: list[Finding] = []
+        self.tokens: dict[str, _LockToken] = {}
+        # token key -> token key -> (file, line) of first edge site
+        self.edges: dict[str, dict[str, tuple[str, int]]] = {}
+        self._facts: dict[int, _FuncFacts] = {}
+        self._facts_stack: set[int] = set()
+        # id(fn) -> [(owner_cls|None, target_fn, module, held)] — the
+        # resolved call graph with the lock context at each call site,
+        # built during the main walk. Held-context PROPAGATES through
+        # it: a helper only ever called under the lock is analyzed as
+        # lock-held (the ``_locked``-helper idiom), not flagged.
+        self._call_graph: dict[int, list] = {}
+        # id(fn) -> [held at each resolved call site] — a method whose
+        # every caller holds a lock is exempt from TONY-T004.
+        self._call_sites: dict[int, list[tuple]] = {}
+
+    # -- fact computation (acquire sets, blocking reach) -------------------
+    def _blocking_name(self, mod: _ModuleInfo, call: ast.Call) -> "str | None":
+        name = mod.aliases.resolve(call.func)
+        if name in _BLOCKING_BUILTINS:
+            return name
+        for pat in _BLOCKING_CALLS:
+            if pat.endswith("."):
+                if name.startswith(pat):
+                    return name
+            elif name == pat:
+                return name
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _BLOCKING_ATTRS:
+            return name or call.func.attr
+        return None
+
+    def facts(self, mod: _ModuleInfo, cls: "_ClassInfo | None",
+              fn: ast.FunctionDef) -> _FuncFacts:
+        cached = self._facts.get(id(fn))
+        if cached is not None:
+            return cached
+        out = _FuncFacts()
+        self._facts[id(fn)] = out
+        if id(fn) in self._facts_stack:   # recursion guard
+            return out
+        self._facts_stack.add(id(fn))
+        try:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        tok = self.index.resolve_lock(
+                            mod, cls, item.context_expr
+                        )
+                        if tok is not None:
+                            out.acquires.add(tok.key)
+                            self.tokens.setdefault(tok.key, tok)
+                elif isinstance(node, ast.Call):
+                    if out.blocking is None:
+                        out.blocking = self._blocking_name(mod, node)
+                    resolved = self.index.resolve_call(mod, cls, node)
+                    if resolved is not None:
+                        owner, target = resolved
+                        target_mod = self._module_of(owner, mod)
+                        sub = self.facts(target_mod, owner, target)
+                        out.acquires |= sub.acquires
+                        if out.blocking is None and sub.blocking:
+                            out.blocking = sub.blocking
+        finally:
+            self._facts_stack.discard(id(fn))
+        return out
+
+    def _module_of(self, cls: "_ClassInfo | None",
+                   default: _ModuleInfo) -> _ModuleInfo:
+        if cls is None:
+            return default
+        for mod in self.index.modules:
+            if mod.file == cls.file:
+                return mod
+        return default
+
+    # -- per-function walk under lock context ------------------------------
+    def _walk_function(self, mod: _ModuleInfo, cls: "_ClassInfo | None",
+                       fn: ast.FunctionDef) -> None:
+        self._walk_block(mod, cls, fn, fn.body, held=())
+
+    def _walk_block(self, mod, cls, fn, stmts, held) -> None:
+        for stmt in stmts:
+            self._walk_stmt(mod, cls, fn, stmt, held)
+
+    def _walk_stmt(self, mod, cls, fn, stmt, held) -> None:
+        if isinstance(stmt, ast.With):
+            new_held = held
+            for item in stmt.items:
+                tok = self.index.resolve_lock(mod, cls, item.context_expr)
+                if tok is not None:
+                    self.tokens.setdefault(tok.key, tok)
+                    self._note_acquire(held=new_held, tok=tok, mod=mod,
+                                       node=stmt)
+                    new_held = new_held + (tok.key,)
+                else:
+                    # A non-lock context expression (``with open(...)``)
+                    # evaluates while the items to its left — and any
+                    # enclosing critical section — are held: its calls
+                    # are subject to the under-lock rules too.
+                    self._scan_calls(mod, cls, fn, item.context_expr,
+                                     new_held)
+            self._walk_block(mod, cls, fn, stmt.body, new_held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs run later, not here: no held context.
+            self._walk_block(mod, cls, fn, stmt.body, ())
+            return
+        # Statements that may contain calls/expressions: scan calls at
+        # this nesting level, then recurse into compound bodies with the
+        # SAME held context.
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_block(mod, cls, fn, sub, held)
+        handlers = getattr(stmt, "handlers", None)
+        if handlers:
+            for h in handlers:
+                self._walk_block(mod, cls, fn, h.body, held)
+        for node in self._own_expressions(stmt):
+            self._scan_calls(mod, cls, fn, node, held)
+
+    def _scan_calls(self, mod, cls, fn, node, held) -> None:
+        """Record every resolvable call under ``node`` into the call
+        graph (with its held context) and apply the under-lock rules."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            resolved = self.index.resolve_call(mod, cls, call)
+            if resolved is not None:
+                owner, target = resolved
+                self._call_graph.setdefault(id(fn), []).append(
+                    (owner, target, self._module_of(owner, mod), held)
+                )
+                self._call_sites.setdefault(id(target), []).append(held)
+            if held:
+                self._check_call_under_lock(mod, cls, call, held,
+                                            resolved)
+
+    @staticmethod
+    def _own_expressions(stmt) -> list:
+        """Expression children of a statement, EXCLUDING nested
+        statement bodies (those are walked with their own context)."""
+        out = []
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                out.append(value)
+            elif isinstance(value, list):
+                out.extend(v for v in value if isinstance(v, ast.AST)
+                           and not isinstance(v, ast.stmt))
+        return out
+
+    def _note_acquire(self, held, tok, mod, node) -> None:
+        for h in held:
+            if h == tok.key:
+                if not tok.reentrant:
+                    self.findings.append(Finding(
+                        RULE_ORDER, ERROR,
+                        f"non-reentrant lock `{tok.key}` re-acquired "
+                        f"while already held — single-thread deadlock",
+                        file=mod.file, line=node.lineno,
+                    ))
+                continue
+            self.edges.setdefault(h, {}).setdefault(
+                tok.key, (mod.file, node.lineno)
+            )
+
+    def _check_call_under_lock(self, mod, cls, call, held,
+                               resolved) -> None:
+        blocking = self._blocking_name(mod, call)
+        if blocking:
+            self.findings.append(Finding(
+                RULE_BLOCKING, ERROR,
+                f"blocking call `{blocking}` while holding "
+                f"`{held[-1]}` — every thread needing the lock stalls "
+                f"behind the I/O",
+                file=mod.file, line=call.lineno,
+                suggestion="move the blocking work outside the lock "
+                           "(snapshot under the lock, act after)",
+            ))
+        if resolved is None:
+            return
+        owner, target = resolved
+        target_mod = self._module_of(owner, mod)
+        sub = self.facts(target_mod, owner, target)
+        if sub.blocking:
+            self.findings.append(Finding(
+                RULE_BLOCKING, ERROR,
+                f"call to `{target.name}` while holding `{held[-1]}` "
+                f"reaches blocking `{sub.blocking}`",
+                file=mod.file, line=call.lineno,
+                suggestion="move the blocking work outside the lock",
+            ))
+        for key in sub.acquires:
+            tok = self.tokens.get(key)
+            for h in held:
+                if h == key:
+                    if tok is not None and not tok.reentrant:
+                        self.findings.append(Finding(
+                            RULE_ORDER, ERROR,
+                            f"call to `{target.name}` while holding "
+                            f"`{h}` re-acquires the same non-reentrant "
+                            f"lock — single-thread deadlock",
+                            file=mod.file, line=call.lineno,
+                        ))
+                else:
+                    self.edges.setdefault(h, {}).setdefault(
+                        key, (mod.file, call.lineno)
+                    )
+
+    # -- rule drivers ------------------------------------------------------
+    def run(self) -> list[Finding]:
+        for mod in self.index.modules:
+            for fn in mod.functions.values():
+                self._walk_function(mod, None, fn)
+            for cls in mod.classes.values():
+                for meth in cls.methods.values():
+                    self._walk_function(mod, cls, meth)
+        self._check_cycles()
+        for mod in self.index.modules:
+            self._check_threads_and_joins(mod)
+        self._check_shared_state_all()
+        for mod in self.index.modules:
+            for cls in mod.classes.values():
+                self._check_check_then_act(mod, cls)
+        return self.findings
+
+    # TONY-T001: cycles in the global edge graph.
+    def _check_cycles(self) -> None:
+        color: dict[str, int] = {}
+        stack: list[str] = []
+        reported: set[frozenset] = set()
+
+        def visit(node: str) -> None:
+            color[node] = 1
+            stack.append(node)
+            for nxt, site in sorted(self.edges.get(node, {}).items()):
+                if color.get(nxt, 0) == 0:
+                    visit(nxt)
+                elif color.get(nxt) == 1:
+                    cycle = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cycle)
+                    if key not in reported:
+                        reported.add(key)
+                        self.findings.append(Finding(
+                            RULE_ORDER, ERROR,
+                            f"lock-order cycle: "
+                            f"{' -> '.join(cycle)} — two threads taking "
+                            f"these edges in opposite order deadlock",
+                            file=site[0], line=site[1],
+                            suggestion="pick one global order for these "
+                                       "locks and restructure the "
+                                       "out-of-order acquisition",
+                        ))
+            stack.pop()
+            color[node] = 2
+
+        for node in sorted(set(self.edges) | set(self.tokens)):
+            if color.get(node, 0) == 0:
+                visit(node)
+
+    # TONY-T005 / TONY-T006.
+    def _check_threads_and_joins(self, mod: _ModuleInfo) -> None:
+        for fn in self._all_functions(mod):
+            daemon_fixed: set[str] = set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and node.targets[0].attr == "daemon"):
+                    chain = _attr_chain(node.targets[0])
+                    if chain:
+                        daemon_fixed.add(chain[0])
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = mod.aliases.resolve(node.func)
+                if name in ("threading.Thread", "threading.Timer"):
+                    kwargs = {k.arg for k in node.keywords}
+                    if "daemon" not in kwargs and not daemon_fixed:
+                        self.findings.append(Finding(
+                            RULE_DAEMON, WARNING,
+                            f"`{name}` created without `daemon=True` — "
+                            f"a forgotten non-daemon thread wedges "
+                            f"interpreter exit",
+                            file=mod.file, line=node.lineno,
+                            suggestion="pass daemon=True and join with "
+                                       "a timeout where drain matters",
+                        ))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "join"
+                      and not node.args and not node.keywords):
+                    chain = _attr_chain(node.func)
+                    root = chain[0] if chain else ""
+                    if root in ("os", "posixpath", "ntpath", "shlex"):
+                        continue
+                    self.findings.append(Finding(
+                        RULE_JOIN, WARNING,
+                        "`.join()` without a timeout — a wedged thread "
+                        "hangs shutdown forever",
+                        file=mod.file, line=node.lineno,
+                        suggestion="pass a timeout and handle the "
+                                   "still-alive case",
+                    ))
+
+    def _all_functions(self, mod: _ModuleInfo):
+        for fn in mod.functions.values():
+            yield fn
+        for cls in mod.classes.values():
+            for meth in cls.methods.values():
+                yield meth
+
+    # -- thread entrypoints + shared-state rules ---------------------------
+    def _entrypoints(self, mod: _ModuleInfo,
+                     cls: _ClassInfo) -> dict[str, ast.FunctionDef]:
+        """root name -> method: the methods of ``cls`` that some thread
+        other than the constructor's caller may enter."""
+        roots: dict[str, ast.FunctionDef] = {}
+        for meth in cls.methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = mod.aliases.resolve(node.func)
+                target = None
+                if name in ("threading.Thread", "threading.Timer"):
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            target = kw.value
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit" and node.args:
+                    target = node.args[0]
+                if target is None:
+                    continue
+                chain = _attr_chain(target)
+                if chain and len(chain) == 2 and chain[0] == "self" \
+                        and chain[1] in cls.methods:
+                    roots[chain[1]] = cls.methods[chain[1]]
+        for base in cls.bases:
+            tail = base.rsplit(".", 1)[-1]
+            if tail == "Thread" and "run" in cls.methods:
+                roots["run"] = cls.methods["run"]
+            if tail in _HANDLER_BASES:
+                for h in _HANDLER_METHODS:
+                    if h in cls.methods:
+                        roots[h] = cls.methods[h]
+            if tail == "ApplicationRpc":
+                for m in self.index.rpc_methods:
+                    if m in cls.methods:
+                        roots[m] = cls.methods[m]
+        return roots
+
+    def _reachable(self, mod: _ModuleInfo, cls: _ClassInfo,
+                   root: ast.FunctionDef) -> list:
+        """(module, class, function, inherited_held) set reachable from
+        ``root`` via the resolved call graph. ``inherited_held`` is the
+        union of locks held along the call chain — a mutation inside a
+        helper only reached under a lock counts as guarded."""
+        seen: set[tuple] = set()
+        out = []
+        work: list[tuple] = [(mod, cls, root, frozenset())]
+        while work:
+            m, c, fn, inherited = work.pop()
+            key = (id(fn), inherited)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((m, c, fn, inherited))
+            for owner, target, target_mod, held in self._call_graph.get(
+                id(fn), ()
+            ):
+                work.append((
+                    target_mod,
+                    owner if owner is not None else c,
+                    target,
+                    inherited | frozenset(held),
+                ))
+        return out
+
+    def _mutations(self, mod: _ModuleInfo, cls: _ClassInfo,
+                   fn: ast.FunctionDef):
+        """Yield (attr, node, locks_held) for every mutation of a
+        ``self.X`` attribute inside ``fn`` — with the SAME held-context
+        walk the edge builder uses."""
+        results: list[tuple[str, ast.AST, tuple]] = []
+
+        def scan(stmts, held):
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    new_held = held
+                    for item in stmt.items:
+                        tok = self.index.resolve_lock(
+                            mod, cls, item.context_expr,
+                        )
+                        if tok is not None:
+                            new_held = new_held + (tok.key,)
+                    scan(stmt.body, new_held)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        scan(sub, held)
+                for h in getattr(stmt, "handlers", None) or []:
+                    scan(h.body, held)
+                self._scan_mutating_exprs(cls, stmt, held, results)
+            return results
+
+        scan(fn.body, ())
+        return results
+
+    def _scan_mutating_exprs(self, cls, stmt, held, results) -> None:
+        def is_self_attr(node) -> "str | None":
+            chain = _attr_chain(node)
+            if chain and len(chain) == 2 and chain[0] == "self":
+                return chain[1]
+            return None
+
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = is_self_attr(base)
+                if attr:
+                    results.append((attr, stmt, held))
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = is_self_attr(base)
+                if attr:
+                    results.append((attr, stmt, held))
+        for node in self._own_expressions(stmt):
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _MUTATING_METHODS):
+                    attr = is_self_attr(call.func.value)
+                    if attr:
+                        results.append((attr, call, held))
+
+    # TONY-T003: collect every root in the program, BFS its reachable
+    # methods (self-calls + inferred-attr-type calls cross class), and
+    # attribute each ``self.X`` mutation to the OWNING class — so an
+    # HTTP handler thread reaching ``engine.submit`` counts as a second
+    # entrypoint into the engine's state.
+    def _check_shared_state_all(self) -> None:
+        # (class id) -> attr -> root label -> [(node, held, file)]
+        per_class: dict[int, dict[str, dict[str, list]]] = {}
+        owners: dict[int, _ClassInfo] = {}
+        for mod in self.index.modules:
+            for cls in mod.classes.values():
+                for root_name, root_fn in self._entrypoints(
+                    mod, cls
+                ).items():
+                    label = f"{cls.name}.{root_name}"
+                    for m, c, fn, inherited in self._reachable(
+                        mod, cls, root_fn
+                    ):
+                        if c is None or fn.name == "__init__":
+                            continue
+                        owners[id(c)] = c
+                        for attr, node, held in self._mutations(m, c, fn):
+                            if c.attr_types.get(attr) in _SYNC_TYPES:
+                                continue
+                            if attr in c.locks or attr in c.cond_alias:
+                                continue
+                            per_class.setdefault(id(c), {}).setdefault(
+                                attr, {}
+                            ).setdefault(label, []).append(
+                                (node, inherited | frozenset(held), m.file)
+                            )
+        for cls_id, attrs in per_class.items():
+            cls = owners[cls_id]
+            for attr, by_root in sorted(attrs.items()):
+                if len(by_root) < 2:
+                    continue
+                # Locks common to EVERY mutation site across all roots.
+                locksets = [
+                    set(held)
+                    for sites in by_root.values()
+                    for (_, held, _) in sites
+                ]
+                common = set.intersection(*locksets) if locksets else set()
+                if common:
+                    continue
+                first = min(
+                    (site for sites in by_root.values() for site in sites),
+                    key=lambda s: (s[2], s[0].lineno),
+                )
+                self.findings.append(Finding(
+                    RULE_UNGUARDED, ERROR,
+                    f"`self.{attr}` of {cls.name} is mutated from "
+                    f"{len(by_root)} thread entrypoints "
+                    f"({', '.join(sorted(by_root))}) with no common "
+                    f"guarding lock",
+                    file=first[2], line=first[0].lineno,
+                    suggestion="guard every mutation with one lock, or "
+                               "confine the attribute to a single "
+                               "thread",
+                ))
+
+    def _check_check_then_act(self, mod: _ModuleInfo,
+                              cls: _ClassInfo) -> None:
+        """TONY-T004: attr guarded somewhere, but some function tests it
+        and then mutates it with no lock held at either site."""
+        guarded: set[str] = set()
+        for meth in cls.methods.values():
+            for attr, _, held in self._mutations(mod, cls, meth):
+                if held:
+                    guarded.add(attr)
+        if not guarded:
+            return
+        init = cls.methods.get("__init__")
+        for meth in cls.methods.values():
+            if meth is init:
+                continue
+            # The ``_locked``-helper idiom: a method whose every
+            # resolved call site already holds a lock runs in the
+            # caller's critical section — its bare accesses are guarded.
+            sites = self._call_sites.get(id(meth))
+            if sites and all(held for held in sites):
+                continue
+            unlocked_writes = {
+                attr for attr, _, held in self._mutations(mod, cls, meth)
+                if not held and attr in guarded
+            }
+            if not unlocked_writes:
+                continue
+            for node in ast.walk(meth):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if self._under_any_with(meth, node):
+                    continue
+                for sub in ast.walk(node.test):
+                    chain = _attr_chain(sub)
+                    if chain and len(chain) >= 2 and chain[0] == "self" \
+                            and chain[1] in unlocked_writes:
+                        self.findings.append(Finding(
+                            RULE_CHECK_ACT, ERROR,
+                            f"non-atomic check-then-act on "
+                            f"`self.{chain[1]}` — it is lock-guarded "
+                            f"elsewhere in {cls.name}, but this test "
+                            f"and the mutation in `{meth.name}` hold "
+                            f"no lock",
+                            file=mod.file, line=node.lineno,
+                            suggestion="take the guarding lock around "
+                                       "the whole test-and-set",
+                        ))
+                        break
+                else:
+                    continue
+                break
+
+    @staticmethod
+    def _under_any_with(fn: ast.FunctionDef, target: ast.AST) -> bool:
+        """True when ``target`` sits inside any ``with`` block of ``fn``
+        (cheap containment test by line span)."""
+        t_line = getattr(target, "lineno", 0)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno < t_line <= end:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def _collect_files(paths) -> list[Path]:
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+    return files
+
+
+def _apply_waivers(findings: list[Finding],
+                   sources: dict[str, str]) -> list[Finding]:
+    """Drop findings waived by an inline ``# tony: noqa[...]`` on their
+    line; both ``TONY-T001`` and the short ``T001`` spelling match."""
+    maps: dict[str, dict] = {}
+    kept: list[Finding] = []
+    for f in findings:
+        source = sources.get(f.file)
+        if source is None:
+            kept.append(f)
+            continue
+        noqa = maps.get(f.file)
+        if noqa is None:
+            noqa = maps[f.file] = _noqa_map(source)
+        rule_filter = noqa.get(f.line, ...)
+        if rule_filter is None:
+            continue
+        if rule_filter is not ... and (
+            f.rule_id.upper() in rule_filter
+            or f.rule_id.upper().replace("TONY-", "") in rule_filter
+        ):
+            continue
+        kept.append(f)
+    return kept
+
+
+def check_concurrency(paths, docs=None) -> list[Finding]:
+    """Run the whole TONY-T pass over ``paths`` (files or directories),
+    waivers applied. With ``docs``, the rule catalogue is drift-checked
+    against the operator docs too (every TONY-T rule id must have a
+    DEPLOY.md row, like TONY-E001/M002)."""
+    sources: dict[str, str] = {}
+    trees: list[tuple[Path, ast.AST]] = []
+    for path in _collect_files(paths):
+        try:
+            source = path.read_text()
+            trees.append((path, ast.parse(source, filename=str(path))))
+            sources[str(path)] = source
+        except (SyntaxError, ValueError, OSError):
+            continue   # script_lint owns reporting unparseable files
+    findings = ConcurrencyAnalyzer(trees).run()
+    findings = _apply_waivers(findings, sources)
+    if docs is not None:
+        findings += check_rule_docs(docs)
+    return findings
+
+
+def check_rule_docs(docs) -> list[Finding]:
+    """Every TONY-T rule id must appear in the operator docs — the rule
+    catalogue and DEPLOY.md move in lockstep or tier-1 fails."""
+    try:
+        doc_text = Path(docs).read_text()
+    except OSError:
+        doc_text = ""
+    return [
+        Finding(
+            rule, ERROR,
+            f"concurrency rule {rule} is not documented in {docs} — "
+            f"operators waive by rule id, so each needs a catalogue row",
+            file=str(docs), line=0,
+        )
+        for rule in ALL_RULES if rule not in doc_text
+    ]

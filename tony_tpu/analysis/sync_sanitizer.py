@@ -1,0 +1,422 @@
+"""Runtime sync sanitizer — the dynamic half of the TONY-T discipline.
+
+``analysis/concurrency.py`` proves the lock-order discipline statically;
+this module watches the orders the control plane *actually takes*. With
+``TONY_SYNC_SANITIZER=1`` every lock the big five lock owners create
+through the factories below is wrapped in an instrumented shim that, on
+each acquisition, records the per-thread held-lock stack and folds the
+(held → acquired) pairs into a process-global order graph:
+
+* **lock_order_inversion** — the reverse edge was already observed
+  (lock ``b`` taken while holding ``a`` after ``a`` was taken while
+  holding ``b``): two threads interleaving those paths deadlock. Both
+  acquisition stacks (the one that recorded the forward edge and the
+  one that closed the inversion) ride the violation.
+* **long_hold** — a lock held past ``TONY_SYNC_LONG_HOLD_MS``
+  (default 1000): blocking work leaked into a critical section. A
+  hygiene warning, not a failure — the tier-1 gate fails only on
+  inversions.
+
+Edges are keyed by lock *name* (the factory argument, conventionally
+``module.Class.attr``), not instance: two ``EventLog``\\ s are one node,
+so the graph stays bounded and an order learned on one job applies to
+the next. Re-entrant acquisition of the same instance (``RLock``) and
+same-name nesting across *instances* add no edge — neither is an
+ordering fact.
+
+Off (the default), the factories return the plain ``threading``
+primitives — zero overhead, zero behavior change. On, the per-
+acquisition cost is a thread-local list append plus one set probe per
+held lock; stacks are captured only when an edge is first seen.
+
+The violation report is flight-recorder compatible: ``dump()`` writes a
+``blackbox-sync-sanitizer-*.json`` with the same envelope the
+postmortem tooling already reads (``observability/flight.py``), and the
+tier-1 pytest fixture (tests/conftest.py) fails any test that closed an
+inversion. No tony_tpu imports here — the big five import this module,
+so it must stay a leaf.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import traceback
+
+ENV_FLAG = "TONY_SYNC_SANITIZER"
+ENV_LONG_HOLD_MS = "TONY_SYNC_LONG_HOLD_MS"
+ENV_REPORT_DIR = "TONY_SYNC_REPORT_DIR"
+
+LOCK_ORDER_INVERSION = "lock_order_inversion"
+LONG_HOLD = "long_hold"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+# Frames from this file are noise in a violation stack.
+_SELF_FILE = __file__
+
+
+def enabled() -> bool:
+    """Opt-in check, read per factory call (not import time) so a test
+    or the conftest bootstrap can flip it before any locks exist."""
+    return os.environ.get(ENV_FLAG, "").strip().lower() in _TRUTHY
+
+
+def _long_hold_ms_default() -> float:
+    try:
+        return float(os.environ.get(ENV_LONG_HOLD_MS, "") or 1000.0)
+    except ValueError:
+        return 1000.0
+
+
+def _site_stack(limit: int = 16) -> list[str]:
+    """Compact acquisition stack: ``file:line in func`` strings, newest
+    last, sanitizer frames stripped."""
+    out = []
+    for frame in traceback.extract_stack()[:-1]:
+        if frame.filename == _SELF_FILE:
+            continue
+        out.append(f"{frame.filename}:{frame.lineno} in {frame.name}")
+    return out[-limit:]
+
+
+class _Held:
+    __slots__ = ("lock", "t0", "count")
+
+    def __init__(self, lock: "SanitizedLock", t0: float) -> None:
+        self.lock = lock
+        self.t0 = t0
+        self.count = 1
+
+
+_tls = threading.local()
+
+
+def _stack() -> "list[_Held]":
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class SyncTracker:
+    """The order graph + violation ring. One process-global instance
+    backs the factories; tests seed private instances so deliberate
+    inversions never pollute the suite-wide gate."""
+
+    def __init__(self, long_hold_ms: "float | None" = None,
+                 limit: int = 512) -> None:
+        # Raw stdlib lock ON PURPOSE: the tracker guards its own graph
+        # and must never appear in it.
+        self._mu = threading.Lock()
+        self._long_hold_ms = (
+            _long_hold_ms_default() if long_hold_ms is None
+            else float(long_hold_ms)
+        )
+        # (held_name, acquired_name) -> acquisition stack that first
+        # observed the edge.
+        self._edges: dict[tuple[str, str], list[str]] = {}
+        self._lock_names: set[str] = set()
+        self._violations: collections.deque = collections.deque(
+            maxlen=max(int(limit), 1)
+        )
+        self._seq = 0
+        self._inversions_reported: set[frozenset] = set()
+
+    # -- recording (called from SanitizedLock) -----------------------------
+    def note_created(self, name: str) -> None:
+        with self._mu:
+            self._lock_names.add(name)
+
+    def note_acquired(self, lock: "SanitizedLock",
+                      held: "list[_Held]") -> None:
+        new_pairs = []
+        for entry in held:
+            a = entry.lock.name
+            if a == lock.name:
+                continue   # same-name nesting is not an ordering fact
+            if (a, lock.name) not in self._edges:
+                new_pairs.append(a)
+        if not new_pairs:
+            return
+        stack = _site_stack()
+        with self._mu:
+            for a in new_pairs:
+                key = (a, lock.name)
+                if key in self._edges:
+                    continue
+                self._edges[key] = stack
+                reverse = self._edges.get((lock.name, a))
+                if reverse is None:
+                    continue
+                pair = frozenset((a, lock.name))
+                if pair in self._inversions_reported:
+                    continue
+                self._inversions_reported.add(pair)
+                self._record_locked({
+                    "kind": LOCK_ORDER_INVERSION,
+                    "locks": sorted(pair),
+                    "detail": f"`{lock.name}` acquired while holding "
+                              f"`{a}` after the opposite order was "
+                              f"observed — interleaved, these two "
+                              f"threads deadlock",
+                    "stack": stack,
+                    "reverse_stack": reverse,
+                })
+
+    def note_released(self, lock: "SanitizedLock", held_ms: float) -> None:
+        if held_ms <= self._long_hold_ms:
+            return
+        with self._mu:
+            self._record_locked({
+                "kind": LONG_HOLD,
+                "locks": [lock.name],
+                "detail": f"`{lock.name}` held for {held_ms:.1f} ms "
+                          f"(threshold {self._long_hold_ms:.0f} ms) — "
+                          f"blocking work leaked into the critical "
+                          f"section",
+                "stack": _site_stack(limit=8),
+            })
+
+    def _record_locked(self, violation: dict) -> None:
+        self._seq += 1
+        violation["seq"] = self._seq
+        violation["ts_ms"] = int(time.time() * 1000)
+        violation["thread"] = threading.current_thread().name
+        self._violations.append(violation)
+
+    # -- reading -----------------------------------------------------------
+    def mark(self) -> int:
+        """Current violation sequence — pair with violations_since for
+        per-test attribution."""
+        with self._mu:
+            return self._seq
+
+    def violations(self, kind: "str | None" = None) -> list[dict]:
+        with self._mu:
+            out = list(self._violations)
+        if kind is not None:
+            out = [v for v in out if v["kind"] == kind]
+        return out
+
+    def violations_since(self, mark: int,
+                         kind: "str | None" = None) -> list[dict]:
+        return [
+            v for v in self.violations(kind) if v["seq"] > mark
+        ]
+
+    def edges(self) -> list[tuple[str, str]]:
+        with self._mu:
+            return sorted(self._edges)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+            self._inversions_reported.clear()
+            self._seq = 0
+
+    def report(self) -> dict:
+        """Flight-recorder-shaped document: the postmortem/blackbox
+        readers (``observability/flight.load_blackboxes`` and the
+        history side) consume this without special-casing."""
+        with self._mu:
+            return {
+                "proc": "sync-sanitizer",
+                "locks": sorted(self._lock_names),
+                "edges": [list(e) for e in sorted(self._edges)],
+                "reports": [],
+                "rpcs": [],
+                "events": list(self._violations),
+            }
+
+    def dump(self, directory, reason: str = "sync-sanitizer") -> "str | None":
+        """Atomic ``blackbox-sync-sanitizer-<pid>.json`` dump, same
+        tmp+rename contract as the flight recorder; best-effort."""
+        doc = self.report()
+        doc["reason"] = reason
+        doc["dumped_ts_ms"] = int(time.time() * 1000)
+        fname = f"blackbox-sync-sanitizer-{os.getpid()}.json"
+        path = os.path.join(str(directory), fname)
+        try:
+            os.makedirs(str(directory), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+_default_tracker: "SyncTracker | None" = None
+_default_tracker_mu = threading.Lock()
+
+
+def tracker() -> SyncTracker:
+    """The process-global tracker behind the factories."""
+    global _default_tracker
+    with _default_tracker_mu:
+        if _default_tracker is None:
+            _default_tracker = SyncTracker()
+        return _default_tracker
+
+
+class SanitizedLock:
+    """Instrumented shim over ``threading.Lock``/``RLock``. Supports the
+    full context-manager + acquire/release surface, and the private
+    ``Condition`` integration hooks (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``) so a ``Condition`` built on a
+    sanitized lock tracks correctly through ``wait()`` — the wait
+    window does not count as holding."""
+
+    __slots__ = ("name", "_inner", "_tracker")
+
+    def __init__(self, name: str, inner, tracker_: SyncTracker) -> None:
+        self.name = name
+        self._inner = inner
+        self._tracker = tracker_
+        tracker_.note_created(name)
+
+    # -- lock protocol -----------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._note_released()
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<SanitizedLock {self.name} {self._inner!r}>"
+
+    # -- tracking ----------------------------------------------------------
+    def _note_acquired(self) -> None:
+        stack = _stack()
+        for entry in stack:
+            if entry.lock is self:       # RLock re-entry: no new facts
+                entry.count += 1
+                return
+        if stack:
+            self._tracker.note_acquired(self, stack)
+        stack.append(_Held(self, time.monotonic()))
+
+    def _note_released(self) -> None:
+        stack = _stack()
+        for i in range(len(stack) - 1, -1, -1):
+            entry = stack[i]
+            if entry.lock is self:
+                entry.count -= 1
+                if entry.count == 0:
+                    del stack[i]
+                    self._tracker.note_released(
+                        self, (time.monotonic() - entry.t0) * 1000.0
+                    )
+                return
+        # Release of a lock this thread never tracked (acquired before
+        # instrumentation, or released cross-thread): let the inner
+        # lock's own error semantics speak.
+
+    # -- Condition integration (threading.Condition private API) -----------
+    def _release_save(self):
+        """Full release for ``Condition.wait`` — drops the whole
+        re-entrant hold and stops the hold-time clock."""
+        stack = _stack()
+        count = 1
+        for i in range(len(stack) - 1, -1, -1):
+            entry = stack[i]
+            if entry.lock is self:
+                count = entry.count
+                del stack[i]
+                self._tracker.note_released(
+                    self, (time.monotonic() - entry.t0) * 1000.0
+                )
+                break
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), count)
+        self._inner.release()
+        return (None, count)
+
+    def _acquire_restore(self, saved) -> None:
+        state, count = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        stack = _stack()
+        if stack:
+            self._tracker.note_acquired(self, stack)
+        entry = _Held(self, time.monotonic())
+        entry.count = count
+        stack.append(entry)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # Plain-lock fallback — same heuristic threading.Condition uses.
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# factories — what the control plane actually calls
+# ---------------------------------------------------------------------------
+def make_lock(name: str, tracker_: "SyncTracker | None" = None):
+    """``threading.Lock()`` (sanitizer off) or an instrumented shim
+    (on). ``name`` is the graph node: conventionally
+    ``module.Class.attr``, shared by every instance of that lock."""
+    if tracker_ is None:
+        if not enabled():
+            return threading.Lock()
+        tracker_ = tracker()
+    return SanitizedLock(name, threading.Lock(), tracker_)
+
+
+def make_rlock(name: str, tracker_: "SyncTracker | None" = None):
+    if tracker_ is None:
+        if not enabled():
+            return threading.RLock()
+        tracker_ = tracker()
+    return SanitizedLock(name, threading.RLock(), tracker_)
+
+
+def make_condition(name: str, lock=None,
+                   tracker_: "SyncTracker | None" = None):
+    """A ``Condition`` whose underlying lock is sanitized. Pass an
+    existing ``make_lock``/``make_rlock`` result to share one lock
+    between ``with self._lock:`` sites and the condition."""
+    if tracker_ is None and not enabled():
+        return threading.Condition(lock)
+    if lock is None:
+        lock = make_rlock(name, tracker_)
+    return threading.Condition(lock)
+
+
+def _atexit_dump() -> None:  # pragma: no cover - process teardown
+    report_dir = os.environ.get(ENV_REPORT_DIR)
+    if not report_dir or _default_tracker is None:
+        return
+    if _default_tracker.violations():
+        _default_tracker.dump(report_dir, reason="atexit")
+
+
+if enabled() and os.environ.get(ENV_REPORT_DIR):  # pragma: no cover
+    import atexit
+
+    atexit.register(_atexit_dump)

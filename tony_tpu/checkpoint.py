@@ -391,8 +391,18 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until the in-flight async write (if any) is durable;
         re-raises the writer's exception if it failed."""
-        if self._writer is not None:
-            self._writer.join()
+        writer = self._writer
+        if writer is not None:
+            while writer.is_alive():
+                # Bounded join (TONY-T006): durability still blocks, but
+                # a wedged storage backend shows up in the log every
+                # minute instead of hanging this thread silently.
+                writer.join(timeout=60.0)
+                if writer.is_alive():
+                    log.warning(
+                        "async checkpoint write still in flight after "
+                        "60s — storage backend slow or wedged"
+                    )
             self._writer = None
         if self._writer_exc is not None:
             exc, self._writer_exc = self._writer_exc, None

@@ -235,11 +235,17 @@ def lint(argv: list[str]) -> int:
 
         python -m tony_tpu.client.cli lint [paths...]
             [--conf_file tony.json] [--conf k=v] [--strict]
+            [--concurrency]
 
     Paths are training scripts or directories of them (directories are
     scanned recursively for ``*.py``). With ``--conf_file``/``--conf``
     the resolved job config is checked too and its entry point joins the
-    lint set. Exit status: 0 when no findings (or warnings only, without
+    lint set. ``--concurrency`` additionally runs the TONY-T
+    concurrency-discipline pass (``analysis/concurrency``: lock-order
+    cycles, blocking calls under locks, unguarded cross-thread state,
+    check-then-act, thread/join hygiene) over the given paths — or over
+    the installed ``tony_tpu`` package itself when no paths are given.
+    Exit status: 0 when no findings (or warnings only, without
     ``--strict``), 1 on error findings (or any finding with ``--strict``).
     """
     import argparse
@@ -259,6 +265,10 @@ def lint(argv: list[str]) -> int:
                    help="key=value override (repeatable)")
     p.add_argument("--strict", action="store_true",
                    help="exit non-zero on warnings too")
+    p.add_argument("--concurrency", action="store_true",
+                   help="also run the TONY-T concurrency-discipline "
+                        "pass (defaults to the installed tony_tpu "
+                        "package when no paths are given)")
     args = p.parse_args(argv)
 
     scripts: list[str] = []
@@ -278,6 +288,11 @@ def lint(argv: list[str]) -> int:
     if args.conf_file or args.conf:
         conf = load_job_config(conf_file=args.conf_file, overrides=args.conf)
     all_findings = run_preflight(conf, scripts)
+    if args.concurrency:
+        from tony_tpu.analysis.concurrency import check_concurrency
+
+        targets = args.paths or [Path(__file__).resolve().parents[1]]
+        all_findings = all_findings + check_concurrency(targets)
     if all_findings:
         print(fmod.format_findings(all_findings))
     errors = sum(1 for f in all_findings if f.severity == fmod.ERROR)

@@ -66,6 +66,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from tony_tpu.observability import events as obs_events
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -162,7 +163,7 @@ class HealingController:
         self._c = coordinator
         self.config = config or HealConfig()
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = _sync.make_rlock("healing.HealingController._lock")
         # Straggler confirmation: task -> monotonic time its score first
         # crossed the threshold (cleared when it drops back under).
         self._confirm_since: dict[str, float] = {}

@@ -16,6 +16,7 @@ import logging
 import threading
 import time
 from typing import Callable
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -34,7 +35,7 @@ class LivenessMonitor:
         # task -> the incarnation whose pings are current (see
         # receive_ping; replacements re-register with a bumped value).
         self._incarnations: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("liveness.LivenessMonitor._lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -18,6 +18,7 @@ from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
 from tony_tpu.rpc.protocol import TaskUrl
 from tony_tpu.utils import ContainerRequest, parse_container_requests
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -82,7 +83,7 @@ class TonySession:
         self.session_id = session_id
         self.status = SessionStatus.NEW
         self.diagnostics = ""
-        self._lock = threading.RLock()
+        self._lock = _sync.make_rlock("session.TonySession._lock")
         self.requests: dict[str, ContainerRequest] = parse_container_requests(conf)
         self.tasks: dict[str, list[TonyTask]] = {
             job: [TonyTask(job, i, session_id) for i in range(req.num_instances)]

@@ -27,6 +27,7 @@ from tony_tpu.observability.flight import FlightRecorder
 from tony_tpu.observability.profiling import ExecutorProfiler
 from tony_tpu.resilience.faults import ExecutorFaults, FaultPlan
 from tony_tpu.rpc.client import ApplicationRpcClient
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -283,7 +284,7 @@ class TaskExecutor:
         except ValueError:
             self._confirm_generation = 0
         self._resync_event = threading.Event()
-        self._resync_lock = threading.Lock()
+        self._resync_lock = _sync.make_lock("task_executor.TaskExecutor._resync_lock")
         self._resync_payload: dict | None = None
         self._resync_done_generation = 0
         # A resync that superseded the INITIAL registration (a second

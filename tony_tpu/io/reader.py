@@ -67,6 +67,7 @@ import numpy as np
 
 from tony_tpu.io.splits import FileSegment, create_read_info
 from tony_tpu.io.storage import file_size, is_gs_uri, open_lines, read_range
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 _SENTINEL = object()
 
@@ -115,7 +116,7 @@ class _IoMetrics:
     aggregate."""
 
     _instance: "_IoMetrics | None" = None
-    _lock = threading.Lock()
+    _lock = _sync.make_lock("reader._IoMetrics._lock")
 
     def __init__(self) -> None:
         from tony_tpu import observability
@@ -265,7 +266,9 @@ class ShardedRecordReader:
         self._head: np.ndarray | None = None
         self._head_off = 0
         self._fds: dict[str, int] = {}
-        self._fds_lock = threading.Lock()
+        self._fds_lock = _sync.make_lock(
+            "reader.ShardedRecordReader._fds_lock"
+        )
         self._stop = threading.Event()
         self._fetch_exc: BaseException | None = None
         self._fetcher = threading.Thread(

@@ -37,11 +37,13 @@ from typing import Any, Mapping
 
 from tony_tpu.observability import trace as trace_mod
 from tony_tpu.observability.events import EventLog
+from tony_tpu.analysis import sync_sanitizer as _sync
 from tony_tpu.observability.metrics import (
     MetricsRegistry,
     json_safe,
     render_prometheus,
 )
+from tony_tpu.observability.stepstats import counter_rate
 
 log = logging.getLogger(__name__)
 
@@ -137,7 +139,7 @@ class MetricsAggregator:
         self.on_train_progress = None
         self._clock = clock
         self._series_limit = series_limit
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("aggregator.MetricsAggregator._lock")
         self._latest: dict[str, dict[str, Any]] = {}
         self._heartbeats: dict[str, int] = {}
         self._last_seen: dict[str, float] = {}  # task -> wall-clock s
@@ -175,10 +177,9 @@ class MetricsAggregator:
                 prev = self._latest.get(task_id)
                 if prev is not None \
                         and "train_steps_total" in snap["counters"]:
-                    from tony_tpu.observability.stepstats import (
-                        counter_rate,
-                    )
-
+                    # counter_rate imported at module scope: an import
+                    # executed here would hold the interpreter's import
+                    # machinery inside the ingest lock.
                     self._step_rates[task_id] = round(counter_rate(
                         float(prev.get("counters", {})
                               .get("train_steps_total", 0.0)),

@@ -19,6 +19,7 @@ import os
 import threading
 import time
 from typing import Any, Callable
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -118,7 +119,7 @@ class EventLog:
         clock: Callable[[], float] = time.time,
     ) -> None:
         self._events: list[dict[str, Any]] = []
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("events.EventLog._lock")
         self._sink = sink
         self._clock = clock
 

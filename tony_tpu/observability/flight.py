@@ -30,6 +30,7 @@ from pathlib import Path
 from typing import Any, Mapping
 
 from tony_tpu.observability.metrics import json_safe
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -60,7 +61,7 @@ class FlightRecorder:
     def __init__(self, proc: str, limit: int = 256) -> None:
         self.proc = proc
         self._limit = max(int(limit), 1)
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("flight.FlightRecorder._lock")
         self._reports: collections.deque = collections.deque(maxlen=self._limit)
         self._rpcs: collections.deque = collections.deque(maxlen=self._limit)
         self._events: collections.deque = collections.deque(maxlen=self._limit)

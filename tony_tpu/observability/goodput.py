@@ -58,6 +58,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any, Iterable, Mapping
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 # Declared metric names (TONY-M001/M002 lint these module-scope
 # constants; both are gauges — the wasted_by_failure re-attribution
@@ -137,7 +138,7 @@ class GoodputLedger:
         self.chips = max(int(chips), 1)
         self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self._stalled_detectors = frozenset(stalled_detectors)
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("goodput.GoodputLedger._lock")
         self._seconds: dict[str, float] = {c: 0.0 for c in CATEGORIES}
         self._phase: str | None = None
         self._first_ms: int | None = None
@@ -402,7 +403,7 @@ class FleetGoodput:
     published as the fleet's goodput gauges on the daemon's /metrics."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("goodput.FleetGoodput._lock")
         self._tenants: dict[str, dict[str, float]] = {}
 
     def add(

@@ -39,6 +39,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -190,7 +191,7 @@ class HealthMonitor:
             registry.counter(ALERTS_COUNTER) if registry is not None else None
         )
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("health.HealthMonitor._lock")
         self._tasks: dict[str, _TaskHealth] = {}
         self._alerts: collections.deque = collections.deque(maxlen=alert_limit)
         self._alerts_total = 0

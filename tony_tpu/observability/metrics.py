@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Iterable, Mapping
 
+from tony_tpu.analysis import sync_sanitizer as _sync
+
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 # Unit-suffix rules (the runtime half of analysis/metrics_lint TONY-M001):
@@ -115,6 +117,10 @@ class Counter:
         self.name = name
         self.help = help
         self._value = 0.0
+        # Raw stdlib lock on purpose (not a sync_sanitizer lock): the
+        # per-value locks are leaf locks on the hottest telemetry path
+        # (every .inc()/.set()/.observe()), acquire nothing inside, and
+        # would only add sanitizer overhead without ordering facts.
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
@@ -219,7 +225,16 @@ class MetricsRegistry:
         publish_min_interval_s: float = 0.5,
     ) -> None:
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("metrics.MetricsRegistry._lock")
+        # Guards the report()/_maybe_publish() episodic state below —
+        # separate from _lock because report() calls gauge()/counter()
+        # (which take _lock) while holding it. Without this, two
+        # threads reporting concurrently race the step-delta
+        # check-then-act and double- or under-count train_steps_total
+        # (TONY-T004), and racing publish throttles double-write.
+        self._report_lock = _sync.make_lock(
+            "metrics.MetricsRegistry._report_lock"
+        )
         self._publish_path = str(publish_path) if publish_path else None
         self._publish_min_interval_s = publish_min_interval_s
         self._last_publish = 0.0
@@ -285,10 +300,12 @@ class MetricsRegistry:
         if step is not None:
             step = int(step)
             self.gauge("train_step").set(step)
-            delta = step if self._last_step is None else step - self._last_step
+            with self._report_lock:
+                delta = (step if self._last_step is None
+                         else step - self._last_step)
+                self._last_step = step
             if delta > 0:
                 self.counter("train_steps_total").inc(delta)
-            self._last_step = step
         self._maybe_publish()
 
     # -- snapshot / publish ------------------------------------------------
@@ -336,9 +353,11 @@ class MetricsRegistry:
         if not self._publish_path:
             return
         now = time.monotonic()
-        if now - self._last_publish < self._publish_min_interval_s:
-            return
-        self._last_publish = now
+        with self._report_lock:
+            if now - self._last_publish < self._publish_min_interval_s:
+                return
+            self._last_publish = now
+        # flush() is file I/O — outside the lock (TONY-T002).
         self.flush()
 
     def flush(self) -> None:
@@ -348,7 +367,10 @@ class MetricsRegistry:
             return
         try:
             data = json.dumps(self.snapshot())
-            tmp = f"{self._publish_path}.tmp.{os.getpid()}"
+            # Per-thread tmp: two racing flushes must tear neither the
+            # published file (os.replace is atomic) nor each other's tmp.
+            tmp = (f"{self._publish_path}.tmp.{os.getpid()}"
+                   f".{threading.get_ident()}")
             with open(tmp, "w") as f:
                 f.write(data)
             os.replace(tmp, self._publish_path)
@@ -483,7 +505,7 @@ def render_prometheus(
 
 
 _default_registry: MetricsRegistry | None = None
-_default_lock = threading.Lock()
+_default_lock = _sync.make_lock("metrics:_default_lock")
 
 
 def default_registry() -> MetricsRegistry:

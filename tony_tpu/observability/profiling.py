@@ -43,6 +43,7 @@ import threading
 import time
 from pathlib import Path
 from typing import Any, Iterable, Mapping
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -269,7 +270,7 @@ class ProfileBroker:
 
     def __init__(self, clock_ms=None) -> None:
         self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("profiling.ProfileBroker._lock")
         self._req_id: str | None = None
         self._req_seq = 0
         self._duration_ms = DEFAULT_DURATION_MS
@@ -373,7 +374,7 @@ class ExecutorProfiler:
         # The heartbeat metrics callable: captures lift the user
         # process's published HBM gauges from it (see user_process_hbm).
         self.metrics_source = metrics_source
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("profiling.ExecutorProfiler._lock")
         self._seen: set[str] = set()
         self._latest_req: str | None = None
         self._pending: dict[str, Any] | None = None
@@ -431,7 +432,7 @@ class ExecutorProfiler:
 
 
 _hbm_monitor_started = False
-_hbm_lock = threading.Lock()
+_hbm_lock = _sync.make_lock("profiling:_hbm_lock")
 
 
 def start_device_memory_monitor(

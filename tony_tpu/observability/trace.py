@@ -28,6 +28,7 @@ import time
 import uuid
 from pathlib import Path
 from typing import Any
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +92,7 @@ class Tracer:
         self.trace_id = trace_id or ambient_trace_id() or new_trace_id()
         self.proc = proc or f"proc-{os.getpid()}"
         self._events: list[dict[str, Any]] = []
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("trace.Tracer._lock")
 
     # -- recording ---------------------------------------------------------
     def begin(self, name: str, **attrs: Any) -> Span:
@@ -171,7 +172,7 @@ def merge_job_trace(
 
 
 _default_tracer: Tracer | None = None
-_default_lock = threading.Lock()
+_default_lock = _sync.make_lock("trace:_default_lock")
 
 
 def default_tracer() -> Tracer:

@@ -14,6 +14,7 @@ from typing import Any, Callable, Mapping
 
 from tony_tpu.rpc import wire
 from tony_tpu.rpc.protocol import ApplicationRpc, RpcError, TaskUrl
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -54,7 +55,7 @@ class ApplicationRpcClient(ApplicationRpc):
         self._fault_hook = fault_hook
         self._sock: socket.socket | None = None
         # One in-flight call at a time per client (executor threads share it).
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("client.ApplicationRpcClient._lock")
 
     # -- transport ---------------------------------------------------------
     def _connect(self) -> socket.socket:
@@ -86,7 +87,10 @@ class ApplicationRpcClient(ApplicationRpc):
                 try:
                     if self._fault_hook is not None:
                         self._fault_hook()
-                    sock = self._connect()
+                    # The lock IS the channel: one in-flight framed call
+                    # per connection, so the connect/send/recv round
+                    # trip belongs inside it by design.
+                    sock = self._connect()  # tony: noqa[TONY-T002]
                     wire.send_msg(sock, req)
                     resp = wire.recv_msg(sock)
                     if not isinstance(resp, dict):
@@ -100,7 +104,11 @@ class ApplicationRpcClient(ApplicationRpc):
                     last_err = e
                     self._sock = None  # force reconnect
                     if attempt < self._call_retries:
-                        time.sleep(self._retry_interval_s)
+                        # Backoff holds the channel lock deliberately: a
+                        # second caller racing onto a dead connection
+                        # would only burn its own retry budget on the
+                        # same partition.
+                        time.sleep(self._retry_interval_s)  # tony: noqa[TONY-T002]
         raise ConnectionError(
             f"RPC {method} to {self.host}:{self.port} failed after "
             f"{self._call_retries + 1} attempts: {last_err}"

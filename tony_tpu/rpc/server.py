@@ -70,13 +70,25 @@ class ApplicationRpcServer:
     ) -> None:
         """``secret`` is the flat shared-secret mode; ``role_tokens``
         (token → role) additionally enforces ``security.METHOD_ACL`` per
-        caller role — the TFPolicyProvider analogue. ``observer`` is an
-        optional ``(method, ok, args)`` callback fired after every
-        dispatch — the coordinator's flight recorder hangs off it."""
+        caller role — the TFPolicyProvider analogue.
+
+        ``observer`` is an optional ``(method, ok, args)`` callback
+        fired after every dispatch — the coordinator's flight recorder
+        hangs off it. **Threading contract**: ``dispatch`` runs
+        concurrently on per-connection handler threads, so the observer
+        is called from many threads at once and must be thread-safe; it
+        must not block (every RPC on that connection stalls behind it);
+        and it may never kill a dispatch — an observer exception is
+        swallowed, logged, and counted in ``observer_failures``, and the
+        RPC reply still goes out."""
         self._impl = impl
         self._secret = secret
         self._role_tokens = role_tokens
         self._observer = observer
+        # Swallowed observer exceptions, for telemetry/tests. Guarded:
+        # handler threads increment it concurrently.
+        self._observer_failures = 0
+        self._observer_mu = threading.Lock()
         self.host = host
         self.port = self._bind(host, port_range)
         self._thread: threading.Thread | None = None
@@ -175,10 +187,18 @@ class ApplicationRpcServer:
             self._observe(method, False, args)
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
+    @property
+    def observer_failures(self) -> int:
+        """How many observer exceptions dispatch has swallowed."""
+        with self._observer_mu:
+            return self._observer_failures
+
     def _observe(self, method: str, ok: bool, args: dict) -> None:
         if self._observer is None:
             return
         try:
             self._observer(method, ok, args)
-        except Exception:  # pragma: no cover - telemetry never breaks RPC
+        except Exception:  # telemetry never breaks RPC (see __init__)
+            with self._observer_mu:
+                self._observer_failures += 1
             log.warning("rpc observer failed", exc_info=True)

@@ -29,6 +29,7 @@ import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Protocol
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -242,7 +243,7 @@ class SlicePool:
         self.lease_timeout_ms = int(lease_timeout_ms)
         self.idle_timeout_ms = int(idle_timeout_ms)
         self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("pool.SlicePool._lock")
         self._slices: dict[str, PooledSlice] = {}
         if registry is None:
             from tony_tpu.observability.metrics import MetricsRegistry

@@ -18,6 +18,7 @@ from typing import Any, Callable, Mapping
 
 from tony_tpu.conf import keys
 from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 # Declared metric name (TONY-M001/M002): time-in-queue recorded at pop —
 # the first goodput category users see, served as p50/p95 on /api/queue
@@ -150,7 +151,7 @@ class JobQueue:
     def __init__(self, quotas: TenantQuotas | None = None,
                  registry=None, clock_ms: Callable[[], int] | None = None,
                  ) -> None:
-        self._lock = threading.Lock()
+        self._lock = _sync.make_lock("queue.JobQueue._lock")
         self._queued: list[SchedJob] = []
         self._seq = 0
         self.quotas = quotas or TenantQuotas()

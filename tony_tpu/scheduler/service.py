@@ -58,6 +58,7 @@ from tony_tpu.scheduler.pool import (
     SlicePool,
     SliceProvisioner,
 )
+from tony_tpu.analysis import sync_sanitizer as _sync
 from tony_tpu.scheduler.queue import (
     QUEUE_WAIT_BUCKETS,
     QUEUE_WAIT_HISTOGRAM,
@@ -186,7 +187,7 @@ class SchedulerDaemon:
             clock_ms=clock_ms,
         )
         self._backend_factory = backend_factory or self._local_backend
-        self._lock = threading.RLock()
+        self._lock = _sync.make_rlock("service.SchedulerDaemon._lock")
         self._cond = threading.Condition(self._lock)
         self._jobs: dict[str, SchedJob] = {}
         self._runners: dict[str, _JobRunner] = {}
@@ -269,6 +270,8 @@ class SchedulerDaemon:
     def kill(self, job_id: str) -> bool:
         """Kill a queued or running job. Returns False for unknown ids
         and already-terminal jobs."""
+        runner = None
+        killed_queued = False
         with self._lock:
             job = self._jobs.get(job_id)
             if job is None or job.state.terminal:
@@ -281,15 +284,19 @@ class SchedulerDaemon:
                 # flag path so the in-flight launch finalizes it.
                 self._finish_job_locked(job, JobState.KILLED,
                                         "killed while queued")
-                self._publish_state_locked()
-                return True
-            # The flag covers the windows where no runner exists yet
-            # (LAUNCHING inside a long cold provision) or the job is
-            # already PREEMPTING: either way the next lifecycle edge
-            # finalizes KILLED instead of launching or requeueing.
-            job.kill_requested = True
-            runner = self._runners.get(job_id)
-        if runner is not None:
+                killed_queued = True
+            else:
+                # The flag covers the windows where no runner exists yet
+                # (LAUNCHING inside a long cold provision) or the job is
+                # already PREEMPTING: either way the next lifecycle edge
+                # finalizes KILLED instead of launching or requeueing.
+                job.kill_requested = True
+                runner = self._runners.get(job_id)
+        if killed_queued:
+            # Publish OUTSIDE the lock (TONY-T002): the state write is
+            # disk I/O and every control-plane thread contends on _lock.
+            self._publish_state()
+        elif runner is not None:
             runner.kill()
         return True
 
@@ -421,9 +428,10 @@ class SchedulerDaemon:
         with self._lock:
             if reaped:
                 self._dirty = True
-            if self._dirty:
-                self._dirty = False
-                self._publish_state_locked()
+            publish = self._dirty
+            self._dirty = False
+        if publish:
+            self._publish_state()
 
     def _provision_and_launch(self, job: SchedJob, profile: str) -> None:
         """Cold path, off the tick thread: blocking provision, then
@@ -723,7 +731,7 @@ class SchedulerDaemon:
                 self._finish_job_locked(job, state, diag)
         with self._lock:
             self._dirty = False
-            self._publish_state_locked()
+        self._publish_state()
         self._wake.set()
 
     # -- views ---------------------------------------------------------------
@@ -788,13 +796,17 @@ class SchedulerDaemon:
         }
 
     def _publish_state(self) -> None:
-        with self._lock:
-            self._publish_state_locked()
-
-    def _publish_state_locked(self) -> None:
+        """Publish scheduler-state.json. The snapshot takes the lock
+        briefly inside ``state_json()``; the serialization and the disk
+        write happen OUTSIDE it — submit/kill/tick/HTTP views must
+        never stall behind a slow disk (TONY-T002). The tmp name is
+        per-thread so concurrent publishers can never tear each other's
+        file; ``replace`` is atomic and the tick republishes, so a
+        last-writer-wins race only ever costs one tick of staleness."""
         try:
             state = self.state_json()
-            tmp = self.base_dir / f".{STATE_FILE}.tmp"
+            tmp = self.base_dir / \
+                f".{STATE_FILE}.tmp.{threading.get_ident()}"
             tmp.write_text(json.dumps(state, indent=2) + "\n")
             tmp.replace(self.base_dir / STATE_FILE)
         except OSError:

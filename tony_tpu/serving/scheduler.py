@@ -53,6 +53,7 @@ from tony_tpu.models.transformer import TransformerConfig
 from tony_tpu.observability import metrics as obs_metrics
 from tony_tpu.observability import trace as obs_trace
 from tony_tpu.serving import engine as _engine
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 # ms-scale buckets for the serving latency histograms (the registry
 # default buckets are seconds-scale).
@@ -222,7 +223,7 @@ class ServingEngine:
         self._slot_req: list[ServingRequest | None] = [None] * self.slots
         self._queue: deque[ServingRequest] = deque()
         self._pf: deque[tuple[ServingRequest, int]] = deque()
-        self._cond = threading.Condition()
+        self._cond = _sync.make_condition("serving.ServingEngine._cond")
         self._stop = threading.Event()
         self._draining = False
         self._thread: threading.Thread | None = None
@@ -552,13 +553,21 @@ class ServingEngine:
         batched ``prefill_batch`` slots per dispatch and padded by
         duplicating entry 0 (idempotent rewrite), so the executable
         count stays at one whatever the pending population."""
-        if not self._pf:
-            return False
-        budget = (len(self._pf) if self.prefill_chunks_per_iter is None
-                  else min(self.prefill_chunks_per_iter, len(self._pf)))
+        # The pending-prefill deque is shared with _admit and the
+        # close()/loop-death drain paths, so every pop/append holds the
+        # engine condition (TONY-T004); the jitted dispatch below runs
+        # outside it.
+        with self._cond:
+            if not self._pf:
+                return False
+            budget = (len(self._pf) if self.prefill_chunks_per_iter is None
+                      else min(self.prefill_chunks_per_iter, len(self._pf)))
         while budget > 0:
-            n = min(self.prefill_batch, budget, len(self._pf))
-            entries = [self._pf.popleft() for _ in range(n)]
+            with self._cond:
+                n = min(self.prefill_batch, budget, len(self._pf))
+                entries = [self._pf.popleft() for _ in range(n)]
+            if not entries:
+                break
             budget -= n
             pb = self.prefill_batch
             toks = np.zeros((pb, self.prefill_chunk), np.int32)
@@ -595,11 +604,12 @@ class ServingEngine:
                 )
                 firsts = np.asarray(first_toks)  # device sync
             now = time.perf_counter()
+            requeue: list[tuple[ServingRequest, int]] = []
             for i, (req, slot) in enumerate(entries):
                 if not finals[i]:
                     # More chunks to go: back of the queue (round-robin
                     # keeps every pending slot progressing).
-                    self._pf.append((req, slot))
+                    requeue.append((req, slot))
                     continue
                 first = int(firsts[i])
                 req.t_first_token = now  # post-sync: TTFT really is now
@@ -617,6 +627,9 @@ class ServingEngine:
                     self._retire(slot)
                 else:
                     self._active[slot] = True
+            if requeue:
+                with self._cond:
+                    self._pf.extend(requeue)
         return True
 
     def _retire(self, slot: int) -> None:

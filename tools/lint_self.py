@@ -15,7 +15,12 @@ Three registries that must never drift are checked:
   the health detector catalogue to docs/DEPLOY.md;
 * the event catalogue — every lifecycle event kind emitted anywhere is
   registered in ``observability.events.KNOWN_KINDS`` and documented in
-  docs/DEPLOY.md (TONY-E001, ``analysis/events_lint``).
+  docs/DEPLOY.md (TONY-E001, ``analysis/events_lint``);
+* concurrency discipline — the TONY-T pass (``analysis/concurrency``):
+  lock-order cycles, blocking calls under locks, cross-thread mutation
+  without a common lock, check-then-act races, thread/join hygiene —
+  zero unwaived findings, and every TONY-T rule documented in
+  docs/DEPLOY.md.
 
 Invoked from the tier-1 suite (``tests/test_analysis.py``) so drift
 fails CI, and runnable standalone::
@@ -119,10 +124,25 @@ def check_event_drift() -> list[str]:
     ]
 
 
+def check_concurrency_discipline() -> list[str]:
+    """TONY-T001..T006 over every tree that runs control-plane threads,
+    plus the rule-catalogue docs row check. Unwaived findings fail
+    tier-1 — a new race pattern either gets fixed or gets an explicit
+    ``# tony: noqa[TONY-T00x]`` with a justification comment."""
+    from tony_tpu.analysis.concurrency import check_concurrency
+
+    roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+             REPO / "bench.py"]
+    return [
+        f.render()
+        for f in check_concurrency(roots, docs=REPO / "docs" / "DEPLOY.md")
+    ]
+
+
 def main() -> int:
     problems = (
         check_config_drift() + check_protocol_drift() + check_metric_names()
-        + check_event_drift()
+        + check_event_drift() + check_concurrency_discipline()
     )
     for p in problems:
         print(p, file=sys.stderr)
