@@ -291,7 +291,18 @@ def main(argv=None) -> int:
             observability.report(**report)
             if step % 5 == 0 or step == args.steps:
                 print(f"step {step}: loss {loss:.4f}", flush=True)
-            if step % args.checkpoint_every == 0:
+            # Interval saves, plus the coordinator's live-migration /
+            # evict-time flush order (TONY_CKPT_FLUSH_FILE, relayed by
+            # the executor off its heartbeat reply): the coordinator is
+            # waiting on this save's commit marker before tearing the
+            # job down, so the relaunch resumes from THIS step instead
+            # of one checkpoint interval back. flush_requested is
+            # checked FIRST (not behind a short-circuit `or`): an
+            # interval save at/past the target must also CONSUME the
+            # order, or the next step would save a second time for
+            # nothing.
+            flushed = mgr.flush_requested(step)
+            if flushed or step % args.checkpoint_every == 0:
                 mgr.save(step, state)
         mgr.save(int(state.step), state, blocking=True)
 

@@ -1,0 +1,69 @@
+"""Fixture: SIGKILL itself mid-persist at a chosen pipeline stage.
+
+Commits steps 1..3 normally, then saves step 4 with a store wrapper
+that SIGKILLs this process at exactly one commit boundary:
+
+    shard    — inside the step-4 shard upload (tmp written, no rename
+               on fs stores; the raw put on object stores)
+    sidecar  — after the shard landed, before its commit sidecar
+    marker   — after shard + sidecar, before process 0's step marker
+
+Whatever the stage, the parent test must find step 3 the newest
+complete step and step 4 unreadable — the torn-step-unreadability
+contract of the commit-marker layout.
+
+Usage: ckpt_kill_stage.py <dir> <stage>
+"""
+
+import os
+import signal
+import sys
+
+import numpy as np
+
+from tony_tpu.checkpoint import CheckpointManager
+from tony_tpu.checkpoint import layout
+
+KILL_STEP = 4
+
+
+class _KillingStore:
+    def __init__(self, inner, stage: str) -> None:
+        self._inner = inner
+        self._stage = stage
+
+    def put_file(self, step, name, data):
+        if step == KILL_STEP:
+            if self._stage == "shard" and name == layout.shard_name(0):
+                # Die INSIDE the upload: write the tmp file the fs
+                # store would, then never rename it.
+                step_dir = self._inner.directory / f"step_{step}"
+                step_dir.mkdir(parents=True, exist_ok=True)
+                (step_dir / f".tmp_{name}").write_bytes(data[:16])
+                os.kill(os.getpid(), signal.SIGKILL)
+            if self._stage == "sidecar" and name == layout.sidecar_name(0):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if self._stage == "marker" and name == layout.MARKER:
+                os.kill(os.getpid(), signal.SIGKILL)
+        return self._inner.put_file(step, name, data)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def main() -> int:
+    directory, stage = sys.argv[1], sys.argv[2]
+    mgr = CheckpointManager(directory, torn_gc_grace_s=3600.0)
+    for step in (1, 2, 3):
+        mgr.save(step, {"step": np.array(step),
+                        "w": np.full(8, float(step))}, blocking=True)
+    mgr._store = _KillingStore(mgr._store, stage)
+    mgr.save(KILL_STEP, {"step": np.array(KILL_STEP),
+                         "w": np.full(8, float(KILL_STEP))})
+    mgr.wait()
+    print("survived — the kill stage never fired", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Fixture: a checkpointing trainer for the live-migration e2e.
+
+Attempt 1 trains toward a far TARGET (it can only end by preemption),
+saving every CKPT_EVERY steps AND whenever the coordinator's flush
+order arrives (``mgr.flush_requested`` — the migration path under
+test), reporting every step over the heartbeat piggyback so the
+coordinator knows how far it got. The victim's last executed step is
+continuously published to $LAST_STEP_OUT so the test can compare it to
+the relaunch's resume step. A resumed attempt (TONY_RESUME_STEP set)
+runs two more steps and exits 0.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from tony_tpu import observability
+from tony_tpu.checkpoint import CheckpointManager
+
+TARGET = int(os.environ.get("TARGET_STEPS", "500"))
+EVERY = int(os.environ.get("CKPT_EVERY", "10"))
+STEP_S = float(os.environ.get("STEP_S", "0.15"))
+
+
+def main() -> int:
+    mgr = CheckpointManager(
+        os.environ["TONY_CHECKPOINT_DIR"],
+        process_id=int(os.environ.get("TASK_INDEX", "0")),
+        num_processes=int(os.environ.get("TASK_NUM", "1")),
+    )
+    state = {"step": np.array(0), "w": np.zeros(4)}
+    restored = mgr.restore_resumable(state)
+    start = 0
+    if restored is not None:
+        state = restored
+        start = int(state["step"])
+    print(f"starting at step {start}", flush=True)
+    resumed = os.environ.get("TONY_RESUME_STEP") is not None
+    last_out = os.environ.get("LAST_STEP_OUT")
+    for step in range(start + 1, TARGET + 1):
+        time.sleep(STEP_S)
+        state = {"step": np.array(step), "w": state["w"] + 1.0}
+        observability.report(step=step, loss=1.0 / step,
+                            step_time_ms=STEP_S * 1000.0)
+        if last_out:
+            with open(last_out + ".tmp", "w") as f:
+                f.write(str(step))
+            os.replace(last_out + ".tmp", last_out)
+        # Consume the flush order even on interval-save steps (same
+        # pattern as examples/lm_train.py — a short-circuit `or` would
+        # leave the order unserved and double-save one step later).
+        flushed = mgr.flush_requested(step)
+        if flushed or step % EVERY == 0:
+            mgr.save(step, state)
+        if resumed and step >= start + 2:
+            mgr.save(step, state, blocking=True)
+            print(f"resumed run done at step {step}", flush=True)
+            return 0
+    mgr.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

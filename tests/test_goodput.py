@@ -790,3 +790,101 @@ def test_scheduler_fleet_goodput_and_warm_compile(cluster):
     wall2 = sum(g2["categories"].values())
     assert g2["categories"]["compile"] < 0.5 * wall2, g2
     assert g2["categories"]["compile"] < 5.0, g2
+
+
+class TestCommittedCheckpointMark:
+    """ISSUE 14 satellite: the ledger's checkpoint mark advances only on
+    COMMITTED steps (marker written), never on snapshot starts — with
+    the async checkpoint pipeline a save's snapshot can be well ahead of
+    its commit, and an in-flight save must not shrink
+    ``wasted_by_failure`` it hasn't earned."""
+
+    @staticmethod
+    def _snap(ts_ms, gauges=None, counters=None, histograms=None):
+        return {
+            "ts_ms": ts_ms,
+            "gauges": gauges or {},
+            "counters": counters or {},
+            "histograms": histograms or {},
+        }
+
+    def test_commit_hook_fires_on_min_across_tasks(self):
+        agg = MetricsAggregator()
+        fired = []
+        agg.on_checkpoint_commit = fired.append
+        agg.ingest("w0", self._snap(1, {"tony_ckpt_committed_step": 10}))
+        assert fired == [10]
+        # A later-joining reporter at a lower value does not retract.
+        agg.ingest("w1", self._snap(2, {"tony_ckpt_committed_step": 5}))
+        assert fired == [10]
+        # The MIN must advance: one task alone at 20 is not a global
+        # commit while the other sits at 5.
+        agg.ingest("w0", self._snap(3, {"tony_ckpt_committed_step": 20}))
+        assert fired == [10]
+        agg.ingest("w1", self._snap(4, {"tony_ckpt_committed_step": 20}))
+        assert fired == [10, 20]
+
+    def test_snapshot_activity_never_fires_the_commit_hook(self):
+        """A save IN FLIGHT is visible as snapshot-histogram and
+        queue-depth telemetry — none of it may advance the mark."""
+        agg = MetricsAggregator()
+        fired = []
+        agg.on_checkpoint_commit = fired.append
+        agg.ingest("w0", self._snap(
+            1,
+            gauges={"tony_ckpt_queue_depth": 2.0},
+            histograms={"tony_ckpt_snapshot_ms": {
+                "count": 7, "sum": 70.0, "buckets": [[10.0, 7]],
+            }},
+        ))
+        assert fired == []
+
+    def test_inflight_save_does_not_shrink_wasted_by_failure(self):
+        """Regression: 10s of productive work, a save whose snapshot
+        started but whose marker never landed, then a session failure —
+        ALL 10s are recomputation debt. The committed variant (the
+        checkpoint_progress the commit hook emits) bounds the debt to
+        the post-commit seconds."""
+        def run(commit_at_ms):
+            led = GoodputLedger(chips=1)
+            led.seed_start(0)
+            led.observe_event({"ts_ms": 0, "kind": "session_started"})
+            led.observe_event({"ts_ms": 0, "kind": "task_registered",
+                               "task": "w0"})
+            led.observe_event({"ts_ms": 0, "kind": "rendezvous_released"})
+            led.observe_steps("w0", 1, ts_ms=0)
+            led.observe_steps("w0", 50, ts_ms=5_000)
+            if commit_at_ms is not None:
+                # What _on_checkpoint_commit stamps when the MARKER is
+                # seen (heartbeat gauge min-advance, or the migration
+                # wait's probe).
+                led.observe_event({"ts_ms": commit_at_ms,
+                                   "kind": "checkpoint_progress",
+                                   "best_step": 50})
+            led.observe_steps("w0", 100, ts_ms=10_000)
+            led.observe_event({"ts_ms": 10_000, "kind": "session_finished",
+                               "session": 1, "status": "FAILED"})
+            led.finalize(10_000)
+            return led.to_json()["categories"]
+
+        no_commit = run(None)
+        assert no_commit["wasted_by_failure"] == pytest.approx(10.0)
+        assert no_commit["productive"] == pytest.approx(0.0)
+        committed = run(5_000)
+        assert committed["wasted_by_failure"] == pytest.approx(5.0)
+        assert committed["productive"] == pytest.approx(5.0)
+
+    def test_commit_watermark_survives_session_reset(self):
+        """reset_tasks (session retry) drops per-task values but keeps
+        the fired watermark: a restarted gang re-reporting the step it
+        resumed FROM must not re-fire the hook (and re-clear debt that
+        new work is accruing against)."""
+        agg = MetricsAggregator()
+        fired = []
+        agg.on_checkpoint_commit = fired.append
+        agg.ingest("w0", self._snap(1, {"tony_ckpt_committed_step": 10}))
+        agg.reset_tasks()
+        agg.ingest("w0", self._snap(2, {"tony_ckpt_committed_step": 10}))
+        assert fired == [10]
+        agg.ingest("w0", self._snap(3, {"tony_ckpt_committed_step": 11}))
+        assert fired == [10, 11]

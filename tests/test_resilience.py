@@ -164,6 +164,8 @@ GOOD_PLAN = {
          "count": 5},
         {"action": "blackout_rpc", "after_ms": 2000, "ms": 1500},
         {"action": "fail_checkpoint_write", "step": 10},
+        {"action": "fail_checkpoint_write", "step": 12, "mode": "partial"},
+        {"action": "delay_checkpoint_write", "ms": 200, "count": 3},
         {"action": "throttle_io", "target": "worker:0", "ms": 50,
          "after_batches": 4, "count": 100},
     ],
@@ -174,9 +176,15 @@ class TestFaultPlanParse:
     def test_good_plan_parses(self):
         plan = FaultPlan.parse(json.dumps(GOOD_PLAN))
         assert plan.seed == 7
-        assert len(plan.specs) == 11
-        assert plan.specs[5].at == "pre_register"  # exit_executor default
-        assert plan.specs[10].after_batches == 4
+        assert len(plan.specs) == 13
+        by_action: dict[str, list] = {}
+        for s in plan.specs:
+            by_action.setdefault(s.action, []).append(s)
+        assert by_action["exit_executor"][0].at == "pre_register"  # default
+        fails = by_action["fail_checkpoint_write"]
+        assert [s.mode for s in fails] == ["error", "partial"]
+        assert by_action["delay_checkpoint_write"][0].ms == 200
+        assert by_action["throttle_io"][0].after_batches == 4
 
     @pytest.mark.parametrize("mutate,complaint", [
         (lambda p: p.update(seed="x"), "seed must be an integer"),
@@ -227,6 +235,18 @@ class TestFaultPlanParse:
         (lambda p: p["faults"].append(
             {"action": "throttle_io", "target": "any_non_chief", "ms": 5}),
          "concrete 'job:index'"),
+        (lambda p: p["faults"].append(
+            {"action": "fail_checkpoint_write", "step": 1,
+             "mode": "sideways"}), "must be 'error' or 'partial'"),
+        (lambda p: p["faults"].append(
+            {"action": "delay_checkpoint_write"}),
+         "missing required field 'ms'"),
+        (lambda p: p["faults"].append(
+            {"action": "delay_checkpoint_write", "ms": 0}),
+         "must be nonzero for delay_checkpoint_write"),
+        (lambda p: p["faults"].append(
+            {"action": "delay_checkpoint_write", "target": "any_non_chief",
+             "ms": 5}), "concrete 'job:index'"),
     ])
     def test_bad_plans_refused_with_pointed_errors(self, mutate, complaint):
         plan = json.loads(json.dumps(GOOD_PLAN))
@@ -252,7 +272,7 @@ class TestFaultPlanParse:
         conf = TonyConfiguration()
         assert FaultPlan.from_conf(conf, env={}) is None
         conf.set(keys.K_FAULT_PLAN, json.dumps(GOOD_PLAN))
-        assert len(FaultPlan.from_conf(conf, env={}).specs) == 11
+        assert len(FaultPlan.from_conf(conf, env={}).specs) == 13
         path = tmp_path / "plan.json"
         path.write_text(json.dumps(GOOD_PLAN))
         conf.set(keys.K_FAULT_PLAN, str(path))

@@ -488,7 +488,12 @@ def test_preemption_requeues_and_resumes_from_checkpoint(cluster, tmp_path):
         cluster, "preemptible.py",
         **{keys.K_SHELL_ENV: f"MARKER_OUT={marker}",
            keys.K_SCHED_PRIORITY: 0,
-           keys.K_CHECKPOINT_LOCATION: str(ckpt)},
+           keys.K_CHECKPOINT_LOCATION: str(ckpt),
+           # This test pins the requeue/resume mechanics; the fixture
+           # never checkpoints, so live migration's flush wait would
+           # only run out its deadline. The migration path has its own
+           # e2e in test_checkpoint.py.
+           keys.K_CKPT_MIGRATE_ON_PREEMPT: False},
     ))
     # Wait until the low-pri worker actually runs (its marker appears).
     deadline = time.monotonic() + 60

@@ -78,6 +78,16 @@ _MIN_ONE_KEYS = frozenset({
     # A zero-length capture window profiles nothing (0 must be an
     # explicit CLI omission, not a configured default).
     keys.K_PROFILE_DURATION_MS,
+    # A zero-depth checkpoint pipeline can never accept a save; zero
+    # persist workers never commit one; full-every=0 would divide the
+    # compaction clock by nothing; a zero migration/flush window turns
+    # live migration into a plain kill (disable it via
+    # tony.ckpt.migrate-on-preempt / flush-on-evict instead).
+    keys.K_CKPT_PIPELINE_DEPTH,
+    keys.K_CKPT_PERSIST_WORKERS,
+    keys.K_CKPT_FULL_EVERY,
+    keys.K_CKPT_MIGRATE_TIMEOUT_MS,
+    keys.K_CKPT_EVICT_FLUSH_WAIT_MS,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold

@@ -1,0 +1,56 @@
+"""Checkpointing as a staged pipeline — see ``checkpoint/manager.py``.
+
+This package split the former single-module ``tony_tpu/checkpoint.py``
+into layers with distinct import weights:
+
+* ``stores``        — step storage (fs + gs://), jax-free
+* ``layout``        — completeness + differential-chain rules and the
+                      committed-step gauge name, jax-free (shared with
+                      the control plane's progress probe and the
+                      coordinator's aggregator)
+* ``differential``  — hash-per-leaf diff planning, jax-free
+* ``pipeline``      — the bounded snapshot→persist worker pipeline
+* ``manager``       — ``CheckpointManager`` (imports jax)
+
+Public surface is unchanged — ``from tony_tpu.checkpoint import
+CheckpointManager`` keeps working everywhere — but the jax-heavy
+``manager`` names resolve LAZILY (PEP 562): the control plane imports
+``tony_tpu.checkpoint.stores`` / ``.layout`` without an accelerator
+runtime ever loading (the progress probe and the heartbeat aggregator
+both depend on that staying true).
+"""
+
+from tony_tpu.checkpoint.layout import (  # noqa: F401
+    CKPT_COMMITTED_GAUGE,
+    KIND_DIFF,
+    KIND_FULL,
+    LAYOUT_FORMAT,
+)
+from tony_tpu.checkpoint.stores import (  # noqa: F401
+    _FsCheckpointStore,
+    _ObjectCheckpointStore,
+    _fsync_write,
+    store_for,
+)
+
+_MANAGER_EXPORTS = frozenset({
+    "CKPT_BYTES_COUNTER",
+    "CKPT_PERSIST_HISTOGRAM",
+    "CKPT_QUEUE_DEPTH_GAUGE",
+    "CKPT_SNAPSHOT_HISTOGRAM",
+    "CheckpointManager",
+    "FlushSignal",
+    "_MANIFEST",
+    "_decode",
+    "_encode",
+})
+
+
+def __getattr__(name: str):
+    if name in _MANAGER_EXPORTS:
+        from tony_tpu.checkpoint import manager
+
+        return getattr(manager, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -1,0 +1,902 @@
+"""Async, per-process-sharded, differential train-state checkpointing.
+
+The reference delegates checkpoints entirely to the user script and uses
+AM-session retry as the resume path (SURVEY §5.4). This module is the
+training-library half of that contract, rebuilt as a staged pipeline so
+recovery debt is bounded by the checkpoint *interval*, not by how long a
+save takes or how rarely one can be afforded:
+
+* **Staged pipeline** (``checkpoint/pipeline.py``): ``save`` issues the
+  device→host copies and hands the host tree to a background
+  snapshot/encode thread, which hashes leaves, plans the differential,
+  and feeds persist worker(s) that serialize + upload + commit — several
+  steps in flight behind a depth-bounded queue. The train loop pays only
+  the D2H materialization (``tony_ckpt_snapshot_ms``); the persist wall
+  (``tony_ckpt_persist_ms``) is off the step path entirely. With
+  ``background_snapshot=True`` even the materialization moves to the
+  snapshot thread — safe ONLY when the train step does not donate its
+  state buffers (``plan.donate_state=False``): a donated buffer is
+  deleted the instant the next step dispatches, and a background read
+  of it would crash.
+* **Commit markers** (``checkpoint/layout.py``): each process's shard
+  file is followed by a ``process_<i>.json`` sidecar (sha256 of the
+  shard bytes + differential base steps), and process 0 writes the
+  step marker last — a step is restorable only when the marker, every
+  shard, and every sidecar are present and every differential base
+  still holds its bytes. A crash at ANY pipeline stage can never
+  surface a torn step to a reader.
+* **Differential saves** (``checkpoint/differential.py``): leaves whose
+  encoded bytes are unchanged since the last save are not rewritten —
+  their manifest entries reference the owning step. Every
+  ``full_every``-th save compacts to a full rewrite, and GC keeps
+  referenced donor steps alive for as long as a kept step reads them.
+* **Self-verifying restore**: shard bytes are checked against the
+  sidecar checksum, and a torn chain / corrupt shard makes ``restore``
+  fall back to the previous complete step instead of raising.
+* **Flush signal**: ``flush_requested(step)`` polls the coordinator's
+  live-migration order (``TONY_CKPT_FLUSH_FILE``, written by the
+  executor when a ``ckpt_flush`` command rides its heartbeat reply) —
+  the "snapshot now, then die" half of preemption-as-live-migration.
+
+Per-process sharding, crash safety, dtype-exact encoding, gs:// object
+stores, and topology-portable restore are unchanged from the original
+module (see ``stores.py`` and the restore docstrings below).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from tony_tpu import constants
+from tony_tpu.analysis import sync_sanitizer as _sync
+from tony_tpu.checkpoint import layout
+from tony_tpu.checkpoint.differential import DiffTracker, hash_pieces
+from tony_tpu.checkpoint.pipeline import SavePipeline
+from tony_tpu.checkpoint.stores import store_for
+
+log = logging.getLogger(__name__)
+
+_MANIFEST = "__manifest__"
+
+# Declared metric names (TONY-M001/M002 lint these module-scope
+# constants; all documented in docs/DEPLOY.md "Checkpointing & live
+# migration"). snapshot = the synchronous device→host phase the train
+# loop pays; persist = the background serialize+upload+commit wall;
+# queue depth = saves in flight behind the bounded pipeline; bytes =
+# shard bytes written, labeled kind=full|diff; committed step = the
+# newest step THIS process has fully committed (marker written for
+# process 0) — the heartbeat piggyback carries it to the coordinator,
+# whose goodput ledger advances its checkpoint mark only on commits.
+CKPT_SNAPSHOT_HISTOGRAM = "tony_ckpt_snapshot_ms"
+CKPT_PERSIST_HISTOGRAM = "tony_ckpt_persist_ms"
+CKPT_QUEUE_DEPTH_GAUGE = "tony_ckpt_queue_depth"
+CKPT_BYTES_COUNTER = "tony_ckpt_bytes_total"
+CKPT_COMMITTED_GAUGE = layout.CKPT_COMMITTED_GAUGE
+_SNAPSHOT_BUCKETS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
+                     10000.0)
+
+
+def _registry():
+    from tony_tpu.observability.metrics import default_registry
+
+    return default_registry()
+
+
+def _observe_ms(name: str, value_ms: float) -> None:
+    try:
+        _registry().histogram(name, buckets=_SNAPSHOT_BUCKETS).observe(
+            value_ms
+        )
+    except ValueError:  # a foreign registry squatting the name
+        pass
+
+
+def _set_gauge(name: str, value: float) -> None:
+    try:
+        _registry().gauge(name).set(value)
+    except ValueError:
+        pass
+
+
+def _count_bytes(kind: str, n: int) -> None:
+    try:
+        _registry().counter(
+            CKPT_BYTES_COUNTER, labels={"kind": kind}
+        ).inc(n)
+    except ValueError:
+        pass
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _start_d2h(leaf: Any) -> None:
+    """Kick the device→host copy for one leaf without waiting on it.
+    Best-effort: any array type that cannot async-copy just falls back
+    to the blocking path in ``_snapshot_leaf``."""
+    if not isinstance(leaf, jax.Array):
+        return
+    try:
+        if leaf.is_fully_addressable:
+            leaf.copy_to_host_async()
+        else:
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+    except Exception:  # deleted buffer, exotic layout — blocking path owns it
+        pass
+
+
+def _normalize_index(
+    index: tuple, shape: tuple[int, ...]
+) -> list[list[int]]:
+    """A shard's ``.index`` (tuple of slices) -> [[start, stop], ...] per
+    dim, JSON-able. This is what lets a LATER restore under a different
+    topology paste the piece back into the right region of the global
+    array (the manifest's cross-topology coordinates)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _snapshot_leaf(leaf: Any) -> tuple[list[np.ndarray], dict]:
+    """Host copies of this process's pieces of ``leaf`` plus manifest info.
+    Fully-addressable arrays (single process, or replicated locally) are one
+    piece; global arrays contribute one piece per addressable shard. Each
+    piece's global-coordinate index rides the manifest so a different
+    topology can reassemble (see ``CheckpointManager.restore``)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        shards = leaf.addressable_shards
+        pieces = [np.asarray(s.data) for s in shards]
+        return pieces, {
+            "dtype": str(leaf.dtype),
+            "shape": list(leaf.shape),
+            "num_shards": len(pieces),
+            "shard_shapes": [list(p.shape) for p in pieces],
+            "shard_indices": [
+                _normalize_index(s.index, leaf.shape) for s in shards
+            ],
+        }
+    arr = np.asarray(jax.device_get(leaf))
+    return [arr], {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "num_shards": 1,
+        "shard_shapes": [list(arr.shape)],
+        "shard_indices": [[[0, d] for d in arr.shape]],
+    }
+
+
+def _encode(arr: np.ndarray) -> np.ndarray:
+    """Raw little-endian bytes: np.savez corrupts ml_dtypes (bfloat16 comes
+    back as void), so every array is stored as uint8 and reshaped back via
+    the manifest."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def _decode(raw: np.ndarray, dtype: str, shape: list[int]) -> np.ndarray:
+    return raw.view(np.dtype(dtype)).reshape(shape)
+
+
+def _tree_paths(tree: Any) -> list[tuple[str, Any]]:
+    """Stable (joined-path, leaf) list for any pytree."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+class _CorruptStepError(Exception):
+    """A step that listed as complete turned out unreadable (checksum
+    mismatch, vanished donor, missing blob): readers fall back to the
+    previous complete step instead of surfacing an exception."""
+
+
+class _SaveJob:
+    __slots__ = ("step", "snapped", "leaves")
+
+    def __init__(self, step, snapped=None, leaves=None):
+        self.step = step
+        self.snapped = snapped  # [(path, pieces, info)] when materialized
+        self.leaves = leaves    # [(path, leaf)] when bg-snapshot
+
+
+class _PersistPayload:
+    __slots__ = ("step", "manifest", "blobs", "kind", "base_steps")
+
+    def __init__(self, step, manifest, blobs, kind, base_steps):
+        self.step = step
+        self.manifest = manifest
+        self.blobs = blobs
+        self.kind = kind
+        self.base_steps = base_steps
+
+
+class FlushSignal:
+    """The user-process half of the coordinator's checkpoint-flush order
+    (live migration / evict-time flush). The executor writes the signal
+    file when a ``ckpt_flush`` command rides its heartbeat reply;
+    ``requested(step)`` turns True exactly once per order, at the first
+    step at or past the order's target — lock-step SPMD processes all
+    pass the same target step, so every shard of the flushed step lands
+    in the SAME step directory."""
+
+    def __init__(self, path: str | os.PathLike[str] | None = None) -> None:
+        if path is None:
+            path = os.environ.get(constants.TONY_CKPT_FLUSH_FILE)
+        self._path = Path(path) if path else None
+        self._served: str | None = None
+
+    @property
+    def active(self) -> bool:
+        return self._path is not None
+
+    def requested(self, step: int | None = None) -> bool:
+        if self._path is None:
+            return False
+        try:
+            raw = self._path.read_text()
+        except OSError:
+            return False
+        try:
+            req = json.loads(raw)
+        except ValueError:
+            return False
+        if not isinstance(req, dict):
+            return False
+        req_id = str(req.get("req_id", "") or "")
+        if not req_id or req_id == self._served:
+            return False
+        target = req.get("step")
+        if target is not None and step is not None:
+            try:
+                if int(step) < int(target):
+                    return False
+            except (TypeError, ValueError):
+                pass
+        self._served = req_id
+        return True
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str | os.PathLike[str],
+        *,
+        process_id: int = 0,
+        num_processes: int = 1,
+        max_to_keep: int = 3,
+        torn_gc_grace_s: float = 300.0,
+        pipeline_depth: int | None = None,
+        persist_workers: int | None = None,
+        differential: bool | None = None,
+        full_every: int | None = None,
+        background_snapshot: bool | None = None,
+    ) -> None:
+        self._store: Any = store_for(directory)
+        self.directory: Any = getattr(
+            self._store, "directory", str(directory)
+        )
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.max_to_keep = max_to_keep
+        # Torn (incomplete) dirs are only GC'd once quiescent for this long,
+        # so process 0 can't delete a straggler's in-flight older-step write
+        # out from under it when processes desync.
+        self.torn_gc_grace_s = torn_gc_grace_s
+        # Pipeline + differential knobs: explicit args win; the executor
+        # exports tony.ckpt.* conf as TONY_CKPT_* env (like tony.io.*),
+        # so deployments tune these without touching user scripts.
+        depth = (pipeline_depth if pipeline_depth is not None
+                 else _env_int(constants.TONY_CKPT_PIPELINE_DEPTH, 2))
+        workers = (persist_workers if persist_workers is not None
+                   else _env_int(constants.TONY_CKPT_PERSIST_WORKERS, 1))
+        self._bg_snapshot = (
+            background_snapshot if background_snapshot is not None
+            else _env_bool(constants.TONY_CKPT_BG_SNAPSHOT, False)
+        )
+        self._diff = DiffTracker(
+            full_every=(full_every if full_every is not None
+                        else _env_int(constants.TONY_CKPT_FULL_EVERY, 5)),
+            enabled=(differential if differential is not None
+                     else _env_bool(constants.TONY_CKPT_DIFFERENTIAL, True)),
+        )
+        self._pipeline = SavePipeline(
+            self._encode_job, self._persist_payload,
+            depth=depth, workers=workers,
+            on_depth=lambda d: _set_gauge(CKPT_QUEUE_DEPTH_GAUGE, d),
+        )
+        self._commit_lock = _sync.make_lock(
+            "checkpoint.CheckpointManager._commit_lock"
+        )
+        self.last_committed_step: int | None = None
+        self._flush = FlushSignal()
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: Any, *, blocking: bool = False) -> None:
+        """Snapshot ``state`` at ``step``. Device→host copies happen before
+        returning (the caller may donate the buffers to the next train step
+        immediately after — see ``background_snapshot`` for the opt-out);
+        encoding, differential planning, serialization, upload, and the
+        commit marker all run on the pipeline's background threads, with
+        up to ``pipeline_depth`` saves in flight. Raises a prior async
+        save's failure rather than piling new checkpoints on top of a
+        broken disk; ``blocking=True`` drains the pipeline and persists
+        inline (the pre-exit final save)."""
+        self._raise_pending()
+        if blocking:
+            self.wait()
+            self._persist_payload(
+                self._encode_job(self._snapshot_job(step, state, True))
+            )
+            return
+        job = self._snapshot_job(step, state, not self._bg_snapshot)
+        self._pipeline.submit(job)
+
+    def _snapshot_job(self, step: int, state: Any,
+                      materialize: bool) -> _SaveJob:
+        leaves = _tree_paths(state)
+        # Batch the D2H: start EVERY leaf's (and shard's) copy first, then
+        # materialize — a per-leaf blocking ``device_get`` serialized one
+        # transfer round-trip per leaf on the caller thread, which is
+        # exactly the save-stall the pipeline was built to hide.
+        for _, leaf in leaves:
+            _start_d2h(leaf)
+        if not materialize:
+            return _SaveJob(step, leaves=leaves)
+        t0 = time.monotonic()
+        snapped = [
+            (path, *(_snapshot_leaf(leaf))) for path, leaf in leaves
+        ]
+        _observe_ms(CKPT_SNAPSHOT_HISTOGRAM,
+                    (time.monotonic() - t0) * 1000.0)
+        return _SaveJob(step, snapped=snapped)
+
+    def _encode_job(self, job: _SaveJob) -> _PersistPayload:
+        """Snapshot/encode stage (strictly ordered): materialize when the
+        caller deferred it, hash every leaf's encoded pieces, and plan
+        the differential."""
+        snapped = job.snapped
+        if snapped is None:
+            t0 = time.monotonic()
+            snapped = [
+                (path, *(_snapshot_leaf(leaf))) for path, leaf in job.leaves
+            ]
+            _observe_ms(CKPT_SNAPSHOT_HISTOGRAM,
+                        (time.monotonic() - t0) * 1000.0)
+        manifest: dict[str, dict] = {}
+        encoded: dict[str, list[np.ndarray]] = {}
+        leaf_hashes: dict[str, tuple[str, ...]] = {}
+        for path, pieces, info in snapped:
+            enc = [_encode(p) for p in pieces]
+            info = dict(info)
+            hashes = hash_pieces(enc)
+            info["piece_hashes"] = list(hashes)
+            manifest[path] = info
+            encoded[path] = enc
+            leaf_hashes[path] = hashes
+        plan = self._diff.plan(job.step, leaf_hashes)
+        blobs: dict[str, np.ndarray] = {}
+        for path, enc in encoded.items():
+            ref = plan.refs.get(path)
+            if ref is not None:
+                manifest[path]["ref_step"] = ref
+                continue
+            for i, piece in enumerate(enc):
+                blobs[f"{path}#s{i}"] = piece
+        return _PersistPayload(job.step, manifest, blobs, plan.kind,
+                               plan.base_steps)
+
+    def _persist_payload(self, payload: _PersistPayload) -> None:
+        """Persist stage: serialize, upload the shard, write the commit
+        sidecar (and, on process 0, the step marker), publish telemetry,
+        GC. Fault injection (tony.fault.plan, via TONY_FAULT_PLAN) lands
+        exactly where a real disk/GCS failure would: ``delay`` sleeps
+        here (proving the wall is off the step path), ``error`` raises
+        into the pipeline's surfaced-failure path, and ``partial``
+        uploads the shard but withholds sidecar + marker — the torn step
+        a reader must never see."""
+        import hashlib
+        import io
+
+        from tony_tpu.resilience.faults import checkpoint_faults_from_env
+
+        step = payload.step
+        t0 = time.monotonic()
+        partial = False
+        faults = checkpoint_faults_from_env()
+        if faults is not None:
+            delay_ms = faults.write_delay_ms(step)
+            if delay_ms:
+                time.sleep(delay_ms / 1000.0)
+            faults.maybe_fail_write(step)
+            partial = faults.partial_write(step)
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            **payload.blobs,
+            **{_MANIFEST: np.frombuffer(
+                json.dumps(payload.manifest).encode(), dtype=np.uint8
+            )},
+        )
+        data = buf.getvalue()
+        self._store.put_file(step, layout.shard_name(self.process_id), data)
+        if partial:
+            log.error(
+                "fault injection: checkpoint step %d shard written but "
+                "commit withheld (partial write)", step,
+            )
+            return
+        self._store.put_file(
+            step, layout.sidecar_name(self.process_id),
+            json.dumps({
+                "step": step,
+                "kind": payload.kind,
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "base_steps": payload.base_steps,
+            }).encode(),
+        )
+        if self.process_id == 0:
+            # The step marker: a step is restorable only once this AND
+            # all num_processes shard+sidecar files exist (reader-side
+            # completeness — no cross-process coordination needed).
+            self._store.put_file(
+                step, layout.MARKER,
+                json.dumps({
+                    "step": step,
+                    "num_processes": self.num_processes,
+                    "format": layout.LAYOUT_FORMAT,
+                }).encode(),
+            )
+        with self._commit_lock:
+            if (self.last_committed_step is None
+                    or step > self.last_committed_step):
+                self.last_committed_step = step
+        _observe_ms(CKPT_PERSIST_HISTOGRAM,
+                    (time.monotonic() - t0) * 1000.0)
+        _count_bytes(payload.kind, len(data))
+        if self.process_id == 0:
+            # The committed-step gauge is GLOBAL, not per-process: the
+            # goodput ledger's checkpoint mark (fed off the heartbeat
+            # piggyback) must never advance for a step some other
+            # process's shard hasn't landed for. Process 0 — the marker
+            # writer, which lists the directory for GC anyway — reads
+            # the reader-side completeness rule and publishes the
+            # newest COMPLETE step; other processes publish nothing
+            # (their local commit is visible in last_committed_step and
+            # the persist histogram). A lagging peer makes this
+            # conservative by up to one save interval, never early.
+            entries = self._store.step_entries()
+            complete = self._complete_steps(entries)
+            if complete:
+                _set_gauge(CKPT_COMMITTED_GAUGE, float(complete[-1]))
+            self._gc(entries, complete)
+        log.info("checkpoint step %d committed (%s, %d bytes) under %s",
+                 step, payload.kind, len(data), self.directory)
+
+    def _raise_pending(self) -> None:
+        try:
+            self._pipeline.raise_pending()
+        except RuntimeError:
+            # A failed persist may own leaves later diffs were planned
+            # against: the next save after a surfaced failure is full.
+            self._diff.reset()
+            raise
+
+    def wait(self) -> None:
+        """Block until every in-flight async save is durable; re-raises
+        the first pipeline failure if one occurred."""
+        try:
+            self._pipeline.drain()
+        except RuntimeError:
+            self._diff.reset()
+            raise
+
+    # -- flush signal (live migration) --------------------------------------
+    def flush_requested(self, step: int | None = None) -> bool:
+        """True exactly once per coordinator flush order, at the first
+        ``step`` at or past the order's target: the train loop should
+        then ``save(step, state)`` out of band — the coordinator is
+        waiting on the commit marker before tearing this process down."""
+        return self._flush.requested(step)
+
+    # -- restore ------------------------------------------------------------
+    def _complete_steps(self, entries=None) -> list[int]:
+        return layout.complete_steps(
+            self._store, self.num_processes, entries
+        )
+
+    def latest_step(self) -> int | None:
+        steps = self._complete_steps()
+        return steps[-1] if steps else None
+
+    def restore_resumable(self, state_template: Any) -> Any | None:
+        """Coordinator-assisted resume, the one-liner user scripts should
+        call after a ``TonyCoordinator`` retry: when ``TONY_RESUME_STEP``
+        is set (the newest step the coordinator saw complete before
+        retrying), restore that EXACT step first — so every process
+        resumes the SAME step even if a straggler completed a newer
+        checkpoint mid-teardown — and fall back to the newest complete
+        step when it is gone, torn, corrupt, or its differential chain
+        broke. Behaves like plain ``restore`` outside a retried
+        session."""
+        resume = os.environ.get("TONY_RESUME_STEP")
+        if resume:
+            try:
+                step = int(resume)
+            except ValueError:
+                log.warning("ignoring bad TONY_RESUME_STEP=%r", resume)
+            else:
+                restored = self.restore(state_template, step=step)
+                if restored is not None:
+                    return restored
+                log.warning(
+                    "TONY_RESUME_STEP=%d is not restorable here — "
+                    "falling back to the newest complete step", step,
+                )
+        return self.restore(state_template)
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any | None:
+        """Load the newest complete checkpoint (or ``step``, if complete)
+        into the structure — and shardings — of ``state_template``. Returns
+        None when nothing restorable exists (including an explicit ``step``
+        that is missing, torn, or fails its shard checksum).
+
+        Fallback past damage: without an explicit ``step``, a complete-
+        listed step that turns out unreadable at decode time (checksum
+        mismatch against its commit sidecar, a differential base whose
+        bytes vanished between listing and read) is skipped and the next
+        older complete step is tried — a damaged newest checkpoint costs
+        one interval of progress, never the job.
+
+        Topology-portable: when the template's process/sharding topology
+        matches the one that saved, each process reads only its own shard
+        file (fast path, no remote bytes). When they differ — train on a
+        slice, serve on one host, or resume onto a different mesh — the
+        restore reassembles each leaf's GLOBAL value from ALL processes'
+        shard files via the manifest's recorded shard coordinates, then
+        re-shards onto the template's sharding. Differential steps read
+        an unchanged leaf's bytes from the step that wrote them (the
+        manifest's ``ref_step``); the open-file cache spans donor steps,
+        so peak host memory stays about the touched files' on-disk size
+        plus one assembled leaf.
+
+        Restoring onto MORE processes than saved also works: ranks beyond
+        the saved count have no shard file of their own and assemble
+        every leaf from the donor files (process 0's manifest supplies
+        the structure)."""
+        complete = self._complete_steps()
+        if step is not None:
+            if step not in complete:
+                return None
+            candidates = [step]
+        else:
+            candidates = list(reversed(complete))
+        for cand in candidates:
+            try:
+                return self._restore_step(cand, state_template)
+            except _CorruptStepError as exc:
+                log.warning(
+                    "checkpoint step %d is unreadable (%s) — falling "
+                    "back to the previous complete step", cand, exc,
+                )
+                continue
+        return None
+
+    def _restore_step(self, step: int, state_template: Any) -> Any:
+        saved_n = self._saved_num_processes(step)
+        force_cross = False
+        own_id = self.process_id
+        if self.process_id >= saved_n:
+            # This rank did not exist when the checkpoint was written
+            # (fewer processes saved than now restore): no own shard file
+            # — every leaf reassembles from the donor files; process 0's
+            # manifest describes the structure.
+            own_id, force_cross = 0, True
+        # Lazily-populated cache of open shard files, keyed
+        # (step, process): differential steps read unchanged leaves from
+        # their base steps' files, cross-topology restores read every
+        # process's; closed (raw bytes released) when the restore
+        # finishes.
+        files: dict[tuple[int, int], tuple[dict, Any]] = {}
+        try:
+            own = self._read_shard_file(step, own_id, files)
+            if own is None:  # deleted between listing and read
+                raise _CorruptStepError("own shard file vanished")
+            manifest, _ = own
+            flat = jax.tree_util.tree_flatten_with_path(state_template)
+            leaves = []
+            for key_path, leaf in flat[0]:
+                key = jax.tree_util.keystr(key_path)
+                info = manifest.get(key)
+                if info is None:
+                    raise ValueError(
+                        f"checkpoint step {step} is missing leaf {key!r} — "
+                        f"model/optimizer structure changed since it was "
+                        f"written"
+                    )
+                if not force_cross and self._fast_path_ok(leaf, info):
+                    pieces = self._leaf_pieces(step, own_id, key, info,
+                                               files)
+                    leaves.append(
+                        self._restore_leaf_same_topology(leaf, pieces, info)
+                    )
+                else:
+                    leaves.append(
+                        self._restore_leaf_cross_topology(
+                            leaf, info, key, step, saved_n, files
+                        )
+                    )
+            return jax.tree_util.tree_unflatten(flat[1], leaves)
+        finally:
+            for _, npz in files.values():
+                npz.close()
+
+    def _saved_num_processes(self, step: int) -> int:
+        # A corrupt metadata.json must degrade to the ambient process
+        # count, not abort the restore.
+        meta = layout.parse_metadata(self._store.get_file(step, layout.MARKER))
+        return layout.metadata_num_processes(meta, self.num_processes)
+
+    def _read_shard_file(
+        self, step: int, process_id: int,
+        cache: dict[tuple[int, int], tuple[dict, Any]] | None = None,
+    ) -> tuple[dict, Any] | None:
+        """(manifest, open NpzFile), via ``cache`` when given. The bytes
+        are verified against the commit sidecar's sha256 when one exists
+        (format v2); a mismatch raises ``_CorruptStepError`` so restore
+        falls back instead of handing back bit-rotted state. The NpzFile
+        decodes members lazily on access, so holding one costs the
+        file's raw bytes — not a decoded copy of every array; callers
+        close() it when done."""
+        import hashlib
+        import io
+
+        key = (step, process_id)
+        if cache is not None and key in cache:
+            return cache[key]
+        raw = self._store.get_file(step, layout.shard_name(process_id))
+        if raw is None:
+            return None
+        sidecar = layout.parse_sidecar(
+            self._store.get_file(step, layout.sidecar_name(process_id))
+        )
+        if sidecar is not None and sidecar.get("sha256"):
+            digest = hashlib.sha256(raw).hexdigest()
+            if digest != sidecar["sha256"]:
+                raise _CorruptStepError(
+                    f"shard process_{process_id}.npz at step {step} fails "
+                    f"its commit checksum"
+                )
+        data = np.load(io.BytesIO(raw))
+        manifest = json.loads(bytes(data[_MANIFEST]).decode())
+        entry = (manifest, data)
+        if cache is not None:
+            cache[key] = entry
+        return entry
+
+    def _leaf_pieces(
+        self, step: int, process_id: int, key: str, info: dict,
+        files: dict[tuple[int, int], tuple[dict, Any]],
+    ) -> list[np.ndarray]:
+        """Decode ``key``'s pieces for one process, following the
+        differential reference when the manifest says the bytes live in
+        an earlier step's shard file."""
+        src_step = int(info.get("ref_step", step))
+        entry = self._read_shard_file(src_step, process_id, files)
+        if entry is None:
+            raise _CorruptStepError(
+                f"differential base step {src_step} for leaf {key!r} "
+                f"(process {process_id}) vanished"
+            )
+        _, npz = entry
+        pieces = []
+        for i in range(info["num_shards"]):
+            blob = f"{key}#s{i}"
+            try:
+                raw = npz[blob]
+            except KeyError:
+                raise _CorruptStepError(
+                    f"leaf {key!r} piece {i} missing from step "
+                    f"{src_step}'s shard file"
+                ) from None
+            pieces.append(_decode(raw, info["dtype"],
+                                  info["shard_shapes"][i]))
+        return pieces
+
+    def _fast_path_ok(self, template: Any, info: dict) -> bool:
+        """True when this process's own shard file lines up exactly with
+        the template's addressable shards — same count, same global shape,
+        and (when the manifest records them) identical shard coordinates
+        in identical order."""
+        if (
+            isinstance(template, jax.Array)
+            and not template.is_fully_addressable
+        ):
+            shards = template.addressable_shards
+            if len(shards) != info["num_shards"]:
+                return False
+            if tuple(template.shape) != tuple(info["shape"]):
+                return False
+            recorded = info.get("shard_indices")
+            if recorded is None:
+                return True  # pre-r5 checkpoint: only the old fast path exists
+            return all(
+                _normalize_index(s.index, template.shape) == recorded[i]
+                for i, s in enumerate(shards)
+            )
+        shape = tuple(getattr(template, "shape", ()))
+        # The single piece must SPAN the global shape — a multi-process
+        # save records the global shape but each file holds only a slab.
+        return (
+            info["num_shards"] == 1
+            and tuple(info["shape"]) == shape
+            and tuple(info["shard_shapes"][0]) == shape
+        )
+
+    def _restore_leaf_same_topology(
+        self, template: Any, pieces: list[np.ndarray], info: dict
+    ) -> Any:
+        sharding = getattr(template, "sharding", None)
+        if (
+            isinstance(template, jax.Array)
+            and not template.is_fully_addressable
+        ):
+            arrays = [
+                jax.device_put(piece, shard.device)
+                for piece, shard in zip(pieces, template.addressable_shards)
+            ]
+            return jax.make_array_from_single_device_arrays(
+                tuple(info["shape"]), template.sharding, arrays
+            )
+        value = pieces[0]
+        if sharding is not None:
+            return jax.device_put(value, sharding)
+        return value
+
+    def _restore_leaf_cross_topology(
+        self, template: Any, info: dict, key: str, step: int, saved_n: int,
+        files: dict[tuple[int, int], tuple[dict, Any]],
+    ) -> Any:
+        """Reassemble ``key``'s global value from every process's recorded
+        shard coordinates, then place it under the template's sharding."""
+        shape = tuple(info["shape"])
+        t_shape = tuple(getattr(template, "shape", shape))
+        if shape != t_shape:
+            raise ValueError(
+                f"leaf {key!r}: checkpoint global shape {shape} does not "
+                f"match the template's {t_shape} — the model/optimizer "
+                f"definition changed since the checkpoint was written"
+            )
+        if info.get("shard_indices") is None:
+            raise ValueError(
+                f"leaf {key!r}: the checkpoint predates shard-coordinate "
+                f"manifests (pre-r5) and its topology differs from the "
+                f"template's — restore with the same num_processes/mesh "
+                f"that saved it, or re-save under the current format"
+            )
+        out = np.empty(shape, dtype=np.dtype(info["dtype"]))
+        filled = np.zeros(shape, dtype=bool) if shape else None
+        wrote_any = False
+        for p in range(saved_n):
+            entry = self._read_shard_file(step, p, files)
+            if entry is None:
+                raise _CorruptStepError(
+                    f"shard file for process {p} vanished during "
+                    f"cross-topology restore of step {step}"
+                )
+            p_manifest, _ = entry
+            p_info = p_manifest.get(key)
+            if p_info is None:
+                raise ValueError(
+                    f"leaf {key!r}: missing from process {p}'s shard file "
+                    f"at step {step} — inconsistent checkpoint"
+                )
+            pieces = self._leaf_pieces(step, p, key, p_info, files)
+            for i, index in enumerate(p_info["shard_indices"]):
+                region = tuple(slice(a, b) for a, b in index)
+                out[region] = pieces[i]
+                wrote_any = True
+                if filled is not None:
+                    filled[region] = True
+            # Replicated leaves are saved full-span by EVERY process —
+            # stop at full coverage instead of redundantly decoding the
+            # same bytes saved_n times (the serve-on-one-host critical
+            # path restores the whole param tree this way).
+            if wrote_any and (filled is None or filled.all()):
+                break
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"leaf {key!r}: the union of all processes' shards does "
+                f"not cover the global array at step {step} — torn or "
+                f"inconsistent checkpoint"
+            )
+        sharding = getattr(template, "sharding", None)
+        if isinstance(template, jax.Array) and sharding is not None:
+            # Covers single-process and multi-process templates alike:
+            # each process materializes only its addressable shards.
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: out[idx]
+            )
+        return out
+
+    # -- gc -----------------------------------------------------------------
+    def _gc(self, entries=None, complete=None) -> None:
+        """Process 0 prunes old steps — complete ones beyond ``max_to_keep``
+        AND torn/incomplete dirs older than the oldest kept complete step
+        (crash leftovers must not accumulate forever) — EXCEPT donor steps
+        a kept differential step still reads bytes from: deleting a base
+        would tear every chain through it, so donors live until the next
+        full-save compaction rotates them out of every kept chain. The
+        checkpoint dir is shared storage in multi-process deployments; a
+        lone writer avoids deletion races. ``entries``/``complete`` let
+        the persist stage share its one listing pass."""
+        if self.process_id != 0 or not self.max_to_keep:
+            return
+        if entries is None:
+            entries = self._store.step_entries()  # ONE listing serves all
+        if complete is None:
+            complete = self._complete_steps(entries)
+        kept = set(complete[-self.max_to_keep:])
+        protected = layout.referenced_steps(
+            self._store, kept, self.num_processes
+        )
+        threshold = min(kept) if kept else None
+        now = self._now_reference(entries)
+        for n, (_, newest) in entries.items():
+            if n in kept or n in protected:
+                continue
+            stale_complete = n in set(complete)
+            torn_and_old = (
+                n not in complete
+                and threshold is not None
+                and n < threshold
+                and self._quiescent(newest, now)
+            )
+            if stale_complete or torn_and_old:
+                self._store.delete_step(n)
+
+    def _now_reference(
+        self, entries: dict[int, tuple[set[str], float | None]]
+    ) -> float | None:
+        """Clock the quiescence check reads ages against. For object
+        stores the ``updated`` stamps are SERVER time — comparing them to
+        local time.time() would let client clock skew eat into (or
+        inflate) the grace window, so "now" is the newest stamp observed
+        in the same listing (server-clock deltas, NTP-free). FS mtimes
+        come from the local clock, so time.time() is the right reference
+        there. None = no usable stamp observed -> nothing is quiescent."""
+        from tony_tpu.checkpoint.stores import _ObjectCheckpointStore
+
+        if isinstance(self._store, _ObjectCheckpointStore):
+            stamps = [t for _, t in entries.values() if t is not None]
+            return max(stamps) if stamps else None
+        return time.time()
+
+    def _quiescent(self, newest: float | None, now: float | None) -> bool:
+        """True when nothing under the step was modified within the grace
+        window — a straggler still writing an old step keeps its dir
+        alive. None (files vanishing under the listing, or unknown age)
+        reads as active."""
+        if newest is None or now is None:
+            return False
+        return (now - newest) > self.torn_gc_grace_s

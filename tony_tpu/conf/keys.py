@@ -192,6 +192,45 @@ K_HEAL_MIN_SHRINK_FRACTION = HEAL_PREFIX + "min-shrink-fraction"
 K_HEAL_SPECULATIVE = HEAL_PREFIX + "speculative"
 K_HEAL_SPECULATIVE_DELAY_MS = HEAL_PREFIX + "speculative-delay"
 
+# --- checkpoint pipeline (checkpoint/) --------------------------------------
+# The staged save pipeline + differential saves + live migration. The
+# executor exports these to user processes as TONY_CKPT_* env, which
+# CheckpointManager reads as its defaults (explicit constructor args
+# win), like tony.io.*.
+CKPT_PREFIX = TONY_PREFIX + "ckpt."
+# Saves in flight behind the bounded pipeline (snapshot queue +
+# persisting steps). 1 = at most one async save at a time (the
+# pre-pipeline behavior); deeper absorbs slow/bursty stores.
+K_CKPT_PIPELINE_DEPTH = CKPT_PREFIX + "pipeline-depth"
+# Persist-stage upload workers per process (serialize + upload + commit
+# run here, off the step path).
+K_CKPT_PERSIST_WORKERS = CKPT_PREFIX + "persist-workers"
+# Differential saves: leaves whose encoded bytes are unchanged since the
+# last save are referenced, not rewritten.
+K_CKPT_DIFFERENTIAL = CKPT_PREFIX + "differential"
+# Every N-th save is a full rewrite (compaction): bounds chain length
+# and lets GC retire donor steps.
+K_CKPT_FULL_EVERY = CKPT_PREFIX + "full-every"
+# Run the device→host materialization on the snapshot thread too (the
+# caller's save() returns after only ISSUING the copies). Safe ONLY for
+# train steps that do not donate their state buffers
+# (plan.donate_state=False) — the default train step donates, so this
+# defaults off.
+K_CKPT_BG_SNAPSHOT = CKPT_PREFIX + "bg-snapshot"
+# Preemption-as-live-migration: on a scheduler preemption
+# (kill(preempted=True)) the coordinator orders every task to flush a
+# checkpoint over the heartbeat-reply command channel and waits up to
+# migrate-timeout ms for the commit marker before tearing down — the
+# relaunch then resumes within ~one step-interval of the victim's last
+# step instead of one checkpoint-interval behind.
+K_CKPT_MIGRATE_ON_PREEMPT = CKPT_PREFIX + "migrate-on-preempt"
+K_CKPT_MIGRATE_TIMEOUT_MS = CKPT_PREFIX + "migrate-timeout"
+# Self-healing evictions order the same flush while the gang is still
+# live (the straggler is slow, not dead) and wait up to
+# evict-flush-wait ms, so the patched gang resumes near-current.
+K_CKPT_FLUSH_ON_EVICT = CKPT_PREFIX + "flush-on-evict"
+K_CKPT_EVICT_FLUSH_WAIT_MS = CKPT_PREFIX + "evict-flush-wait"
+
 # --- goodput accounting (observability/goodput.py) --------------------------
 # Per-job chip-second ledger: an exclusive breakdown of wall time ×
 # chips into queued/provisioning/staging/compile/rendezvous/productive/
@@ -418,6 +457,15 @@ DEFAULTS: dict[str, object] = {
     K_HEAL_MIN_SHRINK_FRACTION: 0.5,
     K_HEAL_SPECULATIVE: False,
     K_HEAL_SPECULATIVE_DELAY_MS: 30000,
+    K_CKPT_PIPELINE_DEPTH: 2,
+    K_CKPT_PERSIST_WORKERS: 1,
+    K_CKPT_DIFFERENTIAL: True,
+    K_CKPT_FULL_EVERY: 5,
+    K_CKPT_BG_SNAPSHOT: False,
+    K_CKPT_MIGRATE_ON_PREEMPT: True,
+    K_CKPT_MIGRATE_TIMEOUT_MS: 20000,
+    K_CKPT_FLUSH_ON_EVICT: True,
+    K_CKPT_EVICT_FLUSH_WAIT_MS: 5000,
     K_GOODPUT_ENABLED: True,
     K_GOODPUT_CHIPS: 0,
     K_STEPSTATS_ENABLED: True,

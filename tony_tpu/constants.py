@@ -119,6 +119,19 @@ TONY_RESHARD_PLAN = "TONY_RESHARD_PLAN"
 # between a resync order and its registration cannot mark the task
 # confirmed for a patch whose payload it never received.
 TONY_GANG_GENERATION = "TONY_GANG_GENERATION"
+# Checkpoint pipeline (tony.ckpt.* conf → user-process env →
+# checkpoint/manager.py defaults): saves in flight behind the bounded
+# pipeline, persist upload workers, differential on/off + full-save
+# compaction interval, background D2H snapshot (safe only for
+# non-donating train steps), and the flush-signal file the executor
+# writes when a coordinator ``ckpt_flush`` command rides its heartbeat
+# reply (live migration's "snapshot now, then die").
+TONY_CKPT_PIPELINE_DEPTH = "TONY_CKPT_PIPELINE_DEPTH"
+TONY_CKPT_PERSIST_WORKERS = "TONY_CKPT_PERSIST_WORKERS"
+TONY_CKPT_DIFFERENTIAL = "TONY_CKPT_DIFFERENTIAL"
+TONY_CKPT_FULL_EVERY = "TONY_CKPT_FULL_EVERY"
+TONY_CKPT_BG_SNAPSHOT = "TONY_CKPT_BG_SNAPSHOT"
+TONY_CKPT_FLUSH_FILE = "TONY_CKPT_FLUSH_FILE"
 
 # The env contract forwarded into docker containers (utils.build_user_command
 # emits one `-e VAR` per name; values resolve from the launching env).
@@ -140,6 +153,9 @@ DOCKER_FORWARD_ENV = (
     TONY_SERVING_DECODE_WINDOW, TONY_SERVING_MAX_QUEUE, TONY_SERVING_PORT,
     TONY_STEPSTATS_ENABLED, TONY_STEPSTATS_CALIBRATE, TONY_STEPSTATS_WINDOW,
     TONY_TASK_INCARNATION, TONY_RESHARD_PLAN, TONY_GANG_GENERATION,
+    TONY_CKPT_PIPELINE_DEPTH, TONY_CKPT_PERSIST_WORKERS,
+    TONY_CKPT_DIFFERENTIAL, TONY_CKPT_FULL_EVERY, TONY_CKPT_BG_SNAPSHOT,
+    TONY_CKPT_FLUSH_FILE,
 )
 
 # The executor's self-termination code after losing the coordinator (N

@@ -77,6 +77,7 @@ from tony_tpu.resilience import classifier as failure_kinds
 from tony_tpu.resilience.faults import FaultInjector
 from tony_tpu.rpc.protocol import ApplicationRpc, TaskUrl
 from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.analysis import sync_sanitizer as _sync
 
 log = logging.getLogger(__name__)
 
@@ -233,6 +234,24 @@ class TonyCoordinator:
             goodput=self.goodput,
         )
         self.aggregator.on_train_progress = self._on_train_progress
+        # Committed-checkpoint watermark off the heartbeat piggyback:
+        # the ledger's checkpoint mark (and the checkpoint_progress
+        # timeline entry) advance on COMMIT MARKERS only — with the
+        # async pipeline a save's snapshot may be minutes ahead of its
+        # commit, and an in-flight save must not shrink
+        # wasted_by_failure it hasn't yet earned.
+        self.aggregator.on_checkpoint_commit = self._on_checkpoint_commit
+        # Gang-wide checkpoint-flush order (live migration / healing
+        # evictions): while armed, every live task's heartbeat reply
+        # carries the ckpt_flush command. Written from the monitor /
+        # kill threads, read from RPC handler threads.
+        self._flush_lock = _sync.make_lock(
+            "app_master.TonyCoordinator._flush_lock"
+        )
+        self._ckpt_flush: dict[str, Any] | None = None
+        self._ckpt_flush_seq = 0
+        # Migration wait state (monitor thread only).
+        self._migration: dict[str, Any] | None = None
         # Crash flight recorder: recent per-task reports + RPC frame
         # summaries + events, dumped as blackbox-*.json on task failure,
         # retry decision, and final status (persisted into history).
@@ -306,6 +325,177 @@ class TonyCoordinator:
             session=self.session.session_id if self.session else None,
             steps=int(steps),
         )
+
+    def _on_checkpoint_commit(self, step: int) -> None:
+        """Every reporting process has its commit marker down for
+        ``step``: advance the ledger's checkpoint mark and stamp the
+        timeline (events-only replays then attribute the same bound)."""
+        if self.goodput is not None:
+            self.goodput.observe_checkpoint()
+        self.events.emit(
+            obs_events.CHECKPOINT_PROGRESS,
+            session=self.session.session_id if self.session else None,
+            best_step=int(step),
+        )
+
+    # -- checkpoint flush / live migration -----------------------------------
+    def request_checkpoint_flush(self, reason: str = "migration",
+                                 floor: int | None = None,
+                                 ) -> dict[str, Any]:
+        """Arm a gang-wide checkpoint-flush order: every live task's
+        next heartbeat reply carries it (the same command channel
+        profiling and healing resync ride). The target step is one past
+        the furthest reported train step, so lock-step SPMD processes
+        all flush the SAME step directory; with no reported steps the
+        order is targetless and executors flush at their next step.
+        ``floor`` (the already-committed step the caller probed) keeps
+        the target ahead of it — heartbeat-reported steps LAG the train
+        loop by up to one ping, and a flush targeted at an
+        already-committed step would satisfy the wait with stale state
+        instead of forcing a fresh commit."""
+        steps = self.aggregator.latest_counter("train_steps_total")
+        target = int(max(steps.values())) + 1 if steps else None
+        if floor is not None:
+            target = max(target or 0, int(floor) + 1)
+        with self._flush_lock:
+            self._ckpt_flush_seq += 1
+            payload: dict[str, Any] = {
+                "req_id": f"flush-{self._session_seq}-"
+                          f"{self._ckpt_flush_seq}",
+            }
+            if target is not None:
+                payload["step"] = target
+            self._ckpt_flush = payload
+        self.events.emit(
+            obs_events.CHECKPOINT_FLUSH_REQUESTED,
+            session=self.session.session_id if self.session else None,
+            req_id=payload["req_id"], step=target, reason=reason,
+        )
+        log.warning("checkpoint flush ordered (%s): req %s, target "
+                    "step %s", reason, payload["req_id"], target)
+        return payload
+
+    def clear_checkpoint_flush(self) -> None:
+        with self._flush_lock:
+            self._ckpt_flush = None
+
+    def _flush_command(self) -> dict[str, Any] | None:
+        with self._flush_lock:
+            flush = self._ckpt_flush
+        return None if flush is None else {"ckpt_flush": flush}
+
+    def flush_before_evict(self) -> None:
+        """Healing seam (monitor thread): before a straggler eviction —
+        the gang is still LIVE, including the slow victim — order a
+        flush and wait bounded for the commit, so the patched gang
+        resumes near-current instead of a whole checkpoint interval
+        back. Gated by tony.ckpt.flush-on-evict; a gang missing a dead
+        member must never come here (its saves could not complete)."""
+        if not self.conf.get_bool(keys.K_CKPT_FLUSH_ON_EVICT, True):
+            return
+        loc = self.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+        if not loc or not self._rendezvous_released:
+            return
+        wait_ms = self.conf.get_int(keys.K_CKPT_EVICT_FLUSH_WAIT_MS, 5000)
+        base = latest_complete_step(loc)
+        payload = self.request_checkpoint_flush(reason="evict", floor=base)
+        try:
+            if self._await_flush_commit(
+                loc, base, payload.get("step"),
+                time.monotonic() + wait_ms / 1000.0,
+            ):
+                best = latest_complete_step(loc)
+                if best is not None:
+                    # The probe saw the marker before any heartbeat
+                    # could report it: drive the commit mark here so
+                    # the resume step the patch seeds and the ledger's
+                    # debt bound agree with what just landed.
+                    self._on_checkpoint_commit(best)
+        finally:
+            self.clear_checkpoint_flush()
+
+    def _await_flush_commit(self, loc: str, base: int | None,
+                            target: int | None, deadline: float) -> bool:
+        """Poll the jax-free completeness probe until the flush commits
+        (target step complete, or any step newer than ``base``) or the
+        deadline passes. Returns True on commit."""
+        while True:
+            best = latest_complete_step(loc)
+            if best is not None and (
+                (target is not None and best >= target)
+                or (target is None and (base is None or best > base))
+            ):
+                return True
+            if time.monotonic() >= deadline:
+                log.warning(
+                    "checkpoint flush did not commit before the deadline "
+                    "(best complete step: %s)", best,
+                )
+                return False
+            time.sleep(0.2)
+
+    def _migration_tick(self, session) -> bool:
+        """Preemption-as-live-migration, from the monitor loop: on the
+        first tick after a preemption kill, order the gang-wide flush
+        and start the bounded wait; on later ticks poll for the commit.
+        Returns True while the kill should be DEFERRED (migration in
+        progress), False when teardown may proceed."""
+        state = self._migration
+        if state is not None and state.get("done"):
+            return False
+        if state is None:
+            if (
+                not self.conf.get_bool(keys.K_CKPT_MIGRATE_ON_PREEMPT,
+                                       True)
+                or not self._rendezvous_released
+                or session.training_finished()
+            ):
+                return False
+            loc = self.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+            if not loc:
+                return False
+            timeout_ms = self.conf.get_int(
+                keys.K_CKPT_MIGRATE_TIMEOUT_MS, 20000
+            )
+            base = latest_complete_step(loc)
+            payload = self.request_checkpoint_flush(
+                reason="preemption", floor=base
+            )
+            self._migration = {
+                "loc": loc,
+                "base": base,
+                "target": payload.get("step"),
+                "deadline": time.monotonic() + timeout_ms / 1000.0,
+            }
+            return True
+        best = latest_complete_step(state["loc"])
+        target, base = state["target"], state["base"]
+        committed = best is not None and (
+            (target is not None and best >= target)
+            or (target is None and (base is None or best > base))
+        )
+        if committed:
+            log.warning(
+                "live migration: checkpoint step %d committed — tearing "
+                "down; the relaunch resumes from it", best,
+            )
+            # The probe beat the heartbeat to the marker: drive the
+            # commit mark so the ledger clears its recomputation debt
+            # BEFORE stop()'s job_preempted transfer freezes the record
+            # — the whole point of migrating is that this debt is now
+            # ~the resume gap, not the interval since the last save.
+            self._on_checkpoint_commit(best)
+        elif time.monotonic() < state["deadline"]:
+            return True
+        else:
+            log.warning(
+                "live migration: flush did not commit before the "
+                "deadline — tearing down on the last complete step (%s)",
+                best,
+            )
+        state["done"] = True
+        self.clear_checkpoint_flush()
+        return False
 
     def _goodput_chips(self) -> int:
         """Chip weight for the ledger: explicit conf override, else the
@@ -1068,6 +1258,12 @@ class TonyCoordinator:
             # Merge the healing half of the command channel: a survivor
             # mid-patch may owe BOTH a resync and a profile capture.
             command = {**(command or {}), **resync}
+        flush = self._flush_command()
+        if flush is not None:
+            # The checkpoint-flush order (live migration / evict-time
+            # flush) rides every live task's reply until cleared; the
+            # executor dedupes by req_id.
+            command = {**(command or {}), **flush}
         return command
 
     def _on_task_deemed_dead(self, task_id: str) -> None:
@@ -1108,8 +1304,17 @@ class TonyCoordinator:
         deadline = started + timeout_ms / 1000.0 if timeout_ms else None
         while not session.training_finished():
             if self._killed.is_set():
-                session.kill("killed by client")
-                break
+                # Live migration: a PREEMPTION kill is deferred while
+                # the gang flushes a final checkpoint — the flush order
+                # rides the heartbeat replies, and the commit marker
+                # (or the bounded deadline) releases the teardown. The
+                # loop body below keeps polling task exits meanwhile (a
+                # task finishing mid-flush must still be observed).
+                # Operator kills never wait.
+                if not (self._preempted_kill
+                        and self._migration_tick(session)):
+                    session.kill("killed by client")
+                    break
             if deadline is not None and time.monotonic() > deadline:
                 session.fail(f"application timed out after {timeout_ms}ms")
                 break
@@ -1194,6 +1399,10 @@ class TonyCoordinator:
         self._session_failure = None
         self._faults.reset_session()
         self.client_signal_to_finish.clear()
+        # A flush order armed for the dead session must not ride into
+        # the next one's heartbeat replies.
+        self.clear_checkpoint_flush()
+        self._migration = None
         # The next session's /metrics must not serve the dead session's
         # per-task gauges as current (heartbeat totals survive: they are
         # cumulative across the job). Health streaming state restarts

@@ -439,6 +439,21 @@ class HealingController:
             self._patch_active = True
             self._evictions += 1
         old_handle = task.handle
+        if exit_code is None and not fold:
+            # Straggler path: the whole gang — including the slow victim
+            # — is still LIVE, so a checkpoint CAN complete. Order a
+            # flush and wait bounded (tony.ckpt.evict-flush-wait) before
+            # surgery: the patched gang then resumes within about one
+            # step-interval instead of a whole checkpoint interval back.
+            # Dead-member losses never come this way — their shard could
+            # never land and the wait would only park the surgery.
+            flush = getattr(self._c, "flush_before_evict", None)
+            if flush is not None:
+                try:
+                    flush()
+                except Exception:
+                    log.warning("evict-time checkpoint flush failed",
+                                exc_info=True)
         # Evict FIRST: if the task completed between the caller's check
         # and here (register_execution_result on an RPC thread), the
         # rollback must not leave a bumped generation behind — that

@@ -9,6 +9,7 @@ env contract (JOB_NAME / TASK_INDEX / TASK_NUM / SESSION_ID / TONY_AM_ADDRESS
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
@@ -351,6 +352,22 @@ class TaskExecutor:
             Path(log_dir) / f".metrics-{self.job_name}-{self.task_index}.json"
             if log_dir else None
         )
+        # Checkpoint-flush signal file: a coordinator ``ckpt_flush``
+        # command riding a heartbeat reply (live migration / evict-time
+        # flush) is relayed to the user process by writing this file —
+        # CheckpointManager.flush_requested polls it per step. Stale
+        # orders from a previous session must not trigger a save.
+        self._ckpt_flush_file: Path | None = (
+            Path(log_dir)
+            / f".ckpt-flush-{self.job_name}-{self.task_index}.json"
+            if log_dir else None
+        )
+        self._ckpt_flush_req: str | None = None
+        if self._ckpt_flush_file is not None:
+            try:
+                self._ckpt_flush_file.unlink()
+            except OSError:
+                pass
         if self._metrics_file is not None:
             # The scratch dir is shared across session retries: a previous
             # session's last published snapshot must not ride THIS
@@ -532,8 +549,15 @@ class TaskExecutor:
         STALE gang generation — the coordinator patched the gang) parks
         the user process so the main thread can re-register. The
         coordinator re-sends the order every ping until this executor
-        re-registers, so acting on repeats must be idempotent."""
+        re-registers, so acting on repeats must be idempotent. A
+        ``ckpt_flush`` order (live migration: checkpoint NOW — the
+        coordinator is waiting on the commit marker before tearing the
+        job down) is relayed to the user process via the flush-signal
+        file; repeats with the same req_id are no-ops."""
         self.profiler.handle_command(reply)
+        flush = reply.get("ckpt_flush") if isinstance(reply, dict) else None
+        if isinstance(flush, dict):
+            self._relay_ckpt_flush(flush)
         resync = reply.get("resync") if isinstance(reply, dict) else None
         if not isinstance(resync, dict):
             return
@@ -558,6 +582,34 @@ class TaskExecutor:
         # so re-sent orders (and the order landing between exec loops)
         # stay harmless.
         _kill_user_process_group()
+
+    def _relay_ckpt_flush(self, flush: dict) -> None:
+        """Write the flush-signal file (atomic rename so the user
+        process can never read a torn order). Heartbeat-thread only."""
+        if self._ckpt_flush_file is None:
+            return
+        req_id = str(flush.get("req_id", "") or "")
+        if not req_id or req_id == self._ckpt_flush_req:
+            return
+        self._ckpt_flush_req = req_id
+        payload = {"req_id": req_id}
+        if flush.get("step") is not None:
+            payload["step"] = flush["step"]
+        tmp = self._ckpt_flush_file.with_name(
+            self._ckpt_flush_file.name + ".tmp"
+        )
+        try:
+            tmp.write_text(json.dumps(payload))
+            tmp.rename(self._ckpt_flush_file)
+            log.warning(
+                "checkpoint flush ordered (req %s, target step %s): "
+                "signaled the user process", req_id, flush.get("step"),
+            )
+        except OSError:
+            # Next heartbeat's re-sent order retries.
+            self._ckpt_flush_req = None
+            log.warning("could not write checkpoint flush signal",
+                        exc_info=True)
 
     def _resync_env(self, cluster_spec: dict[str, list[str]],
                     resync: dict) -> dict[str, str]:
@@ -662,6 +714,29 @@ class TaskExecutor:
         env[constants.TONY_COMPILE_MIN_ENTRY_SIZE] = str(
             self.conf.get_int(keys.K_COMPILE_MIN_ENTRY_SIZE, 0)
         )
+        # Checkpoint pipeline (tony.ckpt.* conf → user-process env →
+        # checkpoint/manager.py defaults), plus the flush-signal file
+        # the heartbeat thread writes when the coordinator orders a
+        # live-migration checkpoint flush.
+        env[constants.TONY_CKPT_PIPELINE_DEPTH] = str(
+            self.conf.get_int(keys.K_CKPT_PIPELINE_DEPTH, 2)
+        )
+        env[constants.TONY_CKPT_PERSIST_WORKERS] = str(
+            self.conf.get_int(keys.K_CKPT_PERSIST_WORKERS, 1)
+        )
+        env[constants.TONY_CKPT_DIFFERENTIAL] = str(
+            self.conf.get_bool(keys.K_CKPT_DIFFERENTIAL, True)
+        ).lower()
+        env[constants.TONY_CKPT_FULL_EVERY] = str(
+            self.conf.get_int(keys.K_CKPT_FULL_EVERY, 5)
+        )
+        env[constants.TONY_CKPT_BG_SNAPSHOT] = str(
+            self.conf.get_bool(keys.K_CKPT_BG_SNAPSHOT, False)
+        ).lower()
+        if self._ckpt_flush_file is not None:
+            env[constants.TONY_CKPT_FLUSH_FILE] = str(
+                self._ckpt_flush_file
+            )
         # Continuous HBM gauges (tony.profile.hbm-interval → user-process
         # env → runtime.initialize starts the device-memory monitor, so
         # OOM-adjacent jobs are visible on /metrics before they die).

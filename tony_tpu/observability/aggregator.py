@@ -55,6 +55,16 @@ HEARTBEAT_AGE_GAUGE = "tony_task_heartbeat_age_seconds"
 # The train-steps counter the goodput ledger reads out of snapshots
 # (registered by MetricsRegistry.report's step driver, not here).
 _TRAIN_STEPS_KEY = "train_steps_total"
+# The per-process committed-checkpoint gauge the checkpoint pipeline
+# publishes (imported from the jax-free checkpoint/layout.py — the
+# control plane must not drag the jax-heavy manager in). A step is
+# globally committed once EVERY reporting process has committed it, so
+# the hook below fires on the MIN across tasks — the goodput ledger's
+# checkpoint mark must advance on commit markers, never on snapshot
+# starts (an in-flight save has earned nothing yet).
+from tony_tpu.checkpoint.layout import (  # noqa: E402
+    CKPT_COMMITTED_GAUGE as _CKPT_COMMITTED_KEY,
+)
 
 
 def _parse_cursor(query: str) -> int | None:
@@ -137,6 +147,12 @@ class MetricsAggregator:
         # advance surfaced as a train_progress lifecycle event (the
         # coordinator wires its event log here).
         self.on_train_progress = None
+        # Called with (step) when the min-across-tasks committed
+        # checkpoint step advances — every reporting process has its
+        # commit marker down for that step, so the coordinator may
+        # advance the goodput ledger's checkpoint mark and stamp a
+        # checkpoint_progress lifecycle event.
+        self.on_checkpoint_commit = None
         self._clock = clock
         self._series_limit = series_limit
         self._lock = _sync.make_lock("aggregator.MetricsAggregator._lock")
@@ -149,11 +165,17 @@ class MetricsAggregator:
         # (stepstats.counter_rate clamps a restarted task's counter
         # reset to zero rather than a negative rate).
         self._step_rates: dict[str, float] = {}
+        # task -> its reported committed-checkpoint step, plus the
+        # watermark the commit hook last fired at (monotone: a retried
+        # session resumes FROM a committed step, never before it).
+        self._ckpt_committed: dict[str, float] = {}
+        self._ckpt_commit_fired: float | None = None
 
     def ingest(
         self, task_id: str, snapshot: Mapping[str, Any] | None,
     ) -> None:
         snap: dict[str, Any] | None = None
+        commit_step: float | None = None
         with self._lock:
             self._heartbeats[task_id] = self._heartbeats.get(task_id, 0) + 1
             self._last_seen[task_id] = self._clock()
@@ -203,6 +225,14 @@ class MetricsAggregator:
                     # it reads forward.
                     if not series or ts > series[-1][0]:
                         series.append((ts, value))
+                committed = snap["gauges"].get(_CKPT_COMMITTED_KEY)
+                if committed is not None:
+                    self._ckpt_committed[task_id] = float(committed)
+                    floor = min(self._ckpt_committed.values())
+                    if (self._ckpt_commit_fired is None
+                            or floor > self._ckpt_commit_fired):
+                        self._ckpt_commit_fired = floor
+                        commit_step = floor
         # The health detectors run outside the aggregator lock: they
         # take their own lock and may emit lifecycle events (file sink
         # I/O) — neither belongs under the ingest hot path's lock.
@@ -226,12 +256,24 @@ class MetricsAggregator:
                     self.on_train_progress(task_id, steps)
             except Exception:  # pragma: no cover - defensive
                 log.warning("goodput observe failed", exc_info=True)
+        if commit_step is not None and self.on_checkpoint_commit is not None:
+            # Outside the ingest lock: the hook emits lifecycle events
+            # (file sink I/O) and touches the goodput ledger's own lock.
+            try:
+                self.on_checkpoint_commit(int(commit_step))
+            except Exception:  # pragma: no cover - defensive
+                log.warning("checkpoint commit hook failed", exc_info=True)
 
     def reset_tasks(self) -> None:
         with self._lock:
             self._latest.clear()
             self._series.clear()
             self._step_rates.clear()
+            # The fired watermark survives: committed steps are durable
+            # across session retries (the next session resumes from one),
+            # so a restarted gang re-reporting the same step must not
+            # re-fire the commit hook.
+            self._ckpt_committed.clear()
 
     def reset_task(self, task_id: str) -> None:
         """One task was evicted and replaced (self-healing): drop ITS
@@ -245,6 +287,7 @@ class MetricsAggregator:
         with self._lock:
             self._latest.pop(task_id, None)
             self._step_rates.pop(task_id, None)
+            self._ckpt_committed.pop(task_id, None)
             for key in [k for k in self._series if k[0] == task_id]:
                 del self._series[key]
 

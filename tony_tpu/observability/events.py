@@ -39,6 +39,13 @@ TASK_FINISHED = "task_finished"
 SESSION_FINISHED = "session_finished"
 RETRY_DECISION = "retry_decision"
 CHECKPOINT_PROGRESS = "checkpoint_progress"
+# Live migration / evict-time flush (coordinator/app_master.py): the
+# coordinator ordered every live task to flush a checkpoint over the
+# heartbeat-reply command channel — preemption-as-live-migration's
+# "snapshot now, then die", or a healing eviction bounding the patched
+# gang's resume gap. The matching commit surfaces as
+# ``checkpoint_progress`` (the goodput ledger's checkpoint mark).
+CHECKPOINT_FLUSH_REQUESTED = "checkpoint_flush_requested"
 FINAL_STATUS = "final_status"
 
 # Goodput + profiling (observability/goodput.py, profiling.py): the
@@ -90,6 +97,7 @@ KNOWN_KINDS = frozenset({
     SESSION_FINISHED,
     RETRY_DECISION,
     CHECKPOINT_PROGRESS,
+    CHECKPOINT_FLUSH_REQUESTED,
     FINAL_STATUS,
     TRAIN_PROGRESS,
     PROFILE_REQUESTED,

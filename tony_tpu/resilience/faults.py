@@ -22,6 +22,8 @@ Plan shape (inline JSON in the conf value, or a path to a JSON file)::
         {"action": "blackout_rpc", "target": "worker:0", "after_ms": 2000, "ms": 1500},
         {"action": "kill_task", "target": "worker:1", "after_steps": 5},
         {"action": "fail_checkpoint_write", "step": 10, "count": 1},
+        {"action": "fail_checkpoint_write", "step": 10, "mode": "partial"},
+        {"action": "delay_checkpoint_write", "ms": 2000, "count": 100},
         {"action": "throttle_io", "target": "worker:0", "ms": 50,
          "after_batches": 4, "count": 100},
         {"action": "degrade_task", "target": "worker:2", "ms": 400,
@@ -58,9 +60,21 @@ delay_heartbeats       Heartbeater sleeps ``ms`` before each of the next
                        ``count`` pings (slow network simulation)
 blackout_rpc           every RPC from the target executor raises for the
                        window [after_ms, after_ms+ms) of its lifetime
-fail_checkpoint_write  ``CheckpointManager.save`` raises at ``step``
+fail_checkpoint_write  the checkpoint persist stage fails at ``step``
                        (reads the plan from ``TONY_FAULT_PLAN`` in the
-                       user process)
+                       user process). ``mode: "error"`` (default) raises
+                       where a real disk/GCS failure would — surfaced by
+                       ``wait()``/the next save, never silently dropped.
+                       ``mode: "partial"`` uploads the shard file but
+                       WITHHOLDS the commit sidecar and step marker: the
+                       torn-step probe — chaos runs prove readers never
+                       surface the step and resume lands on the last
+                       committed one
+delay_checkpoint_write the persist stage sleeps ``ms`` before each of
+                       the next ``count`` writes (optionally only at
+                       ``step``) — a slow store simulation that proves
+                       the pipeline keeps the persist wall off the step
+                       path (step wall must not grow while saves crawl)
 throttle_io            the input pipeline sleeps ``ms`` before each of the
                        next ``count`` batches once ``after_batches`` have
                        been served (starved-input simulation — flips the
@@ -102,6 +116,7 @@ DROP_HEARTBEATS = "drop_heartbeats"
 DELAY_HEARTBEATS = "delay_heartbeats"
 BLACKOUT_RPC = "blackout_rpc"
 FAIL_CHECKPOINT_WRITE = "fail_checkpoint_write"
+DELAY_CHECKPOINT_WRITE = "delay_checkpoint_write"
 THROTTLE_IO = "throttle_io"
 DEGRADE_TASK = "degrade_task"
 
@@ -121,7 +136,12 @@ _FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
     DROP_HEARTBEATS: (frozenset({"target"}), frozenset()),
     DELAY_HEARTBEATS: (frozenset({"target", "ms"}), frozenset()),
     BLACKOUT_RPC: (frozenset({"ms"}), frozenset({"target", "after_ms"})),
-    FAIL_CHECKPOINT_WRITE: (frozenset({"step"}), frozenset({"target"})),
+    FAIL_CHECKPOINT_WRITE: (
+        frozenset({"step"}), frozenset({"target", "mode"}),
+    ),
+    DELAY_CHECKPOINT_WRITE: (
+        frozenset({"ms"}), frozenset({"target", "step"}),
+    ),
     THROTTLE_IO: (
         frozenset({"ms"}),
         frozenset({"target", "after_batches"}),
@@ -159,6 +179,7 @@ class FaultSpec:
     after_steps: int | None = None
     step: int | None = None
     after_batches: int = 0
+    mode: str = "error"  # fail_checkpoint_write: "error" | "partial"
 
     def in_session(self, session: int) -> bool:
         return self.session is None or self.session == session
@@ -288,22 +309,30 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
                 f"target"
             )
     if action in (DROP_HEARTBEATS, DELAY_HEARTBEATS, FAIL_CHECKPOINT_WRITE,
-                  THROTTLE_IO, DEGRADE_TASK):
+                  DELAY_CHECKPOINT_WRITE, THROTTLE_IO, DEGRADE_TASK):
         if target == ANY_NON_CHIEF:
             errors.append(
                 f"{where}: {action} needs a concrete 'job:index' target"
             )
-    if action in (THROTTLE_IO, DEGRADE_TASK) and ms == 0:
+    if action in (THROTTLE_IO, DEGRADE_TASK, DELAY_CHECKPOINT_WRITE) \
+            and ms == 0:
         errors.append(
             f"{where}.ms must be nonzero for {action} (a 0 ms "
             f"slowdown tests nothing)"
         )
+    mode = obj.get("mode", "error")
+    if action == FAIL_CHECKPOINT_WRITE and mode not in ("error", "partial"):
+        errors.append(
+            f"{where}.mode must be 'error' or 'partial' for "
+            f"fail_checkpoint_write, got {mode!r}"
+        )
+        mode = "error"
 
     return FaultSpec(
         action=action, target=target, at=at, phase=phase, session=session,
         count=count, code=code, ms=ms, after_ms=after_ms,
         after_heartbeats=after_hb, after_steps=after_steps, step=step,
-        after_batches=after_batches,
+        after_batches=after_batches, mode=str(mode),
     )
 
 
@@ -563,6 +592,9 @@ _ckpt_faults: "CheckpointFaults | None | bool" = False  # False = not loaded
 
 
 class CheckpointFaults:
+    """``fail_checkpoint_write`` + ``delay_checkpoint_write``, enforced
+    inside the checkpoint pipeline's persist stage in the user process."""
+
     def __init__(self, plan: FaultPlan, task_id: str | None,
                  session: int = 1) -> None:
         # Session scoping filters here, like every executor-side fault: a
@@ -571,22 +603,54 @@ class CheckpointFaults:
         # recover on retry" expressible.
         self._specs = [
             (i, s) for i, s in enumerate(plan.specs)
-            if s.action == FAIL_CHECKPOINT_WRITE
+            if s.action in (FAIL_CHECKPOINT_WRITE, DELAY_CHECKPOINT_WRITE)
             and (s.target is None or s.target == task_id)
             and s.in_session(session)
         ]
         self._fired: dict[int, int] = {}
 
+    def _take(self, idx: int, spec: FaultSpec) -> bool:
+        if self._fired.get(idx, 0) >= spec.count:
+            return False
+        self._fired[idx] = self._fired.get(idx, 0) + 1
+        return True
+
     def maybe_fail_write(self, step: int) -> None:
         for idx, spec in self._specs:
-            if spec.step != step:
+            if spec.action != FAIL_CHECKPOINT_WRITE or spec.step != step \
+                    or spec.mode != "error":
                 continue
-            if self._fired.get(idx, 0) >= spec.count:
+            if self._take(idx, spec):
+                raise OSError(
+                    f"fault injection: checkpoint write failed at step "
+                    f"{step}"
+                )
+
+    def partial_write(self, step: int) -> bool:
+        """True when this step's shard should land WITHOUT its commit
+        sidecar/marker (fail_checkpoint_write mode=partial): the
+        torn-step-unreadability probe."""
+        for idx, spec in self._specs:
+            if spec.action != FAIL_CHECKPOINT_WRITE or spec.step != step \
+                    or spec.mode != "partial":
                 continue
-            self._fired[idx] = self._fired.get(idx, 0) + 1
-            raise OSError(
-                f"fault injection: checkpoint write failed at step {step}"
-            )
+            if self._take(idx, spec):
+                return True
+        return False
+
+    def write_delay_ms(self, step: int) -> int:
+        """ms to sleep before this step's persist write (0 = none); a
+        ``step``-less delay applies to every write until its count
+        drains — the slow-store probe for the off-step-path claim."""
+        delay = 0
+        for idx, spec in self._specs:
+            if spec.action != DELAY_CHECKPOINT_WRITE:
+                continue
+            if spec.step is not None and spec.step != step:
+                continue
+            if self._take(idx, spec):
+                delay = max(delay, spec.ms)
+        return delay
 
 
 class IoFaults:

@@ -1,118 +1,47 @@
 """Jax-free probe for the newest *complete* checkpoint step.
 
 The coordinator needs to know how far training got — to export
-``TONY_RESUME_STEP`` to retried sessions and to refresh the retry budget
-when a retry makes progress — but it must not import ``tony_tpu.checkpoint``
+``TONY_RESUME_STEP`` to retried sessions, to refresh the retry budget
+when a retry makes progress, and to bound the live-migration wait on a
+preemption flush — but it must not import ``tony_tpu.checkpoint.manager``
 (which imports jax at module scope; the control plane stays accelerator-
-runtime-free). This module re-implements ONLY the completeness rule, which
-is deliberately tiny and reader-side:
+runtime-free).
 
-    a step is complete  ⇔  ``step_<n>/metadata.json`` exists, parses to a
-    dict, and all ``process_<i>.npz`` for ``i < num_processes`` exist.
-
-The rule's source of truth is ``checkpoint.CheckpointManager._complete_steps``;
+The completeness rule used to be re-implemented here and pinned to the
+manager's by a test. The checkpoint package split moved the rule into the
+jax-free ``checkpoint/layout.py`` (storage in ``checkpoint/stores.py``,
+also jax-free), so the probe now runs the SAME implementation the
+training library does — marker + per-process shards + commit sidecars +
+intact differential chains; a torn chain (a diff whose base bytes were
+lost) makes the step invisible here exactly as it does to ``restore``,
+which is what lets the coordinator fall back to the previous complete
+step instead of seeding an unrestorable resume target.
 ``tests/test_resilience.py::test_probe_agrees_with_checkpoint_manager``
-pins the two implementations together so they cannot drift silently.
+still pins the probe to ``CheckpointManager`` end to end.
 """
 
 from __future__ import annotations
 
-import json
 import logging
-import re
 from pathlib import Path
 
 log = logging.getLogger(__name__)
 
-_STEP_RE = re.compile(r"^step_(\d+)$")
-
-
-def _fs_step_files(directory: Path) -> dict[int, set[str]]:
-    out: dict[int, set[str]] = {}
-    if not directory.is_dir():
-        return out
-    for child in directory.iterdir():
-        m = _STEP_RE.match(child.name)
-        if not (m and child.is_dir()):
-            continue
-        try:
-            names = {p.name for p in child.iterdir()
-                     if not p.name.startswith(".")}
-        except OSError:
-            names = set()
-        out[int(m.group(1))] = names
-    return out
-
-
-def _gs_step_files(prefix: str) -> dict[int, set[str]]:
-    from tony_tpu.cloud import default_storage
-    from tony_tpu.cloud.gcs import split_gs_uri
-
-    prefix = prefix.rstrip("/")
-    _, root_key = split_gs_uri(prefix)
-    out: dict[int, set[str]] = {}
-    for key in default_storage().list_prefix(prefix + "/"):
-        rel = key[len(root_key):].lstrip("/") if root_key else key
-        parts = rel.split("/")
-        if len(parts) != 2:
-            continue
-        m = _STEP_RE.match(parts[0])
-        if m:
-            out.setdefault(int(m.group(1)), set()).add(parts[1])
-    return out
-
-
-def _read_metadata(directory: str, step: int) -> bytes | None:
-    from tony_tpu.cloud.gcs import is_gs_uri
-
-    if is_gs_uri(directory):
-        from tony_tpu.cloud import default_storage
-        from tony_tpu.cloud.gcs import GcsError
-
-        try:
-            return default_storage().get_bytes(
-                f"{directory.rstrip('/')}/step_{step}/metadata.json"
-            )
-        except GcsError:
-            return None
-    try:
-        return (Path(directory) / f"step_{step}" / "metadata.json").read_bytes()
-    except OSError:
-        return None
-
 
 def latest_complete_step(directory: str | Path) -> int | None:
-    """Newest step whose commit marker AND full per-process shard set are
-    visible; None when nothing restorable exists (including a missing or
+    """Newest step whose commit marker, full per-process shard set, and
+    (format v2) commit sidecars + differential chain are all visible;
+    None when nothing restorable exists (including a missing or
     unreadable directory — the probe must never fail the retry loop)."""
-    from tony_tpu.cloud.gcs import is_gs_uri
+    from tony_tpu.checkpoint import layout
+    from tony_tpu.checkpoint.stores import store_for
 
-    directory = str(directory)
     try:
-        if is_gs_uri(directory):
-            entries = _gs_step_files(directory)
-        else:
-            entries = _fs_step_files(Path(directory))
+        steps = layout.complete_steps(
+            store_for(str(directory), create=False)
+        )
     except Exception:
-        log.warning("checkpoint probe failed for %s", directory, exc_info=True)
+        log.warning("checkpoint probe failed for %s", directory,
+                    exc_info=True)
         return None
-    for step in sorted(entries, reverse=True):
-        names = entries[step]
-        if "metadata.json" not in names:
-            continue
-        raw = _read_metadata(directory, step)
-        if raw is None:
-            continue
-        try:
-            meta = json.loads(raw)
-        except ValueError:
-            continue
-        if not isinstance(meta, dict):
-            continue
-        try:
-            n = int(meta.get("num_processes", 1))
-        except (TypeError, ValueError):
-            continue
-        if all(f"process_{p}.npz" in names for p in range(n)):
-            return step
-    return None
+    return steps[-1] if steps else None

@@ -15,13 +15,17 @@ instead of provisioning per job:
   job's ``tony.compile.cache-dir`` is unset, the daemon pins it to the
   leased slice's pool-owned cache dir and REWRITES the frozen conf so
   executors inherit it.
-* **Preemption → requeue → resume** — a higher-priority submission may
-  preempt the lowest-priority running job: its coordinator is killed
-  gracefully (executors reaped, checkpoint writes completing), the best
-  complete checkpoint step is probed from ``tony.checkpoint.location``,
-  and the job requeues at the head of its priority band to resume from
-  that step via the PR-2 ``TONY_RESUME_STEP`` path instead of
-  restarting from zero.
+* **Preemption → live migration → requeue → resume** — a higher-
+  priority submission may preempt the lowest-priority running job: its
+  coordinator first orders a gang-wide checkpoint flush and waits
+  (bounded) for the commit marker (``tony.ckpt.migrate-on-preempt``;
+  the checkpoint pipeline makes the flush one step-interval of work,
+  not a whole-tree stall), is then killed gracefully (executors
+  reaped), the best complete checkpoint step is probed from
+  ``tony.checkpoint.location``, and the job requeues at the head of
+  its priority band to resume from that step — within ~one
+  step-interval of where the victim stopped — via the PR-2
+  ``TONY_RESUME_STEP`` path instead of restarting from zero.
 
 Each attempt runs a real ``TonyCoordinator`` on a thread of this
 process (the mini-cluster substrate) against a backend built by the
@@ -89,7 +93,11 @@ _TERMINAL_BY_STATUS = {
 
 class _JobRunner:
     """One coordinator attempt on a daemon thread. ``preempt()`` is a
-    graceful coordinator kill: executors get TERM→KILL through the
+    graceful coordinator kill: with ``tony.ckpt.migrate-on-preempt``
+    the coordinator first orders a gang-wide checkpoint flush over the
+    heartbeat replies and waits (bounded) for its commit marker — live
+    migration; the relaunch resumes within ~one step-interval of the
+    victim's last step — then executors get TERM→KILL through the
     backend, in-flight checkpoint writes finish, history is written —
     exactly what queued-resource preemption does NOT give a job, which
     is why the scheduler's own preemption can resume and YARN-style
