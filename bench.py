@@ -29,6 +29,14 @@ BASELINE_STEPS_PER_SEC_PER_CHIP = 100.0  # see BASELINE.md proxy table
 BATCH = 512
 MEASURE = 200
 
+# Jit sanitizer ON for every bench run (opt-out with =0): each workload
+# records the retraces its dispatches incurred (`retraces_total` in its
+# extras), and those feed the BASELINE.json gate — a steady-state step
+# that starts recompiling fails `bench --check` even when its wall time
+# hides it. setdefault BEFORE any tony_tpu import, matching the tier-1
+# conftest arming.
+os.environ.setdefault("TONY_JIT_SANITIZER", "1")
+
 # Peak dense bf16 throughput per chip, for MFU — the SAME table the
 # live step anatomy uses (observability/stepstats.py), so a bench MFU
 # and a production job's tony_mfu gauge are one definition, one table.
@@ -94,7 +102,7 @@ def bench_mnist() -> float:
             t0 = time.perf_counter()
             for _ in range(calls):
                 state, metrics = step_fn(state, images, labels)
-            float(metrics["loss"])
+            float(metrics["loss"])  # tony: noqa[TONY-X002] — intended per-window timing fence
             best_dt = min(best_dt, time.perf_counter() - t0)
     return calls * per_call / best_dt / n_chips
 
@@ -127,7 +135,7 @@ def _bench_lm_train(cfg, batch: int, seq: int, measure: int,
             t0 = time.perf_counter()
             for _ in range(measure):
                 state, metrics = step_fn(state, tokens)
-            float(metrics["loss"])
+            float(metrics["loss"])  # tony: noqa[TONY-X002] — intended per-window timing fence
             dt = min(dt, time.perf_counter() - t0)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     flops_per_step = (
@@ -216,7 +224,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 128, new_tokens: int = 128,
         d_ff=4096, max_seq=2048, dtype="bfloat16", remat=False,
         n_kv_heads=n_kv_heads,
     )
-    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))  # tony: noqa[TONY-X001] — one-shot init compile, not a step path
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size,
                                           (batch, prompt_len)),
@@ -285,7 +293,7 @@ def bench_moe(batch: int = 4, seq: int = 2048, measure: int = 8):
             t0 = time.perf_counter()
             for _ in range(measure):
                 state, metrics = step_fn(state, tokens)
-            float(metrics["loss"])
+            float(metrics["loss"])  # tony: noqa[TONY-X002] — intended per-window timing fence
             dt = min(dt, time.perf_counter() - t0)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     return {
@@ -317,7 +325,7 @@ def bench_moe_decode(batch: int = 8, windows: int = 3):
             remat=False, n_experts=n_experts, expert_top_k=2,
             moe_decode_mode=mode,
         )
-        params = jax.jit(lambda k, c=cfg: init_params(k, c))(
+        params = jax.jit(lambda k, c=cfg: init_params(k, c))(  # tony: noqa[TONY-X001] — one-shot init compile, not a step path
             jax.random.key(0)
         )
         prompt = jnp.asarray(
@@ -401,7 +409,7 @@ def bench_serving(
         max_seq=max_seq, dtype="bfloat16", remat=False,
         n_kv_heads=n_kv_heads,
     )
-    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))  # tony: noqa[TONY-X001] — one-shot init compile, not a step path
     rng = np.random.default_rng(seed)
     prompts = [
         rng.integers(0, vocab, rng.integers(prompt_rng[0],
@@ -573,7 +581,7 @@ def bench_resnet50(batch: int = 32, size: int = 224, measure: int = 20):
             t0 = time.perf_counter()
             for _ in range(measure):
                 state, metrics = step_fn(state, images, labels)
-            float(metrics["loss"])
+            float(metrics["loss"])  # tony: noqa[TONY-X002] — intended per-window timing fence
             dt = min(dt, time.perf_counter() - t0)
     return {
         "images_per_sec_per_chip": round(batch * measure / dt, 1),
@@ -1183,7 +1191,8 @@ DEFAULT_THRESHOLD = 0.10
 # config parameter (batch, seq, params_m, ...) and is not gated.
 _HIGHER_SUFFIXES = ("per_sec", "per_sec_per_chip", "mfu", "speedup",
                     "mb_per_sec", "vs_baseline", "per_hour", "hit_rate")
-_LOWER_SUFFIXES = ("_ms", "_pct", "ms_mean", "step_ms", "p50_ms", "p95_ms")
+_LOWER_SUFFIXES = ("_ms", "_pct", "ms_mean", "step_ms", "p50_ms", "p95_ms",
+                   "retraces_total")
 
 
 def metric_direction(name: str) -> str | None:
@@ -1241,7 +1250,15 @@ def check_regressions(
         cur = current[name]
         direction = metric_direction(name) or "higher"
         if base == 0:
-            continue  # nothing to scale a drop against
+            # A zero baseline on a lower-is-better COUNT is absolute:
+            # "the steady-state step never re-traces" — any non-zero
+            # current is a regression, no threshold to scale against.
+            if direction == "lower" and cur > 0:
+                problems.append(
+                    f"{name}: {cur:g} regressed from a zero baseline "
+                    f"(was clean, now is not)"
+                )
+            continue  # ratio gates need a non-zero base to scale against
         if direction == "higher" and cur < base * (1 - threshold):
             problems.append(
                 f"{name}: {cur:g} is {(1 - cur / base) * 100:.1f}% below "
@@ -1303,11 +1320,26 @@ def _safe(fn, *args, **kwargs):
     """One extra must not sink the whole bench line: the driver records
     exactly one JSON object per round, so a transient failure (tunnel
     hiccup, compile-helper 500, full /tmp) in a single extra degrades to
-    an inline error string instead of losing every other number."""
+    an inline error string instead of losing every other number.
+
+    With the jit sanitizer armed (the bench default), each workload's
+    extras additionally carry ``retraces_total`` — the re-traces its
+    instrumented dispatches incurred, measured as a tracker delta around
+    the workload. Gated as a lower-is-better metric: a steady-state
+    workload's baseline is 0, so ONE silent recompile fails --check."""
+    from tony_tpu.analysis import jit_sanitizer
+
+    armed = jit_sanitizer.enabled()
+    before = jit_sanitizer.tracker().retraces() if armed else 0
     try:
-        return fn(*args, **kwargs)
+        out = fn(*args, **kwargs)
     except Exception as exc:  # recorded, never raised
         return {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    if armed and isinstance(out, dict) and "error" not in out:
+        out.setdefault(
+            "retraces_total", jit_sanitizer.tracker().retraces() - before
+        )
+    return out
 
 
 def run_benches() -> dict:

@@ -258,13 +258,19 @@ def main(argv=None) -> int:
         from tony_tpu.resilience.faults import step_faults_from_env
 
         step_faults = step_faults_from_env()
-        while int(state.step) < args.steps:
+        # Host-side step mirror: the in-jit counter advances by exactly
+        # one per dispatch, so tracking it here keeps the loop condition
+        # and every consumer below off the device — the loss fence is
+        # the step's ONE intended readback (TONY-X002 polices the rest).
+        step = int(state.step)
+        while step < args.steps:
             tokens = next(batches)
             t0 = time.perf_counter()
             state, metrics = step_fn(state, tokens)
-            loss = float(metrics["loss"])
+            loss = float(jax.device_get(metrics["loss"]))  # tony: noqa[TONY-X002] — the step's intended readback fence
+            step += 1
             if step_faults is not None:
-                step_faults.maybe_degrade(int(state.step))
+                step_faults.maybe_degrade(step)
             # The float() above is the readback fence, so this wall time
             # covers the whole step. report() publishes the snapshot to
             # TONY_METRICS_FILE (when tony launched us), where the
@@ -273,7 +279,6 @@ def main(argv=None) -> int:
             dt = time.perf_counter() - t0
             first = loss if first is None else first
             last = loss
-            step = int(state.step)
             report = {
                 "step": step, "loss": loss,
                 "tokens_per_sec": args.batch * args.seq / dt if dt else 0.0,
@@ -304,7 +309,7 @@ def main(argv=None) -> int:
             flushed = mgr.flush_requested(step)
             if flushed or step % args.checkpoint_every == 0:
                 mgr.save(step, state)
-        mgr.save(int(state.step), state, blocking=True)
+        mgr.save(step, state, blocking=True)
 
     if not np.isfinite(last) or not last < first:
         print(f"loss did not descend: {first} -> {last}", file=sys.stderr)

@@ -12,6 +12,14 @@ import os
 # import — the factories read the flag at lock-creation time.
 os.environ.setdefault("TONY_SYNC_SANITIZER", "1")
 
+# Jit sanitizer ON for the whole tier-1 suite (opt-out with =0): every
+# instrument_jit dispatch the suite exercises is classified cold/hit/
+# retrace in the process-global tracker, and every step region runs
+# under a device-to-host transfer guard. The autouse fixture below
+# fails the test during which an over-budget retrace or an implicit
+# transfer was observed.
+os.environ.setdefault("TONY_JIT_SANITIZER", "1")
+
 # Forced (not setdefault): the ambient environment pins JAX_PLATFORMS to the
 # real TPU and a sitecustomize imports jax at interpreter startup, so both
 # the env var AND the already-imported jax config must be overridden before
@@ -62,5 +70,36 @@ def _sync_sanitizer_gate():
         pytest.fail(
             "sync sanitizer observed lock-order inversion(s):\n"
             + json.dumps(inversions, indent=2),
+            pytrace=False,
+        )
+
+
+@pytest.fixture(autouse=True)
+def _jit_sanitizer_gate():
+    """Fail the test during which the jit sanitizer observed an implicit
+    device-to-host transfer inside a step region, or a retrace past the
+    budget, in the PROCESS-GLOBAL tracker (tests seeding deliberate
+    violations use private ``JitTracker`` instances, which this gate
+    never reads). In-budget retraces are telemetry, not failures — a
+    test legitimately calls the same wrapper with a handful of shapes."""
+    from tony_tpu.analysis import jit_sanitizer as _jit
+
+    if not _jit.enabled():
+        yield
+        return
+    tracker = _jit.tracker()
+    mark = tracker.mark()
+    yield
+    since = tracker.violations_since(mark)
+    bad = [
+        v for v in since
+        if v.get("kind") == _jit.GUARDED_TRANSFER or v.get("over_budget")
+    ]
+    if bad:
+        import json
+
+        pytest.fail(
+            "jit sanitizer observed dispatch violation(s):\n"
+            + json.dumps(bad, indent=2),
             pytrace=False,
         )

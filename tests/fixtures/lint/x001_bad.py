@@ -1,0 +1,19 @@
+"""TONY-X001 fixture: jit constructed per-iteration / per-call."""
+import jax
+
+
+def per_iteration(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)
+        out.append(f(x))
+    return out
+
+
+def immediate(x):
+    return jax.jit(lambda v: v + 1)(x)
+
+
+def once_and_discard(x):
+    g = jax.jit(lambda v: v - 1)
+    return g(x)

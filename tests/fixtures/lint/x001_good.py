@@ -1,0 +1,21 @@
+"""TONY-X001 clean: construct once, reuse across the loop, and a
+closure capture (reused across calls of the returned step fn)."""
+import jax
+
+_double = jax.jit(lambda v: v * 2)
+
+
+def steps(xs):
+    out = []
+    for x in xs:
+        out.append(_double(x))
+    return out
+
+
+def make_step():
+    jitted = jax.jit(lambda v: v + 1)
+
+    def step(x):
+        return jitted(x)
+
+    return step

@@ -1,0 +1,26 @@
+"""TONY-X002 fixture: host round-trips inside an instrumented step
+loop — direct cast, implicit bool branch, and a helper that syncs its
+argument (call-graph propagation)."""
+import jax
+
+_step = jax.jit(lambda s: s + 1)
+
+
+def train(state, steps):
+    for _ in range(steps):
+        state = _step(state)
+        loss = float(state)
+        if state > 0:
+            print(loss)
+    return state
+
+
+def log_metrics(metrics):
+    return float(metrics)
+
+
+def train_with_helper(state, steps):
+    for _ in range(steps):
+        state = _step(state)
+        log_metrics(state)
+    return state

@@ -1,0 +1,20 @@
+"""TONY-X003 fixture: retrace hazards — loop index and len() into
+non-static positions, weak float literal riding in a container."""
+import jax
+
+_f = jax.jit(lambda x, n: x * n)
+
+
+def loop_index(xs):
+    out = []
+    for i in range(8):
+        out.append(_f(xs, i))
+    return out
+
+
+def length(xs):
+    return _f(xs, len(xs))
+
+
+def weak_float(xs):
+    return _f(xs, {"scale": 0.5})

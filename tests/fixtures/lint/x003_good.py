@@ -1,0 +1,16 @@
+"""TONY-X003 clean: the varying scalar position is declared static, so
+each distinct value is a legitimate (cached) specialization."""
+import jax
+
+_f = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+
+def loop_index(xs):
+    out = []
+    for i in range(8):
+        out.append(_f(xs, i))
+    return out
+
+
+def fixed_scalar(xs):
+    return _f(xs, 4)

@@ -1,0 +1,11 @@
+"""TONY-X004 fixture: a donated buffer is read after the call that may
+have aliased its pages."""
+import jax
+
+_update = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+
+def step(state):
+    new = _update(state)
+    total = state.sum()
+    return new, total

@@ -1,0 +1,11 @@
+"""TONY-X004 clean: the donated name is rebound to the call's result,
+so nothing reads the stale buffer."""
+import jax
+
+_update = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+
+def step(state):
+    state = _update(state)
+    total = state.sum()
+    return state, total

@@ -1,0 +1,7 @@
+"""TONY-X005 fixture: in_shardings declared without out_shardings —
+outputs fall back to GSPMD's guess."""
+import jax
+
+
+def build(spec):
+    return jax.jit(lambda x: x * 2, in_shardings=(spec,))

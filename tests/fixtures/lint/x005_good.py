@@ -1,0 +1,8 @@
+"""TONY-X005 clean: both sides of the boundary pinned from the plan."""
+import jax
+
+
+def build(spec):
+    return jax.jit(
+        lambda x: x * 2, in_shardings=(spec,), out_shardings=(spec,)
+    )

@@ -1,0 +1,18 @@
+"""TONY-X006 fixture: PRNG key consumed twice, and consumed in a loop
+without a per-iteration split."""
+import jax
+
+
+def double_draw():
+    key = jax.random.key(0)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a, b
+
+
+def loop_draw(n):
+    key = jax.random.key(0)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (4,)))
+    return out

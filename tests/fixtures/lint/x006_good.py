@@ -1,0 +1,19 @@
+"""TONY-X006 clean: split per consumer, split per iteration."""
+import jax
+
+
+def fresh_draws():
+    key = jax.random.key(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (4,))
+    b = jax.random.uniform(kb, (4,))
+    return a, b
+
+
+def loop_draw(n):
+    key = jax.random.key(0)
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (4,)))
+    return out

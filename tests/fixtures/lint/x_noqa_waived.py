@@ -1,0 +1,16 @@
+"""Waiver fixture: both rule-id spellings suppress TONY-X findings."""
+import jax
+
+_step = jax.jit(lambda s: s + 1)
+
+
+def per_call(x):
+    return jax.jit(lambda v: v + 1)(x)  # tony: noqa[X001] — deliberate: fixture
+
+
+def train(state, steps):
+    for _ in range(steps):
+        state = _step(state)
+        loss = float(state)  # tony: noqa[TONY-X002] — deliberate: fixture
+        del loss
+    return state

@@ -1,0 +1,184 @@
+"""TONY-X dispatch-discipline lint: each rule against its bad/good
+fixture pair, waiver syntax, docs drift, and the single-module
+preflight entry point."""
+
+from pathlib import Path
+
+from tony_tpu.analysis.dispatch import (
+    ALL_RULES,
+    RULE_DONATION,
+    RULE_HOST_SYNC,
+    RULE_JIT_IN_LOOP,
+    RULE_KEY_REUSE,
+    RULE_RETRACE,
+    RULE_SHARDING,
+    check_dispatch,
+    check_rule_docs,
+    lint_dispatch_source,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIX = REPO / "tests" / "fixtures" / "lint"
+
+
+def run(name):
+    return check_dispatch([FIX / name])
+
+
+def rules(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+class TestJitInLoop:
+    def test_all_three_shapes_flagged(self):
+        findings = [f for f in run("x001_bad.py")
+                    if f.rule_id == RULE_JIT_IN_LOOP]
+        # In-loop construction, immediate invocation, and the
+        # construct-dispatch-once-discard local binding (anchored at
+        # the dispatch site).
+        assert len(findings) == 3
+        joined = " | ".join(f.message for f in findings)
+        assert "inside a loop" in joined
+        assert "one expression" in joined
+        assert "discarded" in joined
+
+    def test_module_binding_and_closure_capture_clean(self):
+        assert [f for f in run("x001_good.py")
+                if f.rule_id == RULE_JIT_IN_LOOP] == []
+
+
+class TestHostSync:
+    def test_cast_branch_and_helper_propagation_flagged(self):
+        findings = [f for f in run("x002_bad.py")
+                    if f.rule_id == RULE_HOST_SYNC]
+        assert len(findings) == 3
+        joined = " | ".join(f.message for f in findings)
+        assert "host cast" in joined
+        assert "implicit bool()" in joined
+        # Call-graph propagation: the helper that float()s its
+        # argument flags the CALL SITE inside the step loop.
+        assert "log_metrics" in joined
+
+    def test_post_loop_fence_clean(self):
+        assert [f for f in run("x002_good.py")
+                if f.rule_id == RULE_HOST_SYNC] == []
+
+
+class TestRetraceHazard:
+    def test_loop_index_len_and_weak_float_flagged(self):
+        findings = [f for f in run("x003_bad.py")
+                    if f.rule_id == RULE_RETRACE]
+        assert len(findings) == 3
+        joined = " | ".join(f.message for f in findings)
+        assert "loop index `i`" in joined
+        assert "len(...)" in joined
+        assert "weak-typed" in joined
+
+    def test_static_argnums_clean(self):
+        assert [f for f in run("x003_good.py")
+                if f.rule_id == RULE_RETRACE] == []
+
+    def test_data_iteration_is_not_a_loop_index(self):
+        # ``for batch in batches`` yields data, not Python ints — the
+        # X001 fixture dispatches its for-target and must not trip X003.
+        assert [f for f in run("x001_bad.py")
+                if f.rule_id == RULE_RETRACE] == []
+
+
+class TestDonation:
+    def test_read_after_donation_flagged(self):
+        findings = [f for f in run("x004_bad.py")
+                    if f.rule_id == RULE_DONATION]
+        assert len(findings) == 1
+        assert "donated" in findings[0].message
+
+    def test_rebound_result_clean(self):
+        assert [f for f in run("x004_good.py")
+                if f.rule_id == RULE_DONATION] == []
+
+
+class TestShardingDrift:
+    def test_in_without_out_flagged(self):
+        findings = [f for f in run("x005_bad.py")
+                    if f.rule_id == RULE_SHARDING]
+        assert len(findings) == 1
+        assert "out_shardings" in findings[0].message
+
+    def test_both_sides_clean(self):
+        assert [f for f in run("x005_good.py")
+                if f.rule_id == RULE_SHARDING] == []
+
+
+class TestKeyReuse:
+    def test_double_draw_and_loop_draw_flagged(self):
+        findings = [f for f in run("x006_bad.py")
+                    if f.rule_id == RULE_KEY_REUSE]
+        assert len(findings) == 2
+        joined = " | ".join(f.message for f in findings)
+        assert "reused here" in joined
+        assert "inside a loop" in joined
+
+    def test_split_per_consumer_clean(self):
+        assert [f for f in run("x006_good.py")
+                if f.rule_id == RULE_KEY_REUSE] == []
+
+
+class TestWaivers:
+    def test_both_spellings_suppress(self):
+        assert run("x_noqa_waived.py") == []
+
+    def test_unwaived_copy_still_fires(self, tmp_path):
+        src = (FIX / "x_noqa_waived.py").read_text()
+        stripped = "\n".join(
+            line.split("  # tony: noqa")[0] for line in src.splitlines()
+        )
+        (tmp_path / "m.py").write_text(stripped + "\n")
+        assert rules(check_dispatch([tmp_path])) == sorted(
+            [RULE_HOST_SYNC, RULE_JIT_IN_LOOP]
+        )
+
+
+class TestDocsDrift:
+    def test_repo_docs_cover_every_rule(self):
+        assert check_rule_docs(REPO / "docs" / "DEPLOY.md") == []
+
+    def test_missing_rows_reported(self, tmp_path):
+        doc = tmp_path / "DEPLOY.md"
+        doc.write_text("only TONY-X001 is documented here\n")
+        missing = check_rule_docs(doc)
+        assert sorted(f.rule_id for f in missing) == sorted(
+            r for r in ALL_RULES if r != "TONY-X001"
+        )
+
+
+class TestSingleModuleEntry:
+    def test_preflight_source_entry(self):
+        source = (FIX / "x001_bad.py").read_text()
+        findings = lint_dispatch_source(source, filename="submitted.py")
+        assert rules(findings) == [RULE_JIT_IN_LOOP]
+        assert all(f.file == "submitted.py" for f in findings)
+
+    def test_unparseable_source_is_script_lints_problem(self):
+        assert lint_dispatch_source("def broken(:\n") == []
+
+
+class TestCliDedup:
+    def test_dispatch_flag_does_not_double_report(self, capsys):
+        # Preflight lints each script's dispatch discipline AND
+        # --dispatch sweeps the same path: the merged report must carry
+        # each finding once.
+        from tony_tpu.client.cli import lint as cli_lint
+
+        rc = cli_lint(["--dispatch", str(FIX / "x004_bad.py")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert out.count("TONY-X004") == 1
+        assert "1 error(s)" in out
+
+
+class TestRepoIsClean:
+    def test_zero_unwaived_findings_in_tree(self):
+        roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+                 REPO / "bench.py"]
+        findings = check_dispatch(roots, docs=REPO / "docs" / "DEPLOY.md")
+        assert findings == [], "\n".join(f.render() for f in findings)

@@ -1,0 +1,112 @@
+"""Dispatch-count regression pins for the two hottest paths the TONY-X
+discipline protects: the lm_train steady-state step and the serving
+decode window. Both must be retrace-free after their cold compile and
+free of unannotated device-to-host transfers — the process-global jit
+tracker (armed suite-wide by conftest) is the witness.
+
+On the CPU backend jax's transfer guard cannot fire (arrays are
+host-resident), so the transfer half of these pins is plumbing-level
+here and bites on a real accelerator; the retrace half is fully real
+on any backend."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.analysis import jit_sanitizer
+from tony_tpu.models import TransformerConfig, init_params, make_train_step
+from tony_tpu.parallel.mesh import MeshSpec, build_mesh
+
+pytestmark = pytest.mark.skipif(
+    not jit_sanitizer.enabled(),
+    reason="jit sanitizer disarmed (TONY_JIT_SANITIZER=0)",
+)
+
+
+def _violations_during(mark):
+    tr = jit_sanitizer.tracker()
+    return tr.violations_since(mark)
+
+
+class TestLmTrainSteadyState:
+    def test_steady_state_step_is_retrace_free(self):
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+            d_ff=64, max_seq=32, dtype="float32", remat=False,
+        )
+        mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=1e-3)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (4, 17)), jnp.int32
+        )
+        tr = jit_sanitizer.tracker()
+        mark = tr.mark()
+        with jax.sharding.set_mesh(mesh):
+            state = init_fn(jax.random.key(0))
+            # Cold compile on the first step, then steady state: every
+            # later dispatch must classify as a pure cache hit.
+            for _ in range(4):
+                state, metrics = step_fn(state, tokens)
+        assert int(state.step) == 4
+        during = _violations_during(mark)
+        assert during == [], (
+            "lm_train step path dispatched dirty:\n"
+            + "\n".join(str(v) for v in during)
+        )
+
+    def test_shape_change_is_the_seeded_counterexample(self):
+        """The same harness MUST see a retrace when shapes drift —
+        proves the clean run above is a real measurement, not a dead
+        tracker. Seeded on a private tracker so the suite gate and the
+        bench gate never see the deliberate violation."""
+        from tony_tpu.parallel import plan as plan_lib
+
+        tr = jit_sanitizer.JitTracker(budget=4)
+        fn = jax.jit(lambda x: x * 2)
+        key = "seeded-shape-drift"
+        for batch in (4, 8):
+            x = jnp.zeros((batch, 3))
+            sig = "x".join(str(d) for d in x.shape)
+            jit_sanitizer.note_dispatch(key, sig, tracker_=tr)
+            fn(x)
+        assert tr.retraces(key) == 1
+        del plan_lib
+
+
+class TestServingDecodeWindow:
+    def test_decode_window_and_prefill_are_retrace_free(self):
+        from tony_tpu.serving import ServingEngine
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+            d_ff=64, max_seq=96, dtype="float32", remat=False,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        rng = np.random.default_rng(3)
+        tr = jit_sanitizer.tracker()
+        mark = tr.mark()
+        eng = ServingEngine(
+            params, cfg, slots=2, prefill_chunk=5, decode_window=4,
+            prefill_batch=2,
+        )
+        with eng:
+            reqs = [
+                eng.submit(rng.integers(0, 64, n).astype(np.int32), 5)
+                for n in (3, 7, 11)
+            ]
+            for r in reqs:
+                r.result(timeout=120)
+        # The padded prefill rounds and the fixed decode window pin
+        # every dispatch to ONE signature per key: cold once, hits
+        # forever — zero retraces in the whole serve.
+        decode_key = eng._decode.plan_cache_key
+        prefill_key = eng._prefill.plan_cache_key
+        assert tr.retraces(decode_key) == 0
+        assert tr.retraces(prefill_key) == 0
+        during = _violations_during(mark)
+        assert during == [], (
+            "serving dispatch path dirty:\n"
+            + "\n".join(str(v) for v in during)
+        )

@@ -1,0 +1,186 @@
+"""Runtime jit sanitizer: cold/hit/retrace classification, the retrace
+budget (report and strict modes), the step-region transfer guard, the
+flight-recorder-compatible dump, and the ``instrument_jit`` accounting
+split (compile-cache counters vs ``tony_retraces_total`` can never
+double-count one dispatch).
+
+Every test seeds a PRIVATE ``JitTracker`` for deliberate violations —
+the suite-wide conftest gate reads only the process-global tracker."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.analysis import jit_sanitizer
+from tony_tpu.analysis.jit_sanitizer import (
+    GUARDED_TRANSFER,
+    RETRACE,
+    JitTracker,
+    RetraceBudgetExceeded,
+    note_dispatch,
+    step_region,
+)
+
+
+class TestTrackerClassification:
+    def test_cold_then_hit_then_retrace(self):
+        tr = JitTracker(budget=4)
+        assert tr.note_call("k", "sig-a")[0] == "cold"
+        assert tr.note_call("k", "sig-a")[0] == "hit"
+        status, count, over = tr.note_call("k", "sig-b")
+        assert (status, count, over) == ("retrace", 1, False)
+        # Caught once per signature: replaying the retraced signature is
+        # a cache hit, not a second violation.
+        assert tr.note_call("k", "sig-b")[0] == "hit"
+        assert tr.retraces("k") == 1
+        assert len(tr.violations(RETRACE)) == 1
+
+    def test_keys_are_independent(self):
+        tr = JitTracker(budget=4)
+        tr.note_call("a", "s1")
+        assert tr.note_call("b", "s1")[0] == "cold"
+        assert tr.retraces() == 0
+
+    def test_budget_flags_over(self):
+        tr = JitTracker(budget=2)
+        tr.note_call("k", "s0")
+        overs = [tr.note_call("k", f"s{i}")[2] for i in (1, 2, 3)]
+        assert overs == [False, False, True]
+        violations = tr.violations(RETRACE)
+        assert [v["over_budget"] for v in violations] == overs
+        assert all(v["stack"] for v in violations)
+
+    def test_mark_and_violations_since(self):
+        tr = JitTracker(budget=4)
+        tr.note_call("k", "s0")
+        tr.note_call("k", "s1")
+        mark = tr.mark()
+        assert tr.violations_since(mark) == []
+        tr.note_call("k", "s2")
+        since = tr.violations_since(mark)
+        assert len(since) == 1 and since[0]["signature"] == "s2"
+
+
+class TestNoteDispatch:
+    def test_retrace_counts_metric_only_on_retrace(self):
+        from tony_tpu import observability
+
+        counter = observability.default_registry().counter(
+            jit_sanitizer.RETRACES_COUNTER
+        )
+        tr = JitTracker(budget=4)
+        base = counter.value
+        assert note_dispatch("nd-key", "s0", tracker_=tr) == "cold"
+        assert counter.value == base
+        assert note_dispatch("nd-key", "s0", tracker_=tr) == "hit"
+        assert counter.value == base
+        assert note_dispatch("nd-key", "s1", tracker_=tr) == "retrace"
+        assert counter.value == base + 1
+
+    def test_strict_raises_past_budget(self, monkeypatch):
+        monkeypatch.setenv(jit_sanitizer.ENV_FLAG, "strict")
+        tr = JitTracker(budget=1)
+        note_dispatch("strict-key", "s0", tracker_=tr)
+        note_dispatch("strict-key", "s1", tracker_=tr)  # within budget
+        with pytest.raises(RetraceBudgetExceeded, match="strict-key"):
+            note_dispatch("strict-key", "s2", tracker_=tr)
+
+    def test_report_mode_never_raises(self, monkeypatch):
+        monkeypatch.setenv(jit_sanitizer.ENV_FLAG, "1")
+        tr = JitTracker(budget=1)
+        for i in range(5):
+            note_dispatch("report-key", f"s{i}", tracker_=tr)
+        assert tr.retraces("report-key") == 4
+
+
+class TestStepRegion:
+    def test_implicit_transfer_raises_and_records_stack(self):
+        """The guard exception is recorded with a stack and re-raised.
+        On the CPU backend arrays are host-resident, so jax's
+        device-to-host guard never fires — the violation is seeded with
+        the exact exception shape the guard raises on an accelerator."""
+        tr = JitTracker()
+        with pytest.raises(RuntimeError, match="[Tt]ransfer"):
+            with step_region("guarded-key", tracker_=tr):
+                raise RuntimeError(
+                    "Disallowed device-to-host transfer: aval=f32[4]"
+                )
+        transfers = tr.violations(GUARDED_TRANSFER)
+        assert len(transfers) == 1
+        assert transfers[0]["key"] == "guarded-key"
+        assert transfers[0]["stack"], "violation must carry a stack"
+        assert tr.transfers() == 1
+
+    def test_explicit_device_get_is_the_annotated_fence(self):
+        tr = JitTracker()
+        x = jnp.arange(4)
+        with step_region("fence-key", tracker_=tr):
+            host = np.asarray(jax.device_get(x))
+        assert host.tolist() == [0, 1, 2, 3]
+        assert tr.violations(GUARDED_TRANSFER) == []
+
+    def test_disabled_is_a_noop(self, monkeypatch):
+        monkeypatch.setenv(jit_sanitizer.ENV_FLAG, "0")
+        tr = JitTracker()
+        x = jnp.arange(3)
+        with step_region("off-key", tracker_=tr):
+            assert np.asarray(x).shape == (3,)  # no guard armed
+        assert tr.violations() == []
+
+
+class TestDump:
+    def test_flight_recorder_compatible_envelope(self, tmp_path):
+        import os
+
+        from tony_tpu.observability import flight
+
+        tr = JitTracker(budget=1)
+        tr.note_call("dump-key", "s0")
+        tr.note_call("dump-key", "s1")
+        tr.note_transfer("disallowed device-to-host transfer", key="dump-key")
+        path = tr.dump(tmp_path, reason="unit-test")
+        assert path is not None
+        assert path.endswith(f"blackbox-jit-sanitizer-{os.getpid()}.json")
+        docs = flight.load_blackboxes(tmp_path)
+        assert len(docs) == 1
+        doc = next(iter(docs.values()))
+        assert doc["proc"] == "jit-sanitizer"
+        assert doc["reason"] == "unit-test"
+        assert doc["retraces"] == {"dump-key": 1}
+        assert doc["transfers"] == 1
+        kinds = sorted(e["kind"] for e in doc["events"])
+        assert kinds == [GUARDED_TRANSFER, RETRACE]
+        # The flight-reader envelope fields the postmortem tooling walks.
+        assert doc["reports"] == [] and doc["rpcs"] == []
+
+
+class TestInstrumentJitAccounting:
+    def test_cold_hit_retrace_never_double_count(self, tmp_path):
+        """One dispatch lands in exactly one accounting bucket: the cold
+        compile in ``tony_compile_cache_misses_total``, a retrace in
+        ``tony_retraces_total`` — never both."""
+        from tony_tpu import observability
+        from tony_tpu.parallel import plan as plan_lib
+
+        reg = observability.default_registry()
+        misses = reg.counter("tony_compile_cache_misses_total")
+        hits = reg.counter("tony_compile_cache_hits_total")
+        retraces = reg.counter(jit_sanitizer.RETRACES_COUNTER)
+
+        fn = plan_lib.instrument_jit(
+            jax.jit(lambda x: x * 2), "acct-test-key",
+            cache=plan_lib.CompileCache(str(tmp_path)),
+        )
+        m0, h0, r0 = misses.value, hits.value, retraces.value
+
+        fn(jnp.zeros((4,)))          # cold: compile-cache miss only
+        assert (misses.value, retraces.value) == (m0 + 1, r0)
+        fn(jnp.ones((4,)))           # same shape/dtype: pure cache hit
+        assert (misses.value, hits.value, retraces.value) == (
+            m0 + 1, h0, r0
+        )
+        fn(jnp.zeros((8,)))          # new shape: retrace, NOT a miss
+        assert (misses.value, retraces.value) == (m0 + 1, r0 + 1)
+        assert jit_sanitizer.tracker().retraces("acct-test-key") == 1

@@ -398,6 +398,30 @@ class TestBenchGate:
         assert bench.check_regressions({"io.overhead_pct": 3.9}, base) == []
         assert bench.check_regressions({"io.overhead_pct": 9.0}, base)
 
+    def test_zero_baseline_retrace_counter_gates_absolutely(self):
+        """`retraces_total` is lower-is-better, and its zero baseline is
+        absolute: ONE steady-state recompile fails --check (no threshold
+        to scale against). Zero-baseline higher-direction metrics keep
+        passing free — a drop from zero is unscalable noise."""
+        bench = _bench()
+        assert bench.metric_direction("transformer.retraces_total") == \
+            "lower"
+        base = {"transformer.retraces_total": 0.0}
+        assert bench.check_regressions(
+            {"transformer.retraces_total": 0.0}, base
+        ) == []
+        problems = bench.check_regressions(
+            {"transformer.retraces_total": 2.0}, base
+        )
+        assert len(problems) == 1 and "zero baseline" in problems[0]
+        assert bench.check_regressions({"x.mfu": 0.5}, {"x.mfu": 0.0}) == []
+
+    def test_retrace_baselines_seeded_for_hot_paths(self):
+        bench = _bench()
+        table = bench.load_baselines().get("TPU v5 lite", {})
+        for wl in ("transformer", "serving", "decode_gqa"):
+            assert table.get(f"{wl}.retraces_total") == 0
+
     def test_main_check_exit_codes(self, tmp_path):
         bench = _bench()
         baseline = str(FIXTURES / "baseline.json")

@@ -53,7 +53,8 @@ import ast
 from pathlib import Path
 
 from tony_tpu.analysis.findings import ERROR, WARNING, Finding
-from tony_tpu.analysis.script_lint import _Aliases, _noqa_map
+from tony_tpu.analysis.findings import apply_waivers as _apply_shared_waivers
+from tony_tpu.analysis.script_lint import _Aliases
 
 RULE_ORDER = "TONY-T001"
 RULE_BLOCKING = "TONY-T002"
@@ -936,27 +937,10 @@ def _collect_files(paths) -> list[Path]:
 def _apply_waivers(findings: list[Finding],
                    sources: dict[str, str]) -> list[Finding]:
     """Drop findings waived by an inline ``# tony: noqa[...]`` on their
-    line; both ``TONY-T001`` and the short ``T001`` spelling match."""
-    maps: dict[str, dict] = {}
-    kept: list[Finding] = []
-    for f in findings:
-        source = sources.get(f.file)
-        if source is None:
-            kept.append(f)
-            continue
-        noqa = maps.get(f.file)
-        if noqa is None:
-            noqa = maps[f.file] = _noqa_map(source)
-        rule_filter = noqa.get(f.line, ...)
-        if rule_filter is None:
-            continue
-        if rule_filter is not ... and (
-            f.rule_id.upper() in rule_filter
-            or f.rule_id.upper().replace("TONY-", "") in rule_filter
-        ):
-            continue
-        kept.append(f)
-    return kept
+    line; both ``TONY-T001`` and the short ``T001`` spelling match.
+    Delegates to the waiver engine shared by the S/T/X passes
+    (``analysis.findings.apply_waivers``)."""
+    return _apply_shared_waivers(findings, sources)
 
 
 def check_concurrency(paths, docs=None) -> list[Finding]:

@@ -1,0 +1,1274 @@
+"""TONY-X dispatch-discipline lint: the numerics-plane analog of the
+TONY-T concurrency pass.
+
+Every perf number the repo gates (bench r01–r05, serving, checkpoint)
+assumes the step path is dispatch-clean: jitted callables are built
+once and reused, nothing re-traces in steady state, and the only
+device→host round-trips are the intended, annotated fences. This pass
+checks those invariants statically, whole-program (the call graph is
+indexed across every linted module, like TONY-T's held-context
+analysis), import-free (sources are parsed, never executed).
+
+Rules:
+
+=========  =======  ======================================================
+TONY-X001  error    ``jax.jit``/``pjit``/``shard_map`` constructed inside
+                    a loop or per-call in a function body (built, invoked
+                    once, discarded): every evaluation traces and
+                    compiles from scratch — nothing is cached.
+TONY-X002  warning  host round-trip on a step-path value inside an
+                    instrumented step loop: ``float()``/``int()``/
+                    ``bool()``, ``.item()``, ``np.asarray``,
+                    ``jax.device_get``, or implicit ``bool()`` branching
+                    on a value produced by a jitted dispatch — each one
+                    stalls the dispatch pipeline. Propagated through the
+                    call graph: a helper that syncs its argument flags
+                    the call site passing it a device value. Intended
+                    fences carry ``# tony: noqa[TONY-X002]``.
+TONY-X003  warning  retrace hazard at a jitted call site: a Python loop
+                    index or ``len()`` flows into an argument position
+                    not marked static (every new value re-traces), or a
+                    weak-typed Python float literal rides inside a
+                    container argument (weak-type promotion splits the
+                    trace cache).
+TONY-X004  error    donation violation: a buffer passed in a
+                    ``donate_argnums`` position is read again after the
+                    call — the callee may already have aliased its pages.
+TONY-X005  warning  sharding annotation drift across a pjit boundary:
+                    ``in_shardings`` given without ``out_shardings``
+                    where the Plan layer supplies the mesh — outputs
+                    fall back to GSPMD's guess and the next dispatch
+                    re-shards.
+TONY-X006  error    PRNG key reuse across dispatches: the same key
+                    consumed by two samplers (or by a sampler inside a
+                    loop) without an intervening ``split``/``fold_in`` —
+                    identical randomness where fresh draws were intended.
+=========  =======  ======================================================
+
+A finding on line L is waived by ``# tony: noqa[TONY-X00n]`` (or the
+short ``X00n`` spelling) on that line — same engine as the S/T rules
+(``analysis.findings``).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tony_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    apply_waivers,
+)
+from tony_tpu.analysis.script_lint import _Aliases
+
+RULE_JIT_IN_LOOP = "TONY-X001"
+RULE_HOST_SYNC = "TONY-X002"
+RULE_RETRACE = "TONY-X003"
+RULE_DONATION = "TONY-X004"
+RULE_SHARDING = "TONY-X005"
+RULE_KEY_REUSE = "TONY-X006"
+
+ALL_RULES = (RULE_JIT_IN_LOOP, RULE_HOST_SYNC, RULE_RETRACE,
+             RULE_DONATION, RULE_SHARDING, RULE_KEY_REUSE)
+
+# Callables that CONSTRUCT a jitted dispatcher.
+_JIT_CONSTRUCTORS = (
+    "jax.jit", "jax.pjit", "jit", "pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.shard_map", "shard_map",
+    "jax.experimental.shard_map.shard_map",
+)
+# Callables that WRAP an existing dispatcher and return it (the plan
+# layer's compile instrumentation). Matched by trailing name so both
+# ``instrument_jit`` and ``plan_lib.instrument_jit`` hit.
+_WRAP_TAILS = ("instrument_jit",)
+# Host-sync callables (device -> host readback).
+_NUMPY_SYNCS = ("numpy.asarray", "numpy.array")
+_DEVICE_GET = ("jax.device_get",)
+_CAST_SYNCS = ("float", "int", "bool")
+# PRNG key sources and consumers.
+_KEY_SOURCES = ("jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+                "jax.random.fold_in")
+_SAMPLER_PREFIX = "jax.random."
+_NON_CONSUMING = ("jax.random.split", "jax.random.fold_in",
+                  "jax.random.PRNGKey", "jax.random.key",
+                  "jax.random.key_data", "jax.random.wrap_key_data")
+
+
+def _is_jit_construction(call: ast.AST, aliases: _Aliases) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    dotted = aliases.resolve(call.func)
+    return dotted in _JIT_CONSTRUCTORS
+
+
+def _is_wrap_call(call: ast.AST, aliases: _Aliases) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    dotted = aliases.resolve(call.func)
+    return bool(dotted) and dotted.rsplit(".", 1)[-1] in _WRAP_TAILS
+
+
+def _extract_construction(expr: ast.AST,
+                          aliases: _Aliases) -> ast.Call | None:
+    """The jit-construction Call inside ``expr``: the expression itself,
+    or the first argument of a wrap call (``instrument_jit(jax.jit(...),
+    key)``)."""
+    if _is_jit_construction(expr, aliases):
+        return expr
+    if _is_wrap_call(expr, aliases) and expr.args:
+        inner = expr.args[0]
+        if _is_jit_construction(inner, aliases):
+            return inner
+    return None
+
+
+def _const_tuple(node: ast.AST) -> tuple | None:
+    """Literal value of an int/str constant or tuple of them."""
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not isinstance(elt, ast.Constant):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _static_positions(ctor: ast.Call | None) -> tuple[set, set]:
+    """(static positional indices, static argument names) declared on a
+    jit construction; empty sets when unknown."""
+    nums: set = set()
+    names: set = set()
+    if ctor is None:
+        return nums, names
+    for kw in ctor.keywords:
+        if kw.arg == "static_argnums":
+            vals = _const_tuple(kw.value)
+            if vals:
+                nums.update(v for v in vals if isinstance(v, int))
+        elif kw.arg == "static_argnames":
+            vals = _const_tuple(kw.value)
+            if vals:
+                names.update(v for v in vals if isinstance(v, str))
+    return nums, names
+
+
+def _donated_positions(ctor: ast.Call | None) -> set:
+    out: set = set()
+    if ctor is None:
+        return out
+    for kw in ctor.keywords:
+        if kw.arg == "donate_argnums":
+            vals = _const_tuple(kw.value)
+            if vals:
+                out.update(v for v in vals if isinstance(v, int))
+    return out
+
+
+def _name_targets(target: ast.AST) -> list[ast.AST]:
+    """Flatten an assignment target into its Name/Attribute leaves."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for elt in target.elts:
+            out.extend(_name_targets(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _name_targets(target.value)
+    if isinstance(target, (ast.Name, ast.Attribute)):
+        return [target]
+    return []
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'attr' when node is ``self.attr``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _flatten_stmts(body: list) -> list[ast.stmt]:
+    """Document-order statement list with compound bodies inlined
+    (the compound header stays in the list before its body). Nested
+    function/class defs are NOT descended into — they are their own
+    scopes."""
+    out: list[ast.stmt] = []
+    for stmt in body:
+        out.append(stmt)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            out.extend(_flatten_stmts(getattr(stmt, field, []) or []))
+        for handler in getattr(stmt, "handlers", []) or []:
+            out.extend(_flatten_stmts(handler.body))
+    return out
+
+
+def _own_nodes(stmt: ast.stmt):
+    """ast.walk over a statement, not descending into nested defs or
+    compound sub-statements (those appear separately in the flat list)."""
+    skip_bodies = isinstance(
+        stmt, (ast.For, ast.AsyncFor, ast.While, ast.If, ast.With,
+               ast.AsyncWith, ast.Try)
+    )
+    if not skip_bodies:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)) \
+                    and node is not stmt:
+                continue
+            yield node
+        return
+    # Compound header only: iterator/test/items expressions.
+    headers: list[ast.AST] = []
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        headers = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.While, ast.If)):
+        headers = [stmt.test]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        headers = [i.context_expr for i in stmt.items]
+    for h in headers:
+        yield from ast.walk(h)
+
+
+class _Func:
+    """One function/method scope plus its fixpoint facts."""
+
+    def __init__(self, node, module: "_Module", cls: "_Class | None" = None,
+                 parent: "_Func | None" = None) -> None:
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.parent = parent
+        self.qualname = (f"{cls.name}.{node.name}" if cls else node.name)
+        # Bindings discovered each fixpoint round.
+        self.jit_names: dict[str, ast.Call | None] = {}
+        self.dispatcher_names: set[str] = set()
+        self.device_names: set[str] = set()
+        self.var_types: dict[str, str] = {}
+        self.key_names: set[str] = set()
+        self.nested: dict[str, "_Func"] = {}
+        # Facts.
+        self.dispatches = False
+        self.returns_dispatcher = False
+        self.syncs_param = False
+
+    @property
+    def params(self) -> set[str]:
+        a = self.node.args
+        names = [p.arg for p in
+                 (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return {n for n in names if n != "self"}
+
+
+class _Class:
+    def __init__(self, node: ast.ClassDef, module: "_Module") -> None:
+        self.node = node
+        self.name = node.name
+        self.module = module
+        self.methods: dict[str, _Func] = {}
+        self.attr_jit: dict[str, ast.Call | None] = {}
+        self.attr_dispatchers: set[str] = set()
+        self.attr_device: set[str] = set()
+
+
+class _Module:
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.aliases = _Aliases(tree)
+        self.funcs: dict[str, _Func] = {}
+        self.classes: dict[str, _Class] = {}
+        self.module_jit: dict[str, ast.Call | None] = {}
+        self.module_dispatchers: set[str] = set()
+        self.touches_jax = self.aliases.imports("jax")
+
+
+class DispatchAnalyzer:
+    """Whole-program TONY-X pass over parsed modules."""
+
+    def __init__(self, modules: list[tuple[Path, str, ast.Module]]) -> None:
+        self.modules = [
+            _Module(str(p), src, tree) for p, src, tree in modules
+        ]
+        self.findings: list[Finding] = []
+        # Global indexes (by unambiguous trailing name, TONY-T style).
+        self.func_index: dict[str, list[_Func]] = {}
+        self.class_index: dict[str, list[_Class]] = {}
+        self._collect_scopes()
+
+    # -- scope harvest -----------------------------------------------------
+    def _collect_scopes(self) -> None:
+        for mod in self.modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _Func(stmt, mod)
+                    mod.funcs[stmt.name] = fn
+                    self.func_index.setdefault(stmt.name, []).append(fn)
+                    self._collect_nested(fn)
+                elif isinstance(stmt, ast.ClassDef):
+                    cls = _Class(stmt, mod)
+                    mod.classes[stmt.name] = cls
+                    self.class_index.setdefault(cls.name, []).append(cls)
+                    for sub in stmt.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            m = _Func(sub, mod, cls=cls)
+                            cls.methods[sub.name] = m
+                            self._collect_nested(m)
+
+    def _collect_nested(self, fn: _Func) -> None:
+        for stmt in _flatten_stmts(fn.node.body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not fn.node:
+                sub = _Func(stmt, fn.module, cls=fn.cls, parent=fn)
+                fn.nested[stmt.name] = sub
+                self._collect_nested(sub)
+
+    def _all_funcs(self):
+        for mod in self.modules:
+            stack = list(mod.funcs.values())
+            for cls in mod.classes.values():
+                stack.extend(cls.methods.values())
+            while stack:
+                fn = stack.pop()
+                yield fn
+                stack.extend(fn.nested.values())
+
+    # -- resolution --------------------------------------------------------
+    def _lookup_unique(self, index: dict, name: str):
+        hits = index.get(name, [])
+        return hits[0] if len(hits) == 1 else None
+
+    def _scope_chain(self, fn: _Func):
+        cur = fn
+        while cur is not None:
+            yield cur
+            cur = cur.parent
+
+    def _jit_binding(self, fn: _Func, name: str):
+        """(found, construction) for a name bound to a jit wrapper in the
+        scope chain (locals, enclosing functions, module globals, or an
+        imported jit-decorated def in another linted module)."""
+        for scope in self._scope_chain(fn):
+            if name in scope.jit_names:
+                return True, scope.jit_names[name]
+            if name in scope.dispatcher_names:
+                return True, None
+        mod = fn.module
+        if name in mod.module_jit:
+            return True, mod.module_jit[name]
+        if name in mod.module_dispatchers:
+            return True, None
+        dotted = mod.aliases.resolve(ast.Name(id=name))
+        if dotted and "." in dotted:
+            found, ctor = self._module_jit_lookup(dotted.rsplit(".", 1)[-1])
+            if found:
+                return True, ctor
+        return False, None
+
+    def _module_jit_lookup(self, tail: str):
+        """(found, construction) for an unambiguous module-level jit
+        binding/decorated def anywhere in the program (TONY-T-style
+        trailing-name resolution)."""
+        hits = [mod.module_jit[tail] for mod in self.modules
+                if tail in mod.module_jit]
+        if len(hits) == 1:
+            return True, hits[0]
+        return False, None
+
+    def _resolve_func(self, fn: _Func, name: str) -> "_Func | None":
+        for scope in self._scope_chain(fn):
+            if name in scope.nested:
+                return scope.nested[name]
+        if name in fn.module.funcs:
+            return fn.module.funcs[name]
+        # Imported / global: unambiguous trailing name across the program.
+        dotted = fn.module.aliases.resolve(ast.Name(id=name))
+        tail = dotted.rsplit(".", 1)[-1] if dotted else name
+        return self._lookup_unique(self.func_index, tail)
+
+    def _resolve_call(self, call: ast.Call, fn: _Func):
+        """Classify a call site. Returns (kind, payload):
+        'dispatch'  -> payload is the construction Call or None
+        'func'      -> payload is the resolved _Func
+        (None, None) when unresolvable."""
+        target = call.func
+        if isinstance(target, ast.Name):
+            found, ctor = self._jit_binding(fn, target.id)
+            if found:
+                return "dispatch", ctor
+            callee = self._resolve_func(fn, target.id)
+            if callee is not None:
+                return "func", callee
+            return None, None
+        attr = _self_attr(target)
+        if attr is not None and fn.cls is not None:
+            if attr in fn.cls.attr_jit:
+                return "dispatch", fn.cls.attr_jit[attr]
+            if attr in fn.cls.attr_dispatchers:
+                return "dispatch", None
+            if attr in fn.cls.methods:
+                return "func", fn.cls.methods[attr]
+            return None, None
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name):
+            base = target.value.id
+            # Typed local: var = ClassName(...); var.method()
+            for scope in self._scope_chain(fn):
+                if base in scope.var_types:
+                    cls = self._lookup_unique(
+                        self.class_index, scope.var_types[base]
+                    )
+                    if cls is not None and target.attr in cls.methods:
+                        return "func", cls.methods[target.attr]
+                    return None, None
+            # module.function() — a jit-decorated def elsewhere in the
+            # program is a dispatcher; anything else is a plain callee.
+            dotted = fn.module.aliases.resolve(target)
+            if dotted:
+                tail = dotted.rsplit(".", 1)[-1]
+                found, ctor = self._module_jit_lookup(tail)
+                if found:
+                    return "dispatch", ctor
+                callee = self._lookup_unique(self.func_index, tail)
+                if callee is not None:
+                    return "func", callee
+        return None, None
+
+    # -- expression classification ----------------------------------------
+    def _sync_kind(self, call: ast.Call, mod: _Module) -> str | None:
+        """'cast' | 'numpy' | 'device_get' | 'item' for host-sync calls."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in _CAST_SYNCS and call.args:
+            return "cast"
+        dotted = mod.aliases.resolve(f)
+        if dotted in _NUMPY_SYNCS:
+            return "numpy"
+        if dotted in _DEVICE_GET:
+            return "device_get"
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not call.args:
+            return "item"
+        return None
+
+    def _mentions_device(self, expr: ast.AST, fn: _Func) -> str | None:
+        """Name of the first step-path (device) value ``expr`` touches."""
+        device_attrs = fn.cls.attr_device if fn.cls else set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name):
+                for scope in self._scope_chain(fn):
+                    if node.id in scope.device_names:
+                        return node.id
+            attr = _self_attr(node)
+            if attr is not None and attr in device_attrs:
+                return f"self.{attr}"
+        return None
+
+    _CONCRETIZING_CMP = (ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt,
+                         ast.GtE)
+
+    def _truthy_device(self, test: ast.AST, fn: _Func) -> str | None:
+        """Device value whose truthiness the branch forces to host.
+        Only positions that concretize count: a bare device value,
+        ``not``/``and``/``or`` over one, or an ordering/equality compare
+        with a device operand. ``is (not)``/``(not) in`` tests and call
+        results stay host-side decisions."""
+        if isinstance(test, ast.BoolOp):
+            for value in test.values:
+                dev = self._truthy_device(value, fn)
+                if dev is not None:
+                    return dev
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._truthy_device(test.operand, fn)
+        if isinstance(test, (ast.Name, ast.Attribute, ast.Subscript)):
+            return self._mentions_device(test, fn)
+        if isinstance(test, ast.Compare):
+            if not all(isinstance(op, self._CONCRETIZING_CMP)
+                       for op in test.ops):
+                return None
+            for operand in [test.left, *test.comparators]:
+                if isinstance(operand,
+                              (ast.Name, ast.Attribute, ast.Subscript)):
+                    dev = self._mentions_device(operand, fn)
+                    if dev is not None:
+                        return dev
+        return None
+
+    def _is_dispatcherish(self, expr: ast.AST, fn: _Func) -> bool:
+        """Does ``expr`` evaluate to a jitted dispatcher?"""
+        if _extract_construction(expr, fn.module.aliases) is not None:
+            return True
+        if isinstance(expr, ast.Name):
+            found, _ = self._jit_binding(fn, expr.id)
+            return found
+        attr = _self_attr(expr)
+        if attr is not None and fn.cls is not None:
+            return (attr in fn.cls.attr_jit
+                    or attr in fn.cls.attr_dispatchers)
+        if isinstance(expr, ast.Attribute):
+            # module.jitted_def referenced as a value (e.g. handed to
+            # functools.partial or instrument_jit).
+            dotted = fn.module.aliases.resolve(expr)
+            if dotted and "." in dotted:
+                found, _ = self._module_jit_lookup(dotted.rsplit(".", 1)[-1])
+                return found
+        if isinstance(expr, ast.Call):
+            kind, payload = self._resolve_call(expr, fn)
+            if kind == "func" and payload.returns_dispatcher:
+                return True
+            # Wrapper pattern: a call that is handed a dispatcher returns
+            # something that dispatches (``_instrumented(step, stats)``).
+            return any(self._is_dispatcherish(a, fn) for a in expr.args)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._is_dispatcherish(e, fn) for e in expr.elts)
+        return False
+
+    # -- fixpoint ----------------------------------------------------------
+    def run(self) -> list[Finding]:
+        for _ in range(8):
+            if not self._fixpoint_round():
+                break
+        for mod in self.modules:
+            if mod.touches_jax:
+                self._check_module(mod)
+        return self._dedup(self.findings)
+
+    def _fixpoint_round(self) -> bool:
+        changed = False
+        for mod in self.modules:
+            changed |= self._harvest_module_scope(mod)
+        for fn in self._all_funcs():
+            changed |= self._harvest_func(fn)
+        for fn in self._all_funcs():
+            changed |= self._eval_facts(fn)
+        return changed
+
+    def _harvest_module_scope(self, mod: _Module) -> bool:
+        changed = False
+        for stmt in mod.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._jit_decorated(stmt, mod.aliases) \
+                        and stmt.name not in mod.module_jit:
+                    mod.module_jit[stmt.name] = None
+                    changed = True
+                continue
+            if not isinstance(stmt, ast.Assign):
+                continue
+            ctor = _extract_construction(stmt.value, mod.aliases)
+            if ctor is not None:
+                for t in _name_targets(stmt.targets[0]):
+                    if isinstance(t, ast.Name) \
+                            and t.id not in mod.module_jit:
+                        mod.module_jit[t.id] = ctor
+                        changed = True
+        return changed
+
+    def _jit_decorated(self, node, aliases: _Aliases) -> bool:
+        for dec in node.decorator_list:
+            if aliases.resolve(dec) in _JIT_CONSTRUCTORS:
+                return True
+            if isinstance(dec, ast.Call):
+                dotted = aliases.resolve(dec.func)
+                if dotted in _JIT_CONSTRUCTORS:
+                    return True
+                if dotted in ("functools.partial", "partial") and dec.args \
+                        and aliases.resolve(dec.args[0]) in _JIT_CONSTRUCTORS:
+                    return True
+        return False
+
+    def _harvest_func(self, fn: _Func) -> bool:
+        changed = False
+        aliases = fn.module.aliases
+
+        def add(container, key, value=None, is_set=False):
+            nonlocal changed
+            if is_set:
+                if key not in container:
+                    container.add(key)
+                    changed = True
+            elif key not in container:
+                container[key] = value
+                changed = True
+
+        for name, sub in fn.nested.items():
+            if self._jit_decorated(sub.node, aliases):
+                add(fn.jit_names, name, None)
+        for stmt in _flatten_stmts(fn.node.body):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            rhs = stmt.value
+            targets = _name_targets(stmt.targets[0])
+            ctor = _extract_construction(rhs, aliases)
+            if ctor is not None or self._is_dispatcherish(rhs, fn):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        add(fn.jit_names, t.id, ctor)
+                    else:
+                        attr = _self_attr(t)
+                        if attr is not None and fn.cls is not None:
+                            add(fn.cls.attr_jit, attr, ctor)
+                continue
+            if isinstance(rhs, ast.Call):
+                kind, payload = self._resolve_call(rhs, fn)
+                if kind == "func" and payload.returns_dispatcher:
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            add(fn.dispatcher_names, t.id, is_set=True)
+                        else:
+                            attr = _self_attr(t)
+                            if attr is not None and fn.cls is not None:
+                                add(fn.cls.attr_dispatchers, attr,
+                                    is_set=True)
+                    continue
+                if kind == "dispatch":
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            add(fn.device_names, t.id, is_set=True)
+                        else:
+                            attr = _self_attr(t)
+                            if attr is not None and fn.cls is not None:
+                                add(fn.cls.attr_device, attr, is_set=True)
+                    continue
+                dotted = aliases.resolve(rhs.func)
+                if dotted in _KEY_SOURCES:
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            add(fn.key_names, t.id, is_set=True)
+                    continue
+                # Typed local for cross-class method resolution.
+                if isinstance(rhs.func, (ast.Name, ast.Attribute)):
+                    tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+                    if tail and tail[:1].isupper() \
+                            and tail in self.class_index:
+                        for t in targets:
+                            if isinstance(t, ast.Name) \
+                                    and t.id not in fn.var_types:
+                                fn.var_types[t.id] = tail
+                                changed = True
+        return changed
+
+    def _eval_facts(self, fn: _Func) -> bool:
+        changed = False
+        # dispatches: body performs a jitted dispatch, transitively.
+        if not fn.dispatches:
+            for stmt in _flatten_stmts(fn.node.body):
+                for node in _own_nodes(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kind, payload = self._resolve_call(node, fn)
+                    if kind == "dispatch" or (
+                            kind == "func" and payload.dispatches):
+                        fn.dispatches = True
+                        changed = True
+                        break
+                if fn.dispatches:
+                    break
+        # returns_dispatcher
+        if not fn.returns_dispatcher:
+            for stmt in _flatten_stmts(fn.node.body):
+                if isinstance(stmt, ast.Return) and stmt.value is not None \
+                        and self._is_dispatcherish(stmt.value, fn):
+                    fn.returns_dispatcher = True
+                    changed = True
+                    break
+        # syncs_param: host-syncs a value derived from its own parameters.
+        if not fn.syncs_param:
+            tainted = set(fn.params)
+            for _ in range(3):
+                grew = False
+                for stmt in _flatten_stmts(fn.node.body):
+                    if not isinstance(stmt, ast.Assign):
+                        continue
+                    if any(isinstance(n, ast.Name) and n.id in tainted
+                           for n in ast.walk(stmt.value)):
+                        for t in _name_targets(stmt.targets[0]):
+                            if isinstance(t, ast.Name) \
+                                    and t.id not in tainted:
+                                tainted.add(t.id)
+                                grew = True
+                if not grew:
+                    break
+            for stmt in _flatten_stmts(fn.node.body):
+                for node in _own_nodes(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    touches = any(
+                        isinstance(n, ast.Name) and n.id in tainted
+                        for a in node.args for n in ast.walk(a)
+                    )
+                    if not touches:
+                        continue
+                    if self._sync_kind(node, fn.module) is not None:
+                        fn.syncs_param = True
+                    else:
+                        kind, payload = self._resolve_call(node, fn)
+                        if kind == "func" and payload.syncs_param:
+                            fn.syncs_param = True
+                    if fn.syncs_param:
+                        changed = True
+                        break
+                if fn.syncs_param:
+                    break
+        return changed
+
+    # -- rule walks --------------------------------------------------------
+    def _emit(self, rule: str, severity: str, mod: _Module, node,
+              message: str, suggestion: str = "") -> None:
+        self.findings.append(Finding(
+            rule, severity, message, file=mod.path,
+            line=getattr(node, "lineno", 0), suggestion=suggestion,
+        ))
+
+    def _dedup(self, findings: list[Finding]) -> list[Finding]:
+        seen = set()
+        out = []
+        for f in findings:
+            k = (f.rule_id, f.file, f.line)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out
+
+    def _check_module(self, mod: _Module) -> None:
+        self._check_x001_module(mod)
+        self._check_x005(mod)
+        funcs = []
+        stack = list(mod.funcs.values())
+        for cls in mod.classes.values():
+            stack.extend(cls.methods.values())
+        while stack:
+            fn = stack.pop()
+            funcs.append(fn)
+            stack.extend(fn.nested.values())
+        for fn in funcs:
+            self._check_x001_func(fn)
+            self._check_x003_x004(fn)
+            self._check_x006(fn)
+        self._check_x002(mod, funcs)
+
+    # X001 ------------------------------------------------------------------
+    def _check_x001_module(self, mod: _Module) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, (ast.For, ast.While)):
+                for node in ast.walk(stmt):
+                    if _is_jit_construction(node, mod.aliases):
+                        self._emit(
+                            RULE_JIT_IN_LOOP, ERROR, mod, node,
+                            "jit/pjit/shard_map constructed inside a loop "
+                            "— every iteration traces and compiles from "
+                            "scratch",
+                            suggestion="construct the jitted callable "
+                            "once, before the loop, and reuse it",
+                        )
+
+    def _check_x001_func(self, fn: _Func) -> None:
+        mod = fn.module
+        flat = _flatten_stmts(fn.node.body)
+        in_loop: set[int] = set()
+        for stmt in flat:
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                for field in ("body", "orelse"):
+                    for sub in _flatten_stmts(getattr(stmt, field, [])):
+                        in_loop.add(id(sub))
+        for stmt in flat:
+            for node in _own_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_jit_construction(node, mod.aliases):
+                    if id(stmt) in in_loop:
+                        self._emit(
+                            RULE_JIT_IN_LOOP, ERROR, mod, node,
+                            f"jit constructed inside a loop in "
+                            f"`{fn.qualname}` — every iteration traces "
+                            f"and compiles from scratch",
+                            suggestion="hoist the construction out of "
+                            "the loop",
+                        )
+                        continue
+                # Immediate invocation: jax.jit(f)(args) builds a fresh
+                # wrapper per evaluation — nothing caches.
+                if isinstance(node.func, ast.Call) \
+                        and _is_jit_construction(node.func, mod.aliases):
+                    self._emit(
+                        RULE_JIT_IN_LOOP, ERROR, mod, node,
+                        f"jit constructed and invoked in one expression "
+                        f"in `{fn.qualname}` — the wrapper is rebuilt "
+                        f"(and re-traced) on every call of the enclosing "
+                        f"function",
+                        suggestion="bind the jitted callable once at "
+                        "module/builder scope and reuse it",
+                    )
+        # Construct-dispatch-once-discard: a local jit binding whose only
+        # use is a single non-loop call — per-call construction in
+        # disguise.
+        closure_names: set[str] = set()
+        for sub in fn.nested.values():
+            for node in ast.walk(sub.node):
+                if isinstance(node, ast.Name):
+                    closure_names.add(node.id)
+        for name, ctor in fn.jit_names.items():
+            if ctor is None:
+                continue
+            if name in closure_names:
+                continue   # captured by a nested def: reused across calls
+            binding_stmt = None
+            loads = []
+            for stmt in flat:
+                for node in _own_nodes(stmt):
+                    if isinstance(node, ast.Name) and node.id == name:
+                        if isinstance(node.ctx, ast.Store):
+                            binding_stmt = stmt
+                        else:
+                            loads.append((stmt, node))
+            if binding_stmt is None or id(binding_stmt) in in_loop:
+                continue
+            call_sites = []
+            escaped = False
+            for stmt, node in loads:
+                parent_call = next(
+                    (c for c in _own_nodes(stmt)
+                     if isinstance(c, ast.Call) and c.func is node), None
+                )
+                if parent_call is None:
+                    escaped = True
+                    break
+                call_sites.append((stmt, parent_call))
+            if escaped or len(call_sites) != 1:
+                continue
+            stmt, site = call_sites[0]
+            if id(stmt) not in in_loop:
+                self._emit(
+                    RULE_JIT_IN_LOOP, ERROR, mod, site,
+                    f"`{name}` is jit-constructed, dispatched once and "
+                    f"discarded inside `{fn.qualname}` — every call of "
+                    f"the function compiles from scratch",
+                    suggestion="construct once at module/builder scope "
+                    "(or cache by configuration) and reuse",
+                )
+
+    # X002 ------------------------------------------------------------------
+    def _check_x002(self, mod: _Module, funcs: list[_Func]) -> None:
+        checked: set[int] = set()
+        worklist: list[_Func] = []
+
+        def flag_sync(fn: _Func, node: ast.Call, dev: str,
+                      kind: str) -> None:
+            what = {"cast": "host cast", "numpy": "np.asarray readback",
+                    "device_get": "jax.device_get readback",
+                    "item": ".item() readback"}[kind]
+            self._emit(
+                RULE_HOST_SYNC, WARNING, fn.module, node,
+                f"{what} of step-path value `{dev}` inside an "
+                f"instrumented step loop (`{fn.qualname}`) — stalls the "
+                f"dispatch pipeline every iteration",
+                suggestion="move the readback outside the loop, or mark "
+                "the intended fence with `# tony: noqa[TONY-X002]`",
+            )
+
+        def check_region(fn: _Func, stmts: list[ast.stmt]) -> None:
+            for stmt in stmts:
+                for node in _own_nodes(stmt):
+                    if isinstance(node, ast.Call):
+                        kind = self._sync_kind(node, fn.module)
+                        if kind is not None:
+                            args = node.args if kind != "item" \
+                                else [node.func.value]
+                            for a in args:
+                                dev = self._mentions_device(a, fn)
+                                if dev is not None:
+                                    flag_sync(fn, node, dev, kind)
+                                    break
+                            continue
+                        rkind, payload = self._resolve_call(node, fn)
+                        if rkind == "func":
+                            if payload.syncs_param:
+                                dev = next(
+                                    (d for d in (
+                                        self._mentions_device(a, fn)
+                                        for a in node.args
+                                    ) if d), None)
+                                if dev is not None:
+                                    self._emit(
+                                        RULE_HOST_SYNC, WARNING, fn.module,
+                                        node,
+                                        f"step-path value `{dev}` flows "
+                                        f"into `{payload.qualname}`, "
+                                        f"which host-syncs its argument "
+                                        f"— a hidden device round-trip "
+                                        f"inside the step loop "
+                                        f"(`{fn.qualname}`)",
+                                        suggestion="sync once at an "
+                                        "annotated fence, or waive the "
+                                        "intended sync point with "
+                                        "`# tony: noqa[TONY-X002]`",
+                                    )
+                            if id(payload) not in checked:
+                                checked.add(id(payload))
+                                worklist.append(payload)
+                # Implicit bool: branching on a device value concretizes
+                # it (one D2H per iteration).
+                test = None
+                if isinstance(stmt, (ast.If, ast.While)):
+                    test = stmt.test
+                if test is not None:
+                    dev = self._truthy_device(test, fn)
+                    if dev is not None:
+                        self._emit(
+                            RULE_HOST_SYNC, WARNING, fn.module, test,
+                            f"branching on step-path value `{dev}` inside "
+                            f"an instrumented step loop "
+                            f"(`{fn.qualname}`) — the implicit bool() "
+                            f"forces a device round-trip per iteration",
+                            suggestion="hoist the condition to a host "
+                            "value, or mark the intended fence with "
+                            "`# tony: noqa[TONY-X002]`",
+                        )
+
+        # Seed: loops whose body dispatches (directly or transitively).
+        for fn in funcs:
+            flat = _flatten_stmts(fn.node.body)
+            for stmt in flat:
+                if not isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                body = _flatten_stmts(stmt.body)
+                steps = False
+                for sub in body:
+                    for node in _own_nodes(sub):
+                        if isinstance(node, ast.Call):
+                            kind, payload = self._resolve_call(node, fn)
+                            if kind == "dispatch" or (
+                                    kind == "func" and payload.dispatches):
+                                steps = True
+                                break
+                    if steps:
+                        break
+                if not steps:
+                    continue
+                check_region(fn, body)
+                if isinstance(stmt, ast.While):
+                    # The while-test re-evaluates per iteration: sync
+                    # calls and device truthiness in it count too.
+                    for node in ast.walk(stmt.test):
+                        if isinstance(node, ast.Call):
+                            kind = self._sync_kind(node, fn.module)
+                            if kind is not None:
+                                args = node.args if kind != "item" \
+                                    else [node.func.value]
+                                for a in args:
+                                    dev = self._mentions_device(a, fn)
+                                    if dev is not None:
+                                        flag_sync(fn, node, dev, kind)
+                                        break
+                    dev = self._truthy_device(stmt.test, fn)
+                    if dev is not None:
+                        self._emit(
+                            RULE_HOST_SYNC, WARNING, fn.module, stmt.test,
+                            f"step loop in `{fn.qualname}` re-evaluates "
+                            f"its condition on step-path value `{dev}` — "
+                            f"an implicit device round-trip per "
+                            f"iteration",
+                            suggestion="track the condition in a host "
+                            "variable, or mark the intended fence with "
+                            "`# tony: noqa[TONY-X002]`",
+                        )
+        while worklist:
+            fn = worklist.pop()
+            check_region(fn, _flatten_stmts(fn.node.body))
+
+    # X003 + X004 ------------------------------------------------------------
+    def _check_x003_x004(self, fn: _Func) -> None:
+        mod = fn.module
+        flat = _flatten_stmts(fn.node.body)
+        # Only index-like iterators make the loop target a retrace
+        # hazard: range() yields fresh Python ints, enumerate()'s first
+        # target does. Iterating data (``for batch in loader``) yields
+        # values whose type the pass cannot judge — not flagged.
+        loop_vars: set[str] = set()
+        for stmt in flat:
+            if not isinstance(stmt, (ast.For, ast.AsyncFor)):
+                continue
+            it = stmt.iter
+            if not (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("range", "enumerate")):
+                continue
+            targets = _name_targets(stmt.target)
+            if it.func.id == "enumerate":
+                targets = targets[:1]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    loop_vars.add(t.id)
+
+        def hazard(arg: ast.AST) -> str | None:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "len":
+                    return "len(...)"
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in loop_vars:
+                    return f"loop index `{node.id}`"
+            return None
+
+        for idx, stmt in enumerate(flat):
+            for node in _own_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind, ctor = self._resolve_call(node, fn)
+                if kind != "dispatch":
+                    continue
+                static_nums, static_names = _static_positions(ctor)
+                for i, arg in enumerate(node.args):
+                    if i in static_nums:
+                        continue
+                    h = hazard(arg)
+                    if h is not None:
+                        self._emit(
+                            RULE_RETRACE, WARNING, mod, node,
+                            f"{h} flows into argument {i} of a jitted "
+                            f"call in `{fn.qualname}` without being "
+                            f"marked static — every new value re-traces "
+                            f"and recompiles",
+                            suggestion="pass it as a device array, or "
+                            "declare the position in static_argnums",
+                        )
+                    elif isinstance(arg, (ast.Dict, ast.List, ast.Tuple)) \
+                            and any(
+                                isinstance(e, ast.Constant)
+                                and isinstance(e.value, float)
+                                for e in ast.walk(arg)
+                            ):
+                        self._emit(
+                            RULE_RETRACE, WARNING, mod, node,
+                            f"weak-typed Python float literal inside a "
+                            f"container argument of a jitted call in "
+                            f"`{fn.qualname}` — weak-type promotion "
+                            f"splits the trace cache",
+                            suggestion="wrap scalars as jnp.asarray(...) "
+                            "with an explicit dtype",
+                        )
+                for kw in node.keywords:
+                    if kw.arg in static_names or kw.arg is None:
+                        continue
+                    h = hazard(kw.value)
+                    if h is not None:
+                        self._emit(
+                            RULE_RETRACE, WARNING, mod, node,
+                            f"{h} flows into keyword `{kw.arg}` of a "
+                            f"jitted call in `{fn.qualname}` without "
+                            f"being marked static",
+                            suggestion="pass it as a device array, or "
+                            "declare the name in static_argnames",
+                        )
+                # X004: donated buffers read after the call.
+                donated = _donated_positions(ctor)
+                if not donated:
+                    continue
+                rebound: set[str] = set()
+                if isinstance(stmt, ast.Assign):
+                    rebound = {
+                        t.id for t in _name_targets(stmt.targets[0])
+                        if isinstance(t, ast.Name)
+                    }
+                for i in sorted(donated):
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    name = arg.id
+                    if name in rebound:
+                        continue
+                    for later in flat[idx + 1:]:
+                        stores = set()
+                        read = False
+                        for sub in _own_nodes(later):
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id == name:
+                                if isinstance(sub.ctx, ast.Store):
+                                    stores.add(sub.id)
+                                else:
+                                    read = True
+                        if read:
+                            self._emit(
+                                RULE_DONATION, ERROR, mod, later,
+                                f"`{name}` was donated to a jitted call "
+                                f"(donate_argnums={sorted(donated)}) on "
+                                f"line {node.lineno} and is read again "
+                                f"here — its buffer may already be "
+                                f"aliased by the callee's outputs",
+                                suggestion="use the call's returned "
+                                "value, or drop the donation",
+                            )
+                            break
+                        if name in stores:
+                            break
+
+    # X005 ------------------------------------------------------------------
+    def _check_x005(self, mod: _Module) -> None:
+        for node in ast.walk(mod.tree):
+            if not _is_jit_construction(node, mod.aliases):
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            if "in_shardings" in kwargs and "out_shardings" not in kwargs:
+                self._emit(
+                    RULE_SHARDING, WARNING, mod, node,
+                    "jit boundary declares in_shardings but no "
+                    "out_shardings — outputs fall back to GSPMD's guess "
+                    "and the next dispatch may re-shard",
+                    suggestion="declare out_shardings from the same plan "
+                    "that produced in_shardings",
+                )
+
+    # X006 ------------------------------------------------------------------
+    def _check_x006(self, fn: _Func) -> None:
+        mod = fn.module
+        aliases = mod.aliases
+        flat = _flatten_stmts(fn.node.body)
+
+        def consumes_key(node: ast.Call) -> str | None:
+            dotted = aliases.resolve(node.func)
+            if not dotted.startswith(_SAMPLER_PREFIX) \
+                    or dotted in _NON_CONSUMING:
+                return None
+            if node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in fn.key_names:
+                return node.args[0].id
+            return None
+
+        consumed: dict[str, int] = {}
+        for stmt in flat:
+            stores = set()
+            for node in _own_nodes(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store):
+                    stores.add(node.id)
+                if isinstance(node, ast.Call):
+                    key = consumes_key(node)
+                    if key is not None:
+                        if key in consumed:
+                            self._emit(
+                                RULE_KEY_REUSE, ERROR, mod, node,
+                                f"PRNG key `{key}` already consumed by a "
+                                f"sampler on line {consumed[key]} and "
+                                f"reused here without split/fold_in — "
+                                f"both dispatches draw identical "
+                                f"randomness",
+                                suggestion="jax.random.split the key and "
+                                "consume each half once",
+                            )
+                        else:
+                            consumed[key] = node.lineno
+            for s in stores:
+                consumed.pop(s, None)
+        # Loop variant: a key consumed inside a loop body with no rebind
+        # in that body repeats the same draw every iteration.
+        for stmt in flat:
+            if not isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            body = _flatten_stmts(stmt.body)
+            rebinds: set[str] = set()
+            for sub in body:
+                for node in _own_nodes(sub):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Store):
+                        rebinds.add(node.id)
+            flagged: set[str] = set()
+            for sub in body:
+                for node in _own_nodes(sub):
+                    if isinstance(node, ast.Call):
+                        key = consumes_key(node)
+                        if key is not None and key not in rebinds \
+                                and key not in flagged:
+                            flagged.add(key)
+                            self._emit(
+                                RULE_KEY_REUSE, ERROR, mod, node,
+                                f"PRNG key `{key}` consumed inside a "
+                                f"loop without split/fold_in in the "
+                                f"body — every iteration draws "
+                                f"identical randomness",
+                                suggestion="split the key per iteration "
+                                "(or fold_in the step index)",
+                            )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def _collect_files(paths) -> list[Path]:
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+    return files
+
+
+def check_dispatch(paths, docs=None) -> list[Finding]:
+    """Run the whole TONY-X pass over ``paths`` (files or directories),
+    waivers applied. With ``docs``, the rule catalogue is drift-checked
+    against the operator docs too (every TONY-X rule id must have a
+    DEPLOY.md row, like the TONY-T catalogue)."""
+    sources: dict[str, str] = {}
+    modules: list[tuple[Path, str, ast.Module]] = []
+    for path in _collect_files(paths):
+        try:
+            source = path.read_text()
+            modules.append(
+                (path, source, ast.parse(source, filename=str(path)))
+            )
+            sources[str(path)] = source
+        except (SyntaxError, ValueError, OSError):
+            continue   # script_lint owns reporting unparseable files
+    findings = DispatchAnalyzer(modules).run()
+    findings = apply_waivers(findings, sources)
+    if docs is not None:
+        findings += check_rule_docs(docs)
+    return findings
+
+
+def lint_dispatch_source(source: str, filename: str = "<script>"
+                         ) -> list[Finding]:
+    """Single-module convenience entry (preflight over a submitted
+    script whose imports are not on the client)."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except (SyntaxError, ValueError):
+        return []   # script_lint owns reporting unparseable files
+    findings = DispatchAnalyzer([(Path(filename), source, tree)]).run()
+    return apply_waivers(findings, {filename: source})
+
+
+def check_rule_docs(docs) -> list[Finding]:
+    """Every TONY-X rule id must appear in the operator docs — the rule
+    catalogue and DEPLOY.md move in lockstep or tier-1 fails."""
+    try:
+        doc_text = Path(docs).read_text()
+    except OSError:
+        doc_text = ""
+    return [
+        Finding(
+            rule, ERROR,
+            f"dispatch rule {rule} is not documented in {docs} — "
+            f"operators waive by rule id, so each needs a catalogue row",
+            file=str(docs), line=0,
+        )
+        for rule in ALL_RULES if rule not in doc_text
+    ]

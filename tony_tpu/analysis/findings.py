@@ -3,10 +3,18 @@
 One flat record type — rule id, severity, message, optional source
 span — so the CLI, the submit-path preflight gate, and the tests all
 consume the same shape regardless of which layer produced it.
+
+This module also owns the one waiver engine every AST pass shares
+(TONY-S, TONY-T, TONY-X): an inline ``# tony: noqa`` suppresses every
+finding on its line, and ``# tony: noqa[TONY-X002]`` (or the short
+``X002`` spelling; comma-separated lists allowed) suppresses only the
+listed rules. One parser + one matcher means a waiver behaves
+identically no matter which pass produced the finding.
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 
 # Severities, in escalation order. ERROR findings block a strict-mode
@@ -39,6 +47,68 @@ class Finding:
         if self.suggestion:
             text += f" — {self.suggestion}"
         return text
+
+
+# ---------------------------------------------------------------------------
+# Shared waiver engine (`# tony: noqa[...]`)
+# ---------------------------------------------------------------------------
+def _noqa_re() -> re.Pattern:
+    from tony_tpu import constants
+
+    return re.compile(
+        re.escape(constants.LINT_NOQA_MARKER)
+        + r"(?:\[([A-Za-z0-9_,\-\s]+)\])?"
+    )
+
+
+def noqa_map(source: str) -> dict[int, set[str] | None]:
+    """line -> None (suppress all) | set of rule ids suppressed there."""
+    pattern = _noqa_re()
+    out: dict[int, set[str] | None] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        if "#" not in line:
+            continue
+        m = pattern.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[lineno] = None
+        else:
+            ids = {part.strip().upper() for part in m.group(1).split(",")}
+            out[lineno] = {i for i in ids if i}
+    return out
+
+
+def waived(finding: Finding, noqa: dict[int, set[str] | None]) -> bool:
+    """Does an inline waiver on the finding's line cover it? Both the
+    full ``TONY-T001`` and the short ``T001`` spelling match."""
+    rule_filter = noqa.get(finding.line, ...)
+    if rule_filter is None:  # bare noqa: everything on the line
+        return True
+    if rule_filter is ...:
+        return False
+    rule = finding.rule_id.upper()
+    return rule in rule_filter or rule.replace("TONY-", "") in rule_filter
+
+
+def apply_waivers(findings: list[Finding],
+                  sources: dict[str, str]) -> list[Finding]:
+    """Drop findings waived by an inline ``# tony: noqa[...]`` on their
+    line. ``sources`` maps finding.file -> source text; findings whose
+    file has no entry pass through unfiltered."""
+    maps: dict[str, dict[int, set[str] | None]] = {}
+    kept: list[Finding] = []
+    for f in findings:
+        source = sources.get(f.file)
+        if source is None:
+            kept.append(f)
+            continue
+        noqa = maps.get(f.file)
+        if noqa is None:
+            noqa = maps[f.file] = noqa_map(source)
+        if not waived(f, noqa):
+            kept.append(f)
+    return kept
 
 
 def max_severity(findings: list[Finding]) -> str | None:

@@ -1,0 +1,326 @@
+"""Runtime jit sanitizer — the dynamic half of the TONY-X discipline.
+
+``analysis/dispatch.py`` proves dispatch discipline statically; this
+module watches what the dispatch path *actually does*. With
+``TONY_JIT_SANITIZER=1`` every callable wrapped by
+``plan.instrument_jit`` reports each dispatch here with a digest of its
+argument shapes/dtypes, and the tracker classifies it:
+
+* **cold** — the first signature a wrapper key ever dispatches: the
+  expected one-time trace + compile, already accounted by
+  ``tony_compile_cache_*``. Not a retrace.
+* **hit** — a signature seen before: the executable cache serves it,
+  nothing recorded.
+* **retrace** — a NEW signature after the cold one: jax silently traces
+  and compiles again. Counted into ``tony_retraces_total`` (never into
+  the compile-cache miss counter — the two can't double-count by
+  construction) and recorded with the dispatch stack. Past the declared
+  budget (``TONY_JIT_RETRACE_BUDGET``, default 4 per key) the violation
+  is flagged ``over_budget``; with ``TONY_JIT_SANITIZER=strict`` the
+  dispatch raises ``RetraceBudgetExceeded`` instead of silently
+  recompiling forever.
+
+``step_region()`` arms ``jax.transfer_guard_device_to_host("disallow")``
+around an instrumented dispatch region: *implicit* D2H transfers
+(``np.asarray`` on a device array, ``float()`` on a device scalar,
+truthiness) raise with a stack and count into
+``tony_guarded_transfers_total``; explicit ``jax.device_get`` — the
+annotated-fence idiom the static pass steers hot paths toward — passes
+untouched. That is exactly the split TONY-X002 enforces lexically, so
+the static and runtime layers agree on what a "clean" step is.
+
+Off (the default) everything passes straight through — zero overhead,
+zero behavior change. The violation report is flight-recorder
+compatible: ``dump()`` writes a ``blackbox-jit-sanitizer-*.json`` with
+the envelope the postmortem tooling already reads, and the tier-1
+pytest fixture (tests/conftest.py) fails any test that tripped the
+guard or blew a retrace budget. Only stdlib at import time; jax and the
+metrics registry are imported lazily inside the paths that need them,
+so the module stays a leaf like its sync sibling.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+
+ENV_FLAG = "TONY_JIT_SANITIZER"
+ENV_RETRACE_BUDGET = "TONY_JIT_RETRACE_BUDGET"
+ENV_REPORT_DIR = "TONY_JIT_REPORT_DIR"
+
+RETRACE = "retrace"
+GUARDED_TRANSFER = "guarded_transfer"
+
+# Metric names (rendered on /metrics, summarized into bench lines and
+# gated by BASELINE.json). Registered lazily: importing this module
+# never touches the registry.
+RETRACES_COUNTER = "tony_retraces_total"
+GUARDED_TRANSFERS_COUNTER = "tony_guarded_transfers_total"
+
+_TRUTHY = ("1", "true", "yes", "on", "report", "strict")
+_DEFAULT_BUDGET = 4
+
+# Frames from this file are noise in a violation stack.
+_SELF_FILE = __file__
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """Raised in strict mode when one wrapper key re-traces past its
+    declared budget — the step path is compiling in steady state."""
+
+
+def enabled() -> bool:
+    """Opt-in check, read per dispatch (not import time) so the
+    conftest bootstrap or a test can flip it first."""
+    return os.environ.get(ENV_FLAG, "").strip().lower() in _TRUTHY
+
+
+def strict() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip().lower() == "strict"
+
+
+def retrace_budget() -> int:
+    try:
+        return int(os.environ.get(ENV_RETRACE_BUDGET, "")
+                   or _DEFAULT_BUDGET)
+    except ValueError:
+        return _DEFAULT_BUDGET
+
+
+def _site_stack(limit: int = 16) -> list[str]:
+    """Compact dispatch stack: ``file:line in func`` strings, newest
+    last, sanitizer frames stripped."""
+    out = []
+    for frame in traceback.extract_stack()[:-1]:
+        if frame.filename == _SELF_FILE:
+            continue
+        out.append(f"{frame.filename}:{frame.lineno} in {frame.name}")
+    return out[-limit:]
+
+
+def _count(name: str) -> None:
+    """Lazy registry increment; never lets observability wiring break a
+    dispatch."""
+    try:
+        from tony_tpu import observability
+
+        observability.default_registry().counter(name).inc()
+    except Exception:
+        pass
+
+
+class JitTracker:
+    """Per-key signature table + violation ring. One process-global
+    instance backs ``instrument_jit``; tests seed private instances so
+    deliberately-seeded retraces never pollute the suite-wide gate."""
+
+    def __init__(self, budget: "int | None" = None,
+                 limit: int = 512) -> None:
+        self._mu = threading.Lock()
+        self._budget = retrace_budget() if budget is None else int(budget)
+        self._sigs: dict[str, set] = {}
+        self._retraces: collections.Counter = collections.Counter()
+        self._transfers = 0
+        self._violations: collections.deque = collections.deque(
+            maxlen=max(int(limit), 1)
+        )
+        self._seq = 0
+
+    # -- recording ---------------------------------------------------------
+    def note_call(self, key: str, sig: str) -> tuple[str, int, bool]:
+        """Classify one dispatch: ``(status, retrace_count,
+        over_budget)`` where status is 'cold' | 'hit' | 'retrace'."""
+        with self._mu:
+            sigs = self._sigs.setdefault(key, set())
+            if sig in sigs:
+                return "hit", self._retraces[key], False
+            cold = not sigs
+            sigs.add(sig)
+            if cold:
+                return "cold", 0, False
+            self._retraces[key] += 1
+            count = self._retraces[key]
+            over = count > self._budget
+            self._record_locked({
+                "kind": RETRACE,
+                "key": key,
+                "signature": sig,
+                "count": count,
+                "budget": self._budget,
+                "over_budget": over,
+                "detail": f"`{key}` re-traced (signature #{count + 1} "
+                          f"for this wrapper) — jax is compiling in "
+                          f"what should be steady state",
+                "stack": _site_stack(),
+            })
+            return "retrace", count, over
+
+    def note_transfer(self, message: str,
+                      key: "str | None" = None) -> None:
+        with self._mu:
+            self._transfers += 1
+            self._record_locked({
+                "kind": GUARDED_TRANSFER,
+                "key": key,
+                "detail": message.splitlines()[0] if message else
+                          "implicit device-to-host transfer inside an "
+                          "instrumented step region",
+                "stack": _site_stack(),
+            })
+
+    def _record_locked(self, violation: dict) -> None:
+        self._seq += 1
+        violation["seq"] = self._seq
+        violation["ts_ms"] = int(time.time() * 1000)
+        violation["thread"] = threading.current_thread().name
+        self._violations.append(violation)
+
+    # -- reading -----------------------------------------------------------
+    def mark(self) -> int:
+        """Current violation sequence — pair with violations_since for
+        per-test attribution."""
+        with self._mu:
+            return self._seq
+
+    def violations(self, kind: "str | None" = None) -> list[dict]:
+        with self._mu:
+            out = list(self._violations)
+        if kind is not None:
+            out = [v for v in out if v["kind"] == kind]
+        return out
+
+    def violations_since(self, mark: int,
+                         kind: "str | None" = None) -> list[dict]:
+        return [v for v in self.violations(kind) if v["seq"] > mark]
+
+    def retraces(self, key: "str | None" = None) -> int:
+        with self._mu:
+            if key is not None:
+                return self._retraces[key]
+            return sum(self._retraces.values())
+
+    def transfers(self) -> int:
+        with self._mu:
+            return self._transfers
+
+    def reset(self) -> None:
+        with self._mu:
+            self._sigs.clear()
+            self._retraces.clear()
+            self._transfers = 0
+            self._violations.clear()
+            self._seq = 0
+
+    def report(self) -> dict:
+        """Flight-recorder-shaped document, same envelope the blackbox
+        readers (``observability/flight.load_blackboxes``) consume."""
+        with self._mu:
+            return {
+                "proc": "jit-sanitizer",
+                "keys": sorted(self._sigs),
+                "retraces": dict(self._retraces),
+                "transfers": self._transfers,
+                "budget": self._budget,
+                "reports": [],
+                "rpcs": [],
+                "events": list(self._violations),
+            }
+
+    def dump(self, directory, reason: str = "jit-sanitizer") -> "str | None":
+        """Atomic ``blackbox-jit-sanitizer-<pid>.json`` dump, same
+        tmp+rename contract as the flight recorder; best-effort."""
+        doc = self.report()
+        doc["reason"] = reason
+        doc["dumped_ts_ms"] = int(time.time() * 1000)
+        fname = f"blackbox-jit-sanitizer-{os.getpid()}.json"
+        path = os.path.join(str(directory), fname)
+        try:
+            os.makedirs(str(directory), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+_default_tracker: "JitTracker | None" = None
+_default_tracker_mu = threading.Lock()
+
+
+def tracker() -> JitTracker:
+    """The process-global tracker behind ``instrument_jit``."""
+    global _default_tracker
+    with _default_tracker_mu:
+        if _default_tracker is None:
+            _default_tracker = JitTracker()
+        return _default_tracker
+
+
+def note_dispatch(key: str, sig: str,
+                  tracker_: "JitTracker | None" = None) -> str:
+    """One instrumented dispatch: classify against the tracker, count
+    retraces into ``tony_retraces_total``, and in strict mode raise once
+    the key's budget is blown. Returns the classification."""
+    tr = tracker() if tracker_ is None else tracker_
+    status, count, over = tr.note_call(key, sig)
+    if status == "retrace":
+        _count(RETRACES_COUNTER)
+        if over and strict():
+            raise RetraceBudgetExceeded(
+                f"jitted callable `{key}` re-traced {count} times "
+                f"(budget {tr.report()['budget']}) — its arguments keep "
+                f"changing shape/dtype/hash in steady state; pin the "
+                f"shapes or raise {ENV_RETRACE_BUDGET}"
+            )
+    return status
+
+
+@contextmanager
+def step_region(key: "str | None" = None,
+                tracker_: "JitTracker | None" = None):
+    """Arm the implicit-D2H transfer guard around a step region.
+
+    Inside, an IMPLICIT device→host transfer raises with a stack (and is
+    recorded + counted into ``tony_guarded_transfers_total``); an
+    explicit ``jax.device_get`` — the annotated fence — passes. No-op
+    with the sanitizer off, so production hot paths wrap their dispatch
+    blocks unconditionally."""
+    if not enabled():
+        yield
+        return
+    try:
+        import jax
+    except Exception:
+        yield
+        return
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    except Exception as exc:
+        message = str(exc)
+        if "transfer" in message.lower():
+            tr = tracker() if tracker_ is None else tracker_
+            tr.note_transfer(message, key=key)
+            _count(GUARDED_TRANSFERS_COUNTER)
+        raise
+
+
+def _atexit_dump() -> None:  # pragma: no cover - process teardown
+    report_dir = os.environ.get(ENV_REPORT_DIR)
+    if not report_dir or _default_tracker is None:
+        return
+    if _default_tracker.violations():
+        _default_tracker.dump(report_dir, reason="atexit")
+
+
+if enabled() and os.environ.get(ENV_REPORT_DIR):  # pragma: no cover
+    import atexit
+
+    atexit.register(_atexit_dump)

@@ -109,10 +109,20 @@ def run_preflight(
             if os.path.realpath(entry) not in seen:
                 paths.append(entry)
     if paths:
+        from tony_tpu.analysis.dispatch import lint_dispatch_source
         from tony_tpu.analysis.script_lint import lint_script
 
         for path in paths:
             findings.extend(lint_script(path, **context))
+            # The dispatch pass runs single-module over each submitted
+            # script: the X errors it can prove from one file (jit in a
+            # loop, donated-then-read, key reuse) are exactly the ones
+            # that burn a slice before the job's first useful step.
+            try:
+                source = Path(path).read_text()
+            except OSError:
+                continue   # script_lint already reported the bad path
+            findings.extend(lint_dispatch_source(source, filename=path))
     return findings
 
 

@@ -46,13 +46,15 @@ TONY-S108  error    ``input()``/``breakpoint()``/``pdb.set_trace()`` in a
 from __future__ import annotations
 
 import ast
-import re
 
 from tony_tpu import constants
-from tony_tpu.analysis.findings import ERROR, WARNING, Finding
 
-_NOQA_RE = re.compile(
-    re.escape(constants.LINT_NOQA_MARKER) + r"(?:\[([A-Za-z0-9_,\-\s]+)\])?"
+from tony_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    noqa_map as _noqa_map,
+    waived as _waived,
 )
 
 # Dotted-call prefixes whose results differ per host (feeding these into a
@@ -82,23 +84,6 @@ _DISTRIBUTED_INIT_CALLS = (
     "jax.distributed.initialize",
     "tony_tpu.runtime.initialize",
 )
-
-
-def _noqa_map(source: str) -> dict[int, set[str] | None]:
-    """line -> None (suppress all) | set of rule ids suppressed there."""
-    out: dict[int, set[str] | None] = {}
-    for lineno, line in enumerate(source.splitlines(), start=1):
-        if "#" not in line:
-            continue
-        m = _NOQA_RE.search(line)
-        if not m:
-            continue
-        if m.group(1) is None:
-            out[lineno] = None
-        else:
-            ids = {part.strip().upper() for part in m.group(1).split(",")}
-            out[lineno] = {i for i in ids if i}
-    return out
 
 
 class _Aliases:
@@ -211,15 +196,7 @@ class _ScriptLinter:
         self._check_unsorted_listing(tree, aliases)
         self._check_interactive(tree, aliases)
 
-        kept = []
-        for f in self.findings:
-            rule_filter = noqa.get(f.line, ...)
-            if rule_filter is None:  # bare noqa: everything on the line
-                continue
-            if rule_filter is not ... and f.rule_id.upper() in rule_filter:
-                continue
-            kept.append(f)
-        return kept
+        return [f for f in self.findings if not _waived(f, noqa)]
 
     # -- TONY-S101 ---------------------------------------------------------
     def _check_seeding(self, tree: ast.AST, aliases: _Aliases) -> None:

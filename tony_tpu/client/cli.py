@@ -235,7 +235,7 @@ def lint(argv: list[str]) -> int:
 
         python -m tony_tpu.client.cli lint [paths...]
             [--conf_file tony.json] [--conf k=v] [--strict]
-            [--concurrency]
+            [--concurrency] [--dispatch]
 
     Paths are training scripts or directories of them (directories are
     scanned recursively for ``*.py``). With ``--conf_file``/``--conf``
@@ -245,8 +245,12 @@ def lint(argv: list[str]) -> int:
     cycles, blocking calls under locks, unguarded cross-thread state,
     check-then-act, thread/join hygiene) over the given paths — or over
     the installed ``tony_tpu`` package itself when no paths are given.
-    Exit status: 0 when no findings (or warnings only, without
-    ``--strict``), 1 on error findings (or any finding with ``--strict``).
+    ``--dispatch`` does the same with the TONY-X dispatch-discipline
+    pass (``analysis/dispatch``: jit construction in loops, host
+    round-trips inside step loops, retrace hazards, donation
+    violations, sharding drift, PRNG key reuse). Exit status: 0 when no
+    findings (or warnings only, without ``--strict``), 1 on error
+    findings (or any finding with ``--strict``).
     """
     import argparse
 
@@ -269,6 +273,10 @@ def lint(argv: list[str]) -> int:
                    help="also run the TONY-T concurrency-discipline "
                         "pass (defaults to the installed tony_tpu "
                         "package when no paths are given)")
+    p.add_argument("--dispatch", action="store_true",
+                   help="also run the TONY-X dispatch-discipline pass "
+                        "(defaults to the installed tony_tpu package "
+                        "when no paths are given)")
     args = p.parse_args(argv)
 
     scripts: list[str] = []
@@ -293,6 +301,20 @@ def lint(argv: list[str]) -> int:
 
         targets = args.paths or [Path(__file__).resolve().parents[1]]
         all_findings = all_findings + check_concurrency(targets)
+    if args.dispatch:
+        from tony_tpu.analysis.dispatch import check_dispatch
+
+        targets = args.paths or [Path(__file__).resolve().parents[1]]
+        all_findings = all_findings + check_dispatch(targets)
+    # Preflight already lints each submitted script's dispatch
+    # discipline, so --dispatch over the same paths would report every
+    # finding twice — keep the first occurrence of each.
+    seen: set[tuple] = set()
+    all_findings = [
+        f for f in all_findings
+        if (k := (f.file, f.line, f.rule_id, f.message)) not in seen
+        and not seen.add(k)
+    ]
     if all_findings:
         print(fmod.format_findings(all_findings))
     errors = sum(1 for f in all_findings if f.severity == fmod.ERROR)

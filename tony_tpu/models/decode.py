@@ -643,7 +643,7 @@ class DecodeSession:
                 # a jitted identity with out_shardings is the blessed
                 # global-array reshard.
                 with jax.sharding.set_mesh(self.mesh):
-                    fused = jax.jit(
+                    fused = jax.jit(  # tony: noqa[TONY-X001] — one-shot reshard at weight refresh, not a step path
                         lambda x: x, out_shardings=shardings
                     )(fused)
         self.params = fused

@@ -342,7 +342,7 @@ def pipeline_apply(
     # (by the stage_fn contract) already identical/pmean'd across the
     # other axes.
     out_specs = (in_spec, P()) if stage_aux else in_spec
-    result = jax.shard_map(
+    result = jax.shard_map(  # tony: noqa[TONY-X001] — callers embed this in jitted steps; the bare path is test-only
         body,
         mesh=mesh,
         in_specs=(param_specs, in_spec),

@@ -442,12 +442,30 @@ def instrument_jit(jit_fn, key: str, *, cache: CompileCache | None = None,
     """Wrap a jitted callable so its FIRST call runs under
     ``timed_compile`` (hit/miss + compile wall metrics) with the base
     ``key`` extended by the call's argument shapes/dtypes; later calls
-    pass straight through. Re-tracings after the first call (new input
-    shapes mid-run) are not separately counted — plans pin shapes, and
-    a step function that retraces per call is its own bug."""
+    pass straight through.
+
+    With ``TONY_JIT_SANITIZER`` armed, every call is additionally
+    classified by the jit sanitizer: the first signature is the **cold**
+    compile (accounted by ``tony_compile_cache_*`` exactly as before), a
+    repeated signature is a runtime cache **hit** (touches no counter),
+    and a NEW signature after the first is a **re-trace** — counted only
+    into ``tony_retraces_total``, never into the compile-cache miss
+    counter, so the two accountings can never double-count one dispatch.
+    Strict mode raises past the per-key retrace budget, and the dispatch
+    itself runs inside ``step_region`` so implicit D2H transfers raise
+    with a stack. Sanitizer off: byte-for-byte the old behavior, zero
+    per-call overhead."""
     state = {"first": True}
 
     def call(*args, **kwargs):
+        from tony_tpu.analysis import jit_sanitizer
+
+        sanitized = jit_sanitizer.enabled()
+        if sanitized:
+            sig = hashlib.sha256(
+                json.dumps(_args_signature(args, kwargs)).encode()
+            ).hexdigest()
+            jit_sanitizer.note_dispatch(key, sig)
         if state["first"]:
             state["first"] = False
             full_key = hashlib.sha256(
@@ -455,6 +473,10 @@ def instrument_jit(jit_fn, key: str, *, cache: CompileCache | None = None,
                 .encode()
             ).hexdigest()
             with timed_compile(full_key, cache=cache, meta=meta):
+                with jit_sanitizer.step_region(key):
+                    return jit_fn(*args, **kwargs)
+        if sanitized:
+            with jit_sanitizer.step_region(key):
                 return jit_fn(*args, **kwargs)
         return jit_fn(*args, **kwargs)
 

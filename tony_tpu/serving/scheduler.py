@@ -44,10 +44,12 @@ import threading
 import time
 from collections import deque
 
+import jax
 import numpy as np
 
 log = logging.getLogger(__name__)
 
+from tony_tpu.analysis import jit_sanitizer
 from tony_tpu.models.decode import _decode_weights_jit
 from tony_tpu.models.transformer import TransformerConfig
 from tony_tpu.observability import metrics as obs_metrics
@@ -485,14 +487,17 @@ class ServingEngine:
             # Chrome trace beside the training/coordinator spans.
             with self._dispatch_span("serving_decode_window",
                                      slots=int(self._active.sum()),
-                                     window=w):
+                                     window=w), \
+                    jit_sanitizer.step_region("serving_decode_window"):
                 self._k, self._v, window = self._decode(
                     self.params, self._k, self._v, self._pos, wpos,
                     self._last, self._temp, self._base_key,
                     np.int32((self._decode_calls * w) % 2**30),
                 )
                 self._decode_calls += 1
-                toks = np.asarray(window)  # device sync: iteration fence
+                # Iteration fence: EXPLICIT readback, so the armed
+                # transfer guard (jit sanitizer) lets it through.
+                toks = np.asarray(jax.device_get(window))  # tony: noqa[TONY-X002] — intended per-window fence
             wall_ms = (time.perf_counter() - t0) * 1000.0
             # Recorded PER TOKEN (wall / window): with a deep window the
             # client sees bursts, but the sustained per-stream gap is
@@ -596,13 +601,14 @@ class ServingEngine:
             # step's key.
             self._pf_draws += 1
             with self._dispatch_span("serving_prefill_chunks", batch=n,
-                                     chunk=self.prefill_chunk):
+                                     chunk=self.prefill_chunk), \
+                    jit_sanitizer.step_region("serving_prefill_chunks"):
                 self._k, self._v, first_toks, _ = self._prefill(
                     self.params, self._k, self._v, toks, slots_a, starts,
                     n_valids, temps, self._base_key,
                     np.int32(2**30 + self._pf_draws % 2**30),
                 )
-                firsts = np.asarray(first_toks)  # device sync
+                firsts = np.asarray(jax.device_get(first_toks))  # tony: noqa[TONY-X002] — intended per-round fence
             now = time.perf_counter()
             requeue: list[tuple[ServingRequest, int]] = []
             for i, (req, slot) in enumerate(entries):

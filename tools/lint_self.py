@@ -20,6 +20,11 @@ Three registries that must never drift are checked:
   lock-order cycles, blocking calls under locks, cross-thread mutation
   without a common lock, check-then-act races, thread/join hygiene —
   zero unwaived findings, and every TONY-T rule documented in
+  docs/DEPLOY.md;
+* dispatch discipline — the TONY-X pass (``analysis/dispatch``): jit
+  construction in loops, host round-trips inside step loops, retrace
+  hazards, donation violations, sharding-annotation drift, PRNG key
+  reuse — zero unwaived findings, and every TONY-X rule documented in
   docs/DEPLOY.md.
 
 Invoked from the tier-1 suite (``tests/test_analysis.py``) so drift
@@ -139,10 +144,27 @@ def check_concurrency_discipline() -> list[str]:
     ]
 
 
+def check_dispatch_discipline() -> list[str]:
+    """TONY-X001..X006 over every tree that dispatches jitted
+    callables, plus the rule-catalogue docs row check. Unwaived
+    findings fail tier-1 — a new dispatch hazard either gets fixed or
+    gets an explicit ``# tony: noqa[TONY-X00x]`` with a justification
+    comment."""
+    from tony_tpu.analysis.dispatch import check_dispatch
+
+    roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
+             REPO / "bench.py"]
+    return [
+        f.render()
+        for f in check_dispatch(roots, docs=REPO / "docs" / "DEPLOY.md")
+    ]
+
+
 def main() -> int:
     problems = (
         check_config_drift() + check_protocol_drift() + check_metric_names()
         + check_event_drift() + check_concurrency_discipline()
+        + check_dispatch_discipline()
     )
     for p in problems:
         print(p, file=sys.stderr)

@@ -38,18 +38,18 @@ def main():
     )
     scale = d ** -0.5
 
-    fwd_ref = jax.jit(lambda q, k, v: _flash_attention_pallas(
+    fwd_ref = jax.jit(lambda q, k, v: _flash_attention_pallas(  # tony: noqa[TONY-X001] — sweep tool: one reference compile per run
         q, k, v, causal=True, scale=scale, block_q=512, block_k=512,
         return_lse=True,
     ))
-    out, lse = fwd_ref(q, k, v)
+    out, lse = fwd_ref(q, k, v)  # tony: noqa[TONY-X001] — reference output computed once per sweep run
 
     blocks = [256, 512, 1024, 2048]
     print(f"== fwd, seq={seq} (kernel ms) ==")
     for bq in blocks:
         for bk in blocks:
             try:
-                fn = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                fn = jax.jit(lambda q, k, v, bq=bq, bk=bk:  # tony: noqa[TONY-X001] — sweep point: one compile per block config is the tool's job
                              _flash_attention_pallas(
                                  q, k, v, causal=True, scale=scale,
                                  block_q=bq, block_k=bk))
@@ -65,7 +65,7 @@ def main():
     for bq in blocks:
         for bk in blocks:
             try:
-                fn = jax.jit(lambda q, k, v, out, lse, do, bq=bq, bk=bk:
+                fn = jax.jit(lambda q, k, v, out, lse, do, bq=bq, bk=bk:  # tony: noqa[TONY-X001] — sweep point: one compile per block config is the tool's job
                              _flash_attention_pallas_bwd(
                                  q, k, v, out, lse, do, causal=True,
                                  scale=scale, block_q=bq, block_k=bk))
@@ -101,7 +101,7 @@ def main():
     for bq in blocks:
         for bk in blocks:
             try:
-                g = jax.jit(jax.grad(
+                g = jax.jit(jax.grad(  # tony: noqa[TONY-X001] — sweep point: one compile per block config is the tool's job
                     lambda q, k, v, bq=bq, bk=bk: flash_attention(
                         q, k, v, block_q=bq, block_k=bk
                     ).astype(jnp.float32).sum()
@@ -112,7 +112,7 @@ def main():
                     t0 = time.perf_counter()
                     for _ in range(iters):
                         out = g(q4, k4, v4)
-                    float(out.sum())
+                    float(out.sum())  # tony: noqa[TONY-X002] — intended per-window timing fence
                     best = min(best, time.perf_counter() - t0)
                 print(f"  bq={bq:5d} bk={bk:5d}  {best / iters * 1e3:7.3f}")
             except Exception as e:
