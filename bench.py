@@ -1025,7 +1025,100 @@ def bench_scheduler(jobs: int = 3, provision_ms: int = 4000):
         "jobs_per_hour": round(jobs / (wall_s / 3600.0), 1),
         "warm_hit_rate": round(warm_hits / max(warm_hits + provisions, 1),
                                3),
+        **_bench_scheduler_ha(),
     }
+
+
+def _bench_scheduler_ha(queued_jobs: int = 8):
+    """Control-plane HA sub-metrics for ``bench_scheduler``:
+
+    * ``recovery_ms`` — a dead leader's base dir (journal seeded with
+      ``queued_jobs`` queued submissions: exactly the bytes a SIGKILL
+      leaves behind) to a fresh daemon's ``start()`` returning with the
+      queue rebuilt and the first snapshot published. Recovery runs
+      synchronously inside ``start()``, so the wall around it IS the
+      SIGKILL-to-first-post-recovery-tick window.
+    * ``failover_ms`` — an active/standby pair on one base dir; the
+      leader dies the way SIGKILL kills it (loop stopped dead, flock
+      dropped, heartbeat left to go stale un-renewed) to the standby
+      holding the seat with recovery done.
+    """
+    import tempfile as _tempfile
+    from pathlib import Path as _Path
+
+    from tony_tpu.conf import keys as _keys
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.scheduler import SchedulerDaemon
+    from tony_tpu.scheduler import journal as _wal
+    from tony_tpu.scheduler.journal import SchedulerJournal
+
+    out: dict[str, float] = {}
+    with _tempfile.TemporaryDirectory(prefix="tony-bench-ha-") as root:
+        base = _Path(root) / "sched"
+        base.mkdir()
+        j = SchedulerJournal(base / _wal.JOURNAL_FILE)
+        now = int(time.time() * 1000)
+        for i in range(queued_jobs):
+            j.append(_wal.J_JOB_QUEUED, ts_ms=now,
+                     job_id=f"job_{i + 1:04d}_bench",
+                     app_dir=str(base / f"app-{i}"), priority=0,
+                     tenant="default", submit_ms=now, seq_no=i + 1)
+        conf = TonyConfiguration()
+        conf.set(_keys.K_SCHED_TICK_MS, 50)
+        # Zero slots: the recovered queue must REBUILD, not launch —
+        # this measures the control plane, not executor spawn time.
+        conf.set(_keys.K_SCHED_MAX_SLICES, 0)
+        t0 = time.perf_counter()
+        daemon = SchedulerDaemon(base, conf=conf).start(serve_http=False)
+        recovery_ms = (time.perf_counter() - t0) * 1000
+        restored = len(daemon._jobs)
+        daemon.shutdown()
+        if daemon.recovered_ms is None or restored != queued_jobs:
+            raise RuntimeError(
+                f"recovery bench restored {restored}/{queued_jobs} jobs"
+            )
+        out["recovery_ms"] = round(recovery_ms, 1)
+
+        pair = _Path(root) / "pair"
+        pair.mkdir()
+
+        def _pair_conf(node: str) -> TonyConfiguration:
+            c = TonyConfiguration()
+            c.set(_keys.K_SCHED_TICK_MS, 50)
+            c.set(_keys.K_SCHED_MAX_SLICES, 0)
+            c.set(_keys.K_SCHED_HA_LEASE_MS, 600)
+            c.set(_keys.K_SCHED_HA_NODE_ID, node)
+            return c
+
+        a = SchedulerDaemon(pair, conf=_pair_conf("bench-a")).start(
+            serve_http=False
+        )
+        b = SchedulerDaemon(pair, conf=_pair_conf("bench-b")).start(
+            serve_http=False
+        )
+        if not a.election.is_leader or b.election.is_leader:
+            raise RuntimeError("failover bench pair did not settle "
+                               "into active/standby")
+        # Crash the leader the way SIGKILL does: loop stopped dead (no
+        # clean release — the heartbeat goes stale un-renewed), then
+        # the kernel drops the flock.
+        a._stop.set()
+        a._wake.set()
+        a._thread.join(timeout=30)
+        t1 = time.perf_counter()
+        a.election.abandon()
+        deadline = t1 + 30
+        while time.perf_counter() < deadline:
+            if b.election.is_leader and b.recovered_ms is not None:
+                break
+            time.sleep(0.005)
+        failover_ms = (time.perf_counter() - t1) * 1000
+        took_over = b.election.is_leader
+        b.shutdown()
+        if not took_over:
+            raise RuntimeError("standby never took the seat")
+        out["failover_ms"] = round(failover_ms, 1)
+    return out
 
 
 def bench_checkpoint(saves: int = 6, store_ms: int = 20,

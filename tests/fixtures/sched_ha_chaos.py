@@ -1,0 +1,78 @@
+"""Fixture: the failover-chaos daemon — detached attempts, a warm-idle
+slice, one RUNNING and one QUEUED job, then the parent SIGKILLs it.
+
+Submits three jobs before starting the loop (so their journal records
+are down deterministically), all against a 2-slice pool with a
+per-tenant quota of 1:
+
+    warm  — exit_0.py, tenant "w": runs, finishes, leaves a FREE slice
+    run   — preemptible.py, tenant "t": sleeps $SLEEP_S holding a slice
+            (detached: its coordinator survives the daemon's death)
+    queue — exit_0.py, tenant "t": quota-blocked behind "run"
+
+Prints the three job ids space-separated on stdout, starts the daemon,
+and waits to be SIGKILLed. The parent watches scheduler-state.json for
+the acceptance shape (warm SUCCEEDED+FREE slice, run RUNNING, queue
+QUEUED), kills this process, and recovers with a fresh daemon.
+
+Usage: sched_ha_chaos.py <base_dir> <marker_file> <sleep_s>
+"""
+
+import sys
+import time
+from pathlib import Path
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.scheduler.service import SchedulerDaemon
+
+FIXTURES = Path(__file__).resolve().parent
+
+
+def _conf(base: Path, **kv) -> TonyConfiguration:
+    conf = TonyConfiguration()
+    conf.set(keys.K_STAGING_LOCATION, str(base / "staging"))
+    conf.set(keys.K_HISTORY_LOCATION, str(base / "history"))
+    conf.set(keys.K_AM_STOP_GRACE_MS, 0)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def main() -> int:
+    base = Path(sys.argv[1])
+    marker, sleep_s = sys.argv[2], sys.argv[3]
+    daemon = SchedulerDaemon(base / "sched", conf=_conf(
+        base,
+        **{keys.K_SCHED_TICK_MS: 50,
+           keys.K_SCHED_MAX_SLICES: 2,
+           keys.K_SCHED_DETACHED: True,
+           keys.K_SCHED_TENANT_QUOTA: 1},
+    ))
+
+    def job(fixture: str, tenant: str, **kv) -> TonyConfiguration:
+        c = _conf(base, **kv)
+        c.set(keys.K_EXECUTES, str(FIXTURES / fixture))
+        c.set(keys.K_PYTHON_BINARY, sys.executable)
+        c.set(keys.instances_key("worker"), 1)
+        c.set(keys.instances_key("ps"), 0)
+        c.set(keys.K_SCHED_TENANT, tenant)
+        return c
+
+    ids = [
+        daemon.submit(job("exit_0.py", "w")),
+        daemon.submit(job(
+            "preemptible.py", "t",
+            **{keys.K_SHELL_ENV: f"MARKER_OUT={marker},SLEEP_S={sleep_s}"},
+        )),
+        daemon.submit(job("exit_0.py", "t")),
+    ]
+    print(" ".join(ids), flush=True)
+
+    daemon.start(serve_http=False)
+    time.sleep(600)  # the parent SIGKILLs us
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

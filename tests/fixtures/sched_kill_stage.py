@@ -1,0 +1,65 @@
+"""Fixture: a scheduler daemon that SIGKILLs itself (``os._exit``, no
+cleanup, no atexit) at a chosen journal/actuation boundary — the
+control-plane half of the kill-at-every-transition contract. The
+parent test recovers the base dir with a fresh daemon and asserts no
+job was lost and none launched twice.
+
+The submitted job is journaled BEFORE ``start()`` so the crash phase
+is deterministic: the first tick after start hits the boundary.
+
+    post-journal — the launch landed in the journal, no coordinator
+                   exists yet (recovery must classify it dead and
+                   requeue, not lose it or double-launch it)
+    mid-tick     — lease expiries handled, pop loop not yet run (the
+                   job is still QUEUED on disk)
+    pre-publish  — transitions journaled, snapshot stale (recovery is
+                   pure journal replay past an old watermark)
+
+Usage: sched_kill_stage.py <base_dir> <phase> <job_script>
+Prints the submitted job id on stdout, then starts the daemon and
+waits to die. Exits 3 if the crash never fires.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.scheduler.service import SchedulerDaemon
+
+
+def main() -> int:
+    base, phase, job_script = Path(sys.argv[1]), sys.argv[2], sys.argv[3]
+    conf = TonyConfiguration()
+    conf.set(keys.K_STAGING_LOCATION, str(base / "staging"))
+    conf.set(keys.K_HISTORY_LOCATION, str(base / "history"))
+    conf.set(keys.K_AM_STOP_GRACE_MS, 0)
+    conf.set(keys.K_SCHED_TICK_MS, 50)
+    conf.set(keys.K_SCHED_MAX_SLICES, 1)
+    conf.set(keys.K_FAULT_PLAN, json.dumps(
+        {"faults": [{"action": "crash_scheduler", "at": phase}]}
+    ))
+    daemon = SchedulerDaemon(base / "sched", conf=conf)
+
+    job = TonyConfiguration()
+    job.set(keys.K_STAGING_LOCATION, str(base / "staging"))
+    job.set(keys.K_HISTORY_LOCATION, str(base / "history"))
+    job.set(keys.K_AM_STOP_GRACE_MS, 0)
+    job.set(keys.K_EXECUTES, job_script)
+    job.set(keys.K_PYTHON_BINARY, sys.executable)
+    job.set(keys.instances_key("worker"), 1)
+    job.set(keys.instances_key("ps"), 0)
+    # submit() before start(): the daemon grabs the leader seat on the
+    # spot and journals the queued job; the loop (and its crash point)
+    # has not run yet.
+    print(daemon.submit(job), flush=True)
+
+    daemon.start(serve_http=False)
+    time.sleep(30)  # the tick thread os._exits the whole process
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,657 @@
+"""Control-plane HA (tony_tpu/scheduler/{journal,election}.py + the
+daemon's recover/fencing paths): journal append/rotate/replay units,
+loader hardening against torn bytes, leader-election + epoch-fence
+units, kill-at-every-transition recovery, standby takeover, zombie
+double-tick fencing, thin-client retry backoff, and the slow failover
+chaos acceptance e2e (SIGKILL the daemon mid-run; nothing is lost,
+nothing runs twice, goodput folds exactly once)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.resilience.faults import (
+    FaultPlan,
+    FaultPlanError,
+    SCHEDULER_PHASES,
+    SchedulerFaults,
+)
+from tony_tpu.scheduler import (
+    FileElectionBackend,
+    JobState,
+    LeaseElection,
+    MemoryElectionBackend,
+    SchedulerDaemon,
+    SchedulerJournal,
+)
+from tony_tpu.scheduler import journal as wal
+from tony_tpu.scheduler.http import scheduler_request
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _fixture_env() -> dict[str, str]:
+    """Env for fixture daemons run as subprocesses: the source tree on
+    PYTHONPATH (the repo may not be pip-installed) and CPU-only jax."""
+    env = dict(os.environ)
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = str(REPO_ROOT) + (
+        os.pathsep + existing if existing else ""
+    )
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Journal units
+# ---------------------------------------------------------------------------
+class TestJournal:
+    def test_append_is_monotonic_and_loads_in_order(self, tmp_path):
+        j = SchedulerJournal(tmp_path / "j.jsonl")
+        s1 = j.append(wal.J_JOB_QUEUED, ts_ms=1, job_id="a")
+        s2 = j.append(wal.J_JOB_LAUNCHED, ts_ms=2, job_id="a")
+        assert (s1, s2) == (1, 2)
+        assert j.last_seq == 2
+        kinds = [r["kind"] for r in SchedulerJournal.load(tmp_path / "j.jsonl")]
+        assert kinds == [wal.J_JOB_QUEUED, wal.J_JOB_LAUNCHED]
+
+    def test_torn_tail_is_skipped(self, tmp_path):
+        """A SIGKILL mid-append leaves half a line; the loader must
+        keep every complete record and drop only the torn tail."""
+        path = tmp_path / "j.jsonl"
+        j = SchedulerJournal(path)
+        j.append(wal.J_JOB_QUEUED, ts_ms=1, job_id="a")
+        j.append(wal.J_JOB_QUEUED, ts_ms=2, job_id="b")
+        with open(path, "ab") as f:
+            f.write(b'{"seq": 3, "ts_ms": 3, "kind": "job_laun')
+        records = SchedulerJournal.load(path)
+        assert [r["job_id"] for r in records] == ["a", "b"]
+        # And a journal reopened over the torn file continues PAST the
+        # highest parseable seq — never reuses one.
+        assert SchedulerJournal(path).append(
+            wal.J_JOB_QUEUED, ts_ms=4, job_id="c"
+        ) == 3
+
+    def test_garbage_lines_are_skipped(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        path.write_bytes(
+            b'\x00\xffgarbage\n'
+            b'{"seq": 1, "ts_ms": 1, "kind": "job_queued", "job_id": "a"}\n'
+            b'[1, 2, 3]\n'
+            b'{"seq": "not-an-int", "kind": "job_queued"}\n'
+            b'{"no": "kind", "seq": 9}\n'
+        )
+        records = SchedulerJournal.load(path)
+        assert len(records) == 1 and records[0]["job_id"] == "a"
+
+    def test_rotate_drops_folded_prefix(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        j = SchedulerJournal(path)
+        for i in range(5):
+            j.append(wal.J_JOB_QUEUED, ts_ms=i, job_id=f"j{i}")
+        assert j.rotate(up_to_seq=3) == 2
+        seqs = [r["seq"] for r in SchedulerJournal.load(path)]
+        assert seqs == [4, 5]
+        # seq keeps counting from where it was, not from the survivors.
+        assert j.append(wal.J_JOB_QUEUED, ts_ms=9, job_id="x") == 6
+        assert j.records_since_rotate == 3
+
+    def test_resync_continues_past_foreign_records(self, tmp_path):
+        """A standby taking over a shared journal must continue the seq
+        sequence past the dead leader's records, not collide."""
+        path = tmp_path / "j.jsonl"
+        leader = SchedulerJournal(path)
+        standby = SchedulerJournal(path)  # opened when journal was empty
+        leader.append(wal.J_JOB_QUEUED, ts_ms=1, job_id="a")
+        leader.append(wal.J_JOB_LAUNCHED, ts_ms=2, job_id="a")
+        assert standby.resync() == 2
+        assert standby.append(wal.J_JOB_FINISHED, ts_ms=3, job_id="a",
+                              state="SUCCEEDED") == 3
+
+    def test_snapshot_loader_degrades_to_none(self, tmp_path):
+        assert wal.load_snapshot(tmp_path / "missing.json") is None
+        torn = tmp_path / "torn.json"
+        torn.write_bytes(b'{"journal_seq": 12, "jobs": [')
+        assert wal.load_snapshot(torn) is None
+        not_a_dict = tmp_path / "list.json"
+        not_a_dict.write_text("[1, 2]")
+        assert wal.load_snapshot(not_a_dict) is None
+
+
+# ---------------------------------------------------------------------------
+# Replay units
+# ---------------------------------------------------------------------------
+def _rec(seq, kind, **fields):
+    return {"seq": seq, "ts_ms": seq, "kind": kind, **fields}
+
+
+class TestReplay:
+    def test_job_lifecycle_folds(self):
+        out = wal.replay(None, [
+            _rec(1, wal.J_JOB_QUEUED, job_id="a", app_dir="/x",
+                 priority=2, tenant="t", submit_ms=1, seq_no=1),
+            _rec(2, wal.J_SLICE_LEASED, slice_id="s1", job_id="a",
+                 profile="local", workspace="/w", expires_ms=99),
+            _rec(3, wal.J_JOB_LAUNCHED, job_id="a", app_id="app1",
+                 slice_id="s1", attempt=1),
+            _rec(4, wal.J_JOB_FINISHED, job_id="a", state="SUCCEEDED"),
+            _rec(5, wal.J_SLICE_RELEASED, slice_id="s1", job_id="a",
+                 healthy=True),
+        ])
+        assert out["journal_seq"] == 5
+        job = out["jobs"]["a"]
+        assert job["state"] == "SUCCEEDED"
+        assert job["app_ids"] == ["app1"]
+        assert out["slices"]["s1"]["state"] == "FREE"
+
+    def test_watermark_skips_snapshotted_records(self):
+        snapshot = {"journal_seq": 2,
+                    "jobs": [{"job_id": "a", "state": "RUNNING",
+                              "seq": 1}]}
+        out = wal.replay(snapshot, [
+            _rec(1, wal.J_JOB_QUEUED, job_id="a"),       # folded already
+            _rec(2, wal.J_JOB_LAUNCHED, job_id="a"),     # folded already
+            _rec(3, wal.J_JOB_FINISHED, job_id="a", state="FAILED"),
+        ])
+        assert out["jobs"]["a"]["state"] == "FAILED"
+        assert out["journal_seq"] == 3
+
+    def test_goodput_folds_exactly_once(self):
+        """The idempotence contract: an attempt id in the snapshot's
+        folded list (or seen twice in the tail) must not double-count."""
+        snapshot = {"journal_seq": 0, "folded": ["app-old"],
+                    "goodput": {"tenants": {"t": {"productive": 10.0}}}}
+        out = wal.replay(snapshot, [
+            _rec(1, wal.J_GOODPUT_FOLDED, app_id="app-old", tenant="t",
+                 chip_seconds={"productive": 10.0}),    # replayed fold
+            _rec(2, wal.J_GOODPUT_FOLDED, app_id="app-new", tenant="t",
+                 chip_seconds={"productive": 5.0}, queued_chip_s=1.0),
+            _rec(3, wal.J_GOODPUT_FOLDED, app_id="app-new", tenant="t",
+                 chip_seconds={"productive": 5.0}),     # duplicate
+        ])
+        assert out["tenants"]["t"]["productive"] == 15.0
+        assert out["tenants"]["t"]["queued"] == 1.0
+        assert sorted(out["folded"]) == ["app-new", "app-old"]
+
+    def test_queued_jobs_preserve_priority_band_order(self):
+        out = wal.replay(None, [
+            _rec(1, wal.J_JOB_QUEUED, job_id="lo1", priority=0, seq_no=1),
+            _rec(2, wal.J_JOB_QUEUED, job_id="hi1", priority=5, seq_no=2),
+            _rec(3, wal.J_JOB_QUEUED, job_id="hi2", priority=5, seq_no=3),
+        ])
+        assert [j["job_id"] for j in wal.queued_jobs(out)] == \
+            ["hi1", "hi2", "lo1"]
+
+    def test_unhealthy_release_and_retire_drop_slice(self):
+        out = wal.replay(None, [
+            _rec(1, wal.J_SLICE_LEASED, slice_id="s1", job_id="a"),
+            _rec(2, wal.J_SLICE_RELEASED, slice_id="s1", healthy=False),
+            _rec(3, wal.J_SLICE_LEASED, slice_id="s2", job_id="b"),
+            _rec(4, wal.J_SLICE_RETIRED, slice_id="s2",
+                 reason="lease_expired"),
+        ])
+        assert out["slices"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Election units
+# ---------------------------------------------------------------------------
+class TestElection:
+    def test_second_daemon_blocks_while_leader_lives(self, tmp_path):
+        a = LeaseElection(FileElectionBackend(tmp_path, node_id="a"))
+        b = LeaseElection(FileElectionBackend(tmp_path, node_id="b"))
+        assert a.try_acquire() and a.epoch == 1
+        assert not b.try_acquire()
+        a.release()
+        assert b.try_acquire() and b.epoch == 2
+
+    def test_sigkill_flock_drop_means_instant_takeover(self, tmp_path):
+        """abandon() leaves exactly what SIGKILL leaves: a fresh
+        heartbeat but a free flock — the standby takes over on the
+        fast path without waiting out the lease."""
+        a = LeaseElection(FileElectionBackend(tmp_path, node_id="a"))
+        assert a.try_acquire()
+        a.abandon()
+        b = LeaseElection(FileElectionBackend(tmp_path, node_id="b"))
+        assert b.try_acquire() and b.epoch == 2
+
+    def test_stale_heartbeat_is_stolen(self, tmp_path):
+        """The wedged-alive leader: flock held, heartbeat stale — a
+        standby must steal by bumping the epoch past it."""
+        clock = [1000]
+        a = LeaseElection(
+            FileElectionBackend(tmp_path, node_id="a",
+                                clock_ms=lambda: clock[0]),
+            lease_ms=500, clock_ms=lambda: clock[0],
+        )
+        assert a.try_acquire()
+        b = LeaseElection(
+            FileElectionBackend(tmp_path, node_id="b",
+                                clock_ms=lambda: clock[0]),
+            lease_ms=500, clock_ms=lambda: clock[0],
+        )
+        assert not b.try_acquire()  # fresh heartbeat, flock held
+        clock[0] += 10_000          # a's heartbeat goes stale un-renewed
+        assert b.try_acquire() and b.epoch == 2
+        # The deposed holder's next heartbeat fails — stop actuating.
+        clock[0] += 1000
+        assert not a.heartbeat()
+        assert not a.is_leader
+
+    def test_check_fence_catches_deposition(self, tmp_path):
+        backend = MemoryElectionBackend(node_id="a")
+        a = LeaseElection(backend, lease_ms=10 ** 9)
+        assert a.try_acquire()
+        assert a.check_fence()
+        backend.depose("usurper")
+        assert not a.check_fence()
+        assert not a.is_leader
+
+
+# ---------------------------------------------------------------------------
+# Scheduler fault-plan validation + windows
+# ---------------------------------------------------------------------------
+class TestSchedulerFaults:
+    def test_crash_phase_is_validated(self):
+        with pytest.raises(FaultPlanError, match="at must be one of"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "crash_scheduler", "at": "somewhere"},
+            ]}))
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "crash_scheduler", "at": phase}
+            for phase in SCHEDULER_PHASES
+        ]}))
+        assert len(plan.specs) == 3
+
+    def test_partition_requires_window(self):
+        with pytest.raises(FaultPlanError, match="ms must be nonzero"):
+            FaultPlan.parse(json.dumps({"faults": [
+                {"action": "partition_scheduler"},
+            ]}))
+
+    def test_partition_window_opens_and_closes(self):
+        plan = FaultPlan.parse(json.dumps({"faults": [
+            {"action": "partition_scheduler", "after_ms": 1000, "ms": 500},
+        ]}))
+        clock = [0.0]
+        faults = SchedulerFaults(plan, clock=lambda: clock[0])
+        assert not faults.rpc_partitioned()
+        clock[0] = 1.2   # 1200 ms after daemon birth: inside the window
+        assert faults.rpc_partitioned()
+        clock[0] = 1.6   # window over
+        assert not faults.rpc_partitioned()
+
+
+# ---------------------------------------------------------------------------
+# Thin-client retry backoff
+# ---------------------------------------------------------------------------
+class TestClientRetries:
+    def test_backoff_is_bounded_exponential(self):
+        """Against a dead port every attempt refuses; the sleeps
+        between them must double from backoff_ms and stay bounded."""
+        delays = []
+        with pytest.raises(OSError):
+            scheduler_request(
+                "127.0.0.1:1", "/api/state", timeout_s=0.5,
+                retries=6, backoff_ms=100, sleep=delays.append,
+            )
+        assert delays == [0.1, 0.2, 0.4, 0.8, 0.8]  # capped at 8x
+
+    def test_single_retry_never_sleeps(self):
+        delays = []
+        with pytest.raises(OSError):
+            scheduler_request(
+                "127.0.0.1:1", "/api/state", timeout_s=0.5,
+                retries=1, backoff_ms=100, sleep=delays.append,
+            )
+        assert delays == []
+
+
+# ---------------------------------------------------------------------------
+# Daemon-level recovery (mini-cluster, jax-free fixtures)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def cluster(tmp_path):
+    with MiniTonyCluster(tmp_path) as c:
+        yield c
+
+
+def _sched_conf(cluster, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_SCHED_TICK_MS, 50)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _job_conf(cluster, fixture, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / fixture))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _events(daemon, kind):
+    return [e for e in daemon.events.to_dicts() if e["kind"] == kind]
+
+
+def _crash(daemon):
+    """Kill an in-process daemon the way SIGKILL would: loop stopped
+    dead, flock dropped, heartbeat left to go stale, no clean release,
+    no final state publish."""
+    daemon._stop.set()
+    daemon._wake.set()
+    if daemon._thread is not None:
+        daemon._thread.join(timeout=30)
+    daemon.election.abandon()
+
+
+def test_recovery_restores_queue_in_priority_band_order(cluster):
+    """Queued jobs survive a daemon crash and relaunch in exactly the
+    order the dead daemon would have served (priority DESC, arrival
+    ASC) — with zero slots the first daemon can only queue."""
+    base = cluster.base_dir / "sched"
+    d1 = SchedulerDaemon(base, conf=_sched_conf(
+        cluster, **{keys.K_SCHED_MAX_SLICES: 0},
+    )).start(serve_http=False)
+    lo = d1.submit(_job_conf(cluster, "exit_0.py",
+                             **{keys.K_SCHED_PRIORITY: 0}))
+    hi1 = d1.submit(_job_conf(cluster, "exit_0.py",
+                              **{keys.K_SCHED_PRIORITY: 5}))
+    hi2 = d1.submit(_job_conf(cluster, "exit_0.py",
+                              **{keys.K_SCHED_PRIORITY: 5}))
+    _crash(d1)
+
+    d2 = SchedulerDaemon(base, conf=_sched_conf(
+        cluster, **{keys.K_SCHED_MAX_SLICES: 1},
+    )).start(serve_http=False)
+    try:
+        for job_id in (hi1, hi2, lo):
+            assert d2.wait_job(job_id, 90) is JobState.SUCCEEDED
+        recovered = _events(d2, "scheduler_recovered")
+        assert len(recovered) == 1
+        assert recovered[0]["resubmitted"] == 3
+        launches = [e["job_id"] for e in _events(d2, "job_launched")]
+        assert launches == [hi1, hi2, lo]
+        # Fresh ids keep counting past recovered ones — no collision.
+        fresh = d2.submit(_job_conf(cluster, "exit_0.py"))
+        assert fresh not in (lo, hi1, hi2)
+        assert d2.wait_job(fresh, 90) is JobState.SUCCEEDED
+    finally:
+        d2.shutdown()
+
+
+def test_daemon_boots_on_torn_journal_and_garbage_snapshot(cluster):
+    """Loader hardening end-to-end: a torn journal tail plus a garbage
+    snapshot must degrade to journal-replay recovery, not a boot
+    crash."""
+    base = cluster.base_dir / "sched"
+    d1 = SchedulerDaemon(base, conf=_sched_conf(
+        cluster, **{keys.K_SCHED_MAX_SLICES: 0},
+    )).start(serve_http=False)
+    job_id = d1.submit(_job_conf(cluster, "exit_0.py"))
+    _crash(d1)
+    with open(base / wal.JOURNAL_FILE, "ab") as f:
+        f.write(b'{"seq": 999, "kind": "job_qu')       # torn tail
+    (base / "scheduler-state.json").write_bytes(b"\x00\xffnot json")
+
+    d2 = SchedulerDaemon(base, conf=_sched_conf(
+        cluster, **{keys.K_SCHED_MAX_SLICES: 1},
+    )).start(serve_http=False)
+    try:
+        assert d2.wait_job(job_id, 90) is JobState.SUCCEEDED
+    finally:
+        d2.shutdown()
+
+
+@pytest.mark.parametrize("phase", SCHEDULER_PHASES)
+def test_kill_at_every_transition_recovers(cluster, phase):
+    """The kill-at-every-transition contract: a daemon SIGKILLed
+    (os._exit via the fault plan) at each journal/actuation boundary
+    leaves a base dir a fresh daemon recovers — the job is not lost,
+    not launched twice, and finishes."""
+    base = cluster.base_dir
+    proc = subprocess.Popen(
+        [sys.executable, str(FIXTURES / "sched_kill_stage.py"),
+         str(base), phase, str(FIXTURES / "exit_0.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=_fixture_env(),
+    )
+    job_id = proc.stdout.readline().strip()
+    rc = proc.wait(timeout=120)
+    assert rc == 1, f"daemon did not crash at {phase} (exit {rc})"
+    assert job_id.startswith("job_")
+
+    d2 = SchedulerDaemon(base / "sched", conf=_sched_conf(
+        cluster, **{keys.K_SCHED_MAX_SLICES: 1},
+    )).start(serve_http=False)
+    try:
+        assert d2.wait_job(job_id, 120) is JobState.SUCCEEDED
+        # Exactly one post-recovery launch — never a duplicate.
+        launches = _events(d2, "job_launched")
+        assert [e["job_id"] for e in launches] == [job_id]
+        job = d2.job(job_id)
+        if phase == "post-journal":
+            # The journaled-but-never-created attempt was classified
+            # dead and requeued: the successful run is attempt 2.
+            assert job.attempts == 2
+        elif phase == "mid-tick":
+            assert job.attempts == 1
+        # Goodput folded exactly once for the one real attempt.
+        state = d2.state_json()
+        assert len(state["folded"]) == len(set(state["folded"])) == 1
+    finally:
+        d2.shutdown()
+
+
+def test_standby_refuses_submit_then_takes_over(cluster):
+    """Active/standby pair on one base dir: the standby rejects
+    submissions while the leader lives, then wins the seat at a higher
+    epoch once the leader dies and serves the same queue."""
+    base = cluster.base_dir / "sched"
+    conf_a = _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1,
+                                     keys.K_SCHED_HA_LEASE_MS: 500,
+                                     keys.K_SCHED_HA_NODE_ID: "a"})
+    conf_b = _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1,
+                                     keys.K_SCHED_HA_LEASE_MS: 500,
+                                     keys.K_SCHED_HA_NODE_ID: "b"})
+    a = SchedulerDaemon(base, conf=conf_a).start(serve_http=False)
+    b = SchedulerDaemon(base, conf=conf_b).start(serve_http=False)
+    try:
+        assert a.election.is_leader and not b.election.is_leader
+        with pytest.raises(RuntimeError, match="not the leader"):
+            b.submit(_job_conf(cluster, "exit_0.py"))
+        epoch_a = a.election.epoch
+        _crash(a)
+        deadline = time.monotonic() + 30
+        while not b.election.is_leader:
+            assert time.monotonic() < deadline, "standby never took over"
+            time.sleep(0.05)
+        assert b.election.epoch > epoch_a
+        job_id = b.submit(_job_conf(cluster, "exit_0.py"))
+        assert b.wait_job(job_id, 90) is JobState.SUCCEEDED
+        assert len(_events(b, "leader_elected")) == 1
+    finally:
+        b.shutdown()
+
+
+def test_deposed_zombie_leader_cannot_double_actuate(cluster):
+    """The epoch-fence acceptance: a leader whose lease was stolen
+    mid-tick (heartbeat still inside its throttle window, so only the
+    fence can catch it) must abdicate instead of launching — across
+    TWO ticks nothing lands in the journal past the deposition."""
+    base = cluster.base_dir / "sched"
+    backend = MemoryElectionBackend(node_id="a")
+    daemon = SchedulerDaemon(
+        base,
+        conf=_sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1}),
+        election=LeaseElection(backend, lease_ms=10 ** 9),
+    )
+    job_id = daemon.submit(_job_conf(cluster, "exit_0.py"))
+    seq_before = daemon.journal.last_seq
+
+    backend.depose("usurper")
+    daemon._tick()  # zombie tick 1: pop → fence check → abdicate
+    deadline = time.monotonic() + 10
+    while not daemon._stop.is_set():
+        assert time.monotonic() < deadline, "zombie never abdicated"
+        time.sleep(0.02)
+    daemon._tick()  # zombie tick 2: heartbeat fails outright
+
+    records = SchedulerJournal.load(base / wal.JOURNAL_FILE)
+    post = [r for r in records if r["seq"] > seq_before]
+    assert not any(r["kind"] in (wal.J_JOB_LAUNCHED, wal.J_SLICE_LEASED)
+                   for r in post), post
+    job = daemon.job(job_id)
+    assert job is not None and not job.state.terminal
+    assert job.attempts == 0
+
+
+def test_partition_window_rides_out_on_client_retries(cluster):
+    """partition_scheduler drops every RPC inside its window; a thin
+    client with retry backoff must ride it out and read state."""
+    import urllib.request
+
+    plan = json.dumps({"faults": [
+        {"action": "partition_scheduler", "after_ms": 0, "ms": 700},
+    ]})
+    daemon = SchedulerDaemon(
+        cluster.base_dir / "sched",
+        conf=_sched_conf(cluster, **{keys.K_FAULT_PLAN: plan}),
+    ).start(serve_http=True)
+    try:
+        addr = f"127.0.0.1:{daemon.http_server.port}"
+        # Inside the window a bare request dies...
+        with pytest.raises((OSError, ValueError)):
+            urllib.request.urlopen(f"http://{addr}/api/state", timeout=5)
+        # ...but the retrying client path lands once it closes.
+        state = scheduler_request(addr, "/api/state", timeout_s=5,
+                                  retries=8, backoff_ms=200)
+        assert state["ha"]["epoch"] >= 1
+    finally:
+        daemon.shutdown()
+
+
+def test_detached_attempt_runs_and_journals(cluster):
+    """Detached mode smoke (tier-1): the coordinator runs as its own
+    session-leader subprocess, the daemon tracks it via
+    coordinator.pid + final-status.json, and the journal says so."""
+    daemon = SchedulerDaemon(
+        cluster.base_dir / "sched",
+        conf=_sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 1,
+                                     keys.K_SCHED_DETACHED: True}),
+    ).start(serve_http=False)
+    try:
+        job_id = daemon.submit(_job_conf(cluster, "exit_0.py"))
+        assert daemon.wait_job(job_id, 120) is JobState.SUCCEEDED
+        job = daemon.job(job_id)
+        app_dir = Path(job.app_dir)
+        assert (app_dir / "final-status.json").is_file()
+        assert (app_dir / "coordinator.pid").is_file()
+        launched = [r for r in SchedulerJournal.load(
+            daemon.base_dir / wal.JOURNAL_FILE
+        ) if r["kind"] == wal.J_JOB_LAUNCHED]
+        assert len(launched) == 1 and launched[0]["detached"] is True
+    finally:
+        daemon.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Failover chaos acceptance (slow): SIGKILL mid-run, nothing lost,
+# nothing twice
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_failover_chaos_sigkill_daemon_mid_run(tmp_path):
+    """The acceptance shape: SIGKILL the daemon with one RUNNING
+    detached job, one quota-blocked QUEUED job, and one warm-idle
+    slice. The restarted daemon re-attaches the live attempt WITHOUT
+    restarting it, relaunches the queued job in order on the re-adopted
+    warm slice, both SUCCEED with exactly one attempt record each, and
+    tenant goodput folds exactly once per attempt."""
+    base = tmp_path
+    marker = base / "marker.txt"
+    proc = subprocess.Popen(
+        [sys.executable, str(FIXTURES / "sched_ha_chaos.py"),
+         str(base), str(marker), "15"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=_fixture_env(),
+    )
+    warm_id, run_id, queued_id = proc.stdout.readline().split()
+
+    state_file = base / "sched" / "scheduler-state.json"
+
+    def shape_reached() -> bool:
+        if not marker.exists() or not state_file.is_file():
+            return False
+        try:
+            state = json.loads(state_file.read_text())
+        except ValueError:
+            return False  # racing the atomic replace
+        jobs = {j["job_id"]: j["state"] for j in state.get("jobs", [])}
+        slices = [s["state"] for s in state.get("pool", [])]
+        return (jobs.get(warm_id) == "SUCCEEDED"
+                and jobs.get(run_id) == "RUNNING"
+                and jobs.get(queued_id) == "QUEUED"
+                and "FREE" in slices)
+    deadline = time.monotonic() + 120
+    while not shape_reached():
+        assert proc.poll() is None, "chaos daemon died before the kill"
+        assert time.monotonic() < deadline, "acceptance shape never formed"
+        time.sleep(0.1)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    conf = TonyConfiguration()
+    conf.set(keys.K_STAGING_LOCATION, str(base / "staging"))
+    conf.set(keys.K_HISTORY_LOCATION, str(base / "history"))
+    conf.set(keys.K_AM_STOP_GRACE_MS, 0)
+    conf.set(keys.K_SCHED_TICK_MS, 50)
+    conf.set(keys.K_SCHED_MAX_SLICES, 2)
+    conf.set(keys.K_SCHED_DETACHED, True)
+    conf.set(keys.K_SCHED_TENANT_QUOTA, 1)
+    d2 = SchedulerDaemon(base / "sched", conf=conf).start(serve_http=False)
+    try:
+        # The live attempt was ADOPTED, not restarted.
+        adopted = _events(d2, "attempt_adopted")
+        assert [e["job_id"] for e in adopted] == [run_id]
+        assert d2.wait_job(run_id, 120) is JobState.SUCCEEDED
+        assert d2.wait_job(queued_id, 120) is JobState.SUCCEEDED
+
+        # Exactly one attempt record each — no restart, no duplicate.
+        assert d2.job(run_id).attempts == 1
+        assert d2.job(queued_id).attempts == 1
+        assert len(d2.job(run_id).app_ids) == 1
+        # The adopted job's worker ran exactly once (one marker line).
+        assert marker.read_text().splitlines() == ["resume=None"]
+        # The queued job relaunched on the re-adopted WARM slice.
+        launches = _events(d2, "job_launched")
+        assert [e["job_id"] for e in launches] == [queued_id]
+        assert launches[0]["warm"] is True
+
+        # Goodput folded exactly once per attempt, across both lives:
+        # every goodput_folded record in the whole journal names a
+        # distinct attempt, and the recovered daemon's folded set
+        # matches.
+        records = SchedulerJournal.load(base / "sched" / wal.JOURNAL_FILE)
+        folds = [r["app_id"] for r in records
+                 if r["kind"] == wal.J_GOODPUT_FOLDED]
+        assert len(folds) == len(set(folds)) == 3
+        state = d2.state_json()
+        assert sorted(state["folded"]) == sorted(folds)
+        assert state["ha"]["epoch"] >= 2  # takeover bumped the epoch
+    finally:
+        d2.shutdown()

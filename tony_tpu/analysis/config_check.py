@@ -75,6 +75,12 @@ _MIN_ONE_KEYS = frozenset({
     # place a job.
     keys.K_SCHED_TICK_MS,
     keys.K_SCHED_MAX_SLICES,
+    # A zero-ms leadership lease makes every heartbeat already stale
+    # (standbys would steal the epoch between any two writes); a
+    # zero-record compaction threshold rewrites the journal on every
+    # append.
+    keys.K_SCHED_HA_LEASE_MS,
+    keys.K_SCHED_HA_JOURNAL_MAX,
     # A zero-length capture window profiles nothing (0 must be an
     # explicit CLI omission, not a configured default).
     keys.K_PROFILE_DURATION_MS,

@@ -1171,7 +1171,15 @@ def _scheduler_state(args) -> tuple[dict | None, str]:
         args.scheduler_dir or conf.get_str(keys.K_SCHED_BASE_DIR) or "."
     )
     addr = args.scheduler or conf.get_str(keys.K_SCHED_ADDRESS) or None
-    return read_state(base_dir, addr=addr)
+    # Bounded-backoff retries so `tony ps|queue` ride out a failover
+    # window instead of dropping to the history fallback mid-restart.
+    return read_state(
+        base_dir, addr=addr,
+        retries=max(conf.get_int(keys.K_SCHED_CLIENT_RETRIES, 5), 1),
+        backoff_ms=max(
+            conf.get_int(keys.K_SCHED_CLIENT_BACKOFF_MS, 250), 1
+        ),
+    )
 
 
 def _fmt_age(now_ms: int, then_ms: int | None) -> str:

@@ -324,20 +324,28 @@ class TonyClient:
             return rc
         return self.monitor()
 
-    def _submit_to_scheduler(self, addr: str) -> str:
-        """POST the staged app dir to the scheduler daemon's JSON API.
-        The daemon reads priority/tenant from the frozen conf inside the
-        app dir (shared filesystem with the daemon, like the staging
-        location itself)."""
-        import urllib.request
-
-        body = json.dumps({"app_dir": str(self.app_dir)}).encode()
-        req = urllib.request.Request(
-            f"http://{addr}/api/submit", data=body,
-            headers={"Content-Type": "application/json"},
+    def _scheduler_retries(self) -> tuple[int, int]:
+        """(retries, backoff_ms) for scheduler RPCs — tuned so a thin
+        client rides out a control-plane failover (daemon restart or
+        standby takeover) instead of failing the user's command."""
+        return (
+            max(self.conf.get_int(keys.K_SCHED_CLIENT_RETRIES, 5), 1),
+            max(self.conf.get_int(keys.K_SCHED_CLIENT_BACKOFF_MS, 250), 1),
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            doc = json.loads(resp.read())
+
+    def _submit_to_scheduler(self, addr: str) -> str:
+        """POST the staged app dir to the scheduler daemon's JSON API
+        (with bounded-backoff retries: a failing-over scheduler answers
+        a few hundred ms late, not never). The daemon reads
+        priority/tenant from the frozen conf inside the app dir (shared
+        filesystem with the daemon, like the staging location itself)."""
+        from tony_tpu.scheduler.http import scheduler_request
+
+        retries, backoff_ms = self._scheduler_retries()
+        doc = scheduler_request(
+            addr, "/api/submit", payload={"app_dir": str(self.app_dir)},
+            timeout_s=30, retries=retries, backoff_ms=backoff_ms,
+        )
         job_id = doc.get("job_id")
         if not job_id:
             raise ValueError(f"scheduler returned no job_id: {doc}")
@@ -347,21 +355,24 @@ class TonyClient:
         """Poll the scheduler's job record until terminal, logging state
         transitions (QUEUED → RUNNING → ... PREEMPTED jobs requeue, so a
         RUNNING → QUEUED transition is normal, not a bug)."""
-        import urllib.request
-
         addr = self.conf.get_str(keys.K_SCHED_ADDRESS)
         interval_s = self.conf.get_int(
             keys.K_CLIENT_MONITOR_INTERVAL_MS, 1000) / 1000
         last_state = None
         misses = 0
+        retries, backoff_ms = self._scheduler_retries()
         while True:
             try:
-                with urllib.request.urlopen(
-                    f"http://{addr}/api/job/{self.job_id}", timeout=10
-                ) as resp:
-                    job = json.loads(resp.read())
+                from tony_tpu.scheduler.http import scheduler_request
+
+                job = scheduler_request(
+                    addr, f"/api/job/{self.job_id}", timeout_s=10,
+                    retries=retries, backoff_ms=backoff_ms,
+                )
                 misses = 0
             except (OSError, ValueError):
+                # Each miss already burned the full retry budget: a
+                # scheduler down this long is down, not failing over.
                 misses += 1
                 if misses >= 5:
                     log.error("scheduler %s stopped answering", addr)

@@ -340,6 +340,33 @@ K_SCHED_TENANT_QUOTAS = SCHEDULER_PREFIX + "tenant-quotas"
 # May a higher-priority submit preempt a running lower-priority job?
 # (Preempted jobs requeue and resume from their best checkpoint step.)
 K_SCHED_PREEMPTION = SCHEDULER_PREFIX + "preemption-enabled"
+# --- control-plane HA (scheduler/{journal,election}.py) ---------------
+# Stable identity of this daemon in the leader election's heartbeat
+# file (default: hostname-pid). An active/standby pair needs distinct
+# ids on a shared base-dir.
+K_SCHED_HA_NODE_ID = SCHEDULER_PREFIX + "ha-node-id"
+# Leadership lease, ms: the leader heartbeats at a third of this; a
+# standby whose view of the heartbeat is staler than this steals the
+# epoch. Failover detection latency trades directly against heartbeat
+# I/O.
+K_SCHED_HA_LEASE_MS = SCHEDULER_PREFIX + "ha-lease-ms"
+# Journal compaction threshold: once this many records accumulate past
+# the last snapshot, the next publish folds them in and truncates the
+# journal (recovery replays at most this many records).
+K_SCHED_HA_JOURNAL_MAX = SCHEDULER_PREFIX + "ha-journal-max-records"
+# Run each attempt's coordinator as a DETACHED subprocess
+# (start_new_session) instead of a daemon thread: the attempt survives
+# the daemon's death, and a recovered/standby daemon re-attaches it via
+# its pid file + observability port instead of restarting it. Costs the
+# in-process spare-pool healing seam (detached coordinators heal like
+# standalone ones).
+K_SCHED_DETACHED = SCHEDULER_PREFIX + "detached-attempts"
+# Thin-client resilience across a failover window: how many times (and
+# from what base backoff, doubling each retry) submit/monitor/ps/queue
+# retry a scheduler RPC that connection-refused — a daemon restart or
+# standby takeover must not fail every in-flight client.
+K_SCHED_CLIENT_RETRIES = SCHEDULER_PREFIX + "client-retries"
+K_SCHED_CLIENT_BACKOFF_MS = SCHEDULER_PREFIX + "client-backoff-ms"
 
 # --- storage / staging -----------------------------------------------------
 # Descoped from the reference (README "descoped keys"): tony.other.namenodes
@@ -492,6 +519,12 @@ DEFAULTS: dict[str, object] = {
     K_SCHED_TENANT_QUOTA: 0,
     K_SCHED_TENANT_QUOTAS: "",
     K_SCHED_PREEMPTION: True,
+    K_SCHED_HA_NODE_ID: "",
+    K_SCHED_HA_LEASE_MS: 5000,
+    K_SCHED_HA_JOURNAL_MAX: 4096,
+    K_SCHED_DETACHED: False,
+    K_SCHED_CLIENT_RETRIES: 5,
+    K_SCHED_CLIENT_BACKOFF_MS: 250,
     K_STAGING_LOCATION: "",
     K_STAGING_BLOB_MAX_BYTES: 0,
     K_LIB_PATH: "",

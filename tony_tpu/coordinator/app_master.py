@@ -16,6 +16,7 @@ import argparse
 import json
 import logging
 import os
+import signal
 import threading
 import time
 from dataclasses import asdict
@@ -1633,6 +1634,18 @@ def main(argv: list[str] | None = None) -> int:
         conf, args.app_dir, app_id=args.app_id, backend=backend,
         resume_step=args.resume_step,
     )
+    # Control-plane HA probes: the pid file is how a recovered scheduler
+    # tells a live detached coordinator from a dead one, and SIGTERM is
+    # the fallback kill path when the loopback /api/kill is unreachable
+    # — it drains gracefully (executors reaped, final-status written)
+    # instead of dying record-less.
+    try:
+        (Path(args.app_dir) / "coordinator.pid").write_text(
+            f"{os.getpid()}\n"
+        )
+    except OSError:
+        pass
+    signal.signal(signal.SIGTERM, lambda *_: coordinator.kill())
     status = coordinator.run()
     return 0 if status is SessionStatus.SUCCEEDED else 1
 

@@ -193,9 +193,18 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 f" / p95 {esc(str(wait.get('p95_ms')))} ms"
                 f" over {esc(str(wait.get('count')))} launch(es)"
             )
+        ha = state.get("ha") or {}
+        ha_line = ""
+        if ha.get("epoch") is not None:
+            ha_line = (
+                f" &middot; leader epoch {esc(str(ha.get('epoch')))}"
+                f" ({esc(str(ha.get('node') or '?'))})"
+            )
+            if ha.get("recovered_ms"):
+                ha_line += " &middot; recovered"
         body = (
             f"<p>source: {esc(source)} &middot; queue depth "
-            f"{state.get('queue_depth', 0)}{wait_line}</p>"
+            f"{state.get('queue_depth', 0)}{wait_line}{ha_line}</p>"
             "<h3>Jobs</h3><table><tr><th>job</th><th>state</th>"
             "<th>prio</th><th>tenant</th><th>slice</th><th>try</th>"
             f"<th>preempt</th><th>resume step</th></tr>{job_rows}</table>"

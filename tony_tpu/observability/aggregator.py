@@ -471,23 +471,24 @@ class _ObsHandler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         try:
             path, _, _ = self.path.partition("?")
-            if path != "/api/profile":
+            if path not in ("/api/profile", "/api/kill"):
                 self.send_error(404)
                 return
-            # The GET views are read-only telemetry; this is the ONE
-            # mutating route on a port that binds all interfaces for
-            # scrapers — and arming capture windows costs every chip.
-            # Loopback only: remote operators go through the
-            # authenticated client-role `request_profile` RPC instead.
+            # The GET views are read-only telemetry; these are the ONLY
+            # mutating routes on a port that binds all interfaces for
+            # scrapers. Loopback only: remote operators go through the
+            # authenticated client-role RPCs instead. The scheduler's
+            # kill/preempt of a DETACHED attempt lands on /api/kill
+            # (daemon and coordinator share the host).
             if self.client_address[0] not in ("127.0.0.1", "::1"):
                 self._send_json(
-                    {"error": "POST /api/profile is loopback-only; use "
-                              "the client-role request_profile RPC"},
+                    {"error": f"POST {path} is loopback-only; use the "
+                              f"authenticated client-role RPC"},
                     status=403,
                 )
                 return
             if self.control is None:
-                self._send_json({"error": "profiling unavailable"},
+                self._send_json({"error": "no coordinator control"},
                                 status=404)
                 return
             try:
@@ -495,8 +496,13 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 body = json.loads(self.rfile.read(n) or b"{}")
             except (ValueError, TypeError):
                 body = {}
-            duration = body.get("duration_ms") if isinstance(body, dict) \
-                else None
+            if not isinstance(body, dict):
+                body = {}
+            if path == "/api/kill":
+                self.control.kill(preempted=bool(body.get("preempted")))
+                self._send_json({"ok": True})
+                return
+            duration = body.get("duration_ms")
             self._send_json(self.control.start_profile(duration))
         except Exception as exc:  # pragma: no cover - defensive
             log.exception("observability POST failed")

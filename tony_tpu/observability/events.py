@@ -77,6 +77,15 @@ SLICE_PROVISIONING = "slice_provisioning"
 SLICE_LEASED = "slice_leased"
 SLICE_RELEASED = "slice_released"
 
+# Control-plane HA (scheduler/{journal,election,service}.py): a daemon
+# rebuilt its state from snapshot + write-ahead journal
+# (`scheduler_recovered`), won the lease election at a new epoch
+# (`leader_elected`), or re-attached a live detached coordinator
+# attempt instead of restarting it (`attempt_adopted`).
+SCHEDULER_RECOVERED = "scheduler_recovered"
+LEADER_ELECTED = "leader_elected"
+ATTEMPT_ADOPTED = "attempt_adopted"
+
 # The event catalogue: every kind any emitter may use. TONY-E001
 # (analysis/events_lint.py, run from tools/lint_self.py in tier-1)
 # checks that every ``.emit(...)`` in the tree uses a registered kind
@@ -113,6 +122,9 @@ KNOWN_KINDS = frozenset({
     SLICE_PROVISIONING,
     SLICE_LEASED,
     SLICE_RELEASED,
+    SCHEDULER_RECOVERED,
+    LEADER_ELECTED,
+    ATTEMPT_ADOPTED,
 })
 
 

@@ -424,6 +424,26 @@ class FleetGoodput:
             if queued_chip_s > 0:
                 acct["queued"] += float(queued_chip_s)
 
+    def restore(
+        self, tenants: Mapping[str, Mapping[str, Any]] | None
+    ) -> None:
+        """Recovery: replace the accounts with what the snapshot +
+        journal replay reconstructed (``replay()``'s ``tenants``).
+        Unknown categories are dropped, never fatal — a newer daemon's
+        snapshot must not wedge an older one's recovery."""
+        restored: dict[str, dict[str, float]] = {}
+        for tenant, acct in (tenants or {}).items():
+            out = dict.fromkeys(CATEGORIES, 0.0)
+            for c, v in (acct or {}).items():
+                if c in out:
+                    try:
+                        out[c] = float(v)
+                    except (TypeError, ValueError):
+                        continue
+            restored[str(tenant)] = out
+        with self._lock:
+            self._tenants = restored
+
     def fleet(self) -> dict[str, float]:
         with self._lock:
             out = dict.fromkeys(CATEGORIES, 0.0)

@@ -27,7 +27,9 @@ Plan shape (inline JSON in the conf value, or a path to a JSON file)::
         {"action": "throttle_io", "target": "worker:0", "ms": 50,
          "after_batches": 4, "count": 100},
         {"action": "degrade_task", "target": "worker:2", "ms": 400,
-         "after_steps": 2, "count": 100}
+         "after_steps": 2, "count": 100},
+        {"action": "crash_scheduler", "at": "post-journal"},
+        {"action": "partition_scheduler", "after_ms": 1000, "ms": 2000}
       ]
     }
 
@@ -88,6 +90,19 @@ degrade_task           the target's train loop sleeps ``ms`` on each of
                        to incarnation 0 only — it models a sick HOST, so
                        an evicted-and-replaced copy of the task runs
                        clean, exactly like a replacement on new hardware
+crash_scheduler        the scheduler daemon ``os._exit``\\ s at a chosen
+                       journal/actuation boundary (``at``:
+                       ``post-journal`` — a transition journaled but not
+                       yet acted on; ``mid-tick`` — between the lease
+                       sweeps and the pop loop; ``pre-publish`` — before
+                       the snapshot write). The control-plane HA chaos
+                       probe: recovery must reach a consistent state
+                       from whatever the crash left
+partition_scheduler    the scheduler's HTTP API drops every client and
+                       coordinator connection (no response, socket
+                       closed) for the window [after_ms, after_ms+ms) of
+                       the daemon's lifetime — the failover window thin
+                       clients must retry across
 =====================  =====================================================
 
 The legacy ``TEST_AM_CRASH`` / ``TEST_WORKER_TERMINATION`` env vars remain
@@ -119,8 +134,15 @@ FAIL_CHECKPOINT_WRITE = "fail_checkpoint_write"
 DELAY_CHECKPOINT_WRITE = "delay_checkpoint_write"
 THROTTLE_IO = "throttle_io"
 DEGRADE_TASK = "degrade_task"
+CRASH_SCHEDULER = "crash_scheduler"
+PARTITION_SCHEDULER = "partition_scheduler"
 
 COORDINATOR_PHASES = ("prepare", "schedule", "monitor")
+# Scheduler-daemon crash boundaries (crash_scheduler's ``at``): right
+# after a write-ahead journal append with the transition not yet acted
+# on; between a tick's lease sweeps and its pop loop; and right before
+# the snapshot publish.
+SCHEDULER_PHASES = ("post-journal", "mid-tick", "pre-publish")
 
 # action → (required fields, optional fields). "session" and "count" are
 # legal everywhere; everything else must be declared here — unknown fields
@@ -150,6 +172,8 @@ _FIELDS: dict[str, tuple[frozenset[str], frozenset[str]]] = {
         frozenset({"target", "ms"}),
         frozenset({"after_steps"}),
     ),
+    CRASH_SCHEDULER: (frozenset({"at"}), frozenset({"code"})),
+    PARTITION_SCHEDULER: (frozenset({"ms"}), frozenset({"after_ms"})),
 }
 _COMMON_FIELDS = frozenset({"action", "session", "count"})
 
@@ -314,6 +338,16 @@ def _parse_spec(i: int, obj: object, errors: list[str]) -> FaultSpec | None:
             errors.append(
                 f"{where}: {action} needs a concrete 'job:index' target"
             )
+    if action == CRASH_SCHEDULER and at not in SCHEDULER_PHASES:
+        errors.append(
+            f"{where}.at must be one of {list(SCHEDULER_PHASES)} for "
+            f"crash_scheduler, got {at!r}"
+        )
+    if action == PARTITION_SCHEDULER and ms == 0:
+        errors.append(
+            f"{where}.ms must be nonzero for partition_scheduler (a "
+            f"0 ms partition tests nothing)"
+        )
     if action in (THROTTLE_IO, DEGRADE_TASK, DELAY_CHECKPOINT_WRITE) \
             and ms == 0:
         errors.append(
@@ -582,6 +616,59 @@ class FaultInjector:
                     and self._take(idx, spec):
                 victims.append(spec.target)
         return victims
+
+
+class SchedulerFaults:
+    """Daemon-side enforcement of ``crash_scheduler`` and
+    ``partition_scheduler`` — the control-plane HA chaos seams. Held by
+    ``SchedulerDaemon``; the crash points sit at the journal/actuation
+    boundaries and the partition gate at the HTTP handler's front door.
+    """
+
+    def __init__(self, plan: FaultPlan | None,
+                 clock=time.monotonic) -> None:
+        self.plan = plan
+        self._clock = clock
+        self._born = clock()
+        self._fired: dict[int, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.plan is not None and bool(self.plan.specs)
+
+    def crash_point(self, at: str) -> None:
+        """``os._exit`` at boundary ``at`` if the plan says so — no
+        cleanup, no journal flush beyond what already landed: exactly
+        the state a SIGKILL would leave."""
+        if self.plan is None:
+            return
+        import os
+
+        for idx, spec in enumerate(self.plan.specs):
+            if spec.action != CRASH_SCHEDULER or spec.at != at:
+                continue
+            fired = self._fired.get(idx, 0)
+            if fired >= spec.count:
+                continue
+            self._fired[idx] = fired + 1  # tony: noqa[TONY-T003] — the very next statement is os._exit: no thread survives to race this count
+            log.error("fault injection: crashing scheduler at %s "
+                      "(exit %d)", at, spec.code)
+            os._exit(spec.code)
+
+    def rpc_partitioned(self) -> bool:
+        """Is a ``partition_scheduler`` window open right now? The HTTP
+        server drops (no response, connection closed) every request
+        that arrives inside it."""
+        if self.plan is None:
+            return False
+        elapsed_ms = (self._clock() - self._born) * 1000.0
+        for spec in self.plan.specs:
+            if spec.action != PARTITION_SCHEDULER:
+                continue
+            start = float(spec.after_ms or 0)
+            if start <= elapsed_ms < start + spec.ms:
+                return True
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -3,8 +3,18 @@ delegated to YARN's ResourceManager, rebuilt TPU-native: a persistent
 daemon queues many jobs (priorities + per-tenant quotas), gang-schedules
 them onto a pool of slices, reuses warm slices across jobs (skip
 provisioning, staging, and cold XLA compiles), and preempts across jobs
-with checkpoint-step resume."""
+with checkpoint-step resume. Control-plane HA rides on a write-ahead
+journal (crash-recoverable state), lease-based leader election (an
+active/standby pair on a shared base dir), and epoch fencing (a deposed
+zombie leader can never double-actuate)."""
 
+from tony_tpu.scheduler.election import (
+    ElectionBackend,
+    FileElectionBackend,
+    LeaseElection,
+    MemoryElectionBackend,
+)
+from tony_tpu.scheduler.journal import SchedulerJournal
 from tony_tpu.scheduler.pool import (
     LocalSliceProvisioner,
     PooledSlice,
@@ -21,12 +31,17 @@ from tony_tpu.scheduler.queue import (
 from tony_tpu.scheduler.service import SchedulerDaemon
 
 __all__ = [
+    "ElectionBackend",
+    "FileElectionBackend",
     "JobQueue",
     "JobState",
+    "LeaseElection",
     "LocalSliceProvisioner",
+    "MemoryElectionBackend",
     "PooledSlice",
     "SchedJob",
     "SchedulerDaemon",
+    "SchedulerJournal",
     "SlicePool",
     "SliceState",
     "TenantQuotas",

@@ -1,0 +1,300 @@
+"""Lease-based leader election for an active/standby scheduler pair.
+
+Two daemons share a base dir (shared filesystem, like the staging
+location itself). Exactly one may actuate at a time; the other watches
+and takes over through the same ``recover()`` path a restart uses. The
+mechanism is deliberately boring:
+
+* ``leader.lock`` — an ``fcntl.flock`` the leader holds for its
+  lifetime. A SIGKILLed leader's flock releases with its fds, so the
+  fast takeover path needs no timeout at all.
+* ``leader.json`` — the epoch-fenced heartbeat, atomically replaced:
+  ``{"epoch": n, "node": id, "ts_ms": t}``. The epoch increments on
+  every acquisition. A leader that cannot flock but sees a heartbeat
+  staler than the lease **steals** leadership by bumping the epoch
+  (serialized through a transient ``steal.lock`` flock so two standbys
+  cannot both steal) — this covers the wedged-alive leader whose fds
+  (and flock) never released.
+
+**Leadership is the epoch, not the lock.** ``check_fence()`` — called
+before every mutating actuation (launch, kill, preempt, lease) — reads
+``leader.json`` and compares epochs: a deposed zombie leader mid-tick
+sees a higher epoch and abdicates instead of double-launching a job or
+double-leasing a slice. The flock is only the fast-path mutex.
+
+The backend is an injectable seam (like ``SliceProvisioner``):
+``FileElectionBackend`` is the shared-filesystem implementation;
+``MemoryElectionBackend`` gives tests deterministic force-deposition;
+a real deployment could drop in etcd/ZK behind the same four methods.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import time
+from pathlib import Path
+from typing import Any, Callable, Protocol
+
+from tony_tpu.analysis import sync_sanitizer as _sync
+
+log = logging.getLogger(__name__)
+
+LOCK_FILE = "leader.lock"
+STEAL_LOCK_FILE = "steal.lock"
+HEARTBEAT_FILE = "leader.json"
+
+
+def default_node_id() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+class ElectionBackend(Protocol):
+    def try_acquire(self, stale_ms: int) -> int | None:
+        """Attempt to become leader. Returns the granted epoch, or None
+        while another holder's heartbeat is fresh."""
+
+    def heartbeat(self, epoch: int) -> bool:
+        """Refresh the heartbeat IF still the ``epoch`` leader. False
+        means deposed (a higher epoch exists) — stop actuating."""
+
+    def observe(self) -> dict[str, Any] | None:
+        """Current heartbeat doc ({epoch, node, ts_ms}) or None."""
+
+    def release(self, epoch: int) -> None:
+        """Abdicate: mark the heartbeat immediately stale so a standby
+        takes over without waiting out the lease."""
+
+
+class FileElectionBackend:
+    """See module docstring. flock + atomically-replaced heartbeat on a
+    shared base dir. Works across processes AND between two instances in
+    one process (flock exclusion is per open-file-description)."""
+
+    def __init__(self, base_dir: str | Path, node_id: str | None = None,
+                 clock_ms: Callable[[], int] | None = None) -> None:
+        self.base_dir = Path(base_dir)
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+        self.node_id = node_id or default_node_id()
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self._lock_fd: int | None = None
+
+    # -- heartbeat file ------------------------------------------------------
+    def observe(self) -> dict[str, Any] | None:
+        try:
+            doc = json.loads(
+                (self.base_dir / HEARTBEAT_FILE).read_text()
+            )
+        except (OSError, ValueError):
+            return None
+        if isinstance(doc, dict) and isinstance(doc.get("epoch"), int):
+            return doc
+        return None
+
+    def _write_heartbeat(self, epoch: int, ts_ms: int | None = None) -> None:
+        doc = {
+            "epoch": int(epoch),
+            "node": self.node_id,
+            "ts_ms": int(self._clock_ms() if ts_ms is None else ts_ms),
+        }
+        tmp = self.base_dir / f".{HEARTBEAT_FILE}.tmp.{os.getpid()}"
+        tmp.write_text(json.dumps(doc) + "\n")
+        tmp.replace(self.base_dir / HEARTBEAT_FILE)
+
+    # -- protocol ------------------------------------------------------------
+    def try_acquire(self, stale_ms: int) -> int | None:
+        import fcntl
+
+        if self._lock_fd is None:
+            fd = os.open(str(self.base_dir / LOCK_FILE),
+                         os.O_WRONLY | os.O_CREAT, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                return self._try_steal(stale_ms)
+            self._lock_fd = fd
+        cur = self.observe()
+        epoch = (cur["epoch"] if cur else 0) + 1
+        self._write_heartbeat(epoch)
+        return epoch
+
+    def _try_steal(self, stale_ms: int) -> int | None:
+        """The flock holder is alive-as-a-process but may be wedged: if
+        its heartbeat is staler than the lease, bump the epoch past it.
+        The transient steal lock serializes concurrent stealers; the
+        epoch fence handles the deposed holder if it ever wakes."""
+        import fcntl
+
+        cur = self.observe()
+        if cur is not None and \
+                self._clock_ms() - int(cur.get("ts_ms", 0)) <= stale_ms:
+            return None
+        fd = os.open(str(self.base_dir / STEAL_LOCK_FILE),
+                     os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return None  # another standby is mid-steal; defer to it
+            cur = self.observe()  # re-check under the steal lock
+            if cur is not None and \
+                    self._clock_ms() - int(cur.get("ts_ms", 0)) <= stale_ms:
+                return None
+            epoch = (cur["epoch"] if cur else 0) + 1
+            self._write_heartbeat(epoch)
+            log.warning("stole leadership at epoch %d (holder %s went "
+                        "stale)", epoch,
+                        cur.get("node") if cur else "<none>")
+            return epoch
+        finally:
+            os.close(fd)  # closing drops the transient flock
+
+    def heartbeat(self, epoch: int) -> bool:
+        cur = self.observe()
+        if cur is None or cur["epoch"] != epoch \
+                or cur.get("node") != self.node_id:
+            self._drop_lock()
+            return False
+        self._write_heartbeat(epoch)
+        return True
+
+    def release(self, epoch: int) -> None:
+        cur = self.observe()
+        if cur is not None and cur["epoch"] == epoch \
+                and cur.get("node") == self.node_id:
+            # ts_ms=0 reads as infinitely stale: a standby steals
+            # immediately instead of waiting out the lease.
+            self._write_heartbeat(epoch, ts_ms=0)
+        self._drop_lock()
+
+    def abandon(self) -> None:
+        """Crash simulation (tests, bench): drop the flock WITHOUT
+        touching the heartbeat — exactly what a SIGKILL leaves behind.
+        Standbys then take over via the fast flock path once the
+        heartbeat goes stale (or instantly, since the flock is free)."""
+        self._drop_lock()
+
+    def _drop_lock(self) -> None:
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+
+
+class MemoryElectionBackend:
+    """In-process backend for deterministic tests: ``depose()`` forces
+    a higher epoch the way a standby's steal would, without files or
+    clocks. Share one instance between two daemons to model a pair."""
+
+    def __init__(self, node_id: str | None = None) -> None:
+        self.node_id = node_id or default_node_id()
+        self._lock = _sync.make_lock("election.MemoryElectionBackend._lock")
+        self._epoch = 0
+        self._holder: str | None = None
+
+    def try_acquire(self, stale_ms: int) -> int | None:
+        with self._lock:
+            if self._holder is not None and self._holder != self.node_id:
+                return None
+            self._epoch += 1
+            self._holder = self.node_id
+            return self._epoch
+
+    def heartbeat(self, epoch: int) -> bool:
+        with self._lock:
+            return self._epoch == epoch and self._holder == self.node_id
+
+    def observe(self) -> dict[str, Any] | None:
+        with self._lock:
+            if self._holder is None:
+                return None
+            return {"epoch": self._epoch, "node": self._holder, "ts_ms": 0}
+
+    def release(self, epoch: int) -> None:
+        with self._lock:
+            if self._holder == self.node_id and self._epoch == epoch:
+                self._holder = None
+
+    def depose(self, new_holder: str = "usurper") -> int:
+        """Force-advance the epoch (the zombie-leader test's lever)."""
+        with self._lock:
+            self._epoch += 1
+            self._holder = new_holder
+            return self._epoch
+
+
+class LeaseElection:
+    """The daemon-facing wrapper: acquire, heartbeat (throttled to a
+    third of the lease), fence-check, release. Not thread-safe beyond
+    what the backend provides — the daemon calls it from its tick
+    thread plus ``check_fence`` from actuation paths, all reads."""
+
+    def __init__(self, backend: ElectionBackend, lease_ms: int = 5000,
+                 clock_ms: Callable[[], int] | None = None) -> None:
+        self.backend = backend
+        self.lease_ms = max(int(lease_ms), 1)
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self.epoch: int | None = None
+        self._last_heartbeat_ms = 0
+
+    @property
+    def is_leader(self) -> bool:
+        return self.epoch is not None
+
+    def try_acquire(self) -> bool:
+        if self.epoch is not None:
+            return True
+        epoch = self.backend.try_acquire(self.lease_ms)
+        if epoch is None:
+            return False
+        self.epoch = epoch
+        self._last_heartbeat_ms = self._clock_ms()
+        return True
+
+    def heartbeat(self) -> bool:
+        """Refresh the lease (throttled). False = deposed: the caller
+        must stop actuating immediately."""
+        if self.epoch is None:
+            return False
+        now = self._clock_ms()
+        if now - self._last_heartbeat_ms < self.lease_ms // 3:
+            return True
+        if not self.backend.heartbeat(self.epoch):
+            self.epoch = None
+            return False
+        self._last_heartbeat_ms = now
+        return True
+
+    def check_fence(self) -> bool:
+        """The epoch fence, read before every mutating actuation: am I
+        STILL the epoch the heartbeat file names? A deposed zombie's
+        in-flight tick fails here and must abdicate rather than
+        double-launch a job or double-lease a slice."""
+        if self.epoch is None:
+            return False
+        cur = self.backend.observe()
+        if cur is None or cur["epoch"] != self.epoch:
+            self.epoch = None
+            return False
+        return True
+
+    def release(self) -> None:
+        if self.epoch is not None:
+            try:
+                self.backend.release(self.epoch)
+            except OSError:
+                log.warning("could not release leadership", exc_info=True)
+            self.epoch = None
+
+    def abandon(self) -> None:
+        """Crash simulation: forget leadership without releasing (see
+        ``FileElectionBackend.abandon``)."""
+        abandon = getattr(self.backend, "abandon", None)
+        if abandon is not None:
+            abandon()
+        self.epoch = None

@@ -28,20 +28,63 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 log = logging.getLogger(__name__)
 
 
+def scheduler_request(
+    addr: str,
+    path: str,
+    payload: dict | None = None,
+    timeout_s: float = 10.0,
+    retries: int = 1,
+    backoff_ms: int = 250,
+    sleep=time.sleep,
+):
+    """One scheduler RPC with bounded exponential backoff — the thin
+    client's resilience to a failing-over control plane. A daemon
+    mid-restart (or a partition window) drops or refuses connections
+    for a few hundred ms; retrying with backoff rides that out instead
+    of failing the user's ``tony submit``/``ps``. ``retries`` is the
+    TOTAL attempt count; backoff doubles per retry (bounded at 8x).
+    Raises the last ``OSError``/``ValueError`` when every attempt
+    fails."""
+    import urllib.request
+
+    url = f"http://{addr}{path}"
+    last: Exception = OSError(f"no attempts made for {url}")
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            sleep(min(backoff_ms * (2 ** (attempt - 1)),
+                      backoff_ms * 8) / 1000.0)
+        try:
+            if payload is None:
+                req = urllib.request.Request(url)
+            else:
+                req = urllib.request.Request(
+                    url, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except (OSError, ValueError) as exc:
+            last = exc
+            log.debug("scheduler rpc %s failed (attempt %d/%d): %s",
+                      path, attempt + 1, retries, exc)
+    raise last
+
+
 def read_state(base_dir, addr: str | None = None,
-               timeout_s: float = 5.0):
+               timeout_s: float = 5.0,
+               retries: int = 1, backoff_ms: int = 250):
     """The one scheduler-state fallback chain every consumer shares
     (`tony ps`/`queue`, the history server's queue/pool panel): live
     daemon ``/api/state`` — address given explicitly or read from
     ``<base_dir>/scheduler.addr`` — then the atomically-published
     ``scheduler-state.json``. Returns ``(state, source)``;
     ``(None, "")`` when both miss."""
-    import urllib.request
     from pathlib import Path
 
     base = Path(base_dir) if base_dir else None
@@ -54,10 +97,11 @@ def read_state(base_dir, addr: str | None = None,
                 addr = None
     if addr:
         try:
-            with urllib.request.urlopen(
-                f"http://{addr}/api/state", timeout=timeout_s
-            ) as resp:
-                return json.loads(resp.read()), "live"
+            state = scheduler_request(
+                addr, "/api/state", timeout_s=timeout_s,
+                retries=retries, backoff_ms=backoff_ms,
+            )
+            return state, "live"
         except (OSError, ValueError):
             pass
     if base is not None:
@@ -85,6 +129,21 @@ class SchedulerHttpServer:
             def log_message(self, *args):
                 pass
 
+            def _partitioned(self) -> bool:
+                # partition_scheduler chaos: DROP the request — no
+                # response, connection closed — so clients see a network
+                # partition, not an HTTP error (their retry/backoff path
+                # is what's under test).
+                faults = getattr(outer.daemon, "faults", None)
+                if faults is not None and faults.rpc_partitioned():
+                    self.close_connection = True
+                    try:
+                        self.connection.close()
+                    except OSError:
+                        pass
+                    return True
+                return False
+
             def _reply(self, code: int, obj, content_type="application/json",
                        ) -> None:
                 body = (obj if isinstance(obj, bytes)
@@ -96,13 +155,24 @@ class SchedulerHttpServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self._partitioned():
+                    return
                 d = outer.daemon
                 try:
                     if self.path == "/healthz":
+                        election = getattr(d, "election", None)
                         self._reply(200, {
                             "ok": True,
                             "queue_depth": d.queue.depth(),
                             "running": len(d._runners),
+                            "leader": bool(election and election.is_leader),
+                            "epoch": election.epoch if election else None,
+                            "node": getattr(
+                                getattr(election, "backend", None),
+                                "node_id", "",
+                            ),
+                            "recovered_ms": getattr(d, "recovered_ms",
+                                                    None),
                         })
                     elif self.path == "/metrics":
                         self._reply(
@@ -141,6 +211,8 @@ class SchedulerHttpServer:
                     self._reply(500, {"error": str(exc)})
 
             def do_POST(self):
+                if self._partitioned():
+                    return
                 d = outer.daemon
                 try:
                     n = int(self.headers.get("Content-Length", "0"))

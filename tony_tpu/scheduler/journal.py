@@ -1,0 +1,359 @@
+"""Write-ahead journal for the scheduler daemon — crash recovery's
+source of truth.
+
+The daemon's queue, leases, and tenant accounts used to live only in
+process memory plus a periodically-published ``scheduler-state.json``
+snapshot: a SIGKILL lost everything since the last publish. Here every
+state transition is appended to ``scheduler-journal.jsonl`` *before* it
+is acted on (write-ahead discipline), one JSON object per line:
+
+    {"seq": 17, "ts_ms": ..., "kind": "job_launched", "job_id": ...}
+
+Appends are line-atomic by construction — the whole line goes down in a
+single ``os.write`` on an ``O_APPEND`` descriptor, exactly the
+``events.jsonl`` sink's trick — so the worst artifact a crash can leave
+is one torn TAIL line, which the lenient loader skips. ``seq`` is
+strictly monotonic per journal; the snapshot embeds the highest seq it
+folds (``journal_seq``), so recovery is snapshot + the journal records
+with ``seq > journal_seq`` (the tail), and compaction is "publish a
+snapshot, then drop the folded prefix" (``rotate``).
+
+``replay`` folds snapshot + tail into a plain-dict recovered state —
+jobs keyed by id, slices keyed by id, the set of attempt ids whose
+goodput already folded into the tenant accounts (idempotence: a
+terminal record must never double-fold), and the tenant accounts
+themselves (``goodput_folded`` records carry the folded amounts, so
+folds after the snapshot survive too). The daemon's ``recover()`` then
+reconciles that state against reality: live coordinators are adopted,
+dead ones classified and requeued, suspect leases retired.
+
+Everything here is jax-free and daemon-free so recovery logic is
+unit-testable with plain dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any, Iterable, Mapping
+
+from tony_tpu.analysis import sync_sanitizer as _sync
+
+log = logging.getLogger(__name__)
+
+JOURNAL_FILE = "scheduler-journal.jsonl"
+
+# Journal record kinds — the scheduler's WAL vocabulary. These shadow
+# the lifecycle-event names where a transition has one (the journal is
+# the durable control-plane record, events.jsonl is telemetry; they are
+# written to different files for different readers).
+J_JOB_QUEUED = "job_queued"
+J_JOB_LAUNCHED = "job_launched"
+J_JOB_REQUEUED = "job_requeued"      # preemption or recovery relaunch
+J_JOB_FINISHED = "job_finished"
+J_KILL_REQUESTED = "kill_requested"
+J_SLICE_LEASED = "slice_leased"
+J_SLICE_RELEASED = "slice_released"
+J_SLICE_RETIRED = "slice_retired"
+J_LEASE_RENEWED = "lease_renewed"
+J_GOODPUT_FOLDED = "goodput_folded"
+
+_ACTIVE_STATES = ("LAUNCHING", "RUNNING", "PREEMPTING")
+
+
+class SchedulerJournal:
+    """Append-only journal with monotonic ``seq`` and lenient load.
+
+    Thread-safe. The internal lock covers seq assignment + the single
+    append write (and ``rotate``'s read-rewrite-replace), so records
+    land in seq order and rotation can never drop a record it has not
+    read."""
+
+    def __init__(self, path: str | Path) -> None:
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = _sync.make_lock("journal.SchedulerJournal._lock")
+        self._seq = 0
+        self._since_rotate = 0
+        for rec in self.load(self.path):
+            self._seq = max(self._seq, int(rec.get("seq", 0)))
+            self._since_rotate += 1
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def records_since_rotate(self) -> int:
+        with self._lock:
+            return self._since_rotate
+
+    def append(self, kind: str, ts_ms: int, **fields: Any) -> int:
+        """Journal one transition BEFORE acting on it. Returns the
+        record's seq. Raises ``OSError`` when the append cannot land —
+        write-ahead means an unjournaled transition must not proceed."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts_ms": int(ts_ms), "kind": kind}
+            rec.update(fields)
+            data = (json.dumps(rec, sort_keys=True) + "\n").encode()
+            fd = os.open(str(self.path),
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+            self._since_rotate += 1
+            return self._seq
+
+    def resync(self) -> int:
+        """Re-read the file to pick up records ANOTHER daemon appended —
+        a standby taking over a shared journal must continue the seq
+        sequence past the dead leader's last record, not collide with
+        it. Returns the new last seq."""
+        with self._lock:
+            records = self.load(self.path)  # tony: noqa[TONY-T002] — takeover-only path; the read must exclude appends so the continued seq cannot collide
+            for rec in records:
+                self._seq = max(self._seq, int(rec["seq"]))
+            self._since_rotate = len(records)
+            return self._seq
+
+    def rotate(self, up_to_seq: int) -> int:
+        """Compaction: drop records with ``seq <= up_to_seq`` (they are
+        folded into a published snapshot). Returns how many records the
+        journal still holds. Atomic: the pruned file is written aside
+        and ``replace``d, so a crash mid-rotate leaves either the old
+        or the new journal, never a torn one."""
+        with self._lock:
+            kept = [r for r in self.load(self.path)  # tony: noqa[TONY-T002] — rotation must exclude appends across read-rewrite-replace or a record landing mid-rotate would be dropped; runs once per journal-max-records at publish, not on the tick path
+                    if int(r.get("seq", 0)) > up_to_seq]
+            tmp = self.path.with_name(self.path.name + ".tmp")
+            tmp.write_text("".join(  # tony: noqa[TONY-T002] — same rotate critical section as above
+                json.dumps(r, sort_keys=True) + "\n" for r in kept
+            ))
+            tmp.replace(self.path)
+            self._since_rotate = len(kept)
+            return len(kept)
+
+    @staticmethod
+    def load(path: str | Path) -> list[dict[str, Any]]:
+        """Lenient journal read: unparseable or shapeless lines (the
+        torn tail a SIGKILL mid-append leaves, or operator damage) are
+        skipped, never fatal — a daemon must always be able to boot on
+        whatever journal it finds. Records come back in seq order.
+        Decoded with errors="replace": raw binary damage on one line
+        must not poison the readable lines around it."""
+        try:
+            text = Path(path).read_text(errors="replace")
+        except OSError:
+            return []
+        records: list[dict[str, Any]] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("seq"), int) \
+                    and isinstance(rec.get("kind"), str):
+                records.append(rec)
+        records.sort(key=lambda r: r["seq"])
+        return records
+
+
+def load_snapshot(path: str | Path) -> dict[str, Any] | None:
+    """Load ``scheduler-state.json`` for recovery. A missing, torn, or
+    corrupt snapshot degrades to ``None`` — recovery then replays from
+    the journal's start instead of crashing the daemon at boot."""
+    try:
+        doc = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _as_int(value: Any, default: int = 0) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def replay(snapshot: Mapping[str, Any] | None,
+           records: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
+    """Fold snapshot + journal tail into a recovered-state dict::
+
+        {
+          "journal_seq": highest seq folded,
+          "jobs":    {job_id: job-record dict (SchedJob.to_json shape)},
+          "slices":  {slice_id: slice-record dict (PooledSlice.to_json)},
+          "folded":  [app_id, ...]  # attempts already in the accounts
+          "tenants": {tenant: {category: chip_seconds}},
+        }
+
+    Only records with ``seq`` past the snapshot's ``journal_seq``
+    watermark apply (the rest are already folded into the snapshot);
+    with no snapshot, every record applies. Unknown record kinds are
+    skipped — an old daemon must be able to replay a newer journal's
+    prefix rather than refuse to boot."""
+    jobs: dict[str, dict[str, Any]] = {}
+    slices: dict[str, dict[str, Any]] = {}
+    folded: set[str] = set()
+    tenants: dict[str, dict[str, float]] = {}
+    watermark = 0
+
+    if snapshot:
+        watermark = _as_int(snapshot.get("journal_seq"), 0)
+        for jd in snapshot.get("jobs") or []:
+            if isinstance(jd, dict) and jd.get("job_id"):
+                jobs[str(jd["job_id"])] = dict(jd)
+        for sd in snapshot.get("pool") or []:
+            if isinstance(sd, dict) and sd.get("slice_id"):
+                slices[str(sd["slice_id"])] = dict(sd)
+        for app_id in snapshot.get("folded") or []:
+            folded.add(str(app_id))
+        accounts = (snapshot.get("goodput") or {}).get("tenants") or {}
+        if isinstance(accounts, dict):
+            for tenant, acct in accounts.items():
+                if isinstance(acct, dict):
+                    tenants[str(tenant)] = {
+                        str(c): float(v) for c, v in acct.items()
+                        if isinstance(v, (int, float))
+                    }
+
+    last_seq = watermark
+    for rec in records:
+        seq = _as_int(rec.get("seq"), 0)
+        if seq <= watermark:
+            continue
+        last_seq = max(last_seq, seq)
+        kind = rec.get("kind")
+        job_id = str(rec.get("job_id") or "")
+        slice_id = str(rec.get("slice_id") or "")
+        if kind == J_JOB_QUEUED and job_id:
+            job = jobs.setdefault(job_id, {"job_id": job_id})
+            job.update({
+                "app_dir": rec.get("app_dir") or job.get("app_dir", ""),
+                "priority": _as_int(rec.get("priority")),
+                "tenant": str(rec.get("tenant") or "default"),
+                "submit_ms": _as_int(rec.get("submit_ms")),
+                "seq": _as_int(rec.get("seq_no"), job.get("seq", 0)),
+                "state": "QUEUED",
+                "queued_ms": _as_int(rec.get("ts_ms")),
+            })
+        elif kind == J_JOB_LAUNCHED and job_id:
+            job = jobs.setdefault(job_id, {"job_id": job_id})
+            app_ids = list(job.get("app_ids") or [])
+            app_id = rec.get("app_id")
+            if app_id and app_id not in app_ids:
+                app_ids.append(str(app_id))
+            job.update({
+                "state": "RUNNING",
+                "slice_id": slice_id or job.get("slice_id"),
+                "attempts": _as_int(rec.get("attempt"),
+                                    _as_int(job.get("attempts")) + 1),
+                "resume_step": rec.get("resume_step"),
+                "app_ids": app_ids,
+            })
+        elif kind == J_JOB_REQUEUED and job_id:
+            job = jobs.setdefault(job_id, {"job_id": job_id})
+            job.update({
+                "state": "QUEUED",
+                "slice_id": None,
+                "resume_step": rec.get("resume_step",
+                                       job.get("resume_step")),
+                "preemptions": _as_int(rec.get("preemptions"),
+                                       _as_int(job.get("preemptions"))),
+                "queued_ms": _as_int(rec.get("ts_ms")),
+                "requeued_by_preemption":
+                    bool(rec.get("preempted", False)),
+            })
+        elif kind == J_JOB_FINISHED and job_id:
+            job = jobs.setdefault(job_id, {"job_id": job_id})
+            job.update({
+                "state": str(rec.get("state") or "FAILED"),
+                "slice_id": None,
+                "diagnostics": str(rec.get("diagnostics") or ""),
+                "finished_ms": _as_int(rec.get("ts_ms")),
+            })
+        elif kind == J_KILL_REQUESTED and job_id:
+            jobs.setdefault(job_id, {"job_id": job_id})[
+                "kill_requested"] = True
+        elif kind == J_SLICE_LEASED and slice_id:
+            sl = slices.setdefault(slice_id, {"slice_id": slice_id})
+            sl.update({
+                "profile": str(rec.get("profile") or
+                               sl.get("profile") or "local"),
+                "workspace": str(rec.get("workspace") or
+                                 sl.get("workspace") or ""),
+                "state": "LEASED",
+                "lease_job_id": job_id or None,
+                "lease_expires_ms": rec.get("expires_ms"),
+                "jobs_served": _as_int(rec.get("jobs_served"),
+                                       _as_int(sl.get("jobs_served"))),
+                "created_ms": _as_int(rec.get("created_ms"),
+                                      _as_int(sl.get("created_ms"))),
+            })
+        elif kind == J_SLICE_RELEASED and slice_id:
+            if rec.get("healthy", True):
+                sl = slices.setdefault(slice_id, {"slice_id": slice_id})
+                sl.update({"state": "FREE", "lease_job_id": None,
+                           "lease_expires_ms": None,
+                           "last_released_ms": _as_int(rec.get("ts_ms"))})
+            else:
+                slices.pop(slice_id, None)
+        elif kind == J_SLICE_RETIRED and slice_id:
+            slices.pop(slice_id, None)
+        elif kind == J_LEASE_RENEWED and slice_id:
+            sl = slices.get(slice_id)
+            if sl is not None and sl.get("state") == "LEASED":
+                sl["lease_expires_ms"] = rec.get("expires_ms")
+        elif kind == J_GOODPUT_FOLDED:
+            app_id = str(rec.get("app_id") or "")
+            if app_id and app_id in folded:
+                continue  # idempotence: never double-fold an attempt
+            if app_id:
+                folded.add(app_id)
+            tenant = str(rec.get("tenant") or "default")
+            acct = tenants.setdefault(tenant, {})
+            amounts = rec.get("chip_seconds")
+            if isinstance(amounts, dict):
+                for c, v in amounts.items():
+                    if isinstance(v, (int, float)):
+                        acct[str(c)] = acct.get(str(c), 0.0) + float(v)
+            queued = rec.get("queued_chip_s")
+            if isinstance(queued, (int, float)) and queued > 0:
+                acct["queued"] = acct.get("queued", 0.0) + float(queued)
+    return {
+        "journal_seq": last_seq,
+        "jobs": jobs,
+        "slices": slices,
+        "folded": sorted(folded),
+        "tenants": tenants,
+    }
+
+
+def active_jobs(recovered: Mapping[str, Any]) -> list[dict[str, Any]]:
+    """The recovered jobs that were holding (or about to hold) a slice
+    when the daemon died — the ones ``recover()`` must probe and either
+    adopt or requeue. Ordered by arrival seq."""
+    out = [j for j in recovered.get("jobs", {}).values()
+           if j.get("state") in _ACTIVE_STATES]
+    out.sort(key=lambda j: _as_int(j.get("seq")))
+    return out
+
+
+def queued_jobs(recovered: Mapping[str, Any]) -> list[dict[str, Any]]:
+    """Recovered QUEUED jobs in priority-band arrival order (priority
+    DESC, seq ASC) — resubmission must preserve exactly the order the
+    dead daemon would have served."""
+    out = [j for j in recovered.get("jobs", {}).values()
+           if j.get("state") == "QUEUED"]
+    out.sort(key=lambda j: (-_as_int(j.get("priority")),
+                            _as_int(j.get("seq"))))
+    return out

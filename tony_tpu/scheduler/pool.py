@@ -342,6 +342,69 @@ class SlicePool:
                  slice_id, profile, dt_ms, job_id)
         return LeaseResult(s, warm=False)
 
+    def adopt(
+        self,
+        slice_id: str,
+        profile: str,
+        workspace: str | Path,
+        leased_to: str | None = None,
+        jobs_served: int = 0,
+        created_ms: int = 0,
+    ) -> PooledSlice | None:
+        """Recovery: re-register a slice a previous daemon incarnation
+        owned, WITHOUT re-provisioning — warm reuse must survive a
+        control-plane restart. The workspace must still carry its
+        bootstrap marker (a half-provisioned or torn-down dir cannot be
+        trusted warm: the caller retires it instead). ``leased_to``
+        re-adopts the lease for a live holder with a fresh expiry;
+        otherwise the slice comes back FREE. Returns None when the
+        workspace fails validation or the pool is already full."""
+        workspace = Path(workspace)
+        if not (workspace / BOOTSTRAP_MARKER).is_file():
+            log.warning("cannot adopt %s: %s has no bootstrap marker",
+                        slice_id, workspace)
+            return None
+        now = self._clock_ms()
+        with self._lock:
+            if slice_id in self._slices:
+                return self._slices[slice_id]
+            if len(self._live_locked()) >= self.max_slices:
+                log.warning("cannot adopt %s: pool already at %d slices",
+                            slice_id, self.max_slices)
+                return None
+            s = PooledSlice(
+                slice_id, profile, workspace,
+                state=(SliceState.LEASED if leased_to
+                       else SliceState.FREE),
+                created_ms=created_ms or now,
+                last_released_ms=now,
+                jobs_served=jobs_served,
+                lease_job_id=leased_to,
+                lease_expires_ms=(now + self.lease_timeout_ms
+                                  if leased_to else None),
+            )
+            self._slices[slice_id] = s
+            self._update_gauges_locked()
+        log.info("adopted slice %s (profile %s, %s)", slice_id, profile,
+                 f"leased to {leased_to}" if leased_to else "free")
+        return s
+
+    def retire(self, slice_id: str, profile: str,
+               workspace: str | Path) -> None:
+        """Recovery: tear down a slice record that cannot be adopted —
+        its holder died with the old daemon, so whatever it left on the
+        slice makes warm reuse unsafe (the expired-lease rule applied
+        at recovery time). Safe on slices the pool never registered."""
+        with self._lock:
+            s = self._slices.pop(slice_id, None)
+            if s is not None:
+                s.state = SliceState.RETIRED
+                self._update_gauges_locked()
+        self._teardown(s or PooledSlice(
+            slice_id, profile, Path(workspace),
+            state=SliceState.RETIRED,
+        ))
+
     def release(self, slice_id: str, healthy: bool = True) -> None:
         """Return a leased slice. ``healthy=False`` (the runner saw the
         slice itself misbehave, not just the job fail) retires it."""

@@ -93,6 +93,7 @@ class SchedJob:
             "priority": self.priority,
             "tenant": self.tenant,
             "submit_ms": self.submit_ms,
+            "seq": self.seq,
             "state": self.state.value,
             "slice_id": self.slice_id,
             "attempts": self.attempts,
@@ -102,7 +103,60 @@ class SchedJob:
             "app_ids": list(self.app_ids),
             "app_dir": self.app_dir,
             "finished_ms": self.finished_ms,
+            # Recovery fields: a restarted daemon rebuilds the job from
+            # this record, so the snapshot must carry everything the
+            # queue-wait accounting and the kill flag depend on.
+            "queued_ms": self.queued_ms,
+            "queue_wait_total_ms": self.queue_wait_total_ms,
+            "preempted_wait_total_ms": self.preempted_wait_total_ms,
+            "requeued_by_preemption": self.requeued_by_preemption,
+            "kill_requested": self.kill_requested,
         }
+
+    @classmethod
+    def from_json(cls, doc: Mapping[str, Any],
+                  conf: TonyConfiguration) -> "SchedJob":
+        """Rebuild a job from a snapshot/journal record (``to_json``'s
+        shape, leniently: missing fields take their defaults so an old
+        snapshot loads under a new daemon). ``conf`` is the frozen conf
+        re-read from the job's app dir — the record itself never
+        carries it."""
+        def _i(name: str, default: int = 0) -> int:
+            try:
+                return int(doc.get(name))
+            except (TypeError, ValueError):
+                return default
+
+        try:
+            state = JobState(str(doc.get("state", "QUEUED")))
+        except ValueError:
+            state = JobState.QUEUED
+        resume = doc.get("resume_step")
+        job = cls(
+            job_id=str(doc["job_id"]),
+            conf=conf,
+            app_dir=str(doc.get("app_dir") or ""),
+            priority=_i("priority"),
+            tenant=str(doc.get("tenant") or "default"),
+            submit_ms=_i("submit_ms"),
+            seq=_i("seq"),
+            state=state,
+            slice_id=doc.get("slice_id") or None,
+            attempts=_i("attempts"),
+            preemptions=_i("preemptions"),
+            resume_step=None if resume is None else _i("resume_step"),
+            queued_ms=_i("queued_ms"),
+            queue_wait_total_ms=_i("queue_wait_total_ms"),
+            preempted_wait_total_ms=_i("preempted_wait_total_ms"),
+            requeued_by_preemption=bool(doc.get("requeued_by_preemption",
+                                                False)),
+            diagnostics=str(doc.get("diagnostics") or ""),
+            kill_requested=bool(doc.get("kill_requested", False)),
+        )
+        job.app_ids = [str(a) for a in (doc.get("app_ids") or [])]
+        fin = doc.get("finished_ms")
+        job.finished_ms = None if fin is None else _i("finished_ms")
+        return job
 
 
 class TenantQuotas:
@@ -170,6 +224,22 @@ class JobQueue:
             job.state = JobState.QUEUED
             job.queued_ms = self._clock_ms()
             self._queued.append(job)
+            self._sort()
+        return job
+
+    def restore(self, job: SchedJob) -> SchedJob:
+        """Recovery resubmission: re-enter a job KEEPING its recovered
+        arrival ``seq`` (and queue-entry time), so the rebuilt queue
+        serves exactly the priority-band arrival order the dead daemon
+        would have. The internal counter advances past every restored
+        seq so post-recovery submissions sort after them."""
+        with self._lock:
+            self._seq = max(self._seq, job.seq)
+            job.state = JobState.QUEUED
+            if not job.queued_ms:
+                job.queued_ms = self._clock_ms()
+            if job not in self._queued:
+                self._queued.append(job)
             self._sort()
         return job
 

@@ -32,12 +32,30 @@ process (the mini-cluster substrate) against a backend built by the
 injectable ``backend_factory`` — local subprocess executors by default;
 a TPU deployment's factory returns a ``TpuVmBackend`` in leased mode
 (``external_slices``) over the pool's ``TpuSliceProvisioner`` slices.
+With ``tony.scheduler.detached-attempts`` the coordinator instead runs
+as a DETACHED subprocess that survives the daemon's death — the mode
+control-plane HA wants, because a recovered daemon can re-attach it.
+
+**Control-plane HA** (the journal → recover → fence pattern): every
+state transition is appended to the write-ahead journal
+(``scheduler/journal.py``) before it is acted on; on restart
+``recover()`` folds snapshot + journal tail and reconciles against
+reality (live attempts adopted, dead ones classified and requeued,
+suspect leases retired, terminal goodput folded exactly once); and a
+lease election (``scheduler/election.py``) lets an active/standby pair
+share the base dir — every mutating actuation is fenced by epoch so a
+deposed zombie leader can never double-launch or double-lease.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
+import re
+import signal
+import subprocess
+import sys
 import threading
 import time
 import uuid
@@ -57,6 +75,14 @@ from tony_tpu.observability.metrics import (
     histogram_quantile,
 )
 from tony_tpu.resilience import latest_complete_step
+from tony_tpu.resilience.faults import FaultPlan, SchedulerFaults
+from tony_tpu.scheduler import journal as wal
+from tony_tpu.scheduler.election import (
+    ElectionBackend,
+    FileElectionBackend,
+    LeaseElection,
+)
+from tony_tpu.scheduler.journal import SchedulerJournal
 from tony_tpu.scheduler.pool import (
     LocalSliceProvisioner,
     SlicePool,
@@ -83,11 +109,20 @@ RUNNING_JOBS_GAUGE = "tony_sched_running_jobs"
 SUBMITTED_COUNTER = "tony_sched_jobs_submitted_total"
 FINISHED_COUNTER = "tony_sched_jobs_finished_total"
 PREEMPTIONS_COUNTER = "tony_sched_preemptions_total"
+LEADER_EPOCH_GAUGE = "tony_sched_leader_epoch"
+RECOVERY_GAUGE = "tony_sched_recovery_ms"
+ADOPTED_COUNTER = "tony_sched_attempts_adopted_total"
 
 _TERMINAL_BY_STATUS = {
     SessionStatus.SUCCEEDED: JobState.SUCCEEDED,
     SessionStatus.FAILED: JobState.FAILED,
     SessionStatus.KILLED: JobState.KILLED,
+}
+
+_TERMINAL_BY_NAME = {
+    "SUCCEEDED": JobState.SUCCEEDED,
+    "FAILED": JobState.FAILED,
+    "KILLED": JobState.KILLED,
 }
 
 
@@ -148,6 +183,113 @@ class _JobRunner:
         self.daemon._on_runner_done(self, status, diag)
 
 
+class _DetachedRunner:
+    """One coordinator attempt as a DETACHED subprocess (or an adopted
+    one after recovery): the daemon monitors it from the OUTSIDE —
+    ``final-status.json`` is the terminal signal, process liveness the
+    heartbeat — and kills/preempts through the coordinator's loopback
+    ``POST /api/kill``, falling back to SIGTERM at the pid when the
+    coordinator serves no HTTP. Because the child is its own session
+    leader it survives the daemon's death, which is exactly what lets a
+    recovered (or standby) daemon re-attach it instead of restarting
+    the job from zero."""
+
+    POLL_S = 0.25
+
+    def __init__(self, daemon: "SchedulerDaemon", job: SchedJob,
+                 app_dir: Path, app_id: str, pid: int | None,
+                 adopted: bool = False) -> None:
+        self.daemon = daemon
+        self.job = job
+        self.app_dir = Path(app_dir)
+        self.app_id = app_id
+        self.pid = pid
+        self.adopted = adopted
+        self.slice_broken = False
+        self._thread = threading.Thread(
+            target=self._watch, name=f"job-{job.job_id}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def preempt(self) -> None:
+        self._signal(preempted=True)
+
+    def kill(self) -> None:
+        self._signal(preempted=False)
+
+    def _signal(self, preempted: bool) -> None:
+        import urllib.request
+
+        addr = ""
+        try:
+            addr = (self.app_dir / "coordinator.http").read_text().strip()
+        except OSError:
+            pass
+        if addr:
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/api/kill",
+                    data=json.dumps({"preempted": preempted}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5):
+                    return
+            except OSError:
+                log.warning("kill RPC to %s (%s) failed; falling back "
+                            "to SIGTERM", self.app_id, addr)
+        if self.pid:
+            try:
+                os.kill(self.pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    def _final(self) -> dict[str, Any] | None:
+        try:
+            doc = json.loads(
+                (self.app_dir / "final-status.json").read_text()
+            )
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) and doc.get("state") else None
+
+    def _alive(self) -> bool:
+        if not self.pid:
+            return False
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            pass  # EPERM: exists but not ours — still alive
+        return True
+
+    def _watch(self) -> None:
+        status: SessionStatus | None = None
+        diag = ""
+        while True:
+            final = self._final()
+            if final is None and not self._alive():
+                # Grace: the terminal record may be mid-write as the
+                # process exits — re-read once before declaring it lost.
+                time.sleep(0.2)
+                final = self._final()
+                if final is None:
+                    diag = ("coordinator process died without a "
+                            "terminal record")
+                    break
+            if final is not None:
+                try:
+                    status = SessionStatus(str(final.get("state")))
+                except ValueError:
+                    status = None
+                diag = str(final.get("diagnostics") or "")
+                break
+            time.sleep(self.POLL_S)
+        self.daemon._on_runner_done(self, status, diag)
+
+
 class SchedulerDaemon:
     """See module docstring. Thread-safe; ``start()`` runs the
     scheduling loop (and the JSON API unless ``serve_http=False``),
@@ -161,6 +303,7 @@ class SchedulerDaemon:
         backend_factory: Callable[..., Any] | None = None,
         registry: MetricsRegistry | None = None,
         clock_ms: Callable[[], int] | None = None,
+        election: LeaseElection | None = None,
     ) -> None:
         self.base_dir = Path(base_dir)
         self.base_dir.mkdir(parents=True, exist_ok=True)
@@ -210,6 +353,32 @@ class SchedulerDaemon:
         self.events = obs_events.EventLog(
             sink=obs_events.jsonl_file_sink(self.base_dir / "events.jsonl")
         )
+        # -- control-plane HA ------------------------------------------------
+        # Write-ahead journal: every transition lands here BEFORE it is
+        # acted on; scheduler-state.json is its periodic compaction.
+        self.journal = SchedulerJournal(self.base_dir / wal.JOURNAL_FILE)
+        self._journal_max = self.conf.get_int(
+            keys.K_SCHED_HA_JOURNAL_MAX, 4096
+        )
+        # Attempt ids whose goodput already folded into the tenant
+        # accounts — the exactly-once guard across restarts.
+        self._folded: set[str] = set()
+        self._renew_journal_ms: dict[str, int] = {}
+        self.detached = self.conf.get_bool(keys.K_SCHED_DETACHED, False)
+        if election is None:
+            election = LeaseElection(
+                FileElectionBackend(
+                    self.base_dir,
+                    node_id=self.conf.get_str(keys.K_SCHED_HA_NODE_ID)
+                    or None,
+                    clock_ms=clock_ms,
+                ),
+                lease_ms=self.conf.get_int(keys.K_SCHED_HA_LEASE_MS, 5000),
+                clock_ms=clock_ms,
+            )
+        self.election = election
+        self.faults = SchedulerFaults(FaultPlan.from_conf(self.conf))
+        self.recovered_ms: int | None = None
 
     # -- submission ----------------------------------------------------------
     def submit(
@@ -241,6 +410,15 @@ class SchedulerDaemon:
         """Queue an ALREADY-staged application dir (what a thin ``tony
         submit`` client POSTs after ``_stage``): the frozen conf inside
         is the job."""
+        # A standby must NEVER accept work (it would journal into a file
+        # the leader owns): clients follow scheduler.addr to the active
+        # daemon. The inline acquire covers in-process submits that race
+        # start() on a free seat.
+        if not self.election.is_leader and not self.election.try_acquire():
+            raise RuntimeError(
+                "not the leader — submit to the active scheduler "
+                "(scheduler.addr names it)"
+            )
         app_dir = Path(app_dir)
         final_conf = app_dir / constants.TONY_FINAL_CONF
         if not final_conf.is_file():
@@ -267,6 +445,14 @@ class SchedulerDaemon:
             self._jobs[job_id] = job
             self.queue.submit(job)
             self._dirty = True
+        # WAL: journaled before the submit is ACKNOWLEDGED — a crash
+        # after this line relaunches the job on recovery; a crash before
+        # it means the client never got a job id and retries.
+        self.journal.append(
+            wal.J_JOB_QUEUED, ts_ms=job.queued_ms or self._clock_ms(),
+            job_id=job_id, app_dir=str(app_dir), priority=job.priority,
+            tenant=job.tenant, submit_ms=job.submit_ms, seq_no=job.seq,
+        )
         self.registry.counter(SUBMITTED_COUNTER).inc()
         self.events.emit(obs_events.JOB_QUEUED, job_id=job_id,
                          priority=job.priority, tenant=job.tenant)
@@ -276,8 +462,20 @@ class SchedulerDaemon:
         return job_id
 
     def kill(self, job_id: str) -> bool:
-        """Kill a queued or running job. Returns False for unknown ids
-        and already-terminal jobs."""
+        """Kill a queued or running job. Returns False for unknown ids,
+        already-terminal jobs, and on a deposed/standby daemon (the
+        epoch fence: a zombie leader must not actuate)."""
+        if not self.election.check_fence():
+            return False
+        with self._lock:
+            probe = self._jobs.get(job_id)
+            if probe is None or probe.state.terminal:
+                return False
+        # WAL: the kill INTENT must survive a crash between this accept
+        # and the runner actually dying — recovery then finalizes KILLED
+        # instead of resurrecting the job.
+        self.journal.append(wal.J_KILL_REQUESTED,
+                            ts_ms=self._clock_ms(), job_id=job_id)
         runner = None
         killed_queued = False
         with self._lock:
@@ -316,8 +514,13 @@ class SchedulerDaemon:
             self.http_server = SchedulerHttpServer(
                 self, port=self.conf.get_int(keys.K_SCHED_PORT, 0)
             )
-            port = self.http_server.start()
-            (self.base_dir / ADDR_FILE).write_text(f"127.0.0.1:{port}\n")
+            self.http_server.start()
+        # Become leader synchronously when the seat is free (the common
+        # single-daemon case): a submission racing start() then lands on
+        # a recovered, actuating leader. A standby's start() returns
+        # with leadership pending; its loop keeps watching the seat.
+        if self.election.try_acquire():
+            self._become_leader()
         self._thread = threading.Thread(
             target=self._loop, name="scheduler", daemon=True
         )
@@ -343,10 +546,294 @@ class SchedulerDaemon:
             self.http_server.stop()
         self.pool.shutdown()
         self._publish_state()
+        # Clean abdication: the heartbeat goes instantly stale so a
+        # standby takes over without waiting out the lease.
+        self.election.release()
+
+    # -- leadership ----------------------------------------------------------
+    def _become_leader(self) -> None:
+        """Just won the seat: advertise, then rebuild state through
+        ``recover()`` — the SAME path a cold restart uses, so takeover
+        and restart cannot drift apart."""
+        self.registry.gauge(LEADER_EPOCH_GAUGE).set(
+            float(self.election.epoch or 0)
+        )
+        if self.http_server is not None:
+            # scheduler.addr names the LEADER: thin clients of an
+            # active/standby pair follow this file across failovers.
+            (self.base_dir / ADDR_FILE).write_text(
+                f"127.0.0.1:{self.http_server.port}\n"
+            )
+        self.events.emit(
+            obs_events.LEADER_ELECTED, epoch=self.election.epoch,
+            node=getattr(self.election.backend, "node_id", ""),
+        )
+        log.info("leader at epoch %s", self.election.epoch)
+        try:
+            self.recover()
+        except Exception:
+            log.exception("recovery failed; continuing from empty state")
+        with self._lock:
+            self._dirty = True
+
+    def _abdicate(self, why: str) -> None:
+        """Deposed: a higher epoch owns the state now. STOP — any
+        further actuation from this incarnation would race the new
+        leader (double launch, double lease). Detached attempts keep
+        running; the new leader adopts them."""
+        log.error("abdicating leadership: %s", why)
+        self._stop.set()
+        self._wake.set()
+
+    def _ensure_leader(self) -> bool:
+        if not self.election.heartbeat():
+            self._abdicate("leadership lease lost")
+            return False
+        return True
+
+    # -- crash recovery ------------------------------------------------------
+    def _job_conf(self, app_dir: str) -> TonyConfiguration:
+        try:
+            return TonyConfiguration.from_final(
+                Path(app_dir) / constants.TONY_FINAL_CONF
+            )
+        except Exception:
+            log.warning("could not reload frozen conf from %s", app_dir,
+                        exc_info=True)
+            return TonyConfiguration(load_defaults=False)
+
+    def _probe_attempt(self, job: SchedJob) -> tuple[str, Any]:
+        """Classify what a recovered active attempt actually did while
+        the control plane was down: ``("finished", final_doc)`` when it
+        left a terminal record, ``("alive", pid)`` when its coordinator
+        process still runs (detached attempts survive the daemon),
+        ``("dead", None)`` otherwise — an in-process attempt always
+        probes dead, its coordinator thread died with the daemon."""
+        app_dir = Path(job.app_dir)
+        try:
+            final = json.loads(
+                (app_dir / "final-status.json").read_text()
+            )
+            if isinstance(final, dict) and final.get("state"):
+                return "finished", final
+        except (OSError, ValueError):
+            pass
+        try:
+            pid = int((app_dir / "coordinator.pid").read_text().strip())
+        except (OSError, ValueError):
+            return "dead", None
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return "dead", None
+        except OSError:
+            pass  # EPERM: exists but not ours — treat as alive
+        return "alive", pid
+
+    def recover(self) -> dict[str, int]:
+        """Rebuild state after a restart or standby takeover: load the
+        last published snapshot, replay the journal tail over it
+        (``journal.replay``), then reconcile with REALITY —
+
+        * finished-while-down attempts finalize (goodput folds exactly
+          once, guarded by attempt id),
+        * live detached coordinators are ADOPTED: the runner re-attaches
+          and the lease re-adopts with a fresh expiry, no restart,
+        * dead attempts requeue with ``resume_step`` probed from their
+          checkpoint tree (kill-requested ones finalize KILLED instead),
+        * queued jobs resubmit preserving priority-band arrival order,
+        * leftover FREE slices re-adopt warm; suspect ones (leased to a
+          dead holder, or mid-provision at the crash) retire.
+
+        Idempotent by job id: jobs this daemon already knows are left
+        alone, so an in-process submit racing takeover cannot double."""
+        t0 = time.monotonic()
+        self.journal.resync()
+        snapshot = wal.load_snapshot(self.base_dir / STATE_FILE)
+        records = SchedulerJournal.load(self.journal.path)
+        recovered = wal.replay(snapshot, records)
+        summary = {"adopted": 0, "requeued": 0, "resubmitted": 0,
+                   "finalized": 0, "slices_adopted": 0,
+                   "slices_retired": 0}
+        self.recovered_ms = self._clock_ms()
+        if not recovered["jobs"] and not recovered["slices"] \
+                and not recovered["folded"]:
+            return summary  # pristine base dir — nothing to rebuild
+        with self._lock:
+            self._folded |= set(recovered["folded"])
+        self.goodput.restore(recovered["tenants"])
+        self.goodput.publish(self.registry)
+        # Continue job-id ordinals past every recovered job: fresh ids
+        # must never collide with recovered ones.
+        max_ord = 0
+        for job_id in recovered["jobs"]:
+            m = re.match(r"job_(\d+)_", job_id)
+            if m:
+                max_ord = max(max_ord, int(m.group(1)))
+        with self._lock:
+            self._job_seq = max(self._job_seq, max_ord)
+
+        slices = dict(recovered["slices"])
+        claimed: set[str] = set()
+        now = self._clock_ms()
+
+        for jd in sorted(recovered["jobs"].values(),
+                         key=lambda j: int(j.get("seq") or 0)):
+            job_id = str(jd.get("job_id"))
+            with self._lock:
+                if job_id in self._jobs:
+                    continue
+            job = SchedJob.from_json(jd, self._job_conf(
+                str(jd.get("app_dir") or "")
+            ))
+            if job.state.terminal:
+                # Already folded in a previous life: record only.
+                with self._lock:
+                    self._jobs[job_id] = job
+                continue
+            if job.state is JobState.QUEUED:
+                with self._lock:
+                    self._jobs[job_id] = job
+                    self.queue.restore(job)
+                summary["resubmitted"] += 1
+                continue
+            # Active when the daemon died: probe what really happened.
+            outcome, detail = self._probe_attempt(job)
+            app_id = job.app_ids[-1] if job.app_ids else job_id
+            if outcome == "finished":
+                final = detail
+                state = _TERMINAL_BY_NAME.get(
+                    str(final.get("state")), JobState.FAILED
+                )
+                with self._lock:
+                    self._jobs[job_id] = job
+                self._accumulate_goodput(job)  # exactly-once by app_id
+                # Its coordinator exited cleanly: the slice it held is
+                # intact — release it to FREE for warm re-adoption.
+                for sid, sd in slices.items():
+                    if sd.get("lease_job_id") == job_id:
+                        self.journal.append(  # tony: noqa[TONY-T003] — SchedulerJournal serializes seq + append behind its own internal lock; callers never need a shared guard
+                            wal.J_SLICE_RELEASED, ts_ms=now,
+                            slice_id=sid, job_id=job_id, healthy=True,
+                        )
+                        sd["state"] = "FREE"
+                        sd["lease_job_id"] = None
+                with self._lock:
+                    self._finish_job_locked(
+                        job, state,
+                        str(final.get("diagnostics") or "")
+                        or "finished while the scheduler was down",
+                    )
+                summary["finalized"] += 1
+            elif outcome == "alive":
+                # RE-ATTACH, don't restart: adopt the lease for the live
+                # holder and monitor the attempt from the outside.
+                sid = jd.get("slice_id")
+                sd = slices.get(str(sid)) if sid else None
+                if sd is not None and self.pool.adopt(
+                    str(sid), str(sd.get("profile") or "local"),
+                    str(sd.get("workspace") or ""),
+                    leased_to=job_id,
+                    jobs_served=int(sd.get("jobs_served") or 0),
+                    created_ms=int(sd.get("created_ms") or 0),
+                ) is not None:
+                    claimed.add(str(sid))
+                else:
+                    job.slice_id = None
+                job.state = JobState.RUNNING
+                runner = _DetachedRunner(
+                    self, job, Path(job.app_dir), app_id,
+                    pid=detail, adopted=True,
+                )
+                with self._lock:
+                    self._jobs[job_id] = job
+                    self._runners[job_id] = runner
+                    self.registry.gauge(RUNNING_JOBS_GAUGE).set(
+                        len(self._runners)
+                    )
+                self.registry.counter(ADOPTED_COUNTER).inc()
+                self.events.emit(
+                    obs_events.ATTEMPT_ADOPTED, job_id=job_id,
+                    app_id=app_id, pid=detail, slice_id=job.slice_id,
+                )
+                runner.start()
+                summary["adopted"] += 1
+            else:  # dead, no terminal record
+                if job.kill_requested:
+                    with self._lock:
+                        self._jobs[job_id] = job
+                        self._finish_job_locked(
+                            job, JobState.KILLED,
+                            "killed; its coordinator died with the old "
+                            "scheduler",
+                        )
+                    summary["finalized"] += 1
+                else:
+                    # Classify-and-requeue (the PR-2 resilience policy's
+                    # resume path): seed the relaunch from the best
+                    # complete checkpoint the dead attempt left.
+                    ckpt = job.conf.get_str(keys.K_CHECKPOINT_LOCATION)
+                    best = latest_complete_step(ckpt) if ckpt else None
+                    if best is not None:
+                        job.resume_step = best
+                    job.slice_id = None
+                    self.journal.append(
+                        wal.J_JOB_REQUEUED, ts_ms=now, job_id=job_id,
+                        resume_step=job.resume_step,
+                        preemptions=job.preemptions, recovered=True,
+                    )
+                    with self._lock:
+                        self._jobs[job_id] = job
+                        self.queue.restore(job)
+                    summary["requeued"] += 1
+
+        # Leftover slices: FREE ones re-adopt warm (bootstrap marker
+        # validated); anything else — leased to a dead holder, or caught
+        # mid-provision — is suspect and retires (expired-lease rule).
+        for sid, sd in slices.items():
+            if sid in claimed:
+                continue
+            profile = str(sd.get("profile") or "local")
+            ws = str(sd.get("workspace") or "")
+            if sd.get("state") == "FREE" and ws and \
+                    self.pool.adopt(sid, profile, ws) is not None:
+                summary["slices_adopted"] += 1
+                continue
+            self.journal.append(
+                wal.J_SLICE_RETIRED, ts_ms=now, slice_id=sid,
+                profile=profile, reason="recovery",
+            )
+            if ws:
+                self.pool.retire(sid, profile, ws)
+            summary["slices_retired"] += 1
+
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        self.registry.gauge(RECOVERY_GAUGE).set(round(dt_ms, 1))
+        self.events.emit(
+            obs_events.SCHEDULER_RECOVERED, epoch=self.election.epoch,
+            recovery_ms=round(dt_ms, 1), **summary,
+        )
+        log.info("recovered: %s (%.0f ms)", summary, dt_ms)
+        with self._lock:
+            self._dirty = True
+        self._publish_state()
+        self._wake.set()
+        return summary
 
     # -- scheduling loop -----------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.is_set():
+            if not self.election.is_leader:
+                # Standby: watch the seat; takeover goes through the
+                # same recover() a restart uses.
+                if self.election.try_acquire():
+                    self._become_leader()
+                else:
+                    self._wake.wait(
+                        max(self.election.lease_ms / 3000.0, 0.05)
+                    )
+                    self._wake.clear()
+                    continue
             try:
                 self._tick()
             except Exception:
@@ -355,18 +842,46 @@ class SchedulerDaemon:
             self._wake.clear()
 
     def _tick(self) -> None:
+        # Epoch fence first: a deposed leader's tick must die here, not
+        # after it launched something a new leader also launched.
+        if not self._ensure_leader():
+            return
         # Renew BEFORE expiring: a tick that just spent minutes inside a
         # blocking provision must not walk straight into expire_leases()
         # and retire slices whose runners are perfectly healthy — after
         # the renew pass, expiry can only hit leases whose job is GONE.
         with self._lock:
-            for job_id in self._runners:
-                job = self._jobs.get(job_id)
-                if job is not None and job.slice_id:
-                    self.pool.renew(job.slice_id)
-        if self.pool.expire_leases():
+            held = [
+                (job_id, self._jobs[job_id].slice_id)
+                for job_id in self._runners
+                if self._jobs.get(job_id) is not None
+                and self._jobs[job_id].slice_id
+            ]
+        now = self._clock_ms()
+        for job_id, slice_id in held:
+            self.pool.renew(slice_id)
+            # Journal renewals at ~a third of the lease, not per tick: a
+            # recovered daemon only needs expiry bounds, not a tick log.
+            if now - self._renew_journal_ms.get(slice_id, 0) >= \
+                    self.pool.lease_timeout_ms // 3:
+                self._renew_journal_ms[slice_id] = now
+                self.journal.append(
+                    wal.J_LEASE_RENEWED, ts_ms=now, slice_id=slice_id,
+                    job_id=job_id,
+                    expires_ms=now + self.pool.lease_timeout_ms,
+                )
+        expired = self.pool.expire_leases()
+        if expired:
+            for s in expired:
+                self.journal.append(
+                    wal.J_SLICE_RETIRED, ts_ms=self._clock_ms(),
+                    slice_id=s.slice_id, profile=s.profile,
+                    reason="lease_expired",
+                )
+                self._renew_journal_ms.pop(s.slice_id, None)
             with self._lock:
                 self._dirty = True
+        self.faults.crash_point("mid-tick")
         while not self._stop.is_set():
             with self._lock:
                 counts = self._running_per_tenant_locked()
@@ -433,6 +948,12 @@ class SchedulerDaemon:
                 name=f"provision-{job.job_id}", daemon=True,
             ).start()
         reaped = self.pool.reap_idle()
+        for s in reaped:
+            self.journal.append(
+                wal.J_SLICE_RETIRED, ts_ms=self._clock_ms(),
+                slice_id=s.slice_id, profile=s.profile, reason="idle",
+            )
+            self._renew_journal_ms.pop(s.slice_id, None)
         with self._lock:
             if reaped:
                 self._dirty = True
@@ -464,6 +985,14 @@ class SchedulerDaemon:
         self._wake.set()
 
     def _launch_or_finalize(self, job: SchedJob, lease) -> None:
+        if not self.election.check_fence():
+            # Deposed mid-flight (zombie leader): the new leader already
+            # recovered this job and lease from the journal — acting
+            # here would double-launch. Abdicate, touch nothing.
+            self._abdicate(
+                f"fence check failed before launching {job.job_id}"
+            )
+            return
         if self._stop.is_set():
             # A provision that outlived shutdown() must not start a
             # coordinator nobody will ever reap.
@@ -520,6 +1049,9 @@ class SchedulerDaemon:
         One preemption in flight at a time: a victim's graceful drain
         spans many ticks, and re-picking a fresh victim each tick would
         let one high-priority submit cascade through the whole pool."""
+        if not self.election.check_fence():
+            self._abdicate("fence check failed before preemption")
+            return
         with self._lock:
             if any(j.state is JobState.PREEMPTING
                    for j in self._jobs.values()):
@@ -565,7 +1097,9 @@ class SchedulerDaemon:
         run_conf.set_all(job.conf.to_dict())
         # The scheduler IS the client: no finish-signal will ever come.
         run_conf.set(keys.K_AM_STOP_GRACE_MS, 0)
-        rewrite = False
+        # A detached child reads the FROZEN conf, so every daemon-side
+        # override must be persisted for it.
+        rewrite = self.detached
         if not run_conf.get_str(keys.K_COMPILE_CACHE_DIR):
             # Pin the pool-owned cache dir so THIS slice's warm reuse
             # serves the next job's compiles; jobs that pinned their own
@@ -586,22 +1120,47 @@ class SchedulerDaemon:
         # attempt's terminal record so a coordinator that crashes before
         # writing its own can never make _accumulate_goodput re-fold the
         # stale breakdown into the tenant accounts (double count).
-        try:
-            (app_dir / "final-status.json").unlink()
-        except OSError:
-            pass
-        backend = self._backend_factory(run_conf, app_dir, app_id, lease)
-        coordinator = TonyCoordinator(
-            run_conf, app_dir, app_id=app_id, backend=backend,
-            resume_step=job.resume_step,
-            # Self-healing seam: a coordinator evicting a straggler
-            # mid-job leases its replacement's slice from the SAME pool
-            # (warm_only — a parked gang must never wait out a cold
-            # provision), keyed by this job's profile.
-            spare_pool=self.pool,
-            spare_profile=lease.slice.profile,
+        for stale in ("final-status.json", "coordinator.pid"):
+            try:
+                (app_dir / stale).unlink()
+            except OSError:
+                pass
+        # WAL: lease + launch are journaled BEFORE the coordinator
+        # exists — a crash right after recovers the lease and relaunches
+        # the job instead of losing both.
+        now = self._clock_ms()
+        self.journal.append(
+            wal.J_SLICE_LEASED, ts_ms=now,
+            slice_id=lease.slice.slice_id, job_id=job.job_id,
+            profile=lease.slice.profile,
+            workspace=str(lease.slice.workspace),
+            jobs_served=lease.slice.jobs_served,
+            created_ms=lease.slice.created_ms,
+            expires_ms=lease.slice.lease_expires_ms,
         )
-        runner = _JobRunner(self, job, coordinator)
+        self.journal.append(
+            wal.J_JOB_LAUNCHED, ts_ms=now, job_id=job.job_id,
+            app_id=app_id, slice_id=lease.slice.slice_id,
+            attempt=job.attempts, resume_step=job.resume_step,
+            app_dir=str(app_dir), detached=self.detached,
+        )
+        self.faults.crash_point("post-journal")
+        if self.detached:
+            runner: Any = self._spawn_detached(job, app_dir, app_id)
+        else:
+            backend = self._backend_factory(run_conf, app_dir, app_id,
+                                            lease)
+            coordinator = TonyCoordinator(
+                run_conf, app_dir, app_id=app_id, backend=backend,
+                resume_step=job.resume_step,
+                # Self-healing seam: a coordinator evicting a straggler
+                # mid-job leases its replacement's slice from the SAME
+                # pool (warm_only — a parked gang must never wait out a
+                # cold provision), keyed by this job's profile.
+                spare_pool=self.pool,
+                spare_profile=lease.slice.profile,
+            )
+            runner = _JobRunner(self, job, coordinator)
         with self._lock:
             job.state = JobState.RUNNING
             self._runners[job.job_id] = runner
@@ -621,6 +1180,35 @@ class SchedulerDaemon:
                  lease.slice.slice_id, "warm" if lease.warm else "cold")
         runner.start()
 
+    def _spawn_detached(self, job: SchedJob, app_dir: Path,
+                        app_id: str) -> _DetachedRunner:
+        """Launch the attempt as a coordinator subprocess in its OWN
+        session: it survives this daemon's death, which is what lets a
+        recovered or standby daemon re-attach it. The pid lands in
+        ``coordinator.pid`` from here (not the child), so even a child
+        that dies in its first millisecond leaves a probeable record."""
+        cmd = [sys.executable, "-m", "tony_tpu.coordinator.app_master",
+               "--app-dir", str(app_dir), "--app-id", app_id]
+        if job.resume_step is not None:
+            cmd += ["--resume-step", str(job.resume_step)]
+        # The child must import tony_tpu even when the package is run
+        # from a source tree rather than an install (same seam as
+        # backend.py's executor env).
+        env = dict(os.environ)
+        pkg_root = str(Path(__file__).resolve().parent.parent.parent)
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else "")
+            )
+        with open(app_dir / f"coordinator-{app_id}.log", "ab") as logf:
+            proc = subprocess.Popen(
+                cmd, stdout=logf, stderr=logf, start_new_session=True,
+                env=env,
+            )
+        (app_dir / "coordinator.pid").write_text(f"{proc.pid}\n")
+        return _DetachedRunner(self, job, app_dir, app_id, pid=proc.pid)
+
     # How many terminal job records the daemon keeps in memory (and in
     # scheduler-state.json). A persistent daemon over thousands of short
     # jobs must not grow without bound — older records live on in job
@@ -630,7 +1218,14 @@ class SchedulerDaemon:
     def _finish_job_locked(self, job: SchedJob, state: JobState,
                            why: str) -> None:
         """Terminal transition (caller holds the lock): state + record
-        keeping + counters + event + waiter wakeup."""
+        keeping + counters + event + waiter wakeup. The journal append
+        is a single O_APPEND write — cheap enough to hold the lock
+        through, and WAL ordering demands it lands before the state
+        flips."""
+        self.journal.append(
+            wal.J_JOB_FINISHED, ts_ms=self._clock_ms(),
+            job_id=job.job_id, state=state.value, diagnostics=why,
+        )
         job.state = state
         job.diagnostics = why
         job.slice_id = None
@@ -656,7 +1251,17 @@ class SchedulerDaemon:
         """Fold a finished attempt's ledger (persisted by its
         coordinator into final-status.json) plus the queue wait the
         daemon measured into the per-tenant chip-second accounts, and
-        refresh the fleet gauges on /metrics."""
+        refresh the fleet gauges on /metrics.
+
+        Exactly-once across restarts: the fold is journaled WITH its
+        amounts keyed by attempt id, and an attempt already in the
+        folded set — from this life or a recovered one — never folds
+        again."""
+        app_id = job.app_ids[-1] if job.app_ids else job.job_id
+        with self._lock:
+            if app_id in self._folded:
+                return
+            self._folded.add(app_id)
         chip_seconds = None
         chips = 1
         try:
@@ -679,11 +1284,18 @@ class SchedulerDaemon:
                 + (job.preempted_wait_total_ms / 1000.0) * chips
             )
             job.preempted_wait_total_ms = 0
+        # WAL with amounts: a fold after the last snapshot must survive
+        # the crash; replay skips app_ids the snapshot already folded.
+        self.journal.append(
+            wal.J_GOODPUT_FOLDED, ts_ms=self._clock_ms(),
+            app_id=app_id, job_id=job.job_id, tenant=job.tenant,
+            chip_seconds=chip_seconds, queued_chip_s=queued_chip_s,
+        )
         self.goodput.add(job.tenant, chip_seconds,
                          queued_chip_s=queued_chip_s)
         self.goodput.publish(self.registry)
 
-    def _on_runner_done(self, runner: _JobRunner,
+    def _on_runner_done(self, runner: Any,
                         status: SessionStatus | None, diag: str) -> None:
         job = runner.job
         slice_id = job.slice_id
@@ -701,6 +1313,12 @@ class SchedulerDaemon:
                 and not self._stop.is_set()
             )
         if slice_id:
+            self.journal.append(
+                wal.J_SLICE_RELEASED, ts_ms=self._clock_ms(),
+                slice_id=slice_id, job_id=job.job_id,
+                healthy=not runner.slice_broken,
+            )
+            self._renew_journal_ms.pop(slice_id, None)
             self.pool.release(slice_id, healthy=not runner.slice_broken)
             self.events.emit(
                 obs_events.SLICE_RELEASED, job_id=job.job_id,
@@ -711,6 +1329,12 @@ class SchedulerDaemon:
             # step the killed attempt left and seed the relaunch with it.
             ckpt = job.conf.get_str(keys.K_CHECKPOINT_LOCATION)
             best = latest_complete_step(ckpt) if ckpt else None
+            self.journal.append(
+                wal.J_JOB_REQUEUED, ts_ms=self._clock_ms(),
+                job_id=job.job_id,
+                resume_step=best if best is not None else job.resume_step,
+                preemptions=job.preemptions + 1, preempted=True,
+            )
             with self._lock:
                 if best is not None:
                     job.resume_step = best
@@ -787,14 +1411,27 @@ class SchedulerDaemon:
         }
 
     def state_json(self) -> dict[str, Any]:
+        # The journal watermark is read FIRST: a record appended after
+        # this read but still reflected below simply replays over the
+        # snapshot on recovery — every replay handler is idempotent
+        # (absolute values; goodput folds keyed by attempt id).
+        journal_seq = self.journal.last_seq
         with self._lock:
             jobs = [j.to_json() for j in
                     sorted(self._jobs.values(), key=lambda j: j.seq)]
             queued = [j.job_id for j in self.queue.queued()]
+            folded = sorted(self._folded)
         depth = len(queued)
         self.registry.gauge(QUEUE_DEPTH_GAUGE).set(depth)
         return {
             "ts_ms": self._clock_ms(),
+            "journal_seq": journal_seq,
+            "folded": folded,
+            "ha": {
+                "epoch": self.election.epoch,
+                "node": getattr(self.election.backend, "node_id", ""),
+                "recovered_ms": self.recovered_ms,
+            },
             "queue": queued,
             "queue_depth": depth,
             "queue_wait_ms": self.queue_wait_stats(),
@@ -810,7 +1447,12 @@ class SchedulerDaemon:
         never stall behind a slow disk (TONY-T002). The tmp name is
         per-thread so concurrent publishers can never tear each other's
         file; ``replace`` is atomic and the tick republishes, so a
-        last-writer-wins race only ever costs one tick of staleness."""
+        last-writer-wins race only ever costs one tick of staleness.
+
+        The published snapshot embeds its journal watermark, which is
+        what makes COMPACTION safe: once published, every record at or
+        below the watermark is redundant and ``rotate`` drops them."""
+        self.faults.crash_point("pre-publish")
         try:
             state = self.state_json()
             tmp = self.base_dir / \
@@ -819,6 +1461,12 @@ class SchedulerDaemon:
             tmp.replace(self.base_dir / STATE_FILE)
         except OSError:
             log.warning("could not publish scheduler state", exc_info=True)
+            return
+        if self.journal.records_since_rotate > self._journal_max:
+            try:
+                self.journal.rotate(int(state.get("journal_seq", 0)))
+            except OSError:
+                log.warning("journal compaction failed", exc_info=True)
 
 
 def main(argv: list[str] | None = None) -> int:
