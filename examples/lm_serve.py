@@ -80,6 +80,18 @@ def parse_args(argv):
     p.add_argument("--max-requests", type=int, default=0,
                    help="exit 0 after this many retired requests "
                         "(0 = serve until /shutdown)")
+    p.add_argument("--models", action="append", default=[],
+                   help="extra resident checkpoint as name=ckpt_dir "
+                        "(repeatable); requests route by their 'model' "
+                        "field, swapped compile-free at idle batch "
+                        "boundaries (DecodeSession identity layout)")
+    p.add_argument("--max-resident-models", type=int, default=4,
+                   help="LRU bound on host-resident model packs")
+    p.add_argument("--role", choices=("both", "prefill", "decode"),
+                   default="both",
+                   help="disaggregated fleet role advertised on "
+                        "/healthz (the router enforces it; the engine "
+                        "itself can always do both)")
     # Model flags shared with lm_train.py (same names, same defaults) —
     # they must match the checkpoint's training config.
     from lm_train import add_model_args
@@ -147,10 +159,38 @@ def main(argv=None) -> int:
         session.params, cfg, slots=args.slots,
         prefill_chunk=args.prefill_chunk,
         decode_window=args.decode_window, max_queue=args.max_queue,
-        seed=args.seed,
+        seed=args.seed, max_resident_models=args.max_resident_models,
     )
+    # Multiplexed checkpoints: every --models name=ckpt registers a lazy
+    # loader — restore happens off the engine loop on first routed
+    # request, and the swap itself is compile-free because every pack
+    # shares the DecodeSession identity layout.
+    for entry in args.models:
+        mname, _, mdir = entry.partition("=")
+        if not mname or not mdir:
+            print(f"bad --models entry {entry!r} (want name=ckpt_dir)",
+                  file=sys.stderr)
+            return 2
+
+        def _load(ckpt_dir=mdir):
+            from tony_tpu.models import make_train_step
+
+            m_init, _ = make_train_step(cfg, mesh, learning_rate=1e-2)
+            m_mgr = CheckpointManager(
+                ckpt_dir, process_id=ctx.process_id,
+                num_processes=ctx.num_processes,
+            )
+            with jax.sharding.set_mesh(mesh):
+                m_restored = m_mgr.restore(m_init(jax.random.key(0)))
+            if m_restored is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint under {ckpt_dir}")
+            return m_restored.params
+
+        engine.add_model(mname, loader=_load)
     engine.start()
-    server = ServingServer(engine, port=_resolve_port(args))
+    server = ServingServer(engine, port=_resolve_port(args),
+                           extra_health={"role": args.role})
     port = server.start()
     addr_file = _addr_file(args)
     if addr_file:

@@ -1,0 +1,106 @@
+"""Fixture: a jax-free stand-in for examples/lm_serve.py — the serving
+task the fleet e2e tests launch as replica jobs. Speaks the replica
+contract the router and daemon reconcile against:
+
+* publishes ``serving-fake-<idx>.addr`` atomically under $TONY_LOG_DIR
+  once bound (what ``discover_replica_addr`` globs for);
+* ``GET /healthz`` -> the serving stats shape the router polls
+  (active_slots / queue_depth / slots / draining / models / retired);
+* ``POST /generate`` -> a deterministic token function of the prompt
+  (stateless, so every replica agrees — the fleet-parity check);
+* ``POST /shutdown`` -> drain and exit 0 (the graceful scale-down
+  path: the replica job SUCCEEDs).
+
+Env knobs: SERVE_SLEEP_MS delays each generate (in-flight failover
+windows); SERVE_MODELS comma-lists the advertised models.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def fake_tokens(prompt, max_new_tokens, eos_id=None):
+    base = sum(int(t) for t in prompt) % 1000
+    out = []
+    for i in range(int(max_new_tokens)):
+        tok = (base * 31 + i * 7 + 1) % 97
+        out.append(tok)
+        if eos_id is not None and tok == eos_id:
+            break
+    return out
+
+
+def main() -> int:
+    shutdown = threading.Event()
+    sleep_ms = int(os.environ.get("SERVE_SLEEP_MS", "0"))
+    models = [m for m in os.environ.get("SERVE_MODELS",
+                                        "default").split(",") if m]
+    retired = [0]
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _reply(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "active_slots": 0, "queue_depth": 0, "slots": 4,
+                    "draining": False, "models": models,
+                    "retired": retired[0],
+                })
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if self.path == "/shutdown":
+                self._reply(200, {"ok": True})
+                shutdown.set()
+            elif self.path == "/generate":
+                if sleep_ms:
+                    time.sleep(sleep_ms / 1000.0)
+                tokens = fake_tokens(body.get("prompt", []),
+                                     body.get("max_new_tokens", 0),
+                                     body.get("eos_id"))
+                retired[0] += 1
+                self._reply(200, {
+                    "id": body.get("request_id", "req"),
+                    "tokens": tokens, "length": len(tokens),
+                    "ttft_ms": 1.0, "wall_ms": 2.0,
+                })
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    log_dir = os.environ.get("TONY_LOG_DIR", ".")
+    idx = os.environ.get("TASK_INDEX", "0")
+    addr_file = os.path.join(log_dir, f"serving-fake-{idx}.addr")
+    tmp = f"{addr_file}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"127.0.0.1:{port}\n")
+    os.replace(tmp, addr_file)
+    print(f"fake serving on :{port}", flush=True)
+
+    shutdown.wait(timeout=float(os.environ.get("SERVE_MAX_S", "600")))
+    httpd.shutdown()
+    httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

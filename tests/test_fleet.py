@@ -1,0 +1,691 @@
+"""Serving fleets (tony_tpu/fleet/): autoscaler decision units with an
+injectable clock, fleet-state/journal-fold units, router routing +
+failover against fake in-process replicas, the daemon fleet lifecycle
+e2e on the mini cluster (create → route → scale down → replica-death
+replacement), crash recovery re-adopting a fleet without a double
+launch, and the `tony fleet ps` live → state-file → history fallback
+order."""
+
+import importlib.util
+import json
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.fleet.autoscale import (
+    AutoscalePolicy,
+    Autoscaler,
+    FleetSignals,
+)
+from tony_tpu.fleet.manager import (
+    FleetSpec,
+    FleetState,
+    discover_replica_addr,
+)
+from tony_tpu.fleet.router import FleetRouter
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.scheduler import JobState, SchedulerDaemon, SchedulerJournal
+from tony_tpu.scheduler import journal as wal
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+_spec = importlib.util.spec_from_file_location(
+    "fake_serve", FIXTURES / "fake_serve.py"
+)
+fake_serve = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(fake_serve)
+
+
+def _wait(cond, timeout_s=90.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        assert time.monotonic() < deadline, msg
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler units (injectable clock)
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.now = 1_000_000
+
+    def __call__(self):
+        return self.now
+
+
+def _scaler(**pol):
+    clock = _Clock()
+    return Autoscaler(policy=AutoscalePolicy(**pol), clock_ms=clock), clock
+
+
+class TestAutoscaler:
+    def test_scale_up_needs_sustained_overload_then_cooldown(self):
+        a, clock = _scaler(max_replicas=4, scale_up_queue_depth=4,
+                           hysteresis_ticks=2, cooldown_ms=15000)
+        hot = FleetSignals(ready_replicas=1, queue_depth=9)
+        assert a.tick(hot, 1) is None          # tick 1: not sustained yet
+        d = a.tick(hot, 1)                     # tick 2: sustained -> +1
+        assert d is not None and d.target == 2 and not d.cold_wake
+        # Inside the cooldown nothing fires, however hot.
+        clock.now += 14_000
+        assert a.tick(hot, 2) is None and a.tick(hot, 2) is None
+        # Cooldown over: the still-saturated hysteresis fires at once.
+        clock.now += 2_000
+        assert a.tick(hot, 2).target == 3
+
+    def test_one_cool_tick_resets_hysteresis(self):
+        a, _ = _scaler(hysteresis_ticks=2, cooldown_ms=0)
+        hot = FleetSignals(ready_replicas=1, queue_depth=9)
+        calm = FleetSignals(ready_replicas=1, queue_depth=1,
+                            active_slots=4, total_slots=4)
+        assert a.tick(hot, 1) is None
+        assert a.tick(calm, 1) is None         # blip over: counter resets
+        assert a.tick(hot, 1) is None          # needs 2 fresh hot ticks
+        assert a.tick(hot, 1).target == 2
+
+    def test_ttft_breach_scales_up(self):
+        a, _ = _scaler(ttft_target_ms=500.0, hysteresis_ticks=1,
+                       cooldown_ms=0)
+        slow = FleetSignals(ready_replicas=2, queue_depth=0,
+                            p95_ttft_ms=900.0)
+        d = a.tick(slow, 2)
+        assert d is not None and d.target == 3 and "ttft" in d.reason
+
+    def test_scale_down_after_sustained_idle_to_min(self):
+        a, clock = _scaler(min_replicas=0, scale_down_idle_ms=30000,
+                           scale_down_util=0.25, cooldown_ms=0)
+        idle = FleetSignals(ready_replicas=2, queue_depth=0,
+                            active_slots=0, total_slots=8)
+        assert a.tick(idle, 2) is None
+        clock.now += 29_000
+        assert a.tick(idle, 2) is None
+        # A busy blip restarts the idle clock entirely.
+        a.tick(FleetSignals(ready_replicas=2, queue_depth=3,
+                            active_slots=8, total_slots=8), 2)
+        clock.now += 29_000
+        assert a.tick(idle, 2) is None
+        clock.now += 31_000
+        assert a.tick(idle, 2).target == 1
+        # ...all the way to zero (scale-to-zero releases the slices).
+        clock.now += 31_000
+        a.tick(idle, 1)
+        clock.now += 31_000
+        assert a.tick(FleetSignals(ready_replicas=1, queue_depth=0,
+                                   active_slots=0, total_slots=4),
+                      1).target == 0
+
+    def test_cold_wake_bypasses_hysteresis_and_cooldown(self):
+        a, _ = _scaler(min_replicas=0, hysteresis_ticks=5,
+                       cooldown_ms=10 ** 9)
+        a._last_action_ms = a.clock_ms()  # mid-cooldown
+        d = a.tick(FleetSignals(wake_requested=True), 0)
+        assert d is not None and d.cold_wake and d.target == 1
+        # Queued work visible at zero replicas also wakes.
+        d2 = a.tick(FleetSignals(queue_depth=1), 0)
+        assert d2 is not None and d2.cold_wake
+
+    def test_bounds_violations_actuate_immediately(self):
+        a, _ = _scaler(min_replicas=1, max_replicas=3)
+        assert a.tick(FleetSignals(), 5).target == 3
+        assert a.tick(FleetSignals(), 0).target == 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet state + journal fold units
+# ---------------------------------------------------------------------------
+class TestFleetState:
+    def test_next_rid_fills_gaps(self):
+        st = FleetState(spec=FleetSpec(name="f", template_dir="/t"))
+        assert st.next_rid() == "r0"
+        st.replicas = {"r0": "j0", "r2": "j2"}
+        assert st.next_rid() == "r1"
+
+    def test_replica_role_split_is_deterministic(self):
+        spec = FleetSpec(name="f", template_dir="/t", disaggregated=True,
+                         prefill_replicas=1)
+        st = FleetState(spec=spec)
+        assert st.replica_role("r0") == "prefill"
+        assert st.replica_role("r1") == "decode"
+        st.spec.disaggregated = False
+        assert st.replica_role("r0") == "both"
+
+    def test_spec_and_state_roundtrip(self):
+        spec = FleetSpec(name="f", template_dir="/t", desired=2,
+                         min_replicas=0, max_replicas=5,
+                         disaggregated=True, prefill_replicas=2,
+                         router_port=7070)
+        st = FleetState(spec=spec, desired=2, replicas={"r0": "j0"})
+        back = FleetState.from_json(json.loads(json.dumps(st.to_json())))
+        assert back.spec == spec
+        assert back.desired == 2 and back.replicas == {"r0": "j0"}
+
+
+def _rec(seq, kind, **fields):
+    return {"seq": seq, "ts_ms": seq, "kind": kind, **fields}
+
+
+class TestFleetJournalFold:
+    def test_fleet_lifecycle_folds(self):
+        spec = FleetSpec(name="f1", template_dir="/t", desired=1)
+        out = wal.replay(None, [
+            _rec(1, wal.J_FLEET_CREATED, fleet="f1",
+                 spec=spec.to_json(), desired=1),
+            _rec(2, wal.J_REPLICA_LAUNCHED, fleet="f1", replica_id="r0",
+                 job_id="job_a", role="both"),
+            _rec(3, wal.J_FLEET_SCALED, fleet="f1", to=2,
+                 reason="operator", **{"from": 1}),
+            _rec(4, wal.J_REPLICA_LAUNCHED, fleet="f1", replica_id="r1",
+                 job_id="job_b", role="both"),
+            _rec(5, wal.J_REPLICA_RETIRED, fleet="f1", replica_id="r0",
+                 job_id="job_a", reason="scale_down"),
+        ])
+        f = out["fleets"]["f1"]
+        assert f["desired"] == 2
+        assert f["replicas"] == {"r1": "job_b"}
+        assert f["spec"]["name"] == "f1"
+
+    def test_snapshot_fleets_parse_and_tail_overrides(self):
+        snapshot = {"journal_seq": 2, "fleets": {
+            "f1": {"spec": FleetSpec(name="f1",
+                                     template_dir="/t").to_json(),
+                   "desired": 2, "replicas": {"r0": "job_a"}},
+            "broken": {"desired": 3},  # no spec: dropped, not a crash
+        }}
+        out = wal.replay(snapshot, [
+            _rec(3, wal.J_REPLICA_RETIRED, fleet="f1", replica_id="r0",
+                 job_id="job_a", reason="recovery"),
+            _rec(4, wal.J_FLEET_SCALED, fleet="f1", to=1,
+                 reason="autoscaler", **{"from": 2}),
+        ])
+        assert set(out["fleets"]) == {"f1"}
+        assert out["fleets"]["f1"]["desired"] == 1
+        assert out["fleets"]["f1"]["replicas"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Router units against fake in-process replicas
+# ---------------------------------------------------------------------------
+class _FakeReplica:
+    """One in-process serving replica with a switchable failure mode:
+    ``ok`` serves, ``die`` drops the connection mid-request (the
+    in-flight-death window), ``shed`` answers 429."""
+
+    def __init__(self, models=("default",), queue_depth=0):
+        self.models = list(models)
+        self.queue_depth = queue_depth
+        self.mode = "ok"
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, {
+                    "active_slots": 0,
+                    "queue_depth": outer.queue_depth,
+                    "slots": 4, "draining": False,
+                    "models": outer.models,
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if outer.mode == "die":
+                    # In-flight death: request accepted, never answered.
+                    self.close_connection = True
+                    self.connection.close()
+                    return
+                if outer.mode == "shed":
+                    self._reply(429, {"error": "serving queue full"},
+                                {"Retry-After": "1"})
+                    return
+                outer.hits += 1
+                tokens = fake_serve.fake_tokens(
+                    body.get("prompt", []),
+                    body.get("max_new_tokens", 0), body.get("eos_id"),
+                )
+                self._reply(200, {"id": "req", "tokens": tokens,
+                                  "length": len(tokens),
+                                  "served_by": id(outer)})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def router():
+    r = FleetRouter(health_interval_s=3600, wake_timeout_s=0.5,
+                    retries=2)
+    reps = []
+
+    def add(rid, rep, role="both"):
+        reps.append(rep)
+        r.add_replica(rid, rep.addr, role=role)
+        return rep
+
+    r.start()
+    yield r, add
+    r.stop()
+    for rep in reps:
+        rep.stop()
+
+
+class TestRouter:
+    def test_least_queue_depth_and_per_model_routing(self, router):
+        r, add = router
+        busy = add("r0", _FakeReplica(queue_depth=7))
+        idle = add("r1", _FakeReplica(queue_depth=0))
+        code, raw, _ = r.route_generate({"prompt": [1], "max_new_tokens": 2})
+        assert code == 200 and idle.hits == 1 and busy.hits == 0
+        # Per-model routing overrides load: only r0 hosts "m2".
+        busy.models = ["default", "m2"]
+        r.poll_once()
+        code, raw, _ = r.route_generate(
+            {"prompt": [1], "max_new_tokens": 2, "model": "m2"}
+        )
+        assert code == 200 and busy.hits == 1
+        sig = r.signals()
+        assert sig.ready_replicas == 2 and sig.total_slots == 8
+
+    def test_draining_replica_stops_receiving_new_work(self, router):
+        r, add = router
+        a = add("r0", _FakeReplica())
+        b = add("r1", _FakeReplica())
+        r.drain_replica("r0")
+        assert r.status()["ready_rids"] == ["r1"]
+        for _ in range(3):
+            code, _, _ = r.route_generate(
+                {"prompt": [2], "max_new_tokens": 1}
+            )
+            assert code == 200
+        assert a.hits == 0 and b.hits == 3
+
+    def test_inflight_replica_death_retries_on_survivor(self, router):
+        """The failover satellite: a replica dying with the request in
+        flight costs a bounded retry against a survivor, not a client
+        error — and the dead replica leaves the rotation."""
+        r, add = router
+        dead = add("r0", _FakeReplica(queue_depth=0))
+        live = add("r1", _FakeReplica(queue_depth=5))
+        dead.mode = "die"  # picked first (lower queue depth), then dies
+        body = {"prompt": [3, 4], "max_new_tokens": 4}
+        code, raw, _ = r.route_generate(body)
+        assert code == 200
+        assert json.loads(raw)["tokens"] == fake_serve.fake_tokens(
+            [3, 4], 4
+        )
+        assert live.hits == 1
+        snap = r.registry.snapshot()["counters"]
+        assert snap["tony_fleet_router_retries_total"] == 1
+        # Out of rotation: subsequent (and queued) requests land on the
+        # survivor directly, no repeat retry.
+        assert r.status()["ready_rids"] == ["r1"]
+        code, _, _ = r.route_generate(body)
+        assert code == 200 and live.hits == 2
+        assert r.registry.snapshot()["counters"][
+            "tony_fleet_router_retries_total"] == 1
+
+    def test_429_retries_elsewhere_then_surfaces_with_retry_after(
+        self, router,
+    ):
+        r, add = router
+        shedding = add("r0", _FakeReplica(queue_depth=0))
+        other = add("r1", _FakeReplica(queue_depth=5))
+        shedding.mode = "shed"
+        code, _, _ = r.route_generate({"prompt": [5], "max_new_tokens": 1})
+        assert code == 200 and other.hits == 1   # shed here, admit there
+        other.mode = "shed"
+        code, raw, headers = r.route_generate(
+            {"prompt": [5], "max_new_tokens": 1}
+        )
+        assert code == 429 and headers.get("Retry-After") == "1"
+        assert r.registry.snapshot()["counters"][
+            "tony_fleet_router_shed_total"] == 1
+
+    def test_cold_wake_raised_for_empty_fleet(self):
+        woke = threading.Event()
+        r = FleetRouter(wake_timeout_s=0.3, on_cold_wake=woke.set)
+        r.start()
+        try:
+            code, _, _ = r.route_generate(
+                {"prompt": [1], "max_new_tokens": 1}
+            )
+            assert code == 503          # nothing came up within the hold
+            assert woke.is_set()
+            assert r.signals().wake_requested
+            assert r.consume_wake() is True
+            assert r.consume_wake() is False
+        finally:
+            r.stop()
+
+
+# ---------------------------------------------------------------------------
+# Daemon fleet lifecycle e2e (mini cluster, jax-free fake replicas)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def cluster(tmp_path):
+    with MiniTonyCluster(tmp_path) as c:
+        yield c
+
+
+def _sched_conf(cluster, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_SCHED_TICK_MS, 50)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _fleet_template(cluster, **kv):
+    conf = cluster.base_conf()
+    conf.set(keys.K_EXECUTES, str(FIXTURES / "fake_serve.py"))
+    conf.set(keys.K_PYTHON_BINARY, sys.executable)
+    conf.set(keys.instances_key("worker"), 1)
+    conf.set(keys.instances_key("ps"), 0)
+    conf.set(keys.K_FLEET_AUTOSCALE, False)
+    for k, v in kv.items():
+        conf.set(k, v)
+    return conf
+
+
+def _journal_kinds(daemon, kind, fleet=None):
+    return [r for r in SchedulerJournal.load(
+        daemon.base_dir / wal.JOURNAL_FILE
+    ) if r["kind"] == kind and (fleet is None or r.get("fleet") == fleet)]
+
+
+def _ready(daemon, name):
+    doc = daemon.fleet_json(name) or {}
+    return (doc.get("router") or {}).get("ready", 0)
+
+
+def test_fleet_create_route_scale_down_and_replace(cluster):
+    """The fleet lifecycle acceptance, jax-free: create launches the
+    replicas as pool jobs, the router serves once their endpoints bind,
+    an operator scale-down retires the highest rid gracefully (its job
+    SUCCEEDs via /shutdown), and a killed replica's record folds out
+    with a journaled replacement launch."""
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 3}),
+    )
+    doc = daemon.create_fleet(
+        "lmfleet",
+        _fleet_template(cluster, **{keys.K_FLEET_MAX_REPLICAS: 3}),
+        replicas=2,
+    )
+    assert doc["desired"] == 2
+    _wait(lambda: _ready(daemon, "lmfleet") == 2, 90,
+          "replicas never entered rotation")
+
+    # Route through the router's own HTTP port: deterministic fake
+    # tokens prove a replica actually served it.
+    router_addr = daemon.fleet_json("lmfleet")["router"]["addr"]
+    body = json.dumps({"prompt": [1, 2, 3],
+                       "max_new_tokens": 5}).encode()
+    req = urllib.request.Request(
+        f"http://{router_addr}/generate", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        out = json.loads(resp.read())
+    assert out["tokens"] == fake_serve.fake_tokens([1, 2, 3], 5)
+
+    # Operator scale-down: r1 (highest rid) retires GRACEFULLY — the
+    # /shutdown path drains and the job SUCCEEDs, not KILLED.
+    r1_job = daemon.fleet_json("lmfleet")["replicas"]["r1"]
+    daemon.scale_fleet("lmfleet", 1)
+    _wait(lambda: set(daemon.fleet_json("lmfleet")["replicas"]) == {"r0"},
+          60, "scale-down never retired r1")
+    assert daemon.wait_job(r1_job, 60) is JobState.SUCCEEDED
+    retired = _journal_kinds(daemon, wal.J_REPLICA_RETIRED, "lmfleet")
+    assert [r["replica_id"] for r in retired] == ["r1"]
+    assert retired[0]["reason"] == "scale_down"
+
+    # Replica death: kill r0's job — reconcile folds the dead record
+    # out and journals a replacement launch (same rid, fresh job).
+    r0_job = daemon.fleet_json("lmfleet")["replicas"]["r0"]
+    daemon.kill(r0_job)
+    _wait(lambda: daemon.fleet_json("lmfleet")["replicas"].get("r0")
+          not in (None, r0_job), 60, "replacement never launched")
+    _wait(lambda: _ready(daemon, "lmfleet") == 1, 90,
+          "replacement never entered rotation")
+    launches = _journal_kinds(daemon, wal.J_REPLICA_LAUNCHED, "lmfleet")
+    assert len(launches) == 3       # r0, r1, r0-replacement
+    assert len({r["job_id"] for r in launches}) == 3
+    assert [r["replica_id"]
+            for r in _journal_kinds(daemon, wal.J_REPLICA_RETIRED,
+                                    "lmfleet")] == ["r1", "r0"]
+
+    # The fleet shows up on the scheduler API.
+    api = f"127.0.0.1:{daemon.http_server.port}"
+    with urllib.request.urlopen(f"http://{api}/api/fleets",
+                                timeout=5) as resp:
+        fleets = json.loads(resp.read())["fleets"]
+    assert fleets["lmfleet"]["desired"] == 1
+    events = [e["kind"] for e in daemon.events.to_dicts()]
+    for kind in ("fleet_created", "fleet_scaled", "replica_launched",
+                 "replica_retired"):
+        assert kind in events
+
+
+def test_recovery_readopts_fleet_without_double_launch(cluster):
+    """Crash-recovery acceptance: a daemon dying with a live detached
+    replica re-adopts the fleet from the journal — same rid -> job_id
+    binding, the surviving replica re-enters rotation, and no second
+    replica_launched record ever lands."""
+    base = cluster.base_dir / "sched"
+    conf = _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 2,
+                                   keys.K_SCHED_DETACHED: True})
+    d1 = SchedulerDaemon(base, conf=conf).start(serve_http=False)
+    d1.create_fleet("f1", _fleet_template(cluster), replicas=1)
+    _wait(lambda: _ready(d1, "f1") == 1, 90, "replica never ready")
+    r0_job = d1.fleet_json("f1")["replicas"]["r0"]
+
+    # SIGKILL-shaped crash: loop stopped dead, flock dropped, no clean
+    # shutdown — the detached replica keeps serving.
+    d1._stop.set()
+    d1._wake.set()
+    if d1._thread is not None:
+        d1._thread.join(timeout=30)
+    d1.election.abandon()
+
+    d2 = SchedulerDaemon(base, conf=conf).start(serve_http=False)
+    try:
+        recovered = [e for e in d2.events.to_dicts()
+                     if e["kind"] == "scheduler_recovered"]
+        assert len(recovered) == 1 and recovered[0]["fleets"] == 1
+        assert d2.fleet_json("f1")["replicas"] == {"r0": r0_job}
+        assert d2.job(r0_job).state is JobState.RUNNING
+        _wait(lambda: _ready(d2, "f1") == 1, 90,
+              "recovered replica never re-entered rotation")
+        # The WHOLE journal (both lives) holds exactly one launch.
+        launches = _journal_kinds(d2, wal.J_REPLICA_LAUNCHED, "f1")
+        assert len(launches) == 1 and launches[0]["job_id"] == r0_job
+        # And the recovered router still routes.
+        addr = d2.fleet_json("f1")["router"]["addr"]
+        req = urllib.request.Request(
+            f"http://{addr}/generate",
+            data=json.dumps({"prompt": [9], "max_new_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["tokens"] == \
+                fake_serve.fake_tokens([9], 3)
+    finally:
+        d2.shutdown()
+
+
+def test_replica_addr_discovery(tmp_path):
+    assert discover_replica_addr(tmp_path / "missing") is None
+    app = tmp_path / "app"
+    (app / "logs").mkdir(parents=True)
+    assert discover_replica_addr(app) is None
+    (app / "logs" / "serving-fake-0.addr").write_text("127.0.0.1:7001\n")
+    assert discover_replica_addr(app) == "127.0.0.1:7001"
+
+
+# ---------------------------------------------------------------------------
+# CLI: `tony fleet ps` fallback order (live -> state-file -> history)
+# ---------------------------------------------------------------------------
+def test_fleet_ps_fallback_order(cluster, capsys):
+    """Pins the documented fallback chain: the live API while the
+    daemon runs, the atomically-published scheduler-state.json once it
+    is gone, and the job history as the last resort."""
+    from tony_tpu.client.cli import fleet_cmd
+
+    daemon = cluster.start_scheduler(
+        # Zero slots: the replica job stays QUEUED — cheap and stable.
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 0}),
+    )
+    daemon.create_fleet("psfleet", _fleet_template(cluster), replicas=1)
+    base_dir = str(daemon.base_dir)
+    state_file = daemon.base_dir / "scheduler-state.json"
+    _wait(lambda: state_file.is_file()
+          and "psfleet" in state_file.read_text(), 30,
+          "fleet never published to the state file")
+
+    # 1) live API.
+    assert fleet_cmd(["ps", "--scheduler-dir", base_dir]) == 0
+    out = capsys.readouterr().out
+    assert "(live)" in out and "psfleet" in out and "r0" in out
+
+    # 2) daemon gone -> state file.
+    cluster.shutdown()
+    assert fleet_cmd(["ps", "--scheduler-dir", base_dir]) == 0
+    out = capsys.readouterr().out
+    assert "(state-file)" in out and "psfleet" in out
+
+    # 3) no state file either -> job history.
+    state_file.unlink()
+    (Path(base_dir) / "scheduler.addr").unlink(missing_ok=True)
+    assert fleet_cmd([
+        "ps", "--scheduler-dir", base_dir,
+        "--history-location", str(cluster.history_dir),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "history fallback" in out
+
+    # status (unlike ps) stops at the state-file rung.
+    assert fleet_cmd(["status", "--scheduler-dir", base_dir]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Slow e2e: a REAL lm_serve fleet through the daemon
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_real_lm_serve_fleet_token_parity(cluster):
+    """The heavyweight acceptance: 3 examples/lm_serve.py replicas
+    (fresh seed-0 weights, real jax engines) launched as fleet jobs,
+    routed through the fleet router's HTTP front door under concurrent
+    load — every response token-for-token equal to a single-request
+    ``generate`` on locally rebuilt identical weights, with the load
+    actually spread across replicas."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import generate, init_params
+
+    repo = Path(__file__).resolve().parent.parent
+    daemon = cluster.start_scheduler(
+        _sched_conf(cluster, **{keys.K_SCHED_MAX_SLICES: 3}),
+    )
+    template = _fleet_template(cluster, **{
+        keys.K_EXECUTES: str(repo / "examples" / "lm_serve.py"),
+        keys.K_FRAMEWORK: "jax",
+        keys.K_FLEET_MAX_REPLICAS: 3,
+        keys.K_TASK_PARAMS: ("--max-seq 96 --seed 0 --slots 2 "
+                             "--prefill-chunk 8 --decode-window 2"),
+    })
+    daemon.create_fleet("jaxfleet", template, replicas=3)
+    _wait(lambda: _ready(daemon, "jaxfleet") == 3, 300,
+          "lm_serve replicas never entered rotation")
+    router_addr = daemon.fleet_json("jaxfleet")["router"]["addr"]
+
+    # The reference: identical fresh weights (lm_train default model
+    # flags at max_seq 96, seed 0) through single-request generate.
+    import argparse
+
+    sys.path.insert(0, str(repo / "examples"))
+    try:
+        import lm_train
+    finally:
+        sys.path.pop(0)
+    p = argparse.ArgumentParser()
+    lm_train.add_model_args(p)
+    cfg = lm_train.model_config_from_args(p.parse_args([]), max_seq=96)
+    params = init_params(jax.random.key(0), cfg)
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            int(n)).astype(np.int32).tolist()
+               for n in (4, 7, 5, 9, 6, 8)]
+    wants = [np.asarray(generate(
+        params, jnp.asarray(pr, jnp.int32)[None], cfg, 6
+    ))[0] for pr in prompts]
+
+    outs: list = [None] * len(prompts)
+
+    def _client(i):
+        body = json.dumps({"prompt": prompts[i],
+                           "max_new_tokens": 6}).encode()
+        req = urllib.request.Request(
+            f"http://{router_addr}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            outs[i] = json.loads(resp.read())
+
+    # Concurrent clients so least-queue-depth routing actually spreads
+    # (sequential idle-fleet requests would all tie-break to one rid).
+    threads = [threading.Thread(target=_client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+
+    for i, want in enumerate(wants):
+        assert outs[i] is not None, f"request {i} never completed"
+        np.testing.assert_array_equal(
+            np.asarray(outs[i]["tokens"]), want,
+            err_msg=f"fleet response {i} diverged from single-request "
+                    f"generate",
+        )
+
+    # Load spread: with 6 concurrent requests against 3 two-slot
+    # replicas, at least two replicas must have retired work.
+    served = 0
+    for rep in daemon.fleet_json("jaxfleet")["router"]["replicas"]:
+        with urllib.request.urlopen(
+            f"http://{rep['addr']}/healthz", timeout=30
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["role"] == "both"  # lm_serve default extra_health
+        served += int(health["retired"] > 0)
+    assert served >= 2, "all requests landed on one replica"

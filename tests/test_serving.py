@@ -536,3 +536,231 @@ class TestDrain:
                 assert r.result(1)["length"] == 6
             with pytest.raises(RuntimeError, match="draining"):
                 eng.submit([1, 2], 2)
+
+
+class TestServingFleetSatellites:
+    """PR-18 serving-side satellites: 429 + Retry-After shed signal,
+    fleet-facing /healthz fields, gauge zeroing on drain/close, model
+    multiplexing parity + LRU residency, and prefill/decode
+    disaggregation parity over the HTTP wire format."""
+
+    def test_http_429_retry_after_and_healthz_fleet_fields(self):
+        from tony_tpu.serving.http import ServingServer
+
+        cfg, params = _tiny_setup()
+        # Engine deliberately NOT started: the queue can't drain, so
+        # filling it is deterministic.
+        eng = ServingEngine(params, cfg, slots=1, max_queue=1)
+        eng.submit([1, 2, 3], 4)  # queue now at max_queue
+        server = ServingServer(eng, port=0,
+                               extra_health={"role": "prefill"})
+        port = server.start()
+        try:
+            body = json.dumps({"prompt": [1, 2], "max_new_tokens": 2})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate",
+                    data=body.encode(),
+                ), timeout=10)
+            # Shed is distinguishable from failure: 429 + Retry-After,
+            # which the fleet router uses to retry another replica.
+            assert err.value.code == 429
+            assert err.value.headers["Retry-After"] == "1"
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                health = json.loads(resp.read())
+            # The fields the router/autoscaler read, plus the merged
+            # extra_health role the fleet layer advertises.
+            assert health["active_slots"] == 0
+            assert health["queue_depth"] == 1
+            assert health["draining"] is False
+            assert health["models"] == ["default"]
+            assert health["role"] == "prefill"
+        finally:
+            server.stop()
+            eng.close()
+
+    def test_gauges_zeroed_on_drain_and_close(self):
+        registry = MetricsRegistry()
+        cfg, params = _tiny_setup()
+        eng = ServingEngine(params, cfg, slots=2, registry=registry)
+        with eng:
+            reqs = [eng.submit([1, 2, 3, 4], 5) for _ in range(3)]
+            assert eng.drain(timeout=60.0)
+            for r in reqs:
+                assert r.result(1)["length"] == 5
+            # A drained replica must publish zero load — stale gauges
+            # would keep attracting router traffic and block the
+            # autoscaler's scale-down forever.
+            for name in ("tony_serving_queue_depth",
+                         "tony_serving_active_slots",
+                         "tony_serving_tokens_per_sec"):
+                assert registry.gauge(name).value == 0
+
+        # close() without a drain (requests still queued) zeroes too.
+        reg2 = MetricsRegistry()
+        eng2 = ServingEngine(params, cfg, slots=1, registry=reg2)
+        eng2.submit([1, 2], 3)  # never started, never stepped
+        eng2.close()
+        for name in ("tony_serving_queue_depth",
+                     "tony_serving_active_slots",
+                     "tony_serving_tokens_per_sec"):
+            assert reg2.gauge(name).value == 0
+
+    def test_multiplexing_parity_and_lru_residency(self):
+        cfg, params_a = _tiny_setup()
+        params_b = init_params(jax.random.key(1), cfg)
+        params_c = init_params(jax.random.key(2), cfg)
+        loads = {"b": 0, "c": 0}
+
+        def load_b():
+            loads["b"] += 1
+            return params_b
+
+        def load_c():
+            loads["c"] += 1
+            return params_c
+
+        prompt = np.arange(1, 8, dtype=np.int32)
+        want = {
+            name: np.asarray(generate(
+                p, jnp.asarray(prompt)[None], cfg, 6
+            ))[0]
+            for name, p in (("default", params_a), ("b", params_b),
+                            ("c", params_c))
+        }
+
+        # max_resident_models=2: "default" (ctor weights, no loader —
+        # pinned) + one loader-backed model; serving the other must
+        # evict its sibling and re-fuse it on the next swap.
+        eng = ServingEngine(params_a, cfg, slots=2,
+                            max_resident_models=2)
+        eng.add_model("b", loader=load_b)
+        eng.add_model("c", loader=load_c)
+        with eng:
+            assert eng.stats()["models"] == ["b", "c", "default"]
+            for name in ("b", "c", "default", "b"):
+                got = eng.submit(prompt, 6, model=name).result(
+                    timeout=120)
+                np.testing.assert_array_equal(
+                    np.asarray(got["tokens"]), want[name],
+                    err_msg=f"model {name!r} diverged from its "
+                            f"single-request generate reference",
+                )
+            # Serving "c" evicted "b" (LRU past the residency bound),
+            # so the second "b" request re-fused from its loader.
+            assert loads["b"] == 2 and loads["c"] == 1
+            assert len(eng._resident) <= 2
+
+    def test_disaggregation_parity_over_http_wire(self):
+        from tony_tpu.serving.http import (ServingServer, decode_kv,
+                                           encode_kv)
+
+        cfg, params = _tiny_setup()
+        prompt = list(range(2, 11))
+        total_new = 6
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg, total_new
+        ))[0]
+
+        # encode/decode roundtrip is exact for float32 KV.
+        rng = np.random.default_rng(3)
+        kk = rng.standard_normal((2, 4, 2, 16)).astype(np.float32)
+        vv = rng.standard_normal((2, 4, 2, 16)).astype(np.float32)
+        rk, rv = decode_kv(encode_kv(kk, vv))
+        np.testing.assert_array_equal(rk, kk)
+        np.testing.assert_array_equal(rv, vv)
+
+        pre_eng = ServingEngine(params, cfg, slots=2).start()
+        dec_eng = ServingEngine(params, cfg, slots=2).start()
+        pre_srv = ServingServer(pre_eng, port=0)
+        dec_srv = ServingServer(dec_eng, port=0)
+        pre_port = pre_srv.start()
+        dec_port = dec_srv.start()
+
+        def _post(port, path, obj):
+            body = json.dumps(obj).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=body,
+                headers={"Content-Type": "application/json"},
+            ), timeout=120) as resp:
+                return json.loads(resp.read())
+
+        try:
+            # Prefill replica: chunked prefill + first token + exported
+            # KV rows; the slot frees instead of decoding.
+            pre = _post(pre_port, "/prefill", {
+                "prompt": prompt, "max_new_tokens": total_new,
+            })
+            assert pre["last_token"] == int(want[0])
+            assert pre["pos"] == len(prompt)
+            assert pre["kv"]["shape"][1] == len(prompt)
+            assert pre_eng.stats()["active_slots"] == 0
+
+            # Decode replica: inject the shipped rows, decode the rest.
+            dec = _post(dec_port, "/inject", {
+                "kv": pre["kv"], "last_token": pre["last_token"],
+                "pos": pre["pos"],
+                "max_new_tokens": total_new - 1,
+            })
+            got = [pre["last_token"]] + list(dec["tokens"])
+            np.testing.assert_array_equal(
+                np.asarray(got), want,
+                err_msg="disaggregated prefill->inject diverged from "
+                        "single-engine generate",
+            )
+        finally:
+            pre_srv.stop()
+            dec_srv.stop()
+            pre_eng.close()
+            dec_eng.close()
+
+
+class TestBenchFleetGate:
+    """bench_serving_fleet sub-metrics flatten into gated names and the
+    seeded cpu baselines catch a fleet-throughput collapse, a TTFT
+    blow-up, and a dead (or slow) autoscaler."""
+
+    _LINE = {
+        "metric": "x",
+        "extras": {"device": "cpu", "serving_fleet": {
+            "fleet_wall_tokens_per_sec": 1459,
+            "fleet_sustained_tokens_per_sec": 1912,
+            "ttft_p50_ms": 167.8, "ttft_p95_ms": 318.9,
+            "autoscale_reaction_ms": 15.5,
+            "replicas_peak": 3, "scale_ups": 2, "requests_ok": 80,
+            "requests_failed": 0, "generated_tokens": 1280,
+            "slots": 4, "max_replicas": 3, "d_model": 128,
+            # _safe stamps this whenever the jit sanitizer is armed
+            # (always, under bench --check); baselined at absolute 0.
+            "retraces_total": 0,
+        }},
+    }
+
+    def test_seeded_cpu_gate_passes_and_catches_collapse(self):
+        bench = TestBenchServingGate()._bench()
+        current = bench.collect_submetrics(self._LINE)
+        # Directionality: throughput gates higher-is-better, reaction
+        # and TTFT lower-is-better, shape params ungated.
+        assert bench.metric_direction(
+            "serving_fleet.autoscale_reaction_ms") == "lower"
+        assert bench.metric_direction(
+            "serving_fleet.fleet_sustained_tokens_per_sec") == "higher"
+        assert "serving_fleet.replicas_peak" not in current
+        baseline = {
+            k: v for k, v in bench.load_baselines().get("cpu", {}).items()
+            if k.startswith("serving_fleet.")
+        }
+        assert baseline, "cpu serving_fleet baselines must be seeded"
+        assert not bench.check_regressions(current, baseline)
+
+        collapsed = dict(current)
+        collapsed["serving_fleet.fleet_sustained_tokens_per_sec"] = 100.0
+        # The no-scale-up sentinel (9e9) must fail the reaction gate.
+        collapsed["serving_fleet.autoscale_reaction_ms"] = 9e9
+        problems = bench.check_regressions(collapsed, baseline)
+        assert any("fleet_sustained_tokens_per_sec" in p
+                   for p in problems)
+        assert any("autoscale_reaction_ms" in p for p in problems)

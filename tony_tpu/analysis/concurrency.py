@@ -106,9 +106,13 @@ _BLOCKING_BUILTINS = frozenset({"open"})
 
 # Attribute types that are themselves synchronization primitives or
 # thread-safe by contract: mutations of these are not TONY-T003 races.
+# SchedulerJournal qualifies by its documented contract — seq
+# assignment + the single O_APPEND write are serialized behind its own
+# internal lock, so callers on any thread never need a shared guard.
 _SYNC_TYPES = frozenset({
     "Event", "Lock", "RLock", "Condition", "Semaphore",
     "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue",
+    "SchedulerJournal",
 })
 
 # Container-mutating method names (``self._x.append(...)`` mutates

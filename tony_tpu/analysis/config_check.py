@@ -71,6 +71,13 @@ _MIN_ONE_KEYS = frozenset({
     keys.K_SERVING_PREFILL_CHUNK,
     keys.K_SERVING_DECODE_WINDOW,
     keys.K_SERVING_MAX_QUEUE,
+    # A fleet that may never have a replica can never serve; a
+    # zero-interval health poll spins the router thread; a zero-tick
+    # hysteresis defeats its own purpose (every tick actuates).
+    keys.K_FLEET_MAX_REPLICAS,
+    keys.K_FLEET_SCALE_UP_QUEUE_DEPTH,
+    keys.K_FLEET_HYSTERESIS_TICKS,
+    keys.K_FLEET_HEALTH_INTERVAL_MS,
     # A zero-tick scheduler loop spins; a zero-slice pool can never
     # place a job.
     keys.K_SCHED_TICK_MS,

@@ -1277,6 +1277,172 @@ def scheduler_cmd(argv: list[str]) -> int:
     return scheduler_main(argv)
 
 
+def _fleet_rpc_target(args) -> tuple[str | None, Any]:
+    """Resolve the live daemon address for the mutating fleet verbs
+    (create/scale need a leader, not a state file): explicit flag, then
+    ``tony.scheduler.address``, then ``<base-dir>/scheduler.addr``."""
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(
+        conf_file=args.conf_file,
+        overrides=list(getattr(args, "conf", []) or []),
+    )
+    addr = args.scheduler or conf.get_str(keys.K_SCHED_ADDRESS) or None
+    base = args.scheduler_dir or conf.get_str(keys.K_SCHED_BASE_DIR)
+    if not addr and base:
+        try:
+            addr = (Path(base) / "scheduler.addr").read_text().strip() \
+                or None
+        except OSError:
+            addr = None
+    return addr, conf
+
+
+def _print_fleets(fleets: dict, jobs_by_id: dict | None = None) -> None:
+    jobs_by_id = jobs_by_id or {}
+    for name in sorted(fleets):
+        f = fleets[name] or {}
+        spec = f.get("spec") or {}
+        router = f.get("router") or {}
+        print(f"# fleet {name} — desired {f.get('desired')} "
+              f"(bounds {spec.get('min_replicas')}-"
+              f"{spec.get('max_replicas')}, "
+              f"autoscale {'on' if spec.get('autoscale') else 'off'}"
+              f"{', disaggregated' if spec.get('disaggregated') else ''})"
+              f" router {router.get('addr', '-')}")
+        by_rid = {r.get("rid"): r for r in router.get("replicas", [])}
+        replicas = f.get("replicas") or {}
+        for rid in sorted(replicas, key=lambda r: (len(r), r)):
+            job_id = replicas[rid]
+            rep = by_rid.get(rid) or {}
+            j = jobs_by_id.get(job_id) or {}
+            print(f"  {rid:6s} {job_id:26s} "
+                  f"{(j.get('state') or '?'):11s} "
+                  f"{(rep.get('addr') or '-'):22s} "
+                  f"role {rep.get('role') or '-':8s} "
+                  f"q {rep.get('queue_depth') if rep.get('queue_depth') is not None else '-'}"
+                  f"{' DRAINING' if rep.get('draining') else ''}")
+
+
+def fleet_cmd(argv: list[str]) -> int:
+    """``cli fleet <create|status|scale|ps>``: autoscaled serving
+    replica groups on the scheduler daemon (fleet/ subsystem).
+    ``create``/``scale`` need the live daemon; ``status``/``ps`` fall
+    back live API -> scheduler-state.json (-> job history for ps)."""
+    import argparse
+    import json as _json
+
+    subs = ("create", "status", "scale", "ps")
+    if not argv or argv[0] not in subs:
+        print(f"usage: python -m tony_tpu.client.cli fleet "
+              f"<{'|'.join(subs)}> [options]", file=sys.stderr)
+        return 2
+    sub, rest = argv[0], argv[1:]
+    p = argparse.ArgumentParser(prog=f"tony_tpu.client.cli fleet {sub}")
+    p.add_argument("--scheduler", default=None,
+                   help="daemon host:port (default: tony.scheduler.address)")
+    p.add_argument("--scheduler-dir", default=None,
+                   help="daemon base dir (scheduler.addr / "
+                        "scheduler-state.json fallback)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    if sub == "create":
+        p.add_argument("--name", required=True)
+        p.add_argument("--replicas", type=int, default=None,
+                       help="initial size (default max(1, min-replicas))")
+        p.add_argument("--conf", action="append", default=[],
+                       help="template key=value override (repeatable); "
+                            "tony.fleet.* keys set the bounds/autoscaler")
+    elif sub == "scale":
+        p.add_argument("--name", required=True)
+        p.add_argument("--replicas", type=int, required=True)
+    else:  # status | ps
+        p.add_argument("--name", default=None)
+        p.add_argument("--history-location", default=None,
+                       help="override tony.history.location (ps fallback)")
+    args = p.parse_args(rest)
+
+    if sub in ("create", "scale"):
+        from tony_tpu.scheduler.http import scheduler_request
+
+        addr, conf = _fleet_rpc_target(args)
+        if not addr:
+            print("no scheduler daemon reachable (set --scheduler or "
+                  "tony.scheduler.address)", file=sys.stderr)
+            return 1
+        if sub == "create":
+            payload = {"name": args.name, "conf": conf.to_dict()}
+            if args.replicas is not None:
+                payload["replicas"] = args.replicas
+        else:
+            payload = {"name": args.name, "replicas": args.replicas}
+        try:
+            doc = scheduler_request(
+                addr, f"/api/fleet/{sub}", payload,
+                retries=max(conf.get_int(keys.K_SCHED_CLIENT_RETRIES, 5),
+                            1),
+                backoff_ms=max(
+                    conf.get_int(keys.K_SCHED_CLIENT_BACKOFF_MS, 250), 1
+                ),
+            )
+        except (OSError, ValueError) as exc:
+            print(f"fleet {sub} failed: {exc}", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(_json.dumps(doc, indent=2))
+        else:
+            _print_fleets({args.name: doc})
+        return 0
+
+    # status / ps: the shared live -> state-file chain, then (ps only)
+    # the job-history listing — pinned by tests/test_fleet.py.
+    state, source = _scheduler_state(args)
+    if state is not None:
+        fleets = state.get("fleets") or {}
+        if args.name is not None:
+            fleets = {k: v for k, v in fleets.items() if k == args.name}
+            if not fleets:
+                print(f"unknown fleet {args.name}", file=sys.stderr)
+                return 1
+        if args.as_json:
+            print(_json.dumps({"source": source, "fleets": fleets},
+                              indent=2))
+            return 0
+        print(f"# scheduler ({source}) — {len(fleets)} fleet(s)")
+        _print_fleets(
+            fleets, {j["job_id"]: j for j in state.get("jobs", [])}
+        )
+        return 0
+    if sub == "status":
+        print("no scheduler daemon reachable (live or state file)",
+              file=sys.stderr)
+        return 1
+    # fleet ps last resort: job history (replica jobs are normal jobs;
+    # their attempts land in history like every other job's).
+    from tony_tpu.conf.configuration import load_job_config
+    from tony_tpu.history.reader import list_jobs
+
+    conf = load_job_config(conf_file=args.conf_file)
+    history = args.history_location or conf.get_str(
+        keys.K_HISTORY_LOCATION
+    )
+    if not history:
+        print("no scheduler daemon reachable (and no history location "
+              "to fall back to)", file=sys.stderr)
+        return 1
+    jobs = list_jobs(history)
+    if args.as_json:
+        from dataclasses import asdict
+
+        print(_json.dumps({"source": "history",
+                           "jobs": [asdict(j) for j in jobs]}, indent=2))
+        return 0
+    print("# history fallback (no scheduler daemon reachable)")
+    for j in jobs:
+        print(f"{j.app_id:40s} {j.status:10s}")
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
@@ -1285,6 +1451,7 @@ SUBMITTERS = {
     "ps": ps_cmd,
     "queue": queue_cmd,
     "scheduler": scheduler_cmd,
+    "fleet": fleet_cmd,
     "lint": lint,
     "list": list_resources,
     "cleanup": cleanup_resources,

@@ -299,6 +299,43 @@ K_SERVING_MAX_QUEUE = SERVING_PREFIX + "max-queue"
 # port when available, else ephemeral).
 K_SERVING_PORT = SERVING_PREFIX + "port"
 
+# --- serving fleets (fleet/, actuated by scheduler/service.py) --------------
+# An autoscaled replica group of serving jobs behind the fleet router.
+# Read from the FLEET TEMPLATE conf at `tony fleet create` (frozen into
+# the fleet's journaled spec); the daemon's own conf only needs the
+# scheduler keys.
+FLEET_PREFIX = TONY_PREFIX + "fleet."
+# Replica-count bounds. min 0 = scale-to-zero: an idle fleet releases
+# every slice back to the warm pool and cold-wakes on the next request.
+K_FLEET_MIN_REPLICAS = FLEET_PREFIX + "min-replicas"
+K_FLEET_MAX_REPLICAS = FLEET_PREFIX + "max-replicas"
+# Autoscaler on/off (off = fleet stays at its created/`tony fleet
+# scale` size; bounds still enforced).
+K_FLEET_AUTOSCALE = FLEET_PREFIX + "autoscale"
+# Scale-up triggers: queued requests per ready replica, and p95 TTFT
+# (ms, 0 disables the latency signal). Both must persist for
+# hysteresis-ticks daemon ticks, and actions are rate-limited by
+# cooldown-ms.
+K_FLEET_SCALE_UP_QUEUE_DEPTH = FLEET_PREFIX + "scale-up-queue-depth"
+K_FLEET_TTFT_TARGET_MS = FLEET_PREFIX + "ttft-target-ms"
+K_FLEET_HYSTERESIS_TICKS = FLEET_PREFIX + "hysteresis-ticks"
+K_FLEET_COOLDOWN_MS = FLEET_PREFIX + "cooldown-ms"
+# Scale-down trigger: empty queue AND slot utilization <= scale-down-
+# util, sustained for scale-down-idle-ms.
+K_FLEET_SCALE_DOWN_UTIL = FLEET_PREFIX + "scale-down-util"
+K_FLEET_SCALE_DOWN_IDLE_MS = FLEET_PREFIX + "scale-down-idle-ms"
+# Router front door: bind port (0 = ephemeral, advertised in the
+# daemon's fleet state), retry budget for idempotent requests whose
+# replica died mid-flight, and replica /healthz poll cadence.
+K_FLEET_ROUTER_PORT = FLEET_PREFIX + "router-port"
+K_FLEET_ROUTER_RETRIES = FLEET_PREFIX + "router-retries"
+K_FLEET_HEALTH_INTERVAL_MS = FLEET_PREFIX + "health-interval-ms"
+# Prefill/decode disaggregation (experimental, default symmetric): the
+# first prefill-replicas replicas only prefill and export KV rows; the
+# rest only decode from injected KV.
+K_FLEET_DISAGGREGATION = FLEET_PREFIX + "disaggregation"
+K_FLEET_PREFILL_REPLICAS = FLEET_PREFIX + "prefill-replicas"
+
 # --- multi-tenant scheduler (scheduler/) ------------------------------------
 # A persistent daemon that queues many jobs, gang-schedules them onto a
 # POOL of slices, and reuses warm slices across jobs: a released slice
@@ -506,6 +543,20 @@ DEFAULTS: dict[str, object] = {
     K_SERVING_DECODE_WINDOW: 1,
     K_SERVING_MAX_QUEUE: 1024,
     K_SERVING_PORT: 0,
+    K_FLEET_MIN_REPLICAS: 1,
+    K_FLEET_MAX_REPLICAS: 4,
+    K_FLEET_AUTOSCALE: True,
+    K_FLEET_SCALE_UP_QUEUE_DEPTH: 4,
+    K_FLEET_TTFT_TARGET_MS: 0,
+    K_FLEET_HYSTERESIS_TICKS: 2,
+    K_FLEET_COOLDOWN_MS: 15000,
+    K_FLEET_SCALE_DOWN_UTIL: 0.25,
+    K_FLEET_SCALE_DOWN_IDLE_MS: 30000,
+    K_FLEET_ROUTER_PORT: 0,
+    K_FLEET_ROUTER_RETRIES: 2,
+    K_FLEET_HEALTH_INTERVAL_MS: 1000,
+    K_FLEET_DISAGGREGATION: False,
+    K_FLEET_PREFILL_REPLICAS: 0,
     K_SCHED_ADDRESS: "",
     K_SCHED_BASE_DIR: "",
     K_SCHED_PORT: 0,

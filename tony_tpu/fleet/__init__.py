@@ -1,0 +1,28 @@
+"""Serving fleets: autoscaled replica groups behind a routing layer.
+
+A *fleet* is a scheduler-owned group of N ``lm_serve`` replicas — each
+a normal journaled attempt on a pool slice — fronted by the
+:class:`~tony_tpu.fleet.router.FleetRouter` (least-queue-depth
+selection, draining-aware removal, bounded retry, per-model routing)
+and sized by the :class:`~tony_tpu.fleet.autoscale.Autoscaler`
+(hysteresis + cooldown over the live serving gauges, scale-to-zero on
+idle, cold-wake on first request). The SchedulerDaemon owns the
+lifecycle: ``fleet_created``/``fleet_scaled``/``replica_launched``/
+``replica_retired`` journal records make a fleet crash-recoverable like
+every other scheduler object.
+"""
+
+from tony_tpu.fleet.autoscale import (AutoscalePolicy, Autoscaler,
+                                      FleetSignals, ScaleDecision)
+from tony_tpu.fleet.manager import FleetSpec, FleetState
+from tony_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
+    "FleetSignals",
+    "ScaleDecision",
+    "FleetRouter",
+    "FleetSpec",
+    "FleetState",
+]

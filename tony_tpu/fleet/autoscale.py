@@ -1,0 +1,164 @@
+"""Tick-driven fleet autoscaler: pure decision logic over live serving
+signals.
+
+The controller consumes the aggregated serving gauges the router
+already polls (queue depth, active slots, p95 TTFT) and emits at most
+one :class:`ScaleDecision` per tick. Everything stateful lives here —
+hysteresis counters, cooldown and idle clocks — while the *actuation*
+(journaling the decision, launching/retiring replica jobs) belongs to
+the SchedulerDaemon, so this module stays jax-free, clock-injectable,
+and unit-testable without a cluster.
+
+Semantics (documented operator-facing in docs/DEPLOY.md):
+
+* **Scale up** when the per-ready-replica queue depth exceeds
+  ``scale_up_queue_depth``, or p95 TTFT exceeds ``ttft_target_ms``
+  (0 disables the TTFT signal) — sustained for ``hysteresis_ticks``
+  consecutive ticks, one replica at a time, bounded by
+  ``max_replicas`` and rate-limited by ``cooldown_ms``.
+* **Scale down** when the fleet is quiet — empty queue and slot
+  utilization at or below ``scale_down_util`` — for
+  ``scale_down_idle_ms``, one replica at a time down to
+  ``min_replicas`` (0 = scale-to-zero releases every slice back to
+  the warm pool).
+* **Cold wake** bypasses hysteresis and cooldown: a request arriving
+  at a zero-replica fleet (the router raises ``wake_requested``, or
+  queued work is visible) scales straight to ``max(1, min_replicas)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+import time
+
+
+@dataclass
+class AutoscalePolicy:
+    """Bounds and thresholds — the ``tony.fleet.*`` keys, resolved."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_queue_depth: int = 4
+    ttft_target_ms: float = 0.0
+    scale_down_util: float = 0.25
+    scale_down_idle_ms: int = 30000
+    cooldown_ms: int = 15000
+    hysteresis_ticks: int = 2
+
+
+@dataclass
+class FleetSignals:
+    """One tick's aggregated view of the fleet, as the router sees it
+    from the replicas' ``/healthz``."""
+
+    ready_replicas: int = 0
+    queue_depth: int = 0
+    active_slots: int = 0
+    total_slots: int = 0
+    p95_ttft_ms: float = 0.0
+    wake_requested: bool = False
+
+
+@dataclass
+class ScaleDecision:
+    target: int
+    reason: str
+    cold_wake: bool = False
+
+
+@dataclass
+class Autoscaler:
+    """Hysteresis + cooldown state machine; ``tick()`` at the daemon's
+    cadence, actuate whatever it returns."""
+
+    policy: AutoscalePolicy = field(default_factory=AutoscalePolicy)
+    clock_ms: Callable[[], int] = field(
+        default=lambda: int(time.time() * 1000)
+    )
+
+    def __post_init__(self) -> None:
+        self._up_ticks = 0
+        self._last_action_ms: int | None = None
+        self._quiet_since_ms: int | None = None
+
+    def _cooled(self, now: int) -> bool:
+        return (self._last_action_ms is None
+                or now - self._last_action_ms >= self.policy.cooldown_ms)
+
+    def tick(self, signals: FleetSignals,
+             current: int) -> ScaleDecision | None:
+        """At most one decision per tick; None = hold. ``current`` is
+        the fleet's desired replica count (what the daemon will
+        reconcile toward), not the momentary live count — the
+        controller must not re-decide a scale-up it already made just
+        because the replica is still launching."""
+        pol = self.policy
+        now = self.clock_ms()
+
+        # Bounds violations actuate immediately (an operator shrank
+        # max-replicas under a running fleet).
+        if current > pol.max_replicas:
+            self._last_action_ms = now
+            return ScaleDecision(pol.max_replicas, "max-replicas bound")
+        if current < pol.min_replicas:
+            self._last_action_ms = now
+            return ScaleDecision(pol.min_replicas, "min-replicas bound")
+
+        # Cold wake: work arrived at a scaled-to-zero fleet. Bypasses
+        # hysteresis AND cooldown — the first request is already
+        # waiting.
+        if current == 0 and (signals.wake_requested
+                             or signals.queue_depth > 0):
+            self._up_ticks = 0
+            self._quiet_since_ms = None
+            self._last_action_ms = now
+            return ScaleDecision(max(1, pol.min_replicas),
+                                 "cold wake", cold_wake=True)
+
+        ready = max(signals.ready_replicas, 1)
+        overloaded = (
+            signals.queue_depth / ready > pol.scale_up_queue_depth
+            or (pol.ttft_target_ms > 0
+                and signals.p95_ttft_ms > pol.ttft_target_ms)
+        )
+        quiet = (
+            signals.queue_depth == 0
+            and (signals.total_slots == 0
+                 or signals.active_slots / signals.total_slots
+                 <= pol.scale_down_util)
+        )
+
+        if overloaded:
+            self._quiet_since_ms = None
+            self._up_ticks += 1
+            if (self._up_ticks >= pol.hysteresis_ticks
+                    and current < pol.max_replicas
+                    and self._cooled(now)):
+                self._up_ticks = 0
+                self._last_action_ms = now
+                return ScaleDecision(
+                    current + 1,
+                    f"queue_depth={signals.queue_depth} over "
+                    f"{pol.scale_up_queue_depth}/replica"
+                    if pol.ttft_target_ms <= 0
+                    or signals.p95_ttft_ms <= pol.ttft_target_ms
+                    else f"p95_ttft={signals.p95_ttft_ms:.0f}ms over "
+                         f"{pol.ttft_target_ms:.0f}ms",
+                )
+            return None
+
+        self._up_ticks = 0
+        if quiet and current > pol.min_replicas:
+            if self._quiet_since_ms is None:
+                self._quiet_since_ms = now
+            if (now - self._quiet_since_ms >= pol.scale_down_idle_ms
+                    and self._cooled(now)):
+                self._last_action_ms = now
+                return ScaleDecision(
+                    current - 1,
+                    f"idle {now - self._quiet_since_ms}ms",
+                )
+        elif not quiet:
+            self._quiet_since_ms = None
+        return None

@@ -1,0 +1,136 @@
+"""Fleet bookkeeping shared by the SchedulerDaemon and its journal.
+
+A fleet is a journaled scheduler object: :class:`FleetSpec` is the
+operator's ask (template conf + bounds), :class:`FleetState` the
+daemon's working record (desired count + the replica→job map the
+``replica_launched``/``replica_retired`` records fold into). Replicas
+are *normal scheduler jobs* — each launch goes through
+``SchedulerDaemon.submit`` onto a pool slice, so warm leases, the
+slice-pinned compile cache, preemption accounting, and recovery
+adoption all apply unchanged; this module only decides what those jobs
+serve and how the daemon finds their endpoints.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+# Declared metric names — daemon-side tony_fleet_* gauges/counters
+# (TONY-M001/M002 lint these module-scope constants).
+FLEET_REPLICAS_GAUGE = "tony_fleet_replicas"
+FLEET_DESIRED_REPLICAS_GAUGE = "tony_fleet_desired_replicas"
+FLEET_SCALE_EVENTS_COUNTER = "tony_fleet_scale_events_total"
+
+_RID_RE = re.compile(r"^r(\d+)$")
+
+
+@dataclass
+class FleetSpec:
+    """The journaled shape of a fleet: everything needed to relaunch a
+    replica after a crash lives here or in the frozen template conf at
+    ``template_dir``."""
+
+    name: str
+    template_dir: str
+    desired: int = 1
+    min_replicas: int = 1
+    max_replicas: int = 4
+    autoscale: bool = True
+    disaggregated: bool = False
+    prefill_replicas: int = 0
+    router_port: int = 0
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "template_dir": self.template_dir,
+            "desired": self.desired,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "autoscale": self.autoscale,
+            "disaggregated": self.disaggregated,
+            "prefill_replicas": self.prefill_replicas,
+            "router_port": self.router_port,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "FleetSpec":
+        return cls(
+            name=str(obj["name"]),
+            template_dir=str(obj.get("template_dir", "")),
+            desired=int(obj.get("desired", 1)),
+            min_replicas=int(obj.get("min_replicas", 1)),
+            max_replicas=int(obj.get("max_replicas", 4)),
+            autoscale=bool(obj.get("autoscale", True)),
+            disaggregated=bool(obj.get("disaggregated", False)),
+            prefill_replicas=int(obj.get("prefill_replicas", 0)),
+            router_port=int(obj.get("router_port", 0)),
+        )
+
+
+@dataclass
+class FleetState:
+    """Daemon-side working record, rebuilt by journal replay."""
+
+    spec: FleetSpec
+    desired: int = 1
+    replicas: dict[str, str] = field(default_factory=dict)  # rid -> job_id
+
+    def next_rid(self) -> str:
+        used = {int(m.group(1)) for rid in self.replicas
+                if (m := _RID_RE.match(rid))}
+        for i in itertools.count():
+            if i not in used:
+                return f"r{i}"
+        raise AssertionError("unreachable")
+
+    def replica_role(self, rid: str) -> str:
+        """Role assignment under disaggregation: the first
+        ``prefill_replicas`` rids (numeric order) prefill, the rest
+        decode; symmetric fleets are all ``both``. Deterministic in the
+        rid so recovery reassigns identically."""
+        if not self.spec.disaggregated or self.spec.prefill_replicas <= 0:
+            return "both"
+        m = _RID_RE.match(rid)
+        idx = int(m.group(1)) if m else 0
+        return ("prefill" if idx < self.spec.prefill_replicas
+                else "decode")
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "spec": self.spec.to_json(),
+            "desired": self.desired,
+            "replicas": dict(self.replicas),
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "FleetState":
+        spec = FleetSpec.from_json(obj["spec"])
+        return cls(
+            spec=spec,
+            desired=int(obj.get("desired", spec.desired)),
+            replicas={str(k): str(v)
+                      for k, v in (obj.get("replicas") or {}).items()},
+        )
+
+
+def discover_replica_addr(app_dir: str | Path) -> str | None:
+    """A serving task publishes ``serving-<job>-<idx>.addr`` atomically
+    under its log dir once bound (``examples/lm_serve.py``); the daemon
+    globs for it to build the routing table — including after recovery,
+    when the replica predates this daemon incarnation."""
+    root = Path(app_dir)
+    if not root.is_dir():
+        return None
+    for f in sorted(root.rglob("serving-*.addr")):
+        try:
+            addr = f.read_text().strip()
+        except OSError:
+            continue
+        if addr:
+            return addr
+    return None

@@ -1,0 +1,500 @@
+"""Fleet router: the HTTP front door over a group of serving replicas.
+
+Built on the same trusted-network stdlib HTTP shape as the proxy and
+the scheduler API. The router keeps a replica registry, polls every
+replica's ``/healthz`` on a background loop (the one readiness endpoint
+the serving layer exposes), and forwards each request to the
+least-loaded ready replica:
+
+* **least-queue-depth selection** — score = replica ``queue_depth`` +
+  requests this router currently has in flight to it (the local
+  in-flight count covers the polling gap);
+* **draining-aware removal** — a replica marked draining (scheduler
+  scale-down) or reporting ``draining`` in its health stops receiving
+  new work before teardown;
+* **bounded retry** — generate requests are idempotent (greedy decode
+  is deterministic), so a replica dying mid-call costs a retry against
+  a survivor, not a client error; 429 (queue shed) also retries
+  elsewhere and only surfaces when every ready replica shed;
+* **per-model routing** — a request's ``model`` field restricts
+  candidates to replicas whose health advertises that model;
+* **cold wake** — a request arriving with zero ready replicas raises
+  ``wake_requested`` (the autoscaler's 0→1 signal, plus an optional
+  callback) and holds the request up to ``wake_timeout_s``;
+* **prefill/decode disaggregation** (config flag, symmetric default) —
+  with ``disaggregated=True`` and both roles present, ``/generate``
+  becomes ``/prefill`` on a prefill-role replica followed by
+  ``/inject`` on a decode-role replica, the KV rows shipped through
+  the wire format in ``serving/http.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.analysis import sync_sanitizer as _sync
+
+log = logging.getLogger(__name__)
+
+# Declared metric names — the router's tony_fleet_* family
+# (TONY-M001/M002 lint these module-scope constants).
+FLEET_ROUTER_REQUESTS_COUNTER = "tony_fleet_router_requests_total"
+FLEET_ROUTER_RETRIES_COUNTER = "tony_fleet_router_retries_total"
+FLEET_ROUTER_SHED_COUNTER = "tony_fleet_router_shed_total"
+FLEET_READY_REPLICAS_GAUGE = "tony_fleet_ready_replicas"
+
+
+class _Replica:
+    def __init__(self, rid: str, addr: str, role: str = "both") -> None:
+        self.rid = rid
+        self.addr = addr
+        self.role = role
+        self.draining = False
+        self.health: dict = {}
+        self.failures = 0
+        self.inflight = 0
+        self.last_ok_ms = 0
+
+    def to_json(self) -> dict:
+        return {
+            "rid": self.rid,
+            "addr": self.addr,
+            "role": self.role,
+            "draining": self.draining,
+            "failures": self.failures,
+            "inflight": self.inflight,
+            "queue_depth": self.health.get("queue_depth"),
+            "active_slots": self.health.get("active_slots"),
+            "models": self.health.get("models"),
+        }
+
+
+class FleetRouter:
+    """HTTP front door + health aggregator for one fleet."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        health_interval_s: float = 1.0,
+        health_misses: int = 3,
+        retries: int = 2,
+        request_timeout_s: float = 600.0,
+        wake_timeout_s: float = 30.0,
+        disaggregated: bool = False,
+        on_cold_wake=None,
+        registry=None,
+    ) -> None:
+        self.health_interval_s = float(health_interval_s)
+        self.health_misses = int(health_misses)
+        self.retries = max(0, int(retries))
+        self.request_timeout_s = float(request_timeout_s)
+        self.wake_timeout_s = float(wake_timeout_s)
+        self.disaggregated = bool(disaggregated)
+        self.on_cold_wake = on_cold_wake
+        self._lock = _sync.make_lock("router.FleetRouter._lock")
+        self._replicas: dict[str, _Replica] = {}
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._wake_requested = False
+        if registry is None:
+            from tony_tpu.observability.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._c_requests = registry.counter(
+            FLEET_ROUTER_REQUESTS_COUNTER, "requests through the router"
+        )
+        self._c_retries = registry.counter(
+            FLEET_ROUTER_RETRIES_COUNTER,
+            "requests re-sent to a survivor after a replica failure",
+        )
+        self._c_shed = registry.counter(
+            FLEET_ROUTER_SHED_COUNTER,
+            "requests shed 429/503 after exhausting every ready replica",
+        )
+        self._g_ready = registry.gauge(
+            FLEET_READY_REPLICAS_GAUGE, "replicas in rotation"
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       headers: dict | None = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, json.dumps(
+                        outer.status()).encode())
+                else:
+                    self._reply(404, json.dumps(
+                        {"error": f"no route {self.path}"}).encode())
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, json.dumps(
+                        {"error": f"no route {self.path}"}).encode())
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(n) or b"{}"
+                try:
+                    body = json.loads(raw)
+                except ValueError as exc:
+                    self._reply(400, json.dumps(
+                        {"error": f"bad request: {exc}"}).encode())
+                    return
+                code, out, headers = outer.route_generate(body)
+                self._reply(code, out, headers)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._http_thread: threading.Thread | None = None
+
+    # -- registry ----------------------------------------------------------
+    def add_replica(self, rid: str, addr: str,
+                    role: str = "both") -> None:
+        with self._lock:
+            self._replicas[rid] = _Replica(rid, addr, role)
+        self.poll_once()
+
+    def remove_replica(self, rid: str) -> None:
+        with self._lock:
+            self._replicas.pop(rid, None)
+        self._publish_ready()
+
+    def drain_replica(self, rid: str) -> None:
+        """Take a replica out of rotation ahead of teardown — new work
+        stops landing on it immediately; its in-flight requests finish
+        on the replica's own drain."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None:
+                r.draining = True
+        self._publish_ready()
+
+    def replicas(self) -> list[dict]:
+        with self._lock:
+            return [r.to_json() for r in self._replicas.values()]
+
+    def status(self) -> dict:
+        with self._lock:
+            reps = [r.to_json() for r in self._replicas.values()]
+            ready = [r.rid for r in self._replicas.values()
+                     if self._ready_locked(r)]
+            wake = self._wake_requested
+        return {"ready": len(ready), "ready_rids": sorted(ready),
+                "replicas": reps, "wake_requested": wake,
+                "disaggregated": self.disaggregated}
+
+    def consume_wake(self) -> bool:
+        """Autoscaler handshake: returns-and-clears the cold-wake flag
+        (a request arrived while no replica was ready)."""
+        with self._lock:
+            wake, self._wake_requested = self._wake_requested, False
+        return wake
+
+    # -- health ------------------------------------------------------------
+    def _ready_locked(self, r: _Replica) -> bool:
+        return (
+            not r.draining
+            and r.failures < self.health_misses
+            and bool(r.health)
+            and not r.health.get("draining", False)
+        )
+
+    def poll_once(self) -> None:
+        """One health sweep (the loop's body; callable inline from
+        tests and the daemon tick). HTTP happens outside the lock."""
+        with self._lock:
+            targets = list(self._replicas.values())
+        for r in targets:
+            try:
+                with urllib.request.urlopen(
+                    f"http://{r.addr}/healthz", timeout=2.0
+                ) as resp:
+                    health = json.loads(resp.read())
+                with self._lock:
+                    r.health = health
+                    r.failures = 0
+                    r.last_ok_ms = int(time.time() * 1000)
+            except (OSError, ValueError):
+                with self._lock:
+                    r.failures += 1
+        self._publish_ready()
+
+    def _publish_ready(self) -> None:
+        with self._lock:
+            n = sum(1 for r in self._replicas.values()
+                    if self._ready_locked(r))
+        self._g_ready.set(n)
+
+    def signals(self):
+        """Aggregated :class:`~tony_tpu.fleet.autoscale.FleetSignals`
+        for the autoscaler — totals over ready replicas plus the
+        cold-wake flag (NOT consumed; the autoscaler consumes it when
+        it acts on one)."""
+        from tony_tpu.fleet.autoscale import FleetSignals
+
+        with self._lock:
+            ready = [r for r in self._replicas.values()
+                     if self._ready_locked(r)]
+            sig = FleetSignals(
+                ready_replicas=len(ready),
+                queue_depth=sum(
+                    int(r.health.get("queue_depth", 0) or 0)
+                    + r.inflight for r in ready),
+                active_slots=sum(
+                    int(r.health.get("active_slots", 0) or 0)
+                    for r in ready),
+                total_slots=sum(int(r.health.get("slots", 0) or 0)
+                                for r in ready),
+                wake_requested=self._wake_requested,
+            )
+        return sig
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            self.poll_once()
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, model: str | None, role: str | None = None,
+              exclude: set[str] | None = None) -> _Replica | None:
+        exclude = exclude or set()
+        with self._lock:
+            best: _Replica | None = None
+            best_score = None
+            for r in self._replicas.values():
+                if r.rid in exclude or not self._ready_locked(r):
+                    continue
+                if role is not None and r.role not in (role, "both"):
+                    continue
+                models = r.health.get("models")
+                if (model is not None and isinstance(models, list)
+                        and model not in models):
+                    continue
+                score = (int(r.health.get("queue_depth", 0) or 0)
+                         + int(r.health.get("prefilling", 0) or 0)
+                         + r.inflight)
+                if best_score is None or score < best_score:
+                    best, best_score = r, score
+            if best is not None:
+                best.inflight += 1
+            return best
+
+    def _release(self, r: _Replica) -> None:
+        with self._lock:
+            r.inflight = max(0, r.inflight - 1)
+
+    def _forward(self, r: _Replica, path: str, body: dict):
+        """POST to one replica; returns (code, raw_bytes, parsed|None).
+        Raises OSError family on connection-level failure."""
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"http://{r.addr}{path}", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.request_timeout_s
+            ) as resp:
+                raw = resp.read()
+                return resp.status, raw, json.loads(raw)
+        except urllib.error.HTTPError as exc:
+            raw = exc.read()
+            try:
+                parsed = json.loads(raw)
+            except ValueError:
+                parsed = None
+            return exc.code, raw, parsed
+
+    def _await_ready(self, model: str | None,
+                     role: str | None) -> _Replica | None:
+        """Cold-wake hold: raise the wake flag, fire the callback, and
+        wait for a replica to come into rotation."""
+        with self._lock:
+            self._wake_requested = True
+        if self.on_cold_wake is not None:
+            try:
+                self.on_cold_wake()
+            except Exception:
+                log.warning("cold-wake callback failed", exc_info=True)
+        deadline = time.monotonic() + self.wake_timeout_s
+        while time.monotonic() < deadline:
+            r = self._pick(model, role)
+            if r is not None:
+                return r
+            time.sleep(0.2)
+        return None
+
+    def route_generate(self, body: dict):
+        """(code, response_bytes, headers) for one /generate. Public so
+        the daemon (and tests) can route without going through the
+        router's own HTTP port."""
+        self._c_requests.inc()
+        model = body.get("model")
+        if self.disaggregated and self._has_split_roles():
+            return self._route_disaggregated(body, model)
+        return self._route_symmetric(body, model)
+
+    def _has_split_roles(self) -> bool:
+        with self._lock:
+            roles = {r.role for r in self._replicas.values()
+                     if self._ready_locked(r)}
+        return ("prefill" in roles or "decode" in roles)
+
+    def _route_symmetric(self, body: dict, model: str | None,
+                         path: str = "/generate"):
+        tried: set[str] = set()
+        shed = None
+        for attempt in range(self.retries + 1):
+            r = self._pick(model, None, tried)
+            if r is None and not tried:
+                r = self._await_ready(model, None)
+            if r is None:
+                break
+            tried.add(r.rid)
+            try:
+                code, raw, _ = self._forward(r, path, body)
+            except (OSError, ValueError):
+                # Connection-level death: the replica never produced a
+                # response, so a bounded retry of this idempotent
+                # request against a survivor is safe.
+                self._fail_replica(r)
+                self._c_retries.inc()
+                continue
+            finally:
+                self._release(r)
+            if code == 429:
+                shed = raw
+                self._c_retries.inc()
+                continue  # shed here may admit elsewhere
+            return code, raw, {}
+        if shed is not None:
+            self._c_shed.inc()
+            return 429, shed, {"Retry-After": "1"}
+        self._c_shed.inc()
+        return 503, json.dumps(
+            {"error": "no ready replica"}).encode(), {}
+
+    def _fail_replica(self, r: _Replica) -> None:
+        with self._lock:
+            r.failures = self.health_misses  # out of rotation now
+        self._publish_ready()
+
+    def _route_disaggregated(self, body: dict, model: str | None):
+        """prefill on a prefill-role replica -> ship KV -> inject on a
+        decode-role replica. The decode side's budget excludes the
+        first token the prefill side already sampled, so token totals
+        match the symmetric path."""
+        max_new = int(body.get("max_new_tokens", 0) or 0)
+        pre = dict(body)
+        tried: set[str] = set()
+        for _ in range(self.retries + 1):
+            r = self._pick(model, "prefill", tried)
+            if r is None:
+                # No prefill-capable replica: fall back symmetric.
+                return self._route_symmetric(body, model)
+            tried.add(r.rid)
+            try:
+                code, raw, parsed = self._forward(r, "/prefill", pre)
+            except (OSError, ValueError):
+                self._fail_replica(r)
+                self._c_retries.inc()
+                continue
+            finally:
+                self._release(r)
+            if code != 200 or parsed is None:
+                return code, raw, {}
+            first = parsed["tokens"]
+            if max_new <= 1 or parsed.get("length", 1) >= max_new:
+                return 200, json.dumps(parsed).encode(), {}
+            inject = {
+                "kv": parsed["kv"],
+                "last_token": parsed["last_token"],
+                "pos": parsed["pos"],
+                "max_new_tokens": max_new - 1,
+                "temperature": body.get("temperature", 0.0),
+                "eos_id": body.get("eos_id"),
+                "model": model,
+            }
+            code2, raw2, parsed2 = self._route_decode(inject, model)
+            if code2 != 200 or parsed2 is None:
+                return code2, raw2, {}
+            merged = {
+                "id": parsed2.get("id", parsed.get("id")),
+                "tokens": list(first) + list(parsed2["tokens"]),
+                "length": len(first) + int(parsed2["length"]),
+                "ttft_ms": parsed.get("ttft_ms", 0.0),
+                "wall_ms": round(float(parsed.get("wall_ms", 0.0))
+                                 + float(parsed2.get("wall_ms", 0.0)), 3),
+            }
+            return 200, json.dumps(merged).encode(), {}
+        self._c_shed.inc()
+        return 503, json.dumps(
+            {"error": "no ready prefill replica"}).encode(), {}
+
+    def _route_decode(self, inject: dict, model: str | None):
+        tried: set[str] = set()
+        for _ in range(self.retries + 1):
+            r = self._pick(model, "decode", tried)
+            if r is None:
+                break
+            tried.add(r.rid)
+            try:
+                code, raw, parsed = self._forward(r, "/inject", inject)
+            except (OSError, ValueError):
+                self._fail_replica(r)
+                self._c_retries.inc()
+                continue
+            finally:
+                self._release(r)
+            if code == 429:
+                self._c_retries.inc()
+                continue
+            return code, raw, parsed
+        return 503, json.dumps(
+            {"error": "no ready decode replica"}).encode(), None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fleet-router",
+            daemon=True,
+        )
+        self._http_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="fleet-router-health",
+            daemon=True,
+        )
+        self._health_thread.start()
+        log.info("fleet router listening on :%d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._http_thread is not None:
+            # shutdown() handshakes with serve_forever and would block
+            # forever if start() was never called (a router used only
+            # through route_generate, e.g. the daemon's embedded one).
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        for t in (self._http_thread, self._health_thread):
+            if t is not None:
+                t.join(timeout=10)
+        self._http_thread = self._health_thread = None

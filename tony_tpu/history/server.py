@@ -211,10 +211,66 @@ class HistoryHandler(BaseHTTPRequestHandler):
             "<h3>Slice pool</h3><table><tr><th>slice</th><th>state</th>"
             "<th>profile</th><th>jobs served</th><th>lease</th></tr>"
             f"{pool_rows}</table>"
+            + self._serving_fleets_section(state, esc)
             + self._fleet_goodput_section(state, esc)
             + "<p><a href='/'>jobs</a></p>"
         )
         return _PAGE.format(title="Scheduler", body=body)
+
+    def _serving_fleets_section(self, state: dict, esc) -> str:
+        """Serving fleets panel (scheduler-state.json ``fleets``): one
+        row per replica with its job, router registration, and live
+        health; headline shows desired size, bounds, and the router's
+        front-door address."""
+        fleets = state.get("fleets")
+        if not isinstance(fleets, dict) or not fleets:
+            return ""
+        jobs = {j.get("job_id"): j for j in state.get("jobs", [])}
+        parts = ["<h3>Serving fleets</h3>"]
+        for name in sorted(fleets):
+            f = fleets[name] or {}
+            spec = f.get("spec") or {}
+            router = f.get("router") or {}
+            by_rid = {r.get("rid"): r
+                      for r in router.get("replicas", [])}
+            flags = []
+            if spec.get("autoscale"):
+                flags.append("autoscale")
+            if spec.get("disaggregated"):
+                flags.append("disaggregated")
+            parts.append(
+                f"<p><b>{esc(str(name))}</b> &middot; desired "
+                f"{esc(str(f.get('desired')))} (bounds "
+                f"{esc(str(spec.get('min_replicas')))}&ndash;"
+                f"{esc(str(spec.get('max_replicas')))})"
+                f" &middot; ready {esc(str(router.get('ready', 0)))}"
+                f" &middot; router {esc(str(router.get('addr') or '-'))}"
+                + (f" &middot; {esc(', '.join(flags))}" if flags else "")
+                + "</p>"
+            )
+            rows = []
+            for rid in sorted(f.get("replicas") or {}):
+                job_id = (f.get("replicas") or {}).get(rid)
+                rep = by_rid.get(rid) or {}
+                j = jobs.get(job_id) or {}
+                rows.append(
+                    f"<tr><td>{esc(str(rid))}</td>"
+                    f"<td>{esc(str(job_id))}</td>"
+                    f"<td class='{esc(str(j.get('state') or ''))}'>"
+                    f"{esc(str(j.get('state') or '?'))}</td>"
+                    f"<td>{esc(str(rep.get('addr') or '-'))}</td>"
+                    f"<td>{esc(str(rep.get('role') or '-'))}</td>"
+                    f"<td>{esc(str(rep.get('queue_depth')))}</td>"
+                    f"<td>{esc(str(rep.get('active_slots')))}</td>"
+                    f"<td>{'yes' if rep.get('draining') else '-'}</td>"
+                    "</tr>"
+                )
+            parts.append(
+                "<table><tr><th>replica</th><th>job</th><th>state</th>"
+                "<th>addr</th><th>role</th><th>queue</th><th>active</th>"
+                f"<th>draining</th></tr>{''.join(rows)}</table>"
+            )
+        return "".join(parts)
 
     def _fleet_goodput_section(self, state: dict, esc) -> str:
         """Fleet + per-tenant chip-hour accounting from the daemon's

@@ -86,6 +86,16 @@ SCHEDULER_RECOVERED = "scheduler_recovered"
 LEADER_ELECTED = "leader_elected"
 ATTEMPT_ADOPTED = "attempt_adopted"
 
+# Serving fleets (scheduler/service.py + fleet/): a journaled replica
+# group was created (`fleet_created`), its desired size changed — by
+# operator or autoscaler (`fleet_scaled`), a replica job was launched
+# for it (`replica_launched`), or a replica was drained and retired
+# (`replica_retired`).
+FLEET_CREATED = "fleet_created"
+FLEET_SCALED = "fleet_scaled"
+REPLICA_LAUNCHED = "replica_launched"
+REPLICA_RETIRED = "replica_retired"
+
 # The event catalogue: every kind any emitter may use. TONY-E001
 # (analysis/events_lint.py, run from tools/lint_self.py in tier-1)
 # checks that every ``.emit(...)`` in the tree uses a registered kind
@@ -125,6 +135,10 @@ KNOWN_KINDS = frozenset({
     SCHEDULER_RECOVERED,
     LEADER_ELECTED,
     ATTEMPT_ADOPTED,
+    FLEET_CREATED,
+    FLEET_SCALED,
+    REPLICA_LAUNCHED,
+    REPLICA_RETIRED,
 })
 
 

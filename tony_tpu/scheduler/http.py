@@ -19,6 +19,11 @@ Routes::
     GET  /api/goodput  -> fleet + per-tenant chip-second accounts
     GET  /api/pool     -> {"pool": [...]}
     GET  /api/job/<id> -> one job record
+    POST /api/fleet/create {"name": ..., "conf": {k: v}, "replicas"?: n}
+                       -> fleet status
+    POST /api/fleet/scale  {"name": ..., "replicas": n} -> fleet status
+    GET  /api/fleets   -> {"fleets": {name: status}}
+    GET  /api/fleet/<name> -> one fleet status
     GET  /metrics      -> Prometheus text
     GET  /healthz      -> {"ok": true, ...}
 """
@@ -196,6 +201,16 @@ class SchedulerHttpServer:
                         self._reply(200, d.goodput.to_json())
                     elif self.path == "/api/pool":
                         self._reply(200, {"pool": d.pool.to_json()})
+                    elif self.path == "/api/fleets":
+                        self._reply(200, {"fleets": d.fleets_json()})
+                    elif self.path.startswith("/api/fleet/"):
+                        doc = d.fleet_json(
+                            self.path[len("/api/fleet/"):]
+                        )
+                        if doc is None:
+                            self._reply(404, {"error": "unknown fleet"})
+                        else:
+                            self._reply(200, doc)
                     elif self.path.startswith("/api/job/"):
                         job = d.job(self.path[len("/api/job/"):])
                         if job is None:
@@ -232,6 +247,22 @@ class SchedulerHttpServer:
                     elif self.path == "/api/kill":
                         self._reply(200,
                                     {"ok": d.kill(str(body["job_id"]))})
+                    elif self.path == "/api/fleet/create":
+                        from tony_tpu.conf.configuration import (
+                            TonyConfiguration,
+                        )
+
+                        conf = TonyConfiguration()
+                        conf.set_all(body.get("conf") or {})
+                        reps = body.get("replicas")
+                        self._reply(200, d.create_fleet(
+                            str(body["name"]), conf,
+                            replicas=None if reps is None else int(reps),
+                        ))
+                    elif self.path == "/api/fleet/scale":
+                        self._reply(200, d.scale_fleet(
+                            str(body["name"]), int(body["replicas"]),
+                        ))
                     else:
                         self._reply(404,
                                     {"error": f"no route {self.path}"})

@@ -59,6 +59,10 @@ J_SLICE_RELEASED = "slice_released"
 J_SLICE_RETIRED = "slice_retired"
 J_LEASE_RENEWED = "lease_renewed"
 J_GOODPUT_FOLDED = "goodput_folded"
+J_FLEET_CREATED = "fleet_created"
+J_FLEET_SCALED = "fleet_scaled"
+J_REPLICA_LAUNCHED = "replica_launched"
+J_REPLICA_RETIRED = "replica_retired"
 
 _ACTIVE_STATES = ("LAUNCHING", "RUNNING", "PREEMPTING")
 
@@ -194,6 +198,7 @@ def replay(snapshot: Mapping[str, Any] | None,
           "slices":  {slice_id: slice-record dict (PooledSlice.to_json)},
           "folded":  [app_id, ...]  # attempts already in the accounts
           "tenants": {tenant: {category: chip_seconds}},
+          "fleets":  {name: {spec, desired, replicas: {rid: job_id}}},
         }
 
     Only records with ``seq`` past the snapshot's ``journal_seq``
@@ -205,10 +210,21 @@ def replay(snapshot: Mapping[str, Any] | None,
     slices: dict[str, dict[str, Any]] = {}
     folded: set[str] = set()
     tenants: dict[str, dict[str, float]] = {}
+    fleets: dict[str, dict[str, Any]] = {}
     watermark = 0
 
     if snapshot:
         watermark = _as_int(snapshot.get("journal_seq"), 0)
+        for name, fd in (snapshot.get("fleets") or {}).items():
+            if isinstance(fd, dict) and fd.get("spec"):
+                fleets[str(name)] = {
+                    "spec": dict(fd["spec"]),
+                    "desired": _as_int(fd.get("desired"), 1),
+                    "replicas": {
+                        str(k): str(v)
+                        for k, v in (fd.get("replicas") or {}).items()
+                    },
+                }
         for jd in snapshot.get("jobs") or []:
             if isinstance(jd, dict) and jd.get("job_id"):
                 jobs[str(jd["job_id"])] = dict(jd)
@@ -329,12 +345,37 @@ def replay(snapshot: Mapping[str, Any] | None,
             queued = rec.get("queued_chip_s")
             if isinstance(queued, (int, float)) and queued > 0:
                 acct["queued"] = acct.get("queued", 0.0) + float(queued)
+        elif kind == J_FLEET_CREATED:
+            name = str(rec.get("fleet") or "")
+            spec = rec.get("spec")
+            if name and isinstance(spec, dict):
+                fleets[name] = {
+                    "spec": dict(spec),
+                    "desired": _as_int(rec.get("desired"),
+                                       _as_int(spec.get("desired"), 1)),
+                    "replicas": {},
+                }
+        elif kind == J_FLEET_SCALED:
+            fl = fleets.get(str(rec.get("fleet") or ""))
+            if fl is not None:
+                fl["desired"] = _as_int(rec.get("to"), fl["desired"])
+        elif kind == J_REPLICA_LAUNCHED:
+            fl = fleets.get(str(rec.get("fleet") or ""))
+            rid = str(rec.get("replica_id") or "")
+            if fl is not None and rid and job_id:
+                fl["replicas"][rid] = job_id
+        elif kind == J_REPLICA_RETIRED:
+            fl = fleets.get(str(rec.get("fleet") or ""))
+            rid = str(rec.get("replica_id") or "")
+            if fl is not None:
+                fl["replicas"].pop(rid, None)
     return {
         "journal_seq": last_seq,
         "jobs": jobs,
         "slices": slices,
         "folded": sorted(folded),
         "tenants": tenants,
+        "fleets": fleets,
     }
 
 

@@ -74,6 +74,16 @@ from tony_tpu.observability.metrics import (
     MetricsRegistry,
     histogram_quantile,
 )
+from tony_tpu.fleet.autoscale import AutoscalePolicy, Autoscaler
+from tony_tpu.fleet.manager import (
+    FLEET_DESIRED_REPLICAS_GAUGE,
+    FLEET_REPLICAS_GAUGE,
+    FLEET_SCALE_EVENTS_COUNTER,
+    FleetSpec,
+    FleetState,
+    discover_replica_addr,
+)
+from tony_tpu.fleet.router import FleetRouter
 from tony_tpu.resilience import latest_complete_step
 from tony_tpu.resilience.faults import FaultPlan, SchedulerFaults
 from tony_tpu.scheduler import journal as wal
@@ -290,6 +300,65 @@ class _DetachedRunner:
         self.daemon._on_runner_done(self, status, diag)
 
 
+def _rid_ord(rid: str) -> int:
+    """Numeric ordinal of an ``rN`` replica id (teardown order: highest
+    first, which under disaggregation retires decode replicas before
+    prefill ones)."""
+    tail = rid[1:] if rid[:1] == "r" else rid
+    return int(tail) if tail.isdigit() else 0
+
+
+class _FleetRuntime:
+    """The live half of one fleet: the journaled :class:`FleetState`
+    plus the router + autoscaler rebuilt from its frozen template conf
+    — construction is deterministic in (spec, template), so a recovered
+    daemon reconstitutes an identical runtime."""
+
+    def __init__(self, daemon: "SchedulerDaemon", state: FleetState) -> None:
+        self.state = state
+        spec = state.spec
+        conf = daemon._job_conf(spec.template_dir)
+        self.conf = conf
+        # rids whose serving endpoint is already in the routing table.
+        self.registered: set[str] = set()
+        self.router = FleetRouter(
+            port=spec.router_port,
+            health_interval_s=max(
+                conf.get_int(keys.K_FLEET_HEALTH_INTERVAL_MS, 1000), 50
+            ) / 1000.0,
+            retries=conf.get_int(keys.K_FLEET_ROUTER_RETRIES, 2),
+            disaggregated=spec.disaggregated,
+            # A request hitting a scaled-to-zero fleet must not wait a
+            # full tick for its cold wake.
+            on_cold_wake=daemon._wake.set,
+            registry=daemon.registry,
+        )
+        self.router.start()
+        self.autoscaler = Autoscaler(
+            policy=AutoscalePolicy(
+                min_replicas=spec.min_replicas,
+                max_replicas=spec.max_replicas,
+                scale_up_queue_depth=conf.get_int(
+                    keys.K_FLEET_SCALE_UP_QUEUE_DEPTH, 4
+                ),
+                ttft_target_ms=conf.get_float(
+                    keys.K_FLEET_TTFT_TARGET_MS, 0.0
+                ),
+                scale_down_util=conf.get_float(
+                    keys.K_FLEET_SCALE_DOWN_UTIL, 0.25
+                ),
+                scale_down_idle_ms=conf.get_int(
+                    keys.K_FLEET_SCALE_DOWN_IDLE_MS, 30000
+                ),
+                cooldown_ms=conf.get_int(keys.K_FLEET_COOLDOWN_MS, 15000),
+                hysteresis_ticks=conf.get_int(
+                    keys.K_FLEET_HYSTERESIS_TICKS, 2
+                ),
+            ),
+            clock_ms=daemon._clock_ms,
+        )
+
+
 class SchedulerDaemon:
     """See module docstring. Thread-safe; ``start()`` runs the
     scheduling loop (and the JSON API unless ``serve_http=False``),
@@ -379,6 +448,9 @@ class SchedulerDaemon:
         self.election = election
         self.faults = SchedulerFaults(FaultPlan.from_conf(self.conf))
         self.recovered_ms: int | None = None
+        # Serving fleets this daemon owns (fleet/ subsystem): name ->
+        # runtime. Journaled like jobs; rebuilt by recover().
+        self._fleets: dict[str, _FleetRuntime] = {}
 
     # -- submission ----------------------------------------------------------
     def submit(
@@ -506,6 +578,271 @@ class SchedulerDaemon:
             runner.kill()
         return True
 
+    # -- serving fleets ------------------------------------------------------
+    def create_fleet(
+        self,
+        name: str,
+        conf: TonyConfiguration,
+        replicas: int | None = None,
+    ) -> dict[str, Any]:
+        """Create a journaled serving fleet: freeze ``conf`` as the
+        replica template, journal the spec (``fleet_created``), and let
+        the tick's reconcile launch the replicas as normal scheduler
+        jobs on pool slices. ``replicas`` overrides the initial size
+        (default ``max(1, min-replicas)``, clamped to the bounds)."""
+        if not self.election.is_leader and not self.election.try_acquire():
+            raise RuntimeError(
+                "not the leader — create fleets on the active scheduler"
+            )
+        if not re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}", name):
+            raise ValueError(f"bad fleet name {name!r}")
+        with self._lock:
+            if name in self._fleets:
+                raise ValueError(f"fleet {name} already exists")
+        template_dir = self.base_dir / "fleets" / name / "template"
+        template_dir.mkdir(parents=True, exist_ok=True)
+        conf.write_final(template_dir / constants.TONY_FINAL_CONF)
+        spec = FleetSpec(
+            name=name,
+            template_dir=str(template_dir),
+            min_replicas=conf.get_int(keys.K_FLEET_MIN_REPLICAS, 1),
+            max_replicas=conf.get_int(keys.K_FLEET_MAX_REPLICAS, 4),
+            autoscale=conf.get_bool(keys.K_FLEET_AUTOSCALE, True),
+            disaggregated=conf.get_bool(keys.K_FLEET_DISAGGREGATION, False),
+            prefill_replicas=conf.get_int(keys.K_FLEET_PREFILL_REPLICAS, 0),
+            router_port=conf.get_int(keys.K_FLEET_ROUTER_PORT, 0),
+        )
+        if spec.max_replicas < max(spec.min_replicas, 1):
+            raise ValueError(
+                f"tony.fleet.max-replicas={spec.max_replicas} below "
+                f"min-replicas={spec.min_replicas}"
+            )
+        desired = (int(replicas) if replicas is not None
+                   else max(1, spec.min_replicas))
+        desired = max(spec.min_replicas, min(desired, spec.max_replicas))
+        spec.desired = desired
+        # WAL before actuation: a crash after this line recovers the
+        # fleet (and reconcile launches its replicas); a crash before it
+        # means the create never happened and the client retries.
+        self.journal.append(
+            wal.J_FLEET_CREATED, ts_ms=self._clock_ms(), fleet=name,
+            spec=spec.to_json(), desired=desired,
+        )
+        rt = _FleetRuntime(self, FleetState(spec=spec, desired=desired))
+        with self._lock:
+            self._fleets[name] = rt
+            self._dirty = True
+        self.registry.gauge(FLEET_DESIRED_REPLICAS_GAUGE,
+                            labels={"fleet": name}).set(desired)
+        self.events.emit(
+            obs_events.FLEET_CREATED, fleet=name, desired=desired,
+            router_port=rt.router.port, autoscale=spec.autoscale,
+            disaggregated=spec.disaggregated,
+        )
+        log.info("fleet %s created (desired %d, router :%d)", name,
+                 desired, rt.router.port)
+        self._wake.set()
+        return self.fleet_json(name) or {}
+
+    def scale_fleet(self, name: str, replicas: int) -> dict[str, Any]:
+        """Operator scale: set the desired size (clamped to the spec's
+        bounds); the tick reconciles launches/retirements."""
+        if not self.election.check_fence():
+            raise RuntimeError("not the leader — scale fleets on the "
+                               "active scheduler")
+        with self._lock:
+            rt = self._fleets.get(name)
+        if rt is None:
+            raise KeyError(f"unknown fleet {name}")
+        spec = rt.state.spec
+        target = max(spec.min_replicas,
+                     min(int(replicas), spec.max_replicas))
+        self._scale_fleet_to(rt, target, "operator")
+        self._wake.set()
+        return self.fleet_json(name) or {}
+
+    def _scale_fleet_to(self, rt: _FleetRuntime, target: int,
+                        reason: str) -> None:
+        name = rt.state.spec.name
+        with self._lock:
+            frm = rt.state.desired
+        if target == frm:
+            return
+        self.journal.append(
+            wal.J_FLEET_SCALED, ts_ms=self._clock_ms(), fleet=name,
+            to=target, reason=reason, **{"from": frm},
+        )
+        with self._lock:
+            rt.state.desired = target
+            self._dirty = True
+        self.registry.counter(FLEET_SCALE_EVENTS_COUNTER,
+                              labels={"fleet": name}).inc()
+        self.registry.gauge(FLEET_DESIRED_REPLICAS_GAUGE,
+                            labels={"fleet": name}).set(target)
+        self.events.emit(obs_events.FLEET_SCALED, fleet=name, to=target,
+                         reason=reason, **{"from": frm})
+        log.info("fleet %s scaled %d -> %d (%s)", name, frm, target,
+                 reason)
+
+    def fleet_json(self, name: str) -> dict[str, Any] | None:
+        with self._lock:
+            rt = self._fleets.get(name)
+        if rt is None:
+            return None
+        doc = rt.state.to_json()
+        doc["router"] = {"addr": f"127.0.0.1:{rt.router.port}",
+                         **rt.router.status()}
+        return doc
+
+    def fleets_json(self) -> dict[str, Any]:
+        with self._lock:
+            names = list(self._fleets)
+        out = {}
+        for n in sorted(names):
+            doc = self.fleet_json(n)
+            if doc is not None:
+                out[n] = doc
+        return out
+
+    def _tick_fleets(self) -> None:
+        with self._lock:
+            runtimes = list(self._fleets.values())
+        for rt in runtimes:
+            try:
+                self._reconcile_fleet(rt)
+            except Exception:
+                log.exception("fleet %s reconcile failed",
+                              rt.state.spec.name)
+
+    def _reconcile_fleet(self, rt: _FleetRuntime) -> None:
+        """Drive the fleet toward its desired size: fold dead replica
+        jobs out of the record (the same pass then launches their
+        replacements), register newly-bound endpoints with the router,
+        run the autoscaler, and launch/retire the difference."""
+        if not self.election.check_fence():
+            self._abdicate("fence check failed during fleet reconcile")
+            return
+        name = rt.state.spec.name
+        with self._lock:
+            snapshot = dict(rt.state.replicas)
+            jobs = {jid: self._jobs.get(jid) for jid in snapshot.values()}
+        for rid, job_id in snapshot.items():
+            job = jobs.get(job_id)
+            if job is None or job.state.terminal:
+                # The replica's job died (or was killed): retire the
+                # record; desired is unchanged, so the count pass below
+                # launches the replacement.
+                self._retire_replica(rt, rid, job_id,
+                                     reason="job_terminal",
+                                     shutdown=False)
+            elif job.state is JobState.RUNNING and rid not in rt.registered:
+                addr = discover_replica_addr(job.app_dir)
+                if addr:
+                    rt.registered.add(rid)
+                    rt.router.add_replica(
+                        rid, addr, role=rt.state.replica_role(rid)
+                    )
+        if rt.state.spec.autoscale:
+            decision = rt.autoscaler.tick(rt.router.signals(),
+                                          rt.state.desired)
+            if decision is not None:
+                if decision.cold_wake:
+                    rt.router.consume_wake()
+                self._scale_fleet_to(rt, decision.target,
+                                     ("autoscaler cold wake"
+                                      if decision.cold_wake else
+                                      f"autoscaler: {decision.reason}"))
+        with self._lock:
+            live = dict(rt.state.replicas)
+            desired = rt.state.desired
+        if len(live) < desired:
+            for _ in range(desired - len(live)):
+                self._launch_replica(rt)
+        elif len(live) > desired:
+            surplus = sorted(live, key=_rid_ord,
+                             reverse=True)[:len(live) - desired]
+            for rid in surplus:
+                self._retire_replica(rt, rid, live[rid],
+                                     reason="scale_down", shutdown=True)
+        with self._lock:
+            n_live = len(rt.state.replicas)
+        self.registry.gauge(FLEET_REPLICAS_GAUGE,
+                            labels={"fleet": name}).set(n_live)
+        self.registry.gauge(FLEET_DESIRED_REPLICAS_GAUGE,
+                            labels={"fleet": name}).set(desired)
+
+    def _launch_replica(self, rt: _FleetRuntime) -> None:
+        """Launch one replica as a normal scheduler job from the frozen
+        template: warm leases, the slice-pinned compile cache, and
+        recovery adoption all apply unchanged."""
+        name = rt.state.spec.name
+        with self._lock:
+            rid = rt.state.next_rid()
+            self._job_seq += 1
+            seq = self._job_seq
+        job_id = f"job_{seq:04d}_{uuid.uuid4().hex[:6]}"
+        role = rt.state.replica_role(rid)
+        app_dir = self.base_dir / "staging" / job_id
+        app_dir.mkdir(parents=True, exist_ok=True)
+        conf = TonyConfiguration(load_defaults=False)
+        conf.set_all(rt.conf.to_dict())
+        conf.write_final(app_dir / constants.TONY_FINAL_CONF)
+        # WAL: the rid -> job_id binding lands before the submit, so a
+        # crash between the two leaves a replica whose job never queued
+        # — recovery prunes it and reconcile relaunches (never doubles).
+        self.journal.append(
+            wal.J_REPLICA_LAUNCHED, ts_ms=self._clock_ms(), fleet=name,
+            replica_id=rid, job_id=job_id, role=role,
+        )
+        with self._lock:
+            rt.state.replicas[rid] = job_id
+            self._dirty = True
+        self.events.emit(obs_events.REPLICA_LAUNCHED, fleet=name,
+                         replica_id=rid, job_id=job_id, role=role)
+        self.submit_app_dir(app_dir, job_id=job_id)
+
+    def _retire_replica(self, rt: _FleetRuntime, rid: str, job_id: str,
+                        reason: str, shutdown: bool) -> None:
+        """Take a replica out of the fleet: drain it in the router
+        first (no new work), then — for scale-downs — ask the serving
+        task to stop gracefully (its drain finishes in-flight requests
+        and the job SUCCEEDs), falling back to a scheduler kill."""
+        import urllib.request
+
+        name = rt.state.spec.name
+        rt.router.drain_replica(rid)
+        addr = None
+        for rep in rt.router.replicas():
+            if rep.get("rid") == rid:
+                addr = rep.get("addr")
+        self.journal.append(
+            wal.J_REPLICA_RETIRED, ts_ms=self._clock_ms(), fleet=name,
+            replica_id=rid, job_id=job_id, reason=reason,
+        )
+        with self._lock:
+            rt.state.replicas.pop(rid, None)
+            self._dirty = True
+        if shutdown:
+            ok = False
+            if addr:
+                try:
+                    req = urllib.request.Request(
+                        f"http://{addr}/shutdown", data=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=5):
+                        ok = True
+                except OSError:
+                    pass
+            if not ok:
+                self.kill(job_id)
+        rt.router.remove_replica(rid)
+        rt.registered.discard(rid)
+        self.events.emit(obs_events.REPLICA_RETIRED, fleet=name,
+                         replica_id=rid, job_id=job_id, reason=reason)
+        log.info("fleet %s retired %s (%s, job %s)", name, rid, reason,
+                 job_id)
+
     # -- lifecycle -----------------------------------------------------------
     def start(self, serve_http: bool = True) -> "SchedulerDaemon":
         if serve_http:
@@ -544,6 +881,13 @@ class SchedulerDaemon:
                 self._cond.wait(timeout=0.5)
         if self.http_server is not None:
             self.http_server.stop()
+        with self._lock:
+            fleet_runtimes = list(self._fleets.values())
+        for rt in fleet_runtimes:
+            try:
+                rt.router.stop()
+            except Exception:
+                log.warning("fleet router stop failed", exc_info=True)
         self.pool.shutdown()
         self._publish_state()
         # Clean abdication: the heartbeat goes instantly stale so a
@@ -654,10 +998,11 @@ class SchedulerDaemon:
         recovered = wal.replay(snapshot, records)
         summary = {"adopted": 0, "requeued": 0, "resubmitted": 0,
                    "finalized": 0, "slices_adopted": 0,
-                   "slices_retired": 0}
+                   "slices_retired": 0, "fleets": 0}
         self.recovered_ms = self._clock_ms()
         if not recovered["jobs"] and not recovered["slices"] \
-                and not recovered["folded"]:
+                and not recovered["folded"] \
+                and not recovered.get("fleets"):
             return summary  # pristine base dir — nothing to rebuild
         with self._lock:
             self._folded |= set(recovered["folded"])
@@ -712,7 +1057,7 @@ class SchedulerDaemon:
                 # intact — release it to FREE for warm re-adoption.
                 for sid, sd in slices.items():
                     if sd.get("lease_job_id") == job_id:
-                        self.journal.append(  # tony: noqa[TONY-T003] — SchedulerJournal serializes seq + append behind its own internal lock; callers never need a shared guard
+                        self.journal.append(
                             wal.J_SLICE_RELEASED, ts_ms=now,
                             slice_id=sid, job_id=job_id, healthy=True,
                         )
@@ -806,6 +1151,41 @@ class SchedulerDaemon:
             if ws:
                 self.pool.retire(sid, profile, ws)
             summary["slices_retired"] += 1
+
+        # Fleets: reconstitute each journaled fleet's runtime (router +
+        # autoscaler from the frozen template). Replicas whose job the
+        # rebuilt job table does not know alive are pruned — the next
+        # tick's reconcile launches replacements, and because the rid ->
+        # job_id binding is journaled before every launch, a recovered
+        # daemon can never double-launch a replica that survived.
+        for fname, fd in (recovered.get("fleets") or {}).items():
+            with self._lock:
+                if fname in self._fleets:
+                    continue
+            try:
+                fstate = FleetState.from_json(fd)
+            except (KeyError, TypeError, ValueError):
+                log.warning("could not recover fleet %s", fname,
+                            exc_info=True)
+                continue
+            for rid, jid in list(fstate.replicas.items()):
+                with self._lock:
+                    rjob = self._jobs.get(jid)
+                if rjob is None or rjob.state.terminal:
+                    self.journal.append(
+                        wal.J_REPLICA_RETIRED, ts_ms=now, fleet=fname,
+                        replica_id=rid, job_id=jid, reason="recovery",
+                    )
+                    fstate.replicas.pop(rid)
+            try:
+                frt = _FleetRuntime(self, fstate)
+            except OSError:
+                log.warning("could not restart router for fleet %s",
+                            fname, exc_info=True)
+                continue
+            with self._lock:
+                self._fleets[fname] = frt
+            summary["fleets"] += 1
 
         dt_ms = (time.monotonic() - t0) * 1000.0
         self.registry.gauge(RECOVERY_GAUGE).set(round(dt_ms, 1))
@@ -947,6 +1327,7 @@ class SchedulerDaemon:
                 target=self._provision_and_launch, args=(job, profile),
                 name=f"provision-{job.job_id}", daemon=True,
             ).start()
+        self._tick_fleets()
         reaped = self.pool.reap_idle()
         for s in reaped:
             self.journal.append(
@@ -1423,6 +1804,7 @@ class SchedulerDaemon:
             folded = sorted(self._folded)
         depth = len(queued)
         self.registry.gauge(QUEUE_DEPTH_GAUGE).set(depth)
+        fleets = self.fleets_json()
         return {
             "ts_ms": self._clock_ms(),
             "journal_seq": journal_seq,
@@ -1438,6 +1820,7 @@ class SchedulerDaemon:
             "jobs": jobs,
             "pool": self.pool.to_json(),
             "goodput": self.goodput.to_json(),
+            "fleets": fleets,
         }
 
     def _publish_state(self) -> None:

@@ -42,7 +42,8 @@ import itertools
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
+from typing import Callable
 
 import jax
 import numpy as np
@@ -89,12 +90,19 @@ class ServingRequest:
 
     def __init__(self, request_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float,
-                 eos_id: int | None) -> None:
+                 eos_id: int | None, model: str = "default") -> None:
         self.id = request_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.eos_id = eos_id
+        self.model = model
+        # Disaggregation hooks: a prefill-only request exports its slot's
+        # K/V rows instead of entering decode; an inject request enters
+        # decode directly from shipped rows, skipping prefill.
+        self.prefill_only = False
+        self.kv: tuple[np.ndarray, np.ndarray] | None = None
+        self._inject: tuple[np.ndarray, np.ndarray, int, int] | None = None
         self.tokens: list[int] = []
         self.error: str | None = None
         self.t_submit = time.perf_counter()
@@ -177,6 +185,7 @@ class ServingEngine:
         prefill_batch: int = 4,
         decode_window: int = 1,
         max_queue: int = 1024,
+        max_resident_models: int = 4,
         registry: obs_metrics.MetricsRegistry | None = None,
         seed: int = 0,
     ) -> None:
@@ -217,6 +226,18 @@ class ServingEngine:
             self.params = params
         else:
             self.params = _decode_weights_jit(params, cfg)
+        # Model multiplexing: named fused-weight sets share the engine's
+        # executables (DecodeSession.refresh proved the fused layout is
+        # identical across checkpoints of one config, so a swap is
+        # compile-free). ``_resident`` is the LRU of fused params;
+        # evicted models re-fuse from their registered loader on the
+        # next swap. The ctor weights are model "default".
+        self.max_resident_models = max(1, int(max_resident_models))
+        self._model = "default"
+        self._resident: OrderedDict[str, dict] = OrderedDict(
+            [("default", self.params)]
+        )
+        self._model_loaders: dict[str, Callable[[], dict]] = {}
         self._k, self._v = _engine.init_slot_cache(cfg, self.slots, max_len)
         self._pos = np.zeros(self.slots, np.int32)
         self._active = np.zeros(self.slots, bool)
@@ -308,10 +329,14 @@ class ServingEngine:
         temperature: float = 0.0,
         eos_id: int | None = None,
         request_id: str | None = None,
+        model: str | None = None,
+        _prefill_only: bool = False,
     ) -> ServingRequest:
         """Enqueue one request; returns a handle whose ``result()``
         blocks until EOS/budget retirement. Thread-safe; raises
-        ``ServingQueueFull`` past ``max_queue`` (shed, don't buffer)."""
+        ``ServingQueueFull`` past ``max_queue`` (shed, don't buffer).
+        ``model`` targets a registered checkpoint (``add_model``);
+        None serves whatever is currently loaded."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -329,7 +354,150 @@ class ServingEngine:
         req = ServingRequest(
             request_id or f"req-{next(self._ids)}", prompt,
             int(max_new_tokens), float(temperature), eos_id,
+            model=self._resolve_model(model),
         )
+        req.prefill_only = bool(_prefill_only)
+        with self._cond:
+            if self._stop.is_set():
+                raise RuntimeError("engine is shut down")
+            if self._draining:
+                raise RuntimeError("engine is draining")
+            if len(self._queue) >= self.max_queue:
+                raise ServingQueueFull(
+                    f"serving queue at max_queue={self.max_queue}"
+                )
+            self._queue.append(req)
+            self._c_requests.inc()
+            self._n_requests += 1
+            self._cond.notify_all()
+        return req
+
+    def _resolve_model(self, model: str | None) -> str:
+        with self._cond:
+            if model is None:
+                return self._model
+            if (model not in self._resident
+                    and model not in self._model_loaders):
+                raise ValueError(f"unknown model {model!r}")
+            return model
+
+    def add_model(self, name: str, params: dict | None = None, *,
+                  loader: Callable[[], dict] | None = None) -> None:
+        """Register a named checkpoint for multiplexed serving. With
+        ``params`` the fused weights become resident immediately
+        (evicting the LRU model past ``max_resident_models``); with
+        ``loader`` fusion is deferred to the first swap — an evicted
+        model with a loader re-fuses on demand, one without is resident
+        forever. The swap itself is compile-free (identical fused
+        layout), and only ever happens at an idle batch boundary, so
+        greedy parity survives multiplexing untouched."""
+        if (params is None) == (loader is None):
+            raise ValueError("add_model needs exactly one of "
+                             "params/loader")
+        if params is not None:
+            if "qkv" not in params["layers"]:
+                params = _decode_weights_jit(params, self.cfg)
+            with self._cond:
+                self._resident[name] = params
+                self._evict_lru_locked()
+        else:
+            with self._cond:
+                self._model_loaders[name] = loader
+
+    def _evict_lru_locked(self) -> None:
+        while len(self._resident) > self.max_resident_models:
+            for old in self._resident:
+                if old != self._model and old in self._model_loaders:
+                    self._resident.pop(old)
+                    break
+            else:
+                return  # nothing evictable (no loader to bring it back)
+
+    def _switch_model(self, name: str) -> None:
+        """Make ``name`` the engine's live weights. Called from the
+        loop thread only, at an idle batch boundary (no active slots,
+        no prefill in flight) — the one point where no in-flight
+        computation can straddle two checkpoints. The loader runs
+        OUTSIDE the engine condition (it may read a checkpoint from
+        disk)."""
+        with self._cond:
+            params = self._resident.get(name)
+        if params is None:
+            raw = self._model_loaders[name]()
+            params = (raw if "qkv" in raw["layers"]
+                      else _decode_weights_jit(raw, self.cfg))
+        with self._cond:
+            self._resident[name] = params
+            self._resident.move_to_end(name)
+            self._model = name
+            self.params = params
+            self._evict_lru_locked()
+
+    def prefill_only(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        request_id: str | None = None,
+        model: str | None = None,
+    ) -> ServingRequest:
+        """Disaggregated prefill: run the prompt through chunked
+        prefill, sample the first token, then EXPORT the slot's K/V
+        rows (``req.kv``) and free the slot instead of decoding — the
+        prefill half of a prefill/decode split. ``max_new_tokens`` is
+        validated (the decode side needs the same KV headroom) but not
+        consumed here."""
+        return self.submit(prompt, max_new_tokens,
+                           temperature=temperature, eos_id=eos_id,
+                           request_id=request_id, model=model,
+                           _prefill_only=True)
+
+    def submit_with_kv(
+        self,
+        kv_k,
+        kv_v,
+        last_token: int,
+        pos: int,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        request_id: str | None = None,
+        model: str | None = None,
+    ) -> ServingRequest:
+        """Disaggregated decode: admit a request whose prefill ran on
+        another replica. ``kv_k``/``kv_v`` are that replica's exported
+        rows (``[L, pos, Hkv, Dh]``), ``last_token`` its sampled first
+        token; the slot's KV rows are written at admission and decode
+        proceeds exactly as if prefill had run here — the per-slot KV
+        layout makes the injection one targeted write."""
+        kv_k = np.asarray(kv_k)
+        kv_v = np.asarray(kv_v)
+        pos = int(pos)
+        if pos < 1 or kv_k.shape[1] != pos or kv_v.shape[1] != pos:
+            raise ValueError(
+                f"kv rows must be [L, pos={pos}, Hkv, Dh]; got "
+                f"{kv_k.shape} / {kv_v.shape}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if pos + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"pos ({pos}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the slot KV capacity ({self.max_len})"
+            )
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        req = ServingRequest(
+            request_id or f"req-{next(self._ids)}",
+            np.zeros(pos, np.int32), int(max_new_tokens),
+            float(temperature), eos_id,
+            model=self._resolve_model(model),
+        )
+        req._inject = (kv_k, kv_v, pos, int(last_token))
         with self._cond:
             if self._stop.is_set():
                 raise RuntimeError("engine is shut down")
@@ -362,6 +530,10 @@ class ServingEngine:
                 "iterations": self._iter,
                 "requests": self._n_requests,
                 "retired": self._n_retired,
+                "draining": bool(self._draining),
+                "model": self._model,
+                "models": sorted(set(self._resident)
+                                 | set(self._model_loaders)),
             }
 
     # -- lifecycle ---------------------------------------------------------
@@ -387,6 +559,7 @@ class ServingEngine:
             s = self.stats()
             if (s["queue_depth"] == 0 and s["active_slots"] == 0
                     and s["prefilling"] == 0):
+                self._zero_gauges()
                 return True
             time.sleep(0.05)
         return False
@@ -412,6 +585,18 @@ class ServingEngine:
             if not req.done():
                 req.error = "engine shut down"
                 req._done.set()
+        self._zero_gauges()
+
+    def _zero_gauges(self) -> None:
+        """A retired or drained replica must not leave stale
+        last-published load in the aggregator — least-loaded routing
+        and the autoscaler both read these gauges, and a dead replica
+        frozen at its peak queue depth would keep attracting traffic
+        and blocking scale-down forever."""
+        self._g_queue.set(0)
+        self._g_active.set(0)
+        self._g_rate.set(0.0)
+        self._reg.report()
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -443,6 +628,7 @@ class ServingEngine:
                 if not req.done():
                     req.error = f"engine loop failed: {exc}"
                     req._done.set()
+            self._zero_gauges()
 
     # Trace sampling for the engine's dispatch spans: the serving loop
     # is the hottest dispatch path in the framework and the Tracer
@@ -525,6 +711,14 @@ class ServingEngine:
             self._n_tokens += n_new
             self._note_rate(n_new)
             decoded = True
+        if not decoded:
+            # Idle decay: the rolling-rate gauge must fall to zero when
+            # generation stops, or the autoscaler reads phantom load.
+            now = time.perf_counter()
+            if (self._rate_window
+                    and now - self._rate_window[-1][0] > _RATE_WINDOW_S):
+                self._rate_window.clear()
+                self._g_rate.set(0.0)
         self._iter += 1
         with self._cond:
             self._g_queue.set(len(self._queue))
@@ -535,22 +729,69 @@ class ServingEngine:
         self._reg.report()
         return did_prefill or decoded
 
+    def _next_admissible_locked(self) -> ServingRequest | None:
+        """First queued request served by the CURRENT weights. Requests
+        for other models wait for an idle batch boundary (the swap
+        point); within one model, order stays FIFO."""
+        for i, req in enumerate(self._queue):
+            if req.model == self._model:
+                del self._queue[i]
+                return req
+        return None
+
     def _admit(self) -> None:
+        injects: list[tuple[ServingRequest, int]] = []
+        switch_to: str | None = None
         with self._cond:
             for s in range(self.slots):
                 if not self._queue:
                     break
                 if self._slot_req[s] is not None:
                     continue
-                req = self._queue.popleft()
+                req = self._next_admissible_locked()
+                if req is None:
+                    break
                 self._slot_req[s] = req
                 self._pos[s] = 0
                 self._active[s] = False
                 self._temp[s] = req.temperature
-                req._chunks = _chunk_plan(req.prompt.size,
-                                          self.prefill_chunk)
-                req._chunk_i = 0
-                self._pf.append((req, s))
+                if req._inject is not None:
+                    injects.append((req, s))
+                else:
+                    req._chunks = _chunk_plan(req.prompt.size,
+                                              self.prefill_chunk)
+                    req._chunk_i = 0
+                    self._pf.append((req, s))
+            # Idle batch boundary + only foreign-model work queued:
+            # swap weights. The boundary (no active slot, no prefill in
+            # flight) is what keeps greedy parity — nothing in flight
+            # can straddle two checkpoints.
+            if (self._queue and not self._pf
+                    and not self._active.any()
+                    and all(r is None for r in self._slot_req)):
+                switch_to = self._queue[0].model
+        for req, s in injects:
+            self._inject_kv(req, s)
+        if switch_to is not None and switch_to != self._model:
+            self._switch_model(switch_to)
+
+    def _inject_kv(self, req: ServingRequest, slot: int) -> None:
+        """Write shipped KV rows into the slot and enter decode
+        directly — the decode half of the prefill/decode split. One
+        targeted ``.at[:, slot, :pos]`` write per request; runs off the
+        decode hot path (admission), outside the engine condition."""
+        import jax.numpy as jnp
+
+        kv_k, kv_v, pos, last = req._inject
+        self._k = self._k.at[:, slot, :pos].set(
+            jnp.asarray(kv_k, self._k.dtype)
+        )
+        self._v = self._v.at[:, slot, :pos].set(
+            jnp.asarray(kv_v, self._v.dtype)
+        )
+        self._pos[slot] = pos
+        self._last[slot] = last
+        self._active[slot] = True
 
     def _prefill_some(self) -> bool:
         """Run one prefill ROUND: one chunk for every pending slot (the
@@ -628,7 +869,20 @@ class ServingEngine:
                 self._c_tokens.inc()
                 self._n_tokens += 1
                 self._note_rate(1)
-                if ((req.eos_id is not None and first == req.eos_id)
+                if req.prefill_only:
+                    # Export the slot's freshly-written KV rows and
+                    # free the slot — the decode replica injects them
+                    # via submit_with_kv. Off the decode hot path
+                    # (one gather per disaggregated request).
+                    P = int(req.prompt.size)
+                    with jit_sanitizer.step_region(
+                            "serving_prefill_extract"):
+                        req.kv = (
+                            np.asarray(jax.device_get(self._k[:, slot, :P])),  # tony: noqa[TONY-X002] — intended KV export fence
+                            np.asarray(jax.device_get(self._v[:, slot, :P])),  # tony: noqa[TONY-X002] — intended KV export fence
+                        )
+                    self._retire(slot)
+                elif ((req.eos_id is not None and first == req.eos_id)
                         or req.max_new_tokens <= 1):
                     self._retire(slot)
                 else:
