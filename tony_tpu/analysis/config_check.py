@@ -51,6 +51,7 @@ _PREFLIGHT_MODES = (
 _ENUM_KEYS: dict[str, tuple[str, ...]] = {
     keys.K_FRAMEWORK: _FRAMEWORKS,
     keys.K_PREFLIGHT_MODE: _PREFLIGHT_MODES,
+    keys.K_TUNE_KV_QUANT: ("none", "int8"),
 }
 
 # Integer keys where 0 is not a legal value (the generic int rule only
@@ -101,6 +102,9 @@ _MIN_ONE_KEYS = frozenset({
     keys.K_CKPT_FULL_EVERY,
     keys.K_CKPT_MIGRATE_TIMEOUT_MS,
     keys.K_CKPT_EVICT_FLUSH_WAIT_MS,
+    # A zero-trial autotune search measures nothing and would persist
+    # an empty record as if it were a tuned one.
+    keys.K_TUNE_TRIAL_BUDGET,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold
@@ -394,6 +398,25 @@ def _cross_key_checks(conf, job_names: set[str]) -> list[Finding]:
             suggestion="use a home- or durable-volume path (empty = "
                        "~/.cache/tony_tpu/xla-cache), or set "
                        "tony.compile.cache-enabled=false",
+        ))
+
+    # Same trap for autotune records: a tune record dir on scratch is
+    # silently cold every run, so every job pays the full search again
+    # while believing it reused a persisted plan.
+    try:
+        tune_enabled = conf.get_bool(keys.K_TUNE_ENABLED, True)
+    except ValueError:
+        tune_enabled = True
+    tune_dir = conf.get_str(keys.K_TUNE_RECORD_DIR, "")
+    if tune_enabled and tune_dir and _is_scratch_path(tune_dir):
+        findings.append(Finding(
+            "TONY-C011", WARNING,
+            f"tony.tune.record-dir={tune_dir} points at non-persistent "
+            f"scratch — autotune records will be cold on every run and "
+            f"every job repeats the full measured search",
+            suggestion="use a home- or durable-volume path (empty = "
+                       "beside the compile cache), or set "
+                       "tony.tune.enabled=false",
         ))
 
     # Every TPU ask must land on a legal slice topology — run the real

@@ -1443,6 +1443,94 @@ def fleet_cmd(argv: list[str]) -> int:
     return 0
 
 
+def tune_cmd(argv: list[str]) -> int:
+    """``cli tune [app_id]``: what the measured autotuner searched and
+    what won — one row per persisted tuning record (label, default vs
+    best trial milliseconds, the production ``live_best_ms`` fed back by
+    stepstats, trial count, and the winning knobs). Records are
+    machine-local (they live beside the compile cache); with an
+    ``app_id`` the job's frozen conf supplies its ``tony.tune.record-dir``
+    override so you inspect the directory that job actually used."""
+    import argparse
+    import json as _json
+
+    from tony_tpu.parallel import autotune as autotune_lib
+
+    p = argparse.ArgumentParser(
+        prog="tony_tpu.client.cli tune",
+        description="Inspect persisted autotune records: what was "
+                    "searched, what won, and how production step times "
+                    "compare to the offline search.",
+    )
+    p.add_argument("app_id", nargs="?", default=None,
+                   help="application id whose frozen conf supplies the "
+                        "record-dir override (omit to read the default "
+                        "record dir beside the compile cache)")
+    p.add_argument("--conf_file", default=None,
+                   help="job config supplying tony.tune.record-dir and "
+                        "tony.staging.location")
+    p.add_argument("--record-dir", default=None,
+                   help="read records from this directory instead")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print raw record JSON instead of a table")
+    args = p.parse_args(argv)
+
+    cache_dir = args.record_dir
+    if cache_dir is None:
+        from tony_tpu.conf.configuration import load_job_config
+
+        conf = load_job_config(conf_file=args.conf_file)
+        if args.app_id:
+            from tony_tpu.conf.configuration import TonyConfiguration
+
+            staging = Path(
+                conf.get_str(keys.K_STAGING_LOCATION)
+                or Path.cwd() / constants.TONY_STAGING_DIR
+            )
+            final_conf = staging / args.app_id / constants.TONY_FINAL_CONF
+            if final_conf.is_file():
+                try:
+                    conf = TonyConfiguration.from_final(final_conf)
+                except (OSError, ValueError):
+                    pass
+        cache_dir = conf.get_str(keys.K_TUNE_RECORD_DIR, "") or None
+
+    records = autotune_lib.list_records(cache_dir)
+    if args.as_json:
+        print(_json.dumps({"record_dir": autotune_lib.record_dir(cache_dir),
+                           "records": records}, indent=2))
+        return 0
+    where = autotune_lib.record_dir(cache_dir) or "(unavailable)"
+    print(f"# tune records in {where}")
+    if not records:
+        print("no tuning records — run a search (bench --check autotune, "
+              "tools/sweep_flash_blocks.py, or a tuned train job) first")
+        return 0
+    print(f"{'label':24s} {'default_ms':>10s} {'best_ms':>9s} "
+          f"{'speedup':>7s} {'live_ms':>8s} {'trials':>6s}  winning knobs")
+    for rec in records:
+        best = rec.get("best") or {}
+        knobs = autotune_lib.knobs_from_dict(best)
+        desc = knobs.describe()
+        default_ms = rec.get("default_ms")
+        best_ms = rec.get("best_ms")
+        speedup = (
+            f"{default_ms / best_ms:7.2f}"
+            if isinstance(default_ms, (int, float))
+            and isinstance(best_ms, (int, float)) and best_ms
+            else f"{'-':>7s}"
+        )
+        live = rec.get("live_best_ms")
+        print(f"{str(rec.get('label', '?')):24s} "
+              f"{default_ms if default_ms is not None else '-':>10} "
+              f"{best_ms if best_ms is not None else '-':>9} "
+              f"{speedup} "
+              f"{live if live is not None else '-':>8} "
+              f"{len(rec.get('trials') or []):>6d}  "
+              f"{desc if desc else '(defaults win)'}")
+    return 0
+
+
 SUBMITTERS = {
     "cluster": cluster_submit,
     "local": local_submit,
@@ -1461,6 +1549,7 @@ SUBMITTERS = {
     "doctor": doctor_cmd,
     "goodput": goodput_cmd,
     "profile": profile_cmd,
+    "tune": tune_cmd,
 }
 
 

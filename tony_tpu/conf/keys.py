@@ -259,6 +259,25 @@ K_STEPSTATS_CALIBRATE = STEPSTATS_PREFIX + "calibrate"
 # wall to actually improve — the table keeps the minimum).
 K_STEPSTATS_WINDOW = STEPSTATS_PREFIX + "window"
 
+# --- measured program autotuner (parallel/autotune.py) ----------------------
+# Persisted per-(model config, topology, jax version) program tuning:
+# flash block sizes, remat policy, microbatching, donation, XLA flags,
+# and the serving engine's KV-cache quantization. The executor exports
+# these as TONY_TUNE_* env, like tony.stepstats.*.
+TUNE_PREFIX = TONY_PREFIX + "tune."
+# Consumption switch: when off, lookups always miss and nothing tuned
+# is applied (explicit search entry points stay callable).
+K_TUNE_ENABLED = TUNE_PREFIX + "enabled"
+# Max measured candidates per search stage (each trial pays a compile).
+K_TUNE_TRIAL_BUDGET = TUNE_PREFIX + "trial-budget"
+# Tune-record directory; empty = beside the compile cache (remote URIs
+# get the plan-measurements local sidecar mirror). A /tmp dir is
+# silently cold every reboot — lint rule TONY-C011, like TONY-C010.
+K_TUNE_RECORD_DIR = TUNE_PREFIX + "record-dir"
+# Serving KV-cache storage: "none" (compute dtype) or "int8"
+# (per-position absmax quantization — half the decode bandwidth).
+K_TUNE_KV_QUANT = TUNE_PREFIX + "kv-quant"
+
 # --- on-demand profiling (observability/profiling.py) -----------------------
 PROFILE_PREFIX = TONY_PREFIX + "profile."
 # Default capture window, ms, when `tony profile` / POST /api/profile
@@ -535,6 +554,10 @@ DEFAULTS: dict[str, object] = {
     K_STEPSTATS_ENABLED: True,
     K_STEPSTATS_CALIBRATE: True,
     K_STEPSTATS_WINDOW: 32,
+    K_TUNE_ENABLED: True,
+    K_TUNE_TRIAL_BUDGET: 12,
+    K_TUNE_RECORD_DIR: "",
+    K_TUNE_KV_QUANT: "none",
     K_PROFILE_DURATION_MS: 2000,
     K_PROFILE_HBM_INTERVAL_MS: 5000,
     K_PROXY_CONNECT_TIMEOUT_MS: 5000,

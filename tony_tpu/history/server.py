@@ -378,6 +378,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
         parts.extend(self._goodput_section(final, esc))
         parts.extend(self._healing_section(app_id, final, esc))
         parts.extend(self._stepstats_section(final, esc))
+        parts.extend(self._autotune_section(final, esc))
         parts.extend(self._diagnosis_section(app_id, final, esc))
         parts.extend(self._metrics_section(final, esc))
         parts.extend(self._timeline_section(app_id, esc))
@@ -526,6 +527,47 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 )
                 + "</p>"
             )
+        return parts
+
+    def _autotune_section(self, final: dict, esc) -> list[str]:
+        """What the measured autotuner did for this job: per-task
+        record hits vs misses (did the fleet reuse persisted tuning or
+        re-pay the search?) and the trial count actually measured —
+        reconstructed from the terminal record's metric snapshots."""
+        from tony_tpu.parallel.autotune import (
+            TUNE_RECORD_HITS_COUNTER,
+            TUNE_RECORD_MISSES_COUNTER,
+            TUNE_SEARCH_TRIALS_COUNTER,
+        )
+
+        tasks = ((final.get("metrics") or {}).get("tasks")
+                 if isinstance(final.get("metrics"), dict) else None)
+        if not isinstance(tasks, dict):
+            return []
+        rows = []
+        for task_id in sorted(tasks):
+            snap = tasks[task_id]
+            if not isinstance(snap, dict):
+                continue
+            hits = snap.get(TUNE_RECORD_HITS_COUNTER, 0)
+            misses = snap.get(TUNE_RECORD_MISSES_COUNTER, 0)
+            trials = snap.get(TUNE_SEARCH_TRIALS_COUNTER, 0)
+            if not (hits or misses or trials):
+                continue
+            rows.append((task_id, hits, misses, trials))
+        if not rows:
+            return []
+        parts = [
+            "<h3>Autotuning</h3>"
+            "<table><tr><th>task</th><th>record hits</th>"
+            "<th>record misses</th><th>search trials</th></tr>"
+        ]
+        for task_id, hits, misses, trials in rows:
+            parts.append(
+                f"<tr><td>{esc(task_id)}</td><td>{esc(hits)}</td>"
+                f"<td>{esc(misses)}</td><td>{esc(trials)}</td></tr>"
+            )
+        parts.append("</table>")
         return parts
 
     def _diagnosis_section(self, app_id: str, final: dict, esc) -> list[str]:

@@ -617,6 +617,17 @@ class DecodeSession:
         # generate signature compiles one executable; its first call is
         # timed and counted as a persistent-cache hit or miss.
         self._compiled: set[tuple] = set()
+        # Measured-autotuner consumption: a persisted decode record for
+        # this (config, topology, jax version) pins flash-attention
+        # block sizes for the prefill pass; None on any miss.
+        from tony_tpu.parallel import autotune as autotune_lib
+
+        tuned = autotune_lib.lookup("decode_generate", config=cfg,
+                                    mesh=mesh)
+        if tuned is not None and (tuned.block_q or tuned.block_k):
+            from tony_tpu.ops import attention as attention_lib
+
+            attention_lib.set_tuned_blocks(tuned.block_q, tuned.block_k)
         self.refresh(params)
 
     def refresh(self, params: dict) -> None:

@@ -224,6 +224,24 @@ def make_train_step(
                 pipeline_virtual = plan.pipeline_virtual
     if mesh is None:
         raise ValueError("make_train_step needs a mesh or a plan")
+    # Measured-autotuner consumption: a persisted record for this exact
+    # (model config, mesh topology, jax version) fills whatever the
+    # caller (and the plan) left at defaults — never overrides an
+    # explicit kwarg. lookup() is a no-op mid-search and one small JSON
+    # read otherwise; every miss path returns None.
+    from tony_tpu.parallel import autotune as autotune_lib
+
+    tuned = autotune_lib.lookup("lm_train_step", config=cfg, mesh=mesh)
+    if tuned is not None:
+        if pipeline_microbatches is None and tuned.microbatches is not None:
+            pipeline_microbatches = tuned.microbatches
+            if pipeline_schedule == "gpipe" and tuned.pipeline_schedule:
+                pipeline_schedule = tuned.pipeline_schedule
+        cfg = autotune_lib.apply_knobs_to_config(cfg, tuned)
+        if tuned.block_q or tuned.block_k:
+            from tony_tpu.ops import attention as attention_lib
+
+            attention_lib.set_tuned_blocks(tuned.block_q, tuned.block_k)
     opt = optimizer or optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(learning_rate, weight_decay=weight_decay),

@@ -458,10 +458,11 @@ class StepStats:
     # -- live calibration ---------------------------------------------------
     def _maybe_record(self) -> None:
         """Feed the best observed wall into the planner's measurement
-        table (PR 6's ``record_step_time``) — throttled to a real
-        improvement at most once per ``window`` steps, after enough
-        observations that the best is a steady-state step."""
-        if not self.calibrate or self.plan is None or self.cfg is None:
+        table (PR 6's ``record_step_time``) and into the autotune
+        record's ``live_best_ms`` — throttled to a real improvement at
+        most once per ``window`` steps, after enough observations that
+        the best is a steady-state step."""
+        if not self.calibrate or self.cfg is None:
             return
         if self.steps_observed < 3:
             return
@@ -472,24 +473,36 @@ class StepStats:
                 and self._best_wall_ms > self._recorded_ms * 0.99:
             return
         try:
+            from tony_tpu.parallel import autotune as autotune_lib
             from tony_tpu.parallel import plan as plan_lib
 
-            plan_lib.record_step_time(
-                self.plan, self.cfg, self._best_wall_ms,
-                global_batch=self.global_batch, seq=self.seq,
+            if self.plan is not None:
+                plan_lib.record_step_time(
+                    self.plan, self.cfg, self._best_wall_ms,
+                    global_batch=self.global_batch, seq=self.seq,
+                )
+            # Close the measured-autotuner loop: a production step that
+            # beats the record's offline best updates ``live_best_ms``,
+            # so `tony tune` shows where search-time numbers drifted
+            # from the fleet's reality. A no-op when no record exists.
+            autotune_lib.note_step_time(
+                "lm_train_step", config=self.cfg, mesh=self._mesh,
+                step_ms=self._best_wall_ms,
             )
             self._recorded_ms = self._best_wall_ms
             self._last_record_step = self.steps_observed
-            residuals = plan_lib.calibration_residuals(
-                self.cfg, self._num_devices,
-                num_slices=getattr(self.plan, "num_slices", 1),
-                global_batch=self.global_batch, seq=self.seq,
-            )
-            r = residuals.get(self.plan.key())
-            if r is not None:
-                self._reg().gauge(
-                    PLAN_RESIDUAL_GAUGE, labels={"plan": self.plan.key()}
-                ).set(round(r, 4))
+            if self.plan is not None:
+                residuals = plan_lib.calibration_residuals(
+                    self.cfg, self._num_devices,
+                    num_slices=getattr(self.plan, "num_slices", 1),
+                    global_batch=self.global_batch, seq=self.seq,
+                )
+                r = residuals.get(self.plan.key())
+                if r is not None:
+                    self._reg().gauge(
+                        PLAN_RESIDUAL_GAUGE,
+                        labels={"plan": self.plan.key()},
+                    ).set(round(r, 4))
         except Exception:
             # Calibration is telemetry: an unwritable cache dir or a
             # cfg the planner can't digest must never touch training.

@@ -608,6 +608,28 @@ def _flash_core_bwd(causal, scale, block_q, block_k, force_jax, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+# Measured-autotuner override (parallel/autotune.py): a persisted tune
+# record's winning (block_q, block_k) is applied process-wide through
+# this pair, consulted by _default_blocks only where the caller left an
+# argument None — an explicit block at a call site always wins. None
+# means "no tuned pin"; values still clamp to the sequence.
+_TUNED_BLOCKS: "tuple[int | None, int | None]" = (None, None)
+
+
+def set_tuned_blocks(block_q: int | None = None,
+                     block_k: int | None = None) -> None:
+    global _TUNED_BLOCKS
+    _TUNED_BLOCKS = (block_q, block_k)
+
+
+def clear_tuned_blocks() -> None:
+    set_tuned_blocks(None, None)
+
+
+def tuned_blocks() -> "tuple[int | None, int | None]":
+    return _TUNED_BLOCKS
+
+
 def _default_blocks(t_q: int, t_k: int,
                     block_q: int | None, block_k: int | None):
     """Length-bucketed defaults, pinned from measured evidence:
@@ -630,11 +652,12 @@ def _default_blocks(t_q: int, t_k: int,
     Re-derive with ``tools/sweep_flash_blocks.py`` (device-trace kernel
     timing + wall check; needs a real TPU — Pallas on CPU is
     interpret-only)."""
+    tuned_q, tuned_k = _TUNED_BLOCKS
     default = 512 if max(t_q, t_k) <= 2048 else 1024
     if block_q is None:
-        block_q = min(default, t_q)
+        block_q = min(tuned_q if tuned_q else default, t_q)
     if block_k is None:
-        block_k = min(default, t_k)
+        block_k = min(tuned_k if tuned_k else default, t_k)
     return block_q, block_k
 
 

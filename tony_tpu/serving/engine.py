@@ -38,6 +38,7 @@ reads it), so stale data is structurally unreadable.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -48,16 +49,157 @@ from tony_tpu.models.transformer import TransformerConfig
 from tony_tpu.ops import apply_rope, rms_norm, rope_frequencies
 
 
+class QuantizedKV(NamedTuple):
+    """An int8-quantized KV cache buffer (``tony.tune.kv-quant=int8``):
+    per-(position, kv-head) symmetric absmax quantization over the head
+    dim — ``data * scale`` reconstructs the stored vectors. Decode is
+    bandwidth-bound, so halving (vs bf16) the KV bytes read per step is
+    the biggest serving-throughput lever; the scale plane adds
+    1/head_dim overhead. A NamedTuple so the pair rides jit/donation as
+    an ordinary pytree — the cache TYPE is part of the executable's
+    trace, never a runtime branch."""
+
+    data: jax.Array   # int8  [..., Dh]
+    scale: jax.Array  # f32   [..., 1]
+
+
+# One cache buffer is either a plain array (kv_quant="none") or a
+# QuantizedKV. These helpers keep decode_window/prefill_chunks agnostic.
+
+
+def _quantize(x: jax.Array) -> QuantizedKV:
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                     keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    data = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedKV(data, scale)
+
+
+def _materialize(cache, dt) -> jax.Array:
+    """Cache rows in compute dtype: identity for a plain buffer (the
+    stored-dtype einsum path keeps its fp32 MXU accumulation), dequant
+    for int8."""
+    if isinstance(cache, QuantizedKV):
+        return (cache.data.astype(jnp.float32) * cache.scale).astype(dt)
+    return cache
+
+
+def _cache_tmax(cache) -> int:
+    return (cache.data if isinstance(cache, QuantizedKV) else cache).shape[2]
+
+
+def _cache_layer(cache, layer):
+    """One layer's rows [S, Tmax, Hkv, Dh] out of the stacked buffer."""
+    if isinstance(cache, QuantizedKV):
+        return QuantizedKV(
+            lax.dynamic_index_in_dim(cache.data, layer, 0, keepdims=False),
+            lax.dynamic_index_in_dim(cache.scale, layer, 0, keepdims=False),
+        )
+    return lax.dynamic_index_in_dim(cache, layer, 0, keepdims=False)
+
+
+def _cache_store_layer(cache, layer_cache, layer):
+    if isinstance(cache, QuantizedKV):
+        return QuantizedKV(
+            lax.dynamic_update_slice(
+                cache.data, layer_cache.data[None], (layer, 0, 0, 0, 0)
+            ),
+            lax.dynamic_update_slice(
+                cache.scale, layer_cache.scale[None], (layer, 0, 0, 0, 0)
+            ),
+        )
+    return lax.dynamic_update_slice(
+        cache, layer_cache[None], (layer, 0, 0, 0, 0)
+    )
+
+
+def _cache_gather(layer_cache, slots):
+    if isinstance(layer_cache, QuantizedKV):
+        return QuantizedKV(layer_cache.data[slots], layer_cache.scale[slots])
+    return layer_cache[slots]
+
+
+def _write_rows(layer_cache, new, wpos):
+    """Per-slot vmapped write of ``new`` [S, 1, Hkv, Dh] at each slot's
+    own offset (decode's one-token append)."""
+    write = jax.vmap(
+        lambda row, val, p: lax.dynamic_update_slice(row, val, (p, 0, 0))
+    )
+    if isinstance(layer_cache, QuantizedKV):
+        q = _quantize(new)
+        return QuantizedKV(
+            write(layer_cache.data, q.data, wpos),
+            write(layer_cache.scale, q.scale, wpos),
+        )
+    return write(layer_cache, new.astype(layer_cache.dtype), wpos)
+
+
+def _write_chunk(layer_cache, chunk, at):
+    """One prefill chunk [1, C, Hkv, Dh] at (slot, start, 0, 0)."""
+    if isinstance(layer_cache, QuantizedKV):
+        q = _quantize(chunk)
+        return QuantizedKV(
+            lax.dynamic_update_slice(layer_cache.data, q.data, at),
+            lax.dynamic_update_slice(layer_cache.scale, q.scale, at),
+        )
+    return lax.dynamic_update_slice(
+        layer_cache, chunk.astype(layer_cache.dtype), at
+    )
+
+
 def init_slot_cache(
-    cfg: TransformerConfig, slots: int, max_len: int
-) -> tuple[jax.Array, jax.Array]:
+    cfg: TransformerConfig, slots: int, max_len: int,
+    kv_quant: str = "none",
+):
     """Zeroed stacked KV cache pair [L, S, Tmax, Hkv, Dh] — one row per
     slot, sized once for the engine's lifetime. Serving HBM budget is
-    2 · L · S · Tmax · Hkv · Dh · dtype bytes; see docs/DEPLOY.md
-    "Serving" for the sizing table."""
+    2 · L · S · Tmax · Hkv · Dh · dtype bytes (``kv_quant="int8"``:
+    1 + 4/Dh bytes per element instead of the compute dtype's 2); see
+    docs/DEPLOY.md "Serving" for the sizing table and "Autotuning" for
+    the quantization contract."""
     shape = (cfg.n_layers, slots, max_len, cfg.kv_heads, cfg.head_dim)
+    if kv_quant == "int8":
+        def one():
+            return QuantizedKV(
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            )
+        return one(), one()
+    if kv_quant not in ("none", "", None):
+        raise ValueError(f"unknown kv_quant mode {kv_quant!r}")
     dt = cfg.compute_dtype
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def cache_inject_rows(cache, slot: int, rows) -> "jax.Array | QuantizedKV":
+    """Host-side write of FLOAT rows [L, P, Hkv, Dh] into one slot's
+    prefix (the inject half of prefill/decode disaggregation). The
+    cross-replica exchange format is always float — quantization is a
+    per-engine storage decision, so a bf16 prefill replica can feed an
+    int8 decode replica and vice versa."""
+    p = rows.shape[1]
+    if isinstance(cache, QuantizedKV):
+        q = _quantize(jnp.asarray(rows, jnp.float32))
+        return QuantizedKV(
+            cache.data.at[:, slot, :p].set(q.data),
+            cache.scale.at[:, slot, :p].set(q.scale),
+        )
+    return cache.at[:, slot, :p].set(jnp.asarray(rows, cache.dtype))
+
+
+def cache_export_rows(cache, slot: int, length: int) -> jax.Array:
+    """One slot's KV prefix as float rows [L, length, Hkv, Dh] — the
+    export half of the exchange contract ``cache_inject_rows``
+    documents (int8 storage dequantizes on the way out)."""
+    if isinstance(cache, QuantizedKV):
+        return _materialize(
+            QuantizedKV(cache.data[:, slot, :length],
+                        cache.scale[:, slot, :length]),
+            jnp.float32,
+        )
+    return cache[:, slot, :length]
 
 
 def _mlp(x, lp, cfg):
@@ -158,7 +300,7 @@ def decode_window(params, k_all, v_all, pos, wpos, tokens, temp,
     Returns (k_all, v_all, window_tokens [S, steps] int32).
     """
     dt = cfg.compute_dtype
-    t_max = k_all.shape[2]
+    t_max = _cache_tmax(k_all)
     n_h, h_kv = cfg.n_heads, cfg.kv_heads
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                 theta=cfg.rope_theta)
@@ -183,24 +325,16 @@ def decode_window(params, k_all, v_all, pos, wpos, tokens, temp,
             v_new = qkv[:, :, n_h + h_kv:]
             q = apply_rope(q, cos, sin, positions=rp)
             k_new = apply_rope(k_new, cos, sin, positions=rp)
-            k_layer = lax.dynamic_index_in_dim(k_all, layer, 0,
-                                               keepdims=False)
-            v_layer = lax.dynamic_index_in_dim(v_all, layer, 0,
-                                               keepdims=False)
-            write = jax.vmap(
-                lambda row, new, p: lax.dynamic_update_slice(
-                    row, new, (p, 0, 0)
-                )
+            k_layer = _cache_layer(k_all, layer)
+            v_layer = _cache_layer(v_all, layer)
+            k_layer = _write_rows(k_layer, k_new, wpos)
+            v_layer = _write_rows(v_layer, v_new, wpos)
+            k_all = _cache_store_layer(k_all, k_layer, layer)
+            v_all = _cache_store_layer(v_all, v_layer, layer)
+            o = _attend_cache(
+                q, _materialize(k_layer, dt), _materialize(v_layer, dt),
+                mask[:, None, :], cfg,
             )
-            k_layer = write(k_layer, k_new.astype(k_all.dtype), wpos)
-            v_layer = write(v_layer, v_new.astype(v_all.dtype), wpos)
-            k_all = lax.dynamic_update_slice(
-                k_all, k_layer[None], (layer, 0, 0, 0, 0)
-            )
-            v_all = lax.dynamic_update_slice(
-                v_all, v_layer[None], (layer, 0, 0, 0, 0)
-            )
-            o = _attend_cache(q, k_layer, v_layer, mask[:, None, :], cfg)
             x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
             x = _mlp(x, lp, cfg)
             return (x, k_all, v_all), None
@@ -252,7 +386,7 @@ def prefill_chunks(params, k_all, v_all, tokens, slots, starts, n_valids,
     executable)."""
     dt = cfg.compute_dtype
     p, c = tokens.shape
-    t_max = k_all.shape[2]
+    t_max = _cache_tmax(k_all)
     n_h, h_kv = cfg.n_heads, cfg.kv_heads
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                 theta=cfg.rope_theta)
@@ -275,30 +409,26 @@ def prefill_chunks(params, k_all, v_all, tokens, slots, starts, n_valids,
         v_new = qkv[:, :, n_h + h_kv:]
         q = apply_rope(q, cos, sin, positions=rope_pos)
         k_new = apply_rope(k_new, cos, sin, positions=rope_pos)
-        k_layer = lax.dynamic_index_in_dim(k_all, layer, 0, keepdims=False)
-        v_layer = lax.dynamic_index_in_dim(v_all, layer, 0, keepdims=False)
+        k_layer = _cache_layer(k_all, layer)
+        v_layer = _cache_layer(v_all, layer)
 
         def write_one(i, kv):
             k_l, v_l = kv
             kc = lax.dynamic_index_in_dim(k_new, i, 0)   # [1, C, Hkv, Dh]
             vc = lax.dynamic_index_in_dim(v_new, i, 0)
             at = (slots[i], starts[i], 0, 0)
-            return (
-                lax.dynamic_update_slice(k_l, kc.astype(k_l.dtype), at),
-                lax.dynamic_update_slice(v_l, vc.astype(v_l.dtype), at),
-            )
+            return _write_chunk(k_l, kc, at), _write_chunk(v_l, vc, at)
 
         # Sequential writes, not a vmap-scatter: P is small and
         # duplicate (padding) rows must overwrite cleanly in order.
         k_layer, v_layer = lax.fori_loop(0, p, write_one,
                                          (k_layer, v_layer))
-        k_all = lax.dynamic_update_slice(
-            k_all, k_layer[None], (layer, 0, 0, 0, 0)
+        k_all = _cache_store_layer(k_all, k_layer, layer)
+        v_all = _cache_store_layer(v_all, v_layer, layer)
+        o = _attend_cache(
+            q, _materialize(_cache_gather(k_layer, slots), dt),
+            _materialize(_cache_gather(v_layer, slots), dt), mask, cfg,
         )
-        v_all = lax.dynamic_update_slice(
-            v_all, v_layer[None], (layer, 0, 0, 0, 0)
-        )
-        o = _attend_cache(q, k_layer[slots], v_layer[slots], mask, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", o.astype(dt), lp["wo"])
         x = _mlp(x, lp, cfg)
         return (x, k_all, v_all), None

@@ -188,11 +188,26 @@ class ServingEngine:
         max_resident_models: int = 4,
         registry: obs_metrics.MetricsRegistry | None = None,
         seed: int = 0,
+        kv_quant: str | None = None,
     ) -> None:
         import jax
 
+        from tony_tpu.parallel import autotune as autotune_lib
+
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        # KV storage mode (the serving-side tuning axis): explicit arg
+        # wins, else tony.tune.kv-quant via the executor env. Decode is
+        # bandwidth-bound, so "int8" halves the bytes every decode step
+        # reads at a bounded sampling-parity cost (pinned by test).
+        if kv_quant is None:
+            kv_quant = autotune_lib.default_kv_quant()
+        if kv_quant not in autotune_lib.KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quant must be one of {autotune_lib.KV_QUANT_MODES}, "
+                f"got {kv_quant!r}"
+            )
+        self.kv_quant = kv_quant
         if decode_window < 1:
             raise ValueError(
                 f"decode_window must be >= 1, got {decode_window}"
@@ -238,7 +253,9 @@ class ServingEngine:
             [("default", self.params)]
         )
         self._model_loaders: dict[str, Callable[[], dict]] = {}
-        self._k, self._v = _engine.init_slot_cache(cfg, self.slots, max_len)
+        self._k, self._v = _engine.init_slot_cache(
+            cfg, self.slots, max_len, kv_quant=self.kv_quant
+        )
         self._pos = np.zeros(self.slots, np.int32)
         self._active = np.zeros(self.slots, bool)
         self._last = np.zeros(self.slots, np.int32)
@@ -307,7 +324,8 @@ class ServingEngine:
         extra = {"slots": self.slots, "max_len": self.max_len,
                  "chunk": self.prefill_chunk,
                  "window": self.decode_window,
-                 "prefill_batch": self.prefill_batch}
+                 "prefill_batch": self.prefill_batch,
+                 "kv_quant": self.kv_quant}
         self._decode = plan_lib.instrument_jit(
             functools.partial(_engine.decode_window, cfg=cfg,
                               steps=self.decode_window),
@@ -531,6 +549,7 @@ class ServingEngine:
                 "requests": self._n_requests,
                 "retired": self._n_retired,
                 "draining": bool(self._draining),
+                "kv_quant": self.kv_quant,
                 "model": self._model,
                 "models": sorted(set(self._resident)
                                  | set(self._model_loaders)),
@@ -783,11 +802,11 @@ class ServingEngine:
         import jax.numpy as jnp
 
         kv_k, kv_v, pos, last = req._inject
-        self._k = self._k.at[:, slot, :pos].set(
-            jnp.asarray(kv_k, self._k.dtype)
+        self._k = _engine.cache_inject_rows(
+            self._k, slot, jnp.asarray(kv_k)
         )
-        self._v = self._v.at[:, slot, :pos].set(
-            jnp.asarray(kv_v, self._v.dtype)
+        self._v = _engine.cache_inject_rows(
+            self._v, slot, jnp.asarray(kv_v)
         )
         self._pos[slot] = pos
         self._last[slot] = last
@@ -878,8 +897,8 @@ class ServingEngine:
                     with jit_sanitizer.step_region(
                             "serving_prefill_extract"):
                         req.kv = (
-                            np.asarray(jax.device_get(self._k[:, slot, :P])),  # tony: noqa[TONY-X002] — intended KV export fence
-                            np.asarray(jax.device_get(self._v[:, slot, :P])),  # tony: noqa[TONY-X002] — intended KV export fence
+                            np.asarray(jax.device_get(_engine.cache_export_rows(self._k, slot, P))),  # tony: noqa[TONY-X002] — intended KV export fence
+                            np.asarray(jax.device_get(_engine.cache_export_rows(self._v, slot, P))),  # tony: noqa[TONY-X002] — intended KV export fence
                         )
                     self._retire(slot)
                 elif ((req.eos_id is not None and first == req.eos_id)
