@@ -1560,6 +1560,123 @@ def bench_autotune(trial_budget: int = 4, n_requests: int = 8,
     }
 
 
+def bench_rollup(targets: int = 24, tasks_per_target: int = 8,
+                 ticks: int = 12, queries: int = 200):
+    """The fleet rollup's control-plane costs at synthetic-fleet scale,
+    hermetic (injected scrape documents, no HTTP, no jobs):
+
+    - ``scrape_fan_in_ms``: one full scrape pass over every target's
+      /api/metrics document (parse + normalize, the per-tick fan-in);
+    - ``rollup_tick_ms``: mean full tick — scrape, fold (counter deltas,
+      gauge folds, histogram merges across all scopes), TSDB record,
+      SLO evaluation;
+    - ``query_p95_ms``: p95 of range reads against the populated store
+      (the /api/query path a dashboard hammers);
+    - ``series_bytes_on_disk`` / ``series``: store shape after a
+      checkpoint, ungated context numbers.
+
+    Counters advance and gauges wobble per tick so the fold exercises
+    the delta path, not the first-sight shortcut."""
+    import tempfile as _tempfile
+
+    from tony_tpu.observability.events import EventLog
+    from tony_tpu.observability.goodput import GOODPUT_RATIO_GAUGE
+    from tony_tpu.observability.rollup import FleetRollup, SloObjective, Target
+    from tony_tpu.observability.stepstats import MFU_GAUGE
+    from tony_tpu.observability.tsdb import TimeSeriesStore
+    from tony_tpu.serving.scheduler import SERVING_TTFT_MS_HISTOGRAM
+
+    bounds = [float(2 ** i) for i in range(16)]
+    tick_state = {"n": 0}
+
+    def doc_for(idx: int) -> dict:
+        n = tick_state["n"]
+        hist = {
+            "count": 100 * (n + 1),
+            "sum": 2500.0 * (n + 1),
+            "buckets": [[b, min(100 * (n + 1), int(b) * (n + 1))]
+                        for b in bounds],
+        }
+        tasks = {
+            f"worker:{t}": {
+                "counters": {"train_steps_total": 50.0 * n + t},
+                "gauges": {"loss": 1.0 / (n + 1), MFU_GAUGE: 0.5,
+                           "tokens_per_sec": 900.0 + t},
+                "histograms": {},
+            }
+            for t in range(tasks_per_target)
+        }
+        return {
+            "coordinator": {
+                "counters": {"train_steps_total": 50.0 * n * tasks_per_target},
+                "gauges": {GOODPUT_RATIO_GAUGE: 0.8 + 0.01 * (idx % 10)},
+                "histograms": {SERVING_TTFT_MS_HISTOGRAM: hist},
+            },
+            "heartbeats": {f"worker:{t}": float(n + 1)
+                           for t in range(tasks_per_target)},
+            "heartbeat_age_s": {f"worker:{t}": 0.5
+                                for t in range(tasks_per_target)},
+            "tasks": tasks,
+        }
+
+    fleet = [Target(f"job{i}", "job", f"host:{i}",
+                    tenant=f"tenant{i % 4}") for i in range(targets)]
+
+    def fetch(url: str, timeout_s: float) -> dict:
+        idx = int(url.split("host:")[1].split("/")[0])
+        return doc_for(idx)
+
+    base_ms = 1_700_000_400_000
+    with _tempfile.TemporaryDirectory(prefix="tony-bench-rollup-") as td:
+        rollup = FleetRollup(
+            None,
+            tsdb=TimeSeriesStore(td),
+            events=EventLog(),
+            objectives=[SloObjective(
+                "goodput", "tony_goodput_ratio|fleet", "min", 0.9
+            )],
+            fast_window_s=60, slow_window_s=300,
+            fetch_json=fetch,
+        )
+        rollup.discover_targets = lambda: list(fleet)
+
+        t0 = time.perf_counter()
+        scraped = [rollup._scrape(t) for t in fleet]
+        fan_in_ms = (time.perf_counter() - t0) * 1e3
+        assert all(s is not None for s in scraped)
+
+        walls = []
+        for n in range(ticks):
+            tick_state["n"] = n
+            t0 = time.perf_counter()
+            rollup.tick(now_ms=base_ms + n * 15_000)
+            walls.append((time.perf_counter() - t0) * 1e3)
+
+        names = rollup.tsdb.names()
+        q_walls = []
+        for i in range(queries):
+            series = names[i % len(names)]
+            name, _, scope = series.rpartition("|")
+            t0 = time.perf_counter()
+            rollup.query_series(name, agg="avg", scope=scope,
+                                since_s=3600, step_s=60)
+            q_walls.append((time.perf_counter() - t0) * 1e3)
+        q_walls.sort()
+
+        rollup.tsdb.checkpoint()
+        stats = rollup.tsdb.stats()
+
+    return {
+        "scrape_fan_in_ms": round(fan_in_ms, 2),
+        "rollup_tick_ms": round(sum(walls) / len(walls), 2),
+        "query_p95_ms": round(q_walls[int(len(q_walls) * 0.95)], 3),
+        # Shape / context, named without direction suffixes (ungated).
+        "targets": targets,
+        "series": stats["series"],
+        "series_bytes_on_disk": stats["disk_bytes"],
+    }
+
+
 # ---------------------------------------------------------------------------
 # Regression gate (`bench.py --check`)
 # ---------------------------------------------------------------------------
@@ -1764,6 +1881,7 @@ def run_benches() -> dict:
             "scheduler": _safe(bench_scheduler),
             "checkpoint": _safe(bench_checkpoint),
             "autotune": _safe(bench_autotune),
+            "rollup": _safe(bench_rollup),
             "flash_attention_2k": _safe(
                 bench_flash_attention, seq=2048, batch=4
             ),
@@ -1794,6 +1912,7 @@ def run_benches() -> dict:
                   "scheduler": _safe(bench_scheduler),
                   "checkpoint": _safe(bench_checkpoint),
                   "autotune": _safe(bench_autotune),
+                  "rollup": _safe(bench_rollup),
                   "device": jax.devices()[0].device_kind}
     # Final aggregated telemetry snapshot (observability.metrics): the
     # instrumented train steps populate the default registry while the

@@ -1,0 +1,730 @@
+"""Fleet observability rollup tests: the multi-resolution TSDB
+(downsampling, retention, WAL/chunk persistence), snapshot/histogram
+merging, the scraping collector's fold rules (restart-safe counter
+deltas, gauge family folds, bucket-aligned histogram merge, staleness
+eviction), SLO burn-rate evaluation with edge-triggered ``slo_burn``
+events, journal size/age compaction bounds, history events truncation,
+the TONY-M003 cardinality lint, and the multi-job mini-cluster e2e:
+two tenants, one scheduler, one ``GET /metrics/fleet`` scrape."""
+
+import json
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.conf import keys
+from tony_tpu.history.reader import events_truncation
+from tony_tpu.history.writer import truncate_events
+from tony_tpu.observability import metrics as obs_metrics
+from tony_tpu.observability.events import EventLog
+from tony_tpu.observability.rollup import (
+    FleetRollup,
+    ROLLUP_EVICTIONS_COUNTER,
+    ROLLUP_MERGE_CONFLICTS_COUNTER,
+    ROLLUP_SCRAPE_FAILURES_COUNTER,
+    SloObjective,
+    Target,
+    default_objectives,
+)
+from tony_tpu.observability.tsdb import TimeSeriesStore
+from tony_tpu.scheduler.journal import SchedulerJournal
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+# A fixed epoch, aligned to the 600 s bucket width so single-minute
+# batches land in one downsample bucket deterministically.
+BASE_MS = 1_700_000_400_000
+
+
+def _hist(count, total, buckets, maximum=None):
+    snap = {"count": count, "sum": total, "buckets": buckets}
+    if maximum is not None:
+        snap["max"] = maximum
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# metrics.py merge primitives
+# ---------------------------------------------------------------------------
+class TestMergePrimitives:
+    def test_merge_histograms_adds_aligned_parts(self):
+        a = _hist(2, 30.0, [[10.0, 1], [100.0, 2]], maximum=25.0)
+        b = _hist(3, 120.0, [[10.0, 0], [100.0, 3]], maximum=90.0)
+        merged = obs_metrics.merge_histograms([a, b])
+        assert merged["count"] == 5
+        assert merged["sum"] == pytest.approx(150.0)
+        assert merged["buckets"] == [[10.0, 1], [100.0, 5]]
+        assert merged["max"] == 90.0
+        # quantiles stay answerable on the merged snapshot
+        q = obs_metrics.histogram_quantile(merged, 0.95)
+        assert q is not None and 10.0 <= q <= 100.0
+
+    def test_merge_histograms_rejects_mismatched_bounds(self):
+        a = _hist(1, 5.0, [[10.0, 1], [100.0, 1]])
+        b = _hist(1, 5.0, [[20.0, 1], [100.0, 1]])
+        with pytest.raises(ValueError, match="mismatched histogram"):
+            obs_metrics.merge_histograms([a, b])
+
+    def test_merge_snapshots_counters_and_gauge_aggs(self):
+        s1 = {"counters": {"x_total": 3}, "gauges": {"loss": 1.0},
+              "histograms": {}}
+        s2 = {"counters": {"x_total": 4}, "gauges": {"loss": 3.0},
+              "histograms": {}}
+        merged = obs_metrics.merge_snapshots([s1, s2], gauge_agg="avg")
+        assert merged["counters"]["x_total"] == 7
+        assert merged["gauges"]["loss"] == pytest.approx(2.0)
+        assert obs_metrics.merge_snapshots(
+            [s1, s2], gauge_agg="max"
+        )["gauges"]["loss"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# tsdb.py
+# ---------------------------------------------------------------------------
+class TestTimeSeriesStore:
+    def test_record_query_and_downsample(self):
+        ts = TimeSeriesStore(None)
+        # six points inside one minute
+        for i, v in enumerate((1.0, 2.0, 3.0, 4.0, 5.0, 6.0)):
+            ts.record_many(BASE_MS + i * 10_000, {"s": v})
+        rows = ts.query("s", since_ms=BASE_MS - 1,
+                        until_ms=BASE_MS + 60_000, step_s=60, agg="avg")
+        assert len(rows) == 1
+        assert rows[0][1] == pytest.approx(3.5)
+        for agg, want in (("sum", 21.0), ("min", 1.0), ("max", 6.0),
+                          ("last", 6.0), ("count", 6.0)):
+            assert ts.query(
+                "s", since_ms=BASE_MS - 1, until_ms=BASE_MS + 60_000,
+                step_s=60, agg=agg,
+            )[0][1] == pytest.approx(want)
+
+    def test_unknown_agg_raises(self):
+        with pytest.raises(ValueError, match="unknown agg"):
+            TimeSeriesStore(None).query("s", agg="p95")
+
+    def test_raw_retention_trims_but_buckets_survive(self):
+        ts = TimeSeriesStore(None, retention_raw_s=120)
+        for i in range(60):  # 10 minutes of 10 s points
+            ts.record_many(BASE_MS + i * 10_000, {"s": float(i)})
+        stats = ts.stats()
+        # raw horizon is 2 minutes => at most ~13 raw points retained
+        assert stats["raw_points"] <= 13
+        # but the 1m buckets still cover the full 10 minutes
+        rows = ts.query("s", since_ms=BASE_MS,
+                        until_ms=BASE_MS + 600_000, step_s=60, agg="avg")
+        assert len(rows) >= 9
+
+    def test_resolution_pick_coarsens_past_raw_horizon(self):
+        ts = TimeSeriesStore(None, retention_raw_s=60,
+                             retention_1m_s=3600)
+        assert ts._pick_resolution(BASE_MS, 60) == 0  # no data: age 0
+        ts.record_many(BASE_MS + 7_200_000, {"s": 1.0})
+        latest = BASE_MS + 7_200_000
+        # inside the raw horizon: finest wins
+        assert ts._pick_resolution(latest - 30_000, 30) == 0
+        # past raw but inside the 1m horizon
+        assert ts._pick_resolution(latest - 600_000, 60) == 60
+        # a since 2 h back outlives both finer horizons
+        assert ts._pick_resolution(BASE_MS, 600) == 600
+
+    def test_persistence_checkpoint_and_wal_replay(self, tmp_path):
+        d = tmp_path / "tsdb"
+        ts = TimeSeriesStore(d)
+        ts.record_many(BASE_MS, {"a": 1.0, "b": 2.0})
+        ts.checkpoint()
+        ts.record_many(BASE_MS + 60_000, {"a": 3.0})  # WAL only
+        # a torn tail line must not poison the load
+        with open(d / "tsdb-wal.jsonl", "a") as f:
+            f.write('{"ts_ms": 999, "val')
+
+        ts2 = TimeSeriesStore(d)
+        assert ts2.names() == ["a", "b"]
+        rows = ts2.query("a", since_ms=BASE_MS - 1,
+                         until_ms=BASE_MS + 120_000, step_s=60, agg="last")
+        assert [v for _, v in rows] == [1.0, 3.0]
+
+    def test_wal_lines_before_watermark_not_doubled(self, tmp_path):
+        d = tmp_path / "tsdb"
+        ts = TimeSeriesStore(d)
+        ts.record_many(BASE_MS, {"a": 1.0})
+        ts.checkpoint()
+        # simulate a crash between append and truncate: re-append the
+        # already-folded line; the watermark must skip it on load
+        with open(d / "tsdb-wal.jsonl", "a") as f:
+            f.write(json.dumps({"ts_ms": BASE_MS, "values": {"a": 1.0}})
+                    + "\n")
+        ts2 = TimeSeriesStore(d)
+        rows = ts2.query("a", since_ms=BASE_MS - 1, until_ms=BASE_MS + 1,
+                         step_s=60, agg="count")
+        assert rows[0][1] == 1.0
+
+    def test_avg_over_window(self):
+        ts = TimeSeriesStore(None)
+        for i in range(10):
+            ts.record_many(BASE_MS + i * 15_000, {"s": 0.5})
+        assert ts.avg_over("s", 300,
+                           until_ms=BASE_MS + 150_000) == pytest.approx(0.5)
+        assert ts.avg_over("missing", 300, until_ms=BASE_MS) is None
+
+    def test_non_finite_and_non_numeric_dropped(self):
+        ts = TimeSeriesStore(None)
+        n = ts.record_many(BASE_MS, {"ok": 1.0, "nan": float("nan"),
+                                     "bad": "x"})
+        assert n == 1 and ts.names() == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the collector (fake fetch_json: no HTTP, no scheduler)
+# ---------------------------------------------------------------------------
+def _job_doc(steps=5.0, goodput=0.4, hb=3, ttft_hist=None):
+    doc = {
+        "coordinator": {
+            "counters": {"train_steps_total": steps},
+            "gauges": {"tony_goodput_ratio": goodput},
+            "histograms": (
+                {"tony_serving_ttft_ms": ttft_hist} if ttft_hist else {}
+            ),
+        },
+        "heartbeats": {"worker:0": hb},
+        "heartbeat_age_s": {"worker:0": 0.5},
+        "tasks": {},
+    }
+    return doc
+
+
+def _rollup(targets, docs, failing=(), **kw):
+    """A FleetRollup whose discovery and scraping are injected: ``docs``
+    maps target key -> /api/metrics document, ``failing`` keys raise."""
+    def fetch(url, timeout_s):
+        for t in targets():
+            if url == f"http://{t.addr}/api/metrics":
+                if t.key in failing:
+                    raise OSError("connection refused")
+                return docs[t.key]
+        raise OSError("unknown target")
+
+    kw.setdefault("tsdb", TimeSeriesStore(None))
+    r = FleetRollup(None, fetch_json=fetch, **kw)
+    r.discover_targets = lambda: targets()
+    return r
+
+
+class TestFleetRollupFold:
+    def test_scope_fold_counters_gauges_tenants(self):
+        t1 = Target("j1", "job", "h:1", tenant="alice")
+        t2 = Target("j2", "job", "h:2", tenant="bob")
+        sched = Target("scheduler", "scheduler", "h:9")
+        docs = {
+            "j1": _job_doc(steps=5.0, goodput=0.4),
+            "j2": _job_doc(steps=7.0, goodput=0.8),
+            "scheduler": {"counters": {"tony_sched_submits_total": 2.0},
+                          "gauges": {}, "histograms": {}},
+        }
+        r = _rollup(lambda: [t1, t2, sched], docs)
+        r.tick(now_ms=BASE_MS)
+        snap = r.fleet_snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c['train_steps_total{scope="fleet"}'] == 12.0
+        assert c['train_steps_total{scope="cluster"}'] == 12.0
+        assert c['train_steps_total{scope="tenant",tenant="alice"}'] == 5.0
+        assert c['train_steps_total{scope="tenant",tenant="bob"}'] == 7.0
+        # the scheduler's own counters roll up to cluster scope only
+        assert c['tony_sched_submits_total{scope="cluster"}'] == 2.0
+        assert 'tony_sched_submits_total{scope="fleet"}' not in c
+        # _ratio family folds by average at fleet scope
+        assert g['tony_goodput_ratio{scope="fleet"}'] == pytest.approx(0.6)
+        assert g['tony_goodput_ratio{scope="tenant",tenant="alice"}'] \
+            == pytest.approx(0.4)
+        # heartbeat part synthesized: counter sum + worst age
+        assert c['tony_task_heartbeats_total{scope="fleet"}'] == 6.0
+        assert g['tony_task_heartbeat_age_seconds{scope="fleet"}'] == 0.5
+
+    def test_counter_deltas_are_restart_safe(self):
+        docs = {"j1": _job_doc(steps=100.0)}
+        t = Target("j1", "job", "h:1", tenant="alice")
+        r = _rollup(lambda: [t], docs)
+        r.tick(now_ms=BASE_MS)
+        fleet = 'train_steps_total{scope="fleet"}'
+        assert r.fleet_snapshot()["counters"][fleet] == 100.0
+        # the job restarts: its counter resets to 10 — the fleet total
+        # must not move backwards (delta clamped at zero)
+        docs["j1"] = _job_doc(steps=10.0)
+        r.tick(now_ms=BASE_MS + 15_000)
+        assert r.fleet_snapshot()["counters"][fleet] == 100.0
+        # and new progress folds in as a delta from the restart point
+        docs["j1"] = _job_doc(steps=15.0)
+        r.tick(now_ms=BASE_MS + 30_000)
+        assert r.fleet_snapshot()["counters"][fleet] == 105.0
+
+    def test_histogram_merge_and_quantile_series(self):
+        h1 = _hist(8, 80.0, [[10.0, 4], [100.0, 8]])
+        h2 = _hist(2, 150.0, [[10.0, 0], [100.0, 2]])
+        docs = {"j1": _job_doc(ttft_hist=h1), "j2": _job_doc(ttft_hist=h2)}
+        r = _rollup(lambda: [Target("j1", "job", "h:1", tenant="a"),
+                             Target("j2", "job", "h:2", tenant="b")], docs)
+        r.tick(now_ms=BASE_MS)
+        merged = r.fleet_snapshot()["histograms"][
+            'tony_serving_ttft_ms{scope="fleet"}'
+        ]
+        assert merged["count"] == 10 and merged["buckets"][1] == [100.0, 10]
+        # quantile series recorded for the range API
+        assert "tony_serving_ttft_ms:p95|fleet" in r.tsdb.names()
+        points = r.tsdb.query("tony_serving_ttft_ms:p95|fleet",
+                              since_ms=BASE_MS - 1, until_ms=BASE_MS + 1,
+                              step_s=60, agg="last")
+        assert points and 10.0 <= points[0][1] <= 100.0
+
+    def test_mismatched_buckets_drop_series_loudly(self):
+        h1 = _hist(1, 5.0, [[10.0, 1], [100.0, 1]])
+        h2 = _hist(1, 5.0, [[25.0, 1], [100.0, 1]])
+        docs = {"j1": _job_doc(ttft_hist=h1), "j2": _job_doc(ttft_hist=h2)}
+        r = _rollup(lambda: [Target("j1", "job", "h:1"),
+                             Target("j2", "job", "h:2")], docs)
+        r.tick(now_ms=BASE_MS)
+        snap = r.fleet_snapshot()
+        assert 'tony_serving_ttft_ms{scope="fleet"}' \
+            not in snap["histograms"]
+        conflicts = r.registry.snapshot()["counters"][
+            ROLLUP_MERGE_CONFLICTS_COUNTER
+        ]
+        assert conflicts >= 1
+
+    def test_gone_target_evicts_gauges_but_keeps_counters(self):
+        docs = {"j1": _job_doc(steps=5.0), "j2": _job_doc(steps=7.0)}
+        live = [Target("j1", "job", "h:1", tenant="a"),
+                Target("j2", "job", "h:2", tenant="b")]
+        r = _rollup(lambda: list(live), docs)
+        r.tick(now_ms=BASE_MS)
+        assert len(r.summary()["targets"]) == 2
+        del live[1]  # the scheduler stops listing j2
+        r.tick(now_ms=BASE_MS + 15_000)
+        snap = r.fleet_snapshot()
+        # j2's gauges are gone from every scope...
+        assert 'tony_goodput_ratio{scope="tenant",tenant="b"}' \
+            not in snap["gauges"]
+        # ...but its folded counter contribution survives
+        assert snap["counters"]['train_steps_total{scope="fleet"}'] == 12.0
+        assert snap["counters"][
+            'train_steps_total{scope="tenant",tenant="b"}'
+        ] == 7.0
+        evictions = r.registry.snapshot()["counters"][
+            ROLLUP_EVICTIONS_COUNTER
+        ]
+        assert evictions == 1
+
+    def test_unreachable_target_ages_out_at_stale_after(self):
+        docs = {"j1": _job_doc(goodput=0.4)}
+        t = Target("j1", "job", "h:1", tenant="a")
+        failing = set()
+        r = _rollup(lambda: [t], docs, failing=failing,
+                    stale_after_ms=30_000)
+        r.tick(now_ms=BASE_MS)
+        assert 'tony_goodput_ratio{scope="fleet"}' \
+            in r.fleet_snapshot()["gauges"]
+        failing.add("j1")  # still discovered, stops answering
+        r.tick(now_ms=BASE_MS + 10_000)
+        # within stale_after: last-good snapshot still serves
+        assert 'tony_goodput_ratio{scope="fleet"}' \
+            in r.fleet_snapshot()["gauges"]
+        assert r.summary()["target_failures"]["j1"] == 1
+        fails = r.registry.snapshot()["counters"][
+            ROLLUP_SCRAPE_FAILURES_COUNTER + '{kind="job"}'
+        ]
+        assert fails == 1
+        r.tick(now_ms=BASE_MS + 50_000)  # past stale_after_ms
+        assert 'tony_goodput_ratio{scope="fleet"}' \
+            not in r.fleet_snapshot()["gauges"]
+
+    def test_prometheus_text_one_scrape(self):
+        h = _hist(2, 30.0, [[10.0, 1], [100.0, 2]])
+        docs = {"j1": _job_doc(goodput=0.4, ttft_hist=h)}
+        r = _rollup(lambda: [Target("j1", "job", "h:1", tenant="a")], docs)
+        r.tick(now_ms=BASE_MS)
+        text = r.prometheus_text()
+        assert 'tony_goodput_ratio{scope="fleet"}' in text
+        assert 'tony_goodput_ratio{scope="tenant",tenant="a"}' in text
+        assert 'tony_serving_ttft_ms_bucket{le="10"' in text
+        assert "tony_rollup_targets 1" in text
+        assert "# TYPE tony_task_heartbeats_total counter" in text
+
+    def test_query_series_scopes(self):
+        docs = {"j1": _job_doc(goodput=0.4)}
+        r = _rollup(lambda: [Target("j1", "job", "h:1", tenant="a")], docs)
+        for i in range(4):
+            r.tick(now_ms=BASE_MS + i * 15_000)
+        doc = r.query_series("tony_goodput_ratio", agg="avg", tenant="a",
+                             since_s=600, step_s=60)
+        assert doc["scope"] == "tenant:a"
+        assert doc["points"] and doc["points"][0][1] == pytest.approx(0.4)
+        assert r.query_series("tony_goodput_ratio")["scope"] == "fleet"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+class TestSloBurn:
+    def _rollup_with_objective(self, kind="min", target=0.9):
+        events = EventLog()
+        r = _rollup(
+            lambda: [], {}, events=events,
+            objectives=[SloObjective("obj", "s|fleet", kind, target)],
+            fast_window_s=60, slow_window_s=120, burn_threshold=1.0,
+        )
+        return r, events
+
+    def test_breach_emits_edge_triggered_event(self):
+        r, events = self._rollup_with_objective()
+        # seed a breaching series: goodput 0.45 against a 0.9 floor
+        for i in range(10):
+            r.tsdb.record_many(BASE_MS + i * 15_000, {"s|fleet": 0.45})
+        r.tick(now_ms=BASE_MS + 150_000)
+        state = r.summary()["slo"]["obj"]
+        assert state["breached"] is True
+        assert state["burn_fast"] == pytest.approx(2.0)
+        burns = [e for e in events.to_dicts() if e["kind"] == "slo_burn"]
+        assert len(burns) == 1
+        assert burns[0]["objective"] == "obj"
+        assert burns[0]["burn_slow"] > 1.0
+        # still breaching on the next tick: latched, no second event
+        r.tsdb.record_many(BASE_MS + 165_000, {"s|fleet": 0.45})
+        r.tick(now_ms=BASE_MS + 165_000)
+        assert len([e for e in events.to_dicts()
+                    if e["kind"] == "slo_burn"]) == 1
+        # the burn gauge is live on the one-scrape page
+        text = r.prometheus_text()
+        assert _sample(
+            text, 'tony_slo_burn_rate{objective="obj"}'
+        ) == pytest.approx(2.0)
+
+    def test_recovery_unlatches_and_rebreach_reemits(self):
+        r, events = self._rollup_with_objective()
+        now = BASE_MS
+        for i in range(10):
+            r.tsdb.record_many(now + i * 15_000, {"s|fleet": 0.45})
+        now += 150_000
+        r.tick(now_ms=now)
+        # recover: both windows must clear before the latch resets
+        for i in range(20):
+            r.tsdb.record_many(now + (i + 1) * 15_000, {"s|fleet": 1.0})
+        now += 20 * 15_000
+        r.tick(now_ms=now)
+        assert r.summary()["breached"] == []
+        for i in range(20):
+            r.tsdb.record_many(now + (i + 1) * 15_000, {"s|fleet": 0.3})
+        now += 20 * 15_000
+        r.tick(now_ms=now)
+        assert len([e for e in events.to_dicts()
+                    if e["kind"] == "slo_burn"]) == 2
+
+    def test_fast_breach_alone_does_not_alert(self):
+        r, events = self._rollup_with_objective()
+        # a long healthy history, then one bad fast window: the slow
+        # window holds the alert back (no flapping on blips)
+        for i in range(8):
+            r.tsdb.record_many(BASE_MS + i * 15_000, {"s|fleet": 1.0})
+        r.tsdb.record_many(BASE_MS + 8 * 15_000, {"s|fleet": 0.2})
+        r.tick(now_ms=BASE_MS + 8 * 15_000)
+        state = r.summary()["slo"]["obj"]
+        assert state["burn_fast"] > 1.0
+        assert state["breached"] is False
+        assert events.to_dicts() == []
+
+    def test_max_kind_objective(self):
+        events = EventLog()
+        r = _rollup(
+            lambda: [], {}, events=events,
+            objectives=[SloObjective("ttft", "t:p95|fleet", "max", 100.0)],
+            fast_window_s=60, slow_window_s=120,
+        )
+        for i in range(10):
+            r.tsdb.record_many(BASE_MS + i * 15_000, {"t:p95|fleet": 250.0})
+        r.tick(now_ms=BASE_MS + 150_000)
+        state = r.summary()["slo"]["ttft"]
+        assert state["burn_fast"] == pytest.approx(2.5)
+        assert state["breached"] is True
+
+    def test_empty_window_holds_gauges_and_latch(self):
+        r, events = self._rollup_with_objective()
+        r.tick(now_ms=BASE_MS)  # nothing recorded yet
+        state = r.summary()["slo"]["obj"]
+        assert state["fast"] is None and "breached" not in state
+        assert events.to_dicts() == []
+
+    def test_default_objectives_from_conf(self):
+        from tony_tpu.conf.configuration import TonyConfiguration
+
+        conf = TonyConfiguration()
+        objs = {o.name: o for o in default_objectives(conf)}
+        assert set(objs) == {"fleet_goodput_ratio", "serving_ttft_p95"}
+        assert objs["fleet_goodput_ratio"].kind == "min"
+        assert objs["serving_ttft_p95"].series \
+            == "tony_serving_ttft_ms:p95|fleet"
+        conf.set(keys.K_SLO_MFU_FLOOR, 0.3)
+        assert "fleet_mfu_floor" in {
+            o.name for o in default_objectives(conf)
+        }
+        conf.set(keys.K_SLO_ENABLED, False)
+        assert default_objectives(conf) == []
+
+
+# ---------------------------------------------------------------------------
+# journal size/age compaction bounds (tony.scheduler.journal-max-*)
+# ---------------------------------------------------------------------------
+class TestJournalRetentionBounds:
+    def test_needs_rotation_by_bytes(self, tmp_path):
+        j = SchedulerJournal(tmp_path / "j.jsonl")
+        j.append("job_queued", BASE_MS, job_id="a", blob="x" * 200)
+        assert not j.needs_rotation(BASE_MS, max_bytes=10_000)
+        assert j.needs_rotation(BASE_MS, max_bytes=64)
+        assert not j.needs_rotation(BASE_MS)  # all bounds disabled
+
+    def test_needs_rotation_by_age_and_reset_on_rotate(self, tmp_path):
+        j = SchedulerJournal(tmp_path / "j.jsonl")
+        s1 = j.append("job_queued", BASE_MS, job_id="a")
+        now = BASE_MS + 3_600_000
+        s2 = j.append("job_launched", now, job_id="a")
+        assert j.oldest_age_ms(now) == 3_600_000
+        assert j.needs_rotation(now, max_age_ms=1_800_000)
+        # rotating away the old prefix clears the age trigger
+        j.rotate(s1)
+        assert j.oldest_age_ms(now) == 0
+        assert not j.needs_rotation(now, max_age_ms=1_800_000)
+        assert j.size_bytes() > 0 and s2 > s1
+
+    def test_age_survives_reload(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        SchedulerJournal(path).append("job_queued", BASE_MS, job_id="a")
+        j2 = SchedulerJournal(path)  # re-scan on boot
+        assert j2.needs_rotation(BASE_MS + 100, max_age_ms=50)
+
+    def test_record_count_bound_unchanged(self, tmp_path):
+        j = SchedulerJournal(tmp_path / "j.jsonl")
+        for i in range(5):
+            j.append("job_queued", BASE_MS + i, job_id=f"j{i}")
+        assert not j.needs_rotation(BASE_MS, max_records=5)
+        assert j.needs_rotation(BASE_MS, max_records=4)
+
+
+# ---------------------------------------------------------------------------
+# history events truncation (tony.history.max-events)
+# ---------------------------------------------------------------------------
+class TestEventsTruncation:
+    def _events(self, n):
+        return [{"ts_ms": BASE_MS + i, "kind": f"k{i}"} for i in range(n)]
+
+    def test_noop_at_or_under_cap(self):
+        events = self._events(10)
+        assert truncate_events(events, 10) is events
+        assert truncate_events(events, 0) is events
+
+    def test_drops_middle_keeps_edges_and_marks(self):
+        events = self._events(100)
+        out = truncate_events(events, 11)
+        assert len(out) == 11
+        assert out[0] == events[0]          # the submission edge
+        assert out[-1] == events[-1]        # the death edge
+        marker = events_truncation(out)
+        assert marker == {"dropped": 90, "ts_ms": out[4]["ts_ms"]}
+
+    def test_reader_returns_none_when_complete(self):
+        assert events_truncation(self._events(5)) is None
+        assert events_truncation(None) is None
+
+
+# ---------------------------------------------------------------------------
+# TONY-M003 cardinality lint
+# ---------------------------------------------------------------------------
+class TestCardinalityLint:
+    def _findings(self, tmp_path, source):
+        from tony_tpu.analysis.metrics_lint import check_label_cardinality
+
+        p = tmp_path / "mod.py"
+        p.write_text(source)
+        return check_label_cardinality([p])
+
+    def test_flags_per_occurrence_id_label(self, tmp_path):
+        found = self._findings(tmp_path, (
+            "def f(reg, request_id):\n"
+            "    reg.counter('rpc_calls_total',"
+            " labels={'request': request_id}).inc()\n"
+        ))
+        assert len(found) == 1
+        assert found[0].rule_id == "TONY-M003"
+        assert "request_id" in found[0].message
+
+    def test_attribute_ids_flagged_too(self, tmp_path):
+        found = self._findings(tmp_path, (
+            "def f(reg, task):\n"
+            "    reg.gauge('queue_depth',"
+            " labels={'seq': task.seq_no}).set(1)\n"
+        ))
+        assert len(found) == 1
+
+    def test_closed_set_labels_pass(self, tmp_path):
+        found = self._findings(tmp_path, (
+            "def f(reg, task_id, state):\n"
+            "    reg.counter('x_total', labels={'state': 'RUNNING'}).inc()\n"
+            "    reg.counter('x_total', labels={'task': task_id}).inc()\n"
+            "    reg.gauge('depth', labels={'state': state}).set(1)\n"
+        ))
+        assert found == []
+
+    def test_noqa_waives(self, tmp_path):
+        found = self._findings(tmp_path, (
+            "def f(reg, trace_id):\n"
+            "    reg.counter('x_total',"
+            " labels={'trace': trace_id}).inc()"
+            "  # tony: noqa[TONY-M003]\n"
+        ))
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# bench_rollup: the workload runs and its gates point the right way
+# ---------------------------------------------------------------------------
+def test_bench_rollup_smoke_and_gate_directions():
+    import bench
+
+    out = bench.bench_rollup(targets=4, tasks_per_target=2, ticks=3,
+                             queries=10)
+    for gated in ("scrape_fan_in_ms", "rollup_tick_ms", "query_p95_ms"):
+        assert out[gated] >= 0
+        assert bench.metric_direction(f"rollup.{gated}") == "lower"
+    # shape numbers stay ungated: a store that grows is not a regression
+    assert bench.metric_direction("rollup.series_bytes_on_disk") is None
+    assert bench.metric_direction("rollup.series") is None
+    assert out["series"] > 0
+
+
+# ---------------------------------------------------------------------------
+# mini-cluster e2e: two tenants, one scheduler, one scrape
+# ---------------------------------------------------------------------------
+def _poll(deadline_s, fn, what):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode()
+
+
+def _sample(text, needle):
+    """The float value of the first exposition line starting needle."""
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_multi_job_rollup_e2e(tmp_path):
+    """The acceptance scenario: two jax-free jobs under different
+    tenants on one scheduler; a history server's rollup discovers both
+    through scheduler state, and ONE ``GET /metrics/fleet`` shows the
+    summed fleet counters plus per-tenant goodput. Killing a job evicts
+    its gauges without perturbing the fleet counters, and a restarted
+    TimeSeriesStore replays the persisted series."""
+    from tony_tpu.history.server import HistoryServer
+    from tony_tpu.mini import MiniTonyCluster
+
+    with MiniTonyCluster(tmp_path) as cluster:
+        sconf = cluster.base_conf()
+        sconf.set(keys.K_SCHED_TICK_MS, 50)
+        daemon = cluster.start_scheduler(sconf, serve_http=True)
+
+        def job_conf():
+            conf = cluster.base_conf()
+            conf.set(keys.K_EXECUTES, str(FIXTURES / "report_metrics.py"))
+            conf.set(keys.K_PYTHON_BINARY, sys.executable)
+            conf.set(keys.instances_key("worker"), 1)
+            conf.set(keys.instances_key("ps"), 0)
+            conf.set(keys.K_TASK_HEARTBEAT_INTERVAL_MS, 150)
+            conf.set(keys.K_SHELL_ENV, "LINGER_S=45.0")
+            return conf
+
+        j1 = daemon.submit(job_conf(), tenant="alice")
+        j2 = daemon.submit(job_conf(), tenant="bob")
+
+        tsdb_dir = tmp_path / "fleet-tsdb"
+        rollup = FleetRollup(
+            cluster.base_dir / "scheduler",
+            tsdb=TimeSeriesStore(tsdb_dir),
+            events=EventLog(),
+            interval_ms=200,
+            stale_after_ms=3_000,
+        )
+        server = HistoryServer(str(cluster.history_dir), port=0,
+                               rollup=rollup)
+        port = server.serve_background()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # -- one scrape shows the whole fleet -------------------------
+            def both_tenants_up():
+                text = _get(f"{base}/metrics/fleet")
+                ok = ('tony_goodput_ratio{scope="tenant",tenant="alice"}'
+                      in text
+                      and 'tony_goodput_ratio{scope="tenant",tenant="bob"}'
+                      in text
+                      and (_sample(
+                          text, 'train_steps_total{scope="fleet"}'
+                      ) or 0) >= 2)
+                return text if ok else None
+
+            text = _poll(90, both_tenants_up, "both tenants on one scrape")
+            fleet_steps = _sample(text, 'train_steps_total{scope="fleet"}')
+            alice = _sample(
+                text, 'train_steps_total{scope="tenant",tenant="alice"}'
+            )
+            bob = _sample(
+                text, 'train_steps_total{scope="tenant",tenant="bob"}'
+            )
+            assert fleet_steps == pytest.approx(alice + bob)
+            assert 'tony_task_heartbeats_total{scope="fleet"}' in text
+            assert "tony_rollup_targets" in text
+
+            # -- the range API answers over HTTP --------------------------
+            doc = json.loads(_get(
+                f"{base}/api/query?name=train_steps_total&agg=last"
+                f"&scope=fleet&since=600&step=60"
+            ))
+            assert doc["points"], doc
+            summary = json.loads(_get(f"{base}/api/fleet/summary"))
+            assert {t["key"] for t in summary["targets"]} >= {j1, j2}
+            assert "SLO" in _get(f"{base}/fleet")
+
+            # -- kill one job: gauges evict, counters survive -------------
+            assert daemon.kill(j2)
+            daemon.wait_job(j2, 60)
+
+            def bob_evicted():
+                t = _get(f"{base}/metrics/fleet")
+                return t if ('tony_goodput_ratio{scope="tenant",'
+                             'tenant="bob"}') not in t else None
+
+            text = _poll(30, bob_evicted, "killed job's gauges to evict")
+            after = _sample(text, 'train_steps_total{scope="fleet"}')
+            assert after is not None and after >= fleet_steps
+            assert _sample(
+                text, 'train_steps_total{scope="tenant",tenant="bob"}'
+            ) == bob
+        finally:
+            server.stop()
+            daemon.kill(j1)
+            daemon.wait_job(j1, 60)
+
+    # -- the store survives the process -----------------------------------
+    replayed = TimeSeriesStore(tsdb_dir)
+    assert "train_steps_total|fleet" in replayed.names()
+    until = replayed.latest_ms()
+    rows = replayed.query("train_steps_total|fleet",
+                          since_ms=until - 600_000, until_ms=until,
+                          step_s=60, agg="last")
+    assert rows and rows[-1][1] >= 2

@@ -105,6 +105,23 @@ _MIN_ONE_KEYS = frozenset({
     # A zero-trial autotune search measures nothing and would persist
     # an empty record as if it were a tuned one.
     keys.K_TUNE_TRIAL_BUDGET,
+    # A zero-interval rollup tick spins the collector; a zero staleness
+    # bound evicts every target between any two scrapes; a zero scrape
+    # timeout fails every scrape; zero retention at any resolution
+    # discards a tier the query planner assumes exists; a history cap
+    # of 0 would persist an empty timeline for every job.
+    keys.K_ROLLUP_INTERVAL_MS,
+    keys.K_ROLLUP_STALE_AFTER_MS,
+    keys.K_ROLLUP_SCRAPE_TIMEOUT_MS,
+    keys.K_ROLLUP_RETENTION_RAW_S,
+    keys.K_ROLLUP_RETENTION_1M_S,
+    keys.K_ROLLUP_RETENTION_10M_S,
+    # Zero-width SLO windows average nothing; a zero budget period
+    # divides the burn extrapolation by nothing.
+    keys.K_SLO_FAST_WINDOW_S,
+    keys.K_SLO_SLOW_WINDOW_S,
+    keys.K_SLO_BUDGET_PERIOD_S,
+    keys.K_HISTORY_MAX_EVENTS,
 })
 
 # Float keys that must be strictly positive: a zero straggler threshold
@@ -119,6 +136,9 @@ _POSITIVE_FLOAT_KEYS = frozenset({
     # A zero (or nan — the finite check above) shrink floor would let
     # elastic shrink walk a gang down to nothing one loss at a time.
     keys.K_HEAL_MIN_SHRINK_FRACTION,
+    # A zero burn threshold declares every objective permanently
+    # breached (burn rates are positive whenever data exists).
+    keys.K_SLO_BURN_THRESHOLD,
 })
 
 _TRUE_FALSE = frozenset(

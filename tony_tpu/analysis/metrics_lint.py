@@ -33,6 +33,13 @@ spelling silently reads zeros. The rule:
   rot — this is what let render-only names escape TONY-M001 before
   the declared-constant convention existed).
 
+TONY-M003 guards the other axis — label CARDINALITY: a label value fed
+from a request id, step counter, timestamp, or uuid mints one new
+series per occurrence, growing the registry (and every scrape, rollup
+fold, and TSDB retention window downstream) without bound. Flagged at
+registration sites; waivable per line with
+``# tony: noqa[TONY-M003] — justification``.
+
 Run from ``tools/lint_self.py`` over this repo (tier-1), and available
 to ``run_preflight`` consumers as a plain findings producer.
 """
@@ -48,6 +55,7 @@ from tony_tpu.observability.metrics import validate_metric_name
 
 RULE = "TONY-M001"
 RULE_DECLARED = "TONY-M002"
+RULE_CARDINALITY = "TONY-M003"
 
 _REGISTER_ATTRS = {"counter": "counter", "gauge": "gauge",
                    "histogram": "histogram"}
@@ -273,6 +281,99 @@ def check_observability_docs(docs: "str | Path") -> list[Finding]:
                 f"key off this name",
                 file=str(docs), line=0,
             ))
+    return findings
+
+
+# TONY-M003: label-cardinality lint. A labeled child is a whole new
+# series per distinct label VALUE; a label fed from a request id, step
+# counter, sequence number, timestamp, or uuid mints unbounded series —
+# the registry grows without bound, every scrape and rollup fold pays
+# for it, and the TSDB retains garbage forever. The lint inspects the
+# ``labels={...}`` dict at every statically-visible registration call
+# and flags values whose feeding identifiers look like per-occurrence
+# ids. Bounded-by-construction labels (enum states, phase names, task
+# names within one job's registry) pass. Waivable per line with
+# ``# tony: noqa[TONY-M003] — justification`` for labels that look
+# unbounded but are provably not.
+_UNBOUNDED_ID_RE = re.compile(
+    r"(^|_)(request|req|rid|seq|seqno|step|steps|ts|ts_ms|ts_s|time_ms"
+    r"|timestamp|uuid|guid|nonce|trace|span|attempt|incarnation)(_|$)",
+)
+_NOQA_CARDINALITY = "tony: noqa[TONY-M003]"
+
+
+def _unbounded_identifiers(value: ast.AST) -> list[str]:
+    """Identifiers inside a label-value expression that look like
+    per-occurrence ids (the unbounded-cardinality tell)."""
+    hits: list[str] = []
+    for node in ast.walk(value):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident and _UNBOUNDED_ID_RE.search(ident):
+            hits.append(ident)
+    return hits
+
+
+def check_label_cardinality(
+    paths: "list[str | Path]",
+    trees: "list[tuple[Path, ast.AST]] | None" = None,
+) -> list[Finding]:
+    """TONY-M003 (see comment above): flag registration-site label
+    values fed from unbounded identifiers."""
+    if trees is None:
+        trees = parse_metric_trees(paths)
+    findings: list[Finding] = []
+    lines_cache: dict[str, list[str]] = {}
+
+    def waived(path: Path, lineno: int) -> bool:
+        key = str(path)
+        if key not in lines_cache:
+            try:
+                lines_cache[key] = path.read_text().splitlines()
+            except OSError:
+                lines_cache[key] = []
+        lines = lines_cache[key]
+        return (0 < lineno <= len(lines)
+                and _NOQA_CARDINALITY in lines[lineno - 1])
+
+    for path, tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if attr not in _REGISTER_ATTRS:
+                continue
+            labels = next(
+                (kw.value for kw in node.keywords if kw.arg == "labels"),
+                None,
+            )
+            if not isinstance(labels, ast.Dict):
+                continue
+            for key_node, value_node in zip(labels.keys, labels.values):
+                if isinstance(value_node, ast.Constant):
+                    continue  # a literal label value is one series
+                hits = _unbounded_identifiers(value_node)
+                if not hits:
+                    continue
+                if waived(path, value_node.lineno):
+                    continue
+                label = (key_node.value
+                         if isinstance(key_node, ast.Constant) else "?")
+                findings.append(Finding(
+                    RULE_CARDINALITY, ERROR,
+                    f"label {label!r} on this {attr} registration is fed "
+                    f"from {', '.join(sorted(set(hits)))!s} — a "
+                    f"per-occurrence id mints unbounded series "
+                    f"(cardinality explosion); aggregate it away or put "
+                    f"it in an event, not a label",
+                    file=str(path), line=value_node.lineno,
+                ))
     return findings
 
 

@@ -645,12 +645,16 @@ def doctor_cmd(argv: list[str]) -> int:
         print(f"no artifacts found for {args.app_id} — nothing to "
               f"diagnose", file=sys.stderr)
         return 1
+    from tony_tpu.history.reader import events_truncation
+
+    truncated = events_truncation(events)
     findings = diagnose(events=events, final=final,
                         blackboxes=blackboxes, health=health)
     if args.as_json:
         print(_json.dumps({
             "app_id": args.app_id,
             "state": (final or {}).get("state"),
+            "events_truncated": truncated,
             "findings": [
                 {"rule_id": f.rule_id, "score": f.score, "cause": f.cause,
                  "task": f.task, "evidence": list(f.evidence)}
@@ -659,6 +663,94 @@ def doctor_cmd(argv: list[str]) -> int:
         }, indent=2))
         return 0
     print(format_report(args.app_id, findings, final=final))
+    if truncated:
+        print(f"(timeline truncated: {truncated['dropped']} mid-run "
+              f"events dropped by tony.history.max-events — the "
+              f"diagnosis saw an incomplete timeline)")
+    return 0
+
+
+def _history_server_get(server: str, path: str, timeout_s: float = 5.0):
+    """One GET against the history server's fleet metrics plane.
+    Returns the parsed JSON or raises OSError/ValueError."""
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{server}{path}",
+                                timeout=timeout_s) as resp:
+        return _json.loads(resp.read())
+
+
+def _history_server_default(conf) -> str:
+    """The default --server target: localhost on tony.http.port when it
+    is numeric, else the reference's default history port."""
+    port = conf.get_str(keys.K_HTTP_PORT, "disabled")
+    try:
+        return f"127.0.0.1:{int(port)}"
+    except ValueError:
+        return "127.0.0.1:19886"
+
+
+def query_cmd(argv: list[str]) -> int:
+    """``cli query <series>``: a range read over the fleet rollup TSDB
+    via the history server's /api/query — rolled-up series like
+    ``tony_goodput_ratio`` or ``tony_serving_ttft_ms:p95``, at fleet,
+    cluster, or per-tenant scope, at a chosen step/aggregation."""
+    import argparse
+    import json as _json
+    import time as _time
+
+    p = argparse.ArgumentParser(
+        prog="tony_tpu.client.cli query",
+        description="Query the fleet rollup time-series store.",
+    )
+    p.add_argument("name",
+                   help="rolled-up series name (e.g. tony_goodput_ratio, "
+                        "tony_serving_ttft_ms:p95)")
+    p.add_argument("--agg", default="avg",
+                   choices=("avg", "sum", "min", "max", "last", "count"))
+    p.add_argument("--tenant", default=None,
+                   help="narrow to one tenant's rollup scope")
+    p.add_argument("--scope", default=None,
+                   help="cluster|fleet (default fleet; ignored with "
+                        "--tenant)")
+    p.add_argument("--since", type=int, default=3600,
+                   help="lookback window, seconds (default 3600)")
+    p.add_argument("--step", type=int, default=60,
+                   help="bucket width, seconds (default 60)")
+    p.add_argument("--server", default=None,
+                   help="history server host:port (default: localhost on "
+                        "tony.http.port)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(conf_file=args.conf_file)
+    server = args.server or _history_server_default(conf)
+    q = f"/api/query?name={args.name}&agg={args.agg}" \
+        f"&since={args.since}&step={args.step}"
+    if args.tenant:
+        q += f"&tenant={args.tenant}"
+    elif args.scope:
+        q += f"&scope={args.scope}"
+    try:
+        doc = _history_server_get(server, q)
+    except (OSError, ValueError) as exc:
+        print(f"query failed against {server}: {exc}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps(doc, indent=2))
+        return 0
+    points = doc.get("points") or []
+    print(f"# {doc.get('name')} scope={doc.get('scope')} "
+          f"agg={doc.get('agg')} step={doc.get('step_s')}s "
+          f"({len(points)} point(s))")
+    for ts_ms, value in points:
+        stamp = _time.strftime("%Y-%m-%d %H:%M:%S",
+                               _time.localtime(ts_ms / 1000))
+        print(f"{stamp}  {value}")
     return 0
 
 
@@ -1324,20 +1416,78 @@ def _print_fleets(fleets: dict, jobs_by_id: dict | None = None) -> None:
                   f"{' DRAINING' if rep.get('draining') else ''}")
 
 
-def fleet_cmd(argv: list[str]) -> int:
-    """``cli fleet <create|status|scale|ps>``: autoscaled serving
-    replica groups on the scheduler daemon (fleet/ subsystem).
-    ``create``/``scale`` need the live daemon; ``status``/``ps`` fall
-    back live API -> scheduler-state.json (-> job history for ps)."""
+def _fleet_top(argv: list[str]) -> int:
+    """``cli fleet top``: the one-scrape fleet view from the history
+    server's rollup — SLO burn rates, live scrape targets, and the
+    headline rolled-up gauges (the CLI twin of the /fleet panel)."""
     import argparse
     import json as _json
 
-    subs = ("create", "status", "scale", "ps")
+    p = argparse.ArgumentParser(prog="tony_tpu.client.cli fleet top")
+    p.add_argument("--server", default=None,
+                   help="history server host:port (default: localhost on "
+                        "tony.http.port)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    from tony_tpu.conf.configuration import load_job_config
+
+    conf = load_job_config(conf_file=args.conf_file)
+    server = args.server or _history_server_default(conf)
+    try:
+        summary = _history_server_get(server, "/api/fleet/summary")
+    except (OSError, ValueError) as exc:
+        print(f"no fleet rollup reachable at {server}: {exc}",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(_json.dumps(summary, indent=2))
+        return 0
+    targets = summary.get("targets") or []
+    print(f"# fleet rollup @ {server} — {len(targets)} live target(s)")
+    slo = summary.get("slo") or {}
+    breached = set(summary.get("breached") or [])
+    if slo:
+        print("## SLOs")
+        for name in sorted(slo):
+            s = slo[name] or {}
+            status = "BURNING" if name in breached else "ok"
+            print(f"{name:24s} target {s.get('target')} "
+                  f"actual {s.get('fast')} "
+                  f"burn {s.get('burn_fast', '-')}/{s.get('burn_slow', '-')} "
+                  f"budget {s.get('budget_remaining', '-')} [{status}]")
+    if targets:
+        print("## targets")
+        for t in targets:
+            print(f"{t.get('key'):28s} {t.get('kind'):10s} "
+                  f"tenant={t.get('tenant') or '-':10s} "
+                  f"{t.get('addr'):22s} failures={t.get('failures')}")
+    tsdb = summary.get("tsdb") or {}
+    print(f"## tsdb: {tsdb.get('series')} series, "
+          f"{tsdb.get('raw_points')} raw points, "
+          f"{tsdb.get('bucket_cells')} downsampled cells, "
+          f"{tsdb.get('disk_bytes')} bytes on disk")
+    return 0
+
+
+def fleet_cmd(argv: list[str]) -> int:
+    """``cli fleet <create|status|scale|ps|top>``: autoscaled serving
+    replica groups on the scheduler daemon (fleet/ subsystem).
+    ``create``/``scale`` need the live daemon; ``status``/``ps`` fall
+    back live API -> scheduler-state.json (-> job history for ps);
+    ``top`` reads the history server's fleet rollup (SLOs + targets)."""
+    import argparse
+    import json as _json
+
+    subs = ("create", "status", "scale", "ps", "top")
     if not argv or argv[0] not in subs:
         print(f"usage: python -m tony_tpu.client.cli fleet "
               f"<{'|'.join(subs)}> [options]", file=sys.stderr)
         return 2
     sub, rest = argv[0], argv[1:]
+    if sub == "top":
+        return _fleet_top(rest)
     p = argparse.ArgumentParser(prog=f"tony_tpu.client.cli fleet {sub}")
     p.add_argument("--scheduler", default=None,
                    help="daemon host:port (default: tony.scheduler.address)")
@@ -1545,6 +1695,7 @@ SUBMITTERS = {
     "cleanup": cleanup_resources,
     "events": events_cmd,
     "metrics": metrics_cmd,
+    "query": query_cmd,
     "top": top_cmd,
     "doctor": doctor_cmd,
     "goodput": goodput_cmd,

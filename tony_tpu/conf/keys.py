@@ -410,6 +410,13 @@ K_SCHED_HA_LEASE_MS = SCHEDULER_PREFIX + "ha-lease-ms"
 # the last snapshot, the next publish folds them in and truncates the
 # journal (recovery replays at most this many records).
 K_SCHED_HA_JOURNAL_MAX = SCHEDULER_PREFIX + "ha-journal-max-records"
+# Size/age companions to the record-count threshold: the journal also
+# rotates once its on-disk byte size or oldest-record age crosses these
+# (0 = that dimension disabled). A quiet fleet with a chatty metric
+# stream should not grow an unbounded journal just because record COUNT
+# stays under ha-journal-max-records between publishes.
+K_SCHED_JOURNAL_MAX_BYTES = SCHEDULER_PREFIX + "journal-max-bytes"
+K_SCHED_JOURNAL_MAX_AGE_MS = SCHEDULER_PREFIX + "journal-max-age-ms"
 # Run each attempt's coordinator as a DETACHED subprocess
 # (start_new_session) instead of a daemon thread: the attempt survives
 # the daemon's death, and a recovered/standby daemon re-attaches it via
@@ -436,6 +443,12 @@ K_STAGING_LOCATION = TONY_PREFIX + "staging.location"    # dir or gs:// URI
 K_STAGING_BLOB_MAX_BYTES = TONY_PREFIX + "staging.blob-store-max-bytes"
 K_LIB_PATH = TONY_PREFIX + "lib.path"                    # staged framework copy for executors
 K_HISTORY_LOCATION = TONY_PREFIX + "history.location"
+# Cap on events persisted per job into history (history/writer.py).
+# Past the cap the MIDDLE of the timeline is dropped — the submission
+# edge and the death edge are what debugging needs — and a
+# ``{"truncated": true, "dropped": N}`` marker record is written where
+# the gap is, which the reader and ``tony doctor`` surface.
+K_HISTORY_MAX_EVENTS = TONY_PREFIX + "history.max-events"
 # CheckpointManager directory (dir or gs:// URI). When set, the coordinator
 # probes it between sessions for the newest complete step: retried tasks
 # get TONY_RESUME_STEP/TONY_CHECKPOINT_DIR, and progress refreshes the
@@ -454,6 +467,45 @@ K_HTTPS_PORT = TONY_PREFIX + "https.port"
 K_HTTPS_CERT = TONY_PREFIX + "https.cert"                # PEM cert chain path
 K_HTTPS_KEY = TONY_PREFIX + "https.key"                  # PEM private key path
 K_SECRET_KEY = TONY_PREFIX + "secret.key"
+
+# --- fleet observability rollup (observability/rollup.py, hosted by the
+# history server) ------------------------------------------------------------
+ROLLUP_PREFIX = TONY_PREFIX + "rollup."
+K_ROLLUP_ENABLED = ROLLUP_PREFIX + "enabled"
+# Collector tick period (discover + scrape + fold + record), ms.
+K_ROLLUP_INTERVAL_MS = ROLLUP_PREFIX + "interval-ms"
+# A target that stops answering keeps serving its last-good snapshot
+# until this staleness bound, then its gauges/histograms are evicted
+# from the fleet view (counter totals persist — the work happened).
+K_ROLLUP_STALE_AFTER_MS = ROLLUP_PREFIX + "stale-after-ms"
+# Per-target scrape timeout, ms. One slow coordinator must not stretch
+# the whole tick past the interval.
+K_ROLLUP_SCRAPE_TIMEOUT_MS = ROLLUP_PREFIX + "scrape-timeout-ms"
+# TSDB retention per resolution, seconds: raw tick samples, 1-minute
+# downsamples, 10-minute downsamples. Queries pick the finest
+# resolution whose retention still covers the requested range.
+K_ROLLUP_RETENTION_RAW_S = ROLLUP_PREFIX + "retention-raw-s"
+K_ROLLUP_RETENTION_1M_S = ROLLUP_PREFIX + "retention-1m-s"
+K_ROLLUP_RETENTION_10M_S = ROLLUP_PREFIX + "retention-10m-s"
+
+# --- SLO objectives over the rolled-up series (observability/rollup.py) -----
+SLO_PREFIX = TONY_PREFIX + "slo."
+K_SLO_ENABLED = SLO_PREFIX + "enabled"
+# Objective targets. Goodput/MFU are floors (burn = target/actual);
+# TTFT is a ceiling (burn = actual/target); 0 disables that objective.
+# MFU ships disabled — absolute MFU varies too much across hardware for
+# a default floor to mean anything.
+K_SLO_GOODPUT_RATIO_TARGET = SLO_PREFIX + "goodput-ratio-target"
+K_SLO_SERVING_TTFT_P95_MS = SLO_PREFIX + "serving-ttft-p95-ms"
+K_SLO_MFU_FLOOR = SLO_PREFIX + "mfu-floor"
+# Multi-window burn evaluation: breach requires BOTH the fast and slow
+# window's burn rate past the threshold (fast = responsive, slow =
+# flap-resistant). Budget-period scales burn into an error-budget-
+# remaining estimate (default 30 days).
+K_SLO_FAST_WINDOW_S = SLO_PREFIX + "fast-window-s"
+K_SLO_SLOW_WINDOW_S = SLO_PREFIX + "slow-window-s"
+K_SLO_BURN_THRESHOLD = SLO_PREFIX + "burn-threshold"
+K_SLO_BUDGET_PERIOD_S = SLO_PREFIX + "budget-period-s"
 
 # --- client ---------------------------------------------------------------
 K_CLIENT_MONITOR_INTERVAL_MS = TONY_PREFIX + "client.monitor-interval"
@@ -596,6 +648,8 @@ DEFAULTS: dict[str, object] = {
     K_SCHED_HA_NODE_ID: "",
     K_SCHED_HA_LEASE_MS: 5000,
     K_SCHED_HA_JOURNAL_MAX: 4096,
+    K_SCHED_JOURNAL_MAX_BYTES: 16777216,
+    K_SCHED_JOURNAL_MAX_AGE_MS: 86400000,
     K_SCHED_DETACHED: False,
     K_SCHED_CLIENT_RETRIES: 5,
     K_SCHED_CLIENT_BACKOFF_MS: 250,
@@ -603,8 +657,24 @@ DEFAULTS: dict[str, object] = {
     K_STAGING_BLOB_MAX_BYTES: 0,
     K_LIB_PATH: "",
     K_HISTORY_LOCATION: "",
+    K_HISTORY_MAX_EVENTS: 20000,
     K_CHECKPOINT_LOCATION: "",
     K_FAULT_PLAN: "",
+    K_ROLLUP_ENABLED: True,
+    K_ROLLUP_INTERVAL_MS: 15000,
+    K_ROLLUP_STALE_AFTER_MS: 120000,
+    K_ROLLUP_SCRAPE_TIMEOUT_MS: 2000,
+    K_ROLLUP_RETENTION_RAW_S: 3600,
+    K_ROLLUP_RETENTION_1M_S: 86400,
+    K_ROLLUP_RETENTION_10M_S: 604800,
+    K_SLO_ENABLED: True,
+    K_SLO_GOODPUT_RATIO_TARGET: 0.9,
+    K_SLO_SERVING_TTFT_P95_MS: 2000.0,
+    K_SLO_MFU_FLOOR: 0.0,
+    K_SLO_FAST_WINDOW_S: 300,
+    K_SLO_SLOW_WINDOW_S: 3600,
+    K_SLO_BURN_THRESHOLD: 1.0,
+    K_SLO_BUDGET_PERIOD_S: 2592000,
     K_HTTP_PORT: "disabled",
     K_HTTPS_PORT: 19886,
     K_HTTPS_CERT: "",

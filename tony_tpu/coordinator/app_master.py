@@ -1524,7 +1524,11 @@ class TonyCoordinator:
             # page shows only config, JobConfigPageController.java:25-59),
             # along with the lifecycle timeline and the job trace.
             write_final_status(job_dir, final)
-            write_events_file(job_dir, self.events.to_dicts())
+            write_events_file(
+                job_dir, self.events.to_dicts(),
+                max_events=self.conf.get_int(keys.K_HISTORY_MAX_EVENTS,
+                                             20000),
+            )
             write_trace_file(job_dir, trace_doc)
             # Every blackbox the job left — the coordinator's own dumps
             # (app dir) and the executors' (logs dir) — rides into

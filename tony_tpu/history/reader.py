@@ -140,6 +140,20 @@ def job_events(
     return None
 
 
+def events_truncation(events: "list[dict] | None") -> "dict | None":
+    """The mid-timeline truncation marker ``write_events_file`` embeds
+    when a job's event count exceeded ``tony.history.max-events``:
+    ``{"dropped": N, "ts_ms": ...}`` or None when the persisted timeline
+    is complete. Timeline consumers (history pages, ``tony doctor``)
+    use this to say the record is incomplete instead of silently
+    presenting a partial timeline as whole."""
+    for e in events or []:
+        if isinstance(e, dict) and e.get("truncated") is True:
+            return {"dropped": int(e.get("dropped") or 0),
+                    "ts_ms": int(e.get("ts_ms") or 0)}
+    return None
+
+
 def job_trace(history_location: str | Path, app_id: str) -> dict | None:
     """One job's merged Chrome trace document (``trace.json``)."""
     return _job_json(history_location, app_id, "trace.json")

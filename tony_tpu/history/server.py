@@ -50,6 +50,7 @@ class HistoryHandler(BaseHTTPRequestHandler):
     history_location: str = "."
     scheduler_dir: str = ""  # "" = no queue/pool panel
     cache: TtlCache = TtlCache(ttl_s=30.0)
+    rollup = None  # FleetRollup when the fleet metrics plane is enabled
 
     # -- routes -------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -58,6 +59,27 @@ class HistoryHandler(BaseHTTPRequestHandler):
                 self._send_html(self._jobs_page())
             elif self.path == "/scheduler":
                 self._send_html(self._scheduler_page())
+            elif self.path == "/metrics/fleet":
+                if self.rollup is None:
+                    self.send_error(404, "fleet rollup not enabled")
+                else:
+                    data = self.rollup.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+            elif self.path.startswith("/api/query"):
+                self._query_api()
+            elif self.path == "/api/fleet/summary":
+                if self.rollup is None:
+                    self._send_json({"error": "fleet rollup not enabled"},
+                                    status=404)
+                else:
+                    self._send_json(self.rollup.summary())
+            elif self.path == "/fleet":
+                self._send_html(self._fleet_page())
             elif self.path == "/api/scheduler":
                 state, _ = self._scheduler_state()
                 if state is None:
@@ -97,6 +119,101 @@ class HistoryHandler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt: str, *args) -> None:
         log.debug("http: " + fmt, *args)
+
+    # -- fleet metrics plane -------------------------------------------------
+    def _query_api(self) -> None:
+        """``GET /api/query?name=&agg=&tenant=&since=&step=&scope=`` — a
+        range read over the rollup TSDB. ``name`` is a rolled-up sample
+        key (``tony_goodput_ratio``, ``tony_serving_ttft_ms:p95``);
+        ``since``/``step`` are seconds."""
+        from urllib.parse import parse_qs, urlparse
+
+        if self.rollup is None:
+            self._send_json({"error": "fleet rollup not enabled"},
+                            status=404)
+            return
+        q = parse_qs(urlparse(self.path).query)
+
+        def one(key: str, default: str = "") -> str:
+            vals = q.get(key)
+            return vals[0] if vals else default
+
+        name = one("name")
+        if not name:
+            self._send_json({"error": "missing required param `name`"},
+                            status=400)
+            return
+        try:
+            doc = self.rollup.query_series(
+                name,
+                agg=one("agg", "avg"),
+                tenant=one("tenant") or None,
+                since_s=int(one("since", "3600")),
+                step_s=int(one("step", "60")),
+                scope=one("scope") or None,
+            )
+        except ValueError as exc:
+            self._send_json({"error": str(exc)}, status=400)
+            return
+        self._send_json(doc)
+
+    def _fleet_page(self) -> str:
+        """The Fleet panel: SLO burn table, live scrape targets, and the
+        headline rolled-up gauges — the human twin of /metrics/fleet."""
+        if self.rollup is None:
+            return _PAGE.format(
+                title="Fleet",
+                body="<p>fleet rollup not enabled (tony.rollup.enabled "
+                     "with a scheduler base dir)</p>",
+            )
+        esc = lambda v: html.escape(str(v))  # noqa: E731
+        summary = self.rollup.summary()
+        snap = self.rollup.fleet_snapshot()
+        slo_rows = []
+        for name, state in sorted((summary.get("slo") or {}).items()):
+            breached = name in (summary.get("breached") or [])
+            slo_rows.append(
+                f"<tr><td>{esc(name)}</td><td>{esc(state.get('series'))}</td>"
+                f"<td>{esc(state.get('target'))}</td>"
+                f"<td>{esc(state.get('fast'))}</td>"
+                f"<td>{esc(state.get('burn_fast', '-'))}</td>"
+                f"<td>{esc(state.get('burn_slow', '-'))}</td>"
+                f"<td>{esc(state.get('budget_remaining', '-'))}</td>"
+                f"<td class='{'FAILED' if breached else 'SUCCEEDED'}'>"
+                f"{'BURNING' if breached else 'ok'}</td></tr>"
+            )
+        target_rows = [
+            f"<tr><td>{esc(t.get('key'))}</td><td>{esc(t.get('kind'))}</td>"
+            f"<td>{esc(t.get('tenant') or '-')}</td>"
+            f"<td>{esc(t.get('addr'))}</td>"
+            f"<td>{esc(t.get('age_ms'))}</td>"
+            f"<td>{esc(t.get('failures'))}</td></tr>"
+            for t in summary.get("targets") or []
+        ]
+        gauge_rows = [
+            f"<tr><td>{esc(key)}</td><td>{esc(round(value, 6))}</td></tr>"
+            for key, value in sorted(snap.get("gauges", {}).items())[:64]
+        ]
+        tsdb = summary.get("tsdb") or {}
+        body = (
+            "<h3>SLOs</h3><table><tr><th>objective</th><th>series</th>"
+            "<th>target</th><th>actual</th><th>burn (fast)</th>"
+            "<th>burn (slow)</th><th>budget left</th><th></th></tr>"
+            + "".join(slo_rows) + "</table>"
+            "<h3>Scrape targets</h3><table><tr><th>target</th>"
+            "<th>kind</th><th>tenant</th><th>addr</th><th>age ms</th>"
+            "<th>failures</th></tr>" + "".join(target_rows) + "</table>"
+            "<h3>Rolled-up gauges</h3><table><tr><th>series</th>"
+            "<th>value</th></tr>" + "".join(gauge_rows) + "</table>"
+            f"<p>tsdb: {esc(tsdb.get('series'))} series &middot; "
+            f"{esc(tsdb.get('raw_points'))} raw points &middot; "
+            f"{esc(tsdb.get('bucket_cells'))} downsampled cells &middot; "
+            f"{esc(tsdb.get('disk_bytes'))} bytes on disk</p>"
+            "<p><a href='/metrics/fleet'>prometheus</a> · "
+            "<a href='/api/fleet/summary'>json</a> · "
+            "<a href='/'>jobs</a></p>"
+        )
+        return _PAGE.format(title="Fleet", body=body)
 
     # -- data (cached scans) -------------------------------------------------
     def _jobs(self):
@@ -145,9 +262,14 @@ class HistoryHandler(BaseHTTPRequestHandler):
             "<table><tr><th>job</th><th>started</th><th>completed</th>"
             f"<th>user</th><th>status</th><th></th></tr>{rows}</table>"
         )
+        links = []
         if self.scheduler_dir:
-            body = ("<p><a href='/scheduler'>scheduler queue &amp; "
-                    "pool</a></p>") + body
+            links.append("<a href='/scheduler'>scheduler queue &amp; "
+                         "pool</a>")
+        if self.rollup is not None:
+            links.append("<a href='/fleet'>fleet metrics &amp; SLOs</a>")
+        if links:
+            body = f"<p>{' · '.join(links)}</p>" + body
         return _PAGE.format(title="Jobs", body=body)
 
     # -- scheduler queue/pool panel ------------------------------------------
@@ -638,8 +760,19 @@ class HistoryHandler(BaseHTTPRequestHandler):
         events = self._events(app_id)
         if not events:
             return []
-        parts = ["<h3>Timeline</h3><table><tr><th>time</th><th>event</th>"
-                 "<th>task</th><th>detail</th></tr>"]
+        from tony_tpu.history.reader import events_truncation
+
+        truncated = events_truncation(events)
+        events = [e for e in events if not e.get("truncated")]
+        parts = ["<h3>Timeline</h3>"]
+        if truncated:
+            parts.append(
+                f"<p>(timeline truncated at persist: "
+                f"{truncated['dropped']} mid-run events dropped by "
+                f"tony.history.max-events)</p>"
+            )
+        parts.append("<table><tr><th>time</th><th>event</th>"
+                     "<th>task</th><th>detail</th></tr>")
         shown = events[:500]
         for e in shown:
             detail = ", ".join(
@@ -689,6 +822,35 @@ class HistoryHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
 
+def build_rollup(conf, history_location: str | None,
+                 scheduler_dir: str | None):
+    """The history server's fleet metrics plane, when it applies:
+    ``tony.rollup.enabled`` (default on), a scheduler base dir to
+    discover targets from, and a LOCAL history dir to persist the TSDB
+    beside (``<history>/fleet-tsdb/`` — a gs:// history location gets
+    an in-memory store; chunk persistence is a local-disk seam).
+    Returns None when disabled or undiscoverable."""
+    from tony_tpu.conf import keys
+
+    if not scheduler_dir:
+        return None
+    if not conf.get_bool(keys.K_ROLLUP_ENABLED, True):
+        return None
+    from pathlib import Path
+
+    from tony_tpu.observability.events import EventLog, jsonl_file_sink
+    from tony_tpu.observability.rollup import FleetRollup
+
+    tsdb_dir = None
+    events = None
+    if history_location and "://" not in str(history_location):
+        tsdb_dir = Path(history_location) / "fleet-tsdb"
+        tsdb_dir.mkdir(parents=True, exist_ok=True)
+        events = EventLog(sink=jsonl_file_sink(tsdb_dir / "events.jsonl"))
+    return FleetRollup.from_conf(conf, scheduler_dir, tsdb_dir=tsdb_dir,
+                                 events=events)
+
+
 class HistoryServer:
     """Binds localhost by default (serving job metadata to the open network
     is an explicit opt-in via ``host="0.0.0.0"``); HTTPS when a PEM
@@ -703,11 +865,13 @@ class HistoryServer:
         certfile: str | None = None,
         keyfile: str | None = None,
         scheduler_dir: str | None = None,
+        rollup=None,
     ) -> None:
+        self.rollup = rollup
         handler = type(
             "BoundHandler", (HistoryHandler,),
             {"history_location": history_location, "cache": TtlCache(30.0),
-             "scheduler_dir": scheduler_dir or ""},
+             "scheduler_dir": scheduler_dir or "", "rollup": rollup},
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.scheme = "http"
@@ -734,6 +898,7 @@ class HistoryServer:
 
         location = history_location or conf.get_str(keys.K_HISTORY_LOCATION)
         sched_dir = conf.get_str(keys.K_SCHED_BASE_DIR) or None
+        rollup = build_rollup(conf, location, sched_dir)
         cert = conf.get_str(keys.K_HTTPS_CERT) or None
         if cert:
             return cls(
@@ -743,6 +908,7 @@ class HistoryServer:
                 certfile=cert,
                 keyfile=conf.get_str(keys.K_HTTPS_KEY) or None,
                 scheduler_dir=sched_dir,
+                rollup=rollup,
             )
         http_port = conf.get_str(keys.K_HTTP_PORT, "disabled")
         if http_port == "disabled":
@@ -751,7 +917,7 @@ class HistoryServer:
                 f"is configured — nothing to serve on"
             )
         return cls(location, port=int(http_port), host=host,
-                   scheduler_dir=sched_dir)
+                   scheduler_dir=sched_dir, rollup=rollup)
 
     _serving = False
 
@@ -761,10 +927,14 @@ class HistoryServer:
         self._serving = True
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         t.start()
+        if self.rollup is not None:
+            self.rollup.serve_background()
         log.info("history server on %s://localhost:%d", self.scheme, self.port)
         return self.port
 
     def stop(self) -> None:
+        if self.rollup is not None:
+            self.rollup.stop()
         # shutdown() blocks until serve_forever acknowledges — calling it
         # when the loop never started would hang forever.
         if self._serving:
@@ -803,7 +973,9 @@ def main(argv: list[str] | None = None) -> int:
         # never silently downgrade an https deployment to plaintext.
         server = HistoryServer(location, args.port, host=args.host,
                                certfile=cert, keyfile=keyf,
-                               scheduler_dir=sched_dir)
+                               scheduler_dir=sched_dir,
+                               rollup=build_rollup(conf, location,
+                                                   sched_dir))
     else:
         try:
             server = HistoryServer.from_conf(conf, location, host=args.host)
@@ -815,7 +987,11 @@ def main(argv: list[str] | None = None) -> int:
             # Nothing configured at all: starting the server IS the opt-in,
             # so fall back to plain http on the reference's default port.
             server = HistoryServer(location, 19886, host=args.host,
-                                   scheduler_dir=sched_dir)
+                                   scheduler_dir=sched_dir,
+                                   rollup=build_rollup(conf, location,
+                                                       sched_dir))
+    if server.rollup is not None:
+        server.rollup.serve_background()
     print(f"history server on {server.scheme}://localhost:{server.port}")
     try:
         server.httpd.serve_forever()

@@ -152,10 +152,37 @@ def write_final_status(job_dir: "Path | str", final: dict) -> None:
     )
 
 
-def write_events_file(job_dir: "Path | str", events: "list[dict]") -> None:
+def truncate_events(events: "list[dict]",
+                    max_events: int) -> "list[dict]":
+    """Bound a timeline to ``max_events`` records by dropping the MIDDLE:
+    debugging needs the submission edge (what was asked for) and the
+    death edge (what killed it) far more than the steady-state center a
+    chaos run inflates. A ``{"truncated": true, "dropped": N}`` marker
+    record is placed at the gap so the reader and ``tony doctor`` can
+    say the timeline is incomplete instead of silently presenting a
+    partial one as whole. No-op at or under the cap."""
+    if max_events <= 0 or len(events) <= max_events:
+        return events
+    # Reserve one slot for the marker; keep head and tail around it.
+    keep = max(max_events - 1, 2)
+    head = keep // 2
+    tail = keep - head
+    dropped = len(events) - head - tail
+    marker_ts = 0
+    if head and isinstance(events[head - 1], dict):
+        marker_ts = int(events[head - 1].get("ts_ms") or 0)
+    marker = {"truncated": True, "dropped": dropped, "ts_ms": marker_ts}
+    return events[:head] + [marker] + events[len(events) - tail:]
+
+
+def write_events_file(job_dir: "Path | str", events: "list[dict]",
+                      max_events: int = 0) -> None:
     """The job's structured lifecycle timeline (observability/events.py)
     as ``events.jsonl`` — one JSON object per line, so tail-truncated
-    copies still parse line by line."""
+    copies still parse line by line. ``max_events`` > 0 bounds the
+    persisted timeline via ``truncate_events`` (the
+    ``tony.history.max-events`` cap)."""
+    events = truncate_events(events, max_events)
     _write_job_file(
         job_dir, "events.jsonl",
         "".join(json.dumps(e, sort_keys=True) + "\n" for e in events),

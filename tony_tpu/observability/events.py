@@ -96,6 +96,13 @@ FLEET_SCALED = "fleet_scaled"
 REPLICA_LAUNCHED = "replica_launched"
 REPLICA_RETIRED = "replica_retired"
 
+# Fleet observability (observability/rollup.py): a declarative SLO's
+# multi-window burn rate crossed its threshold — the fleet is spending
+# error budget fast enough to exhaust it before the budget period ends.
+# Edge-triggered: one event per breach episode, re-armed when both
+# windows drop back under the threshold.
+SLO_BURN = "slo_burn"
+
 # The event catalogue: every kind any emitter may use. TONY-E001
 # (analysis/events_lint.py, run from tools/lint_self.py in tier-1)
 # checks that every ``.emit(...)`` in the tree uses a registered kind
@@ -139,6 +146,7 @@ KNOWN_KINDS = frozenset({
     FLEET_SCALED,
     REPLICA_LAUNCHED,
     REPLICA_RETIRED,
+    SLO_BURN,
 })
 
 

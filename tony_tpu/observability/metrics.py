@@ -426,6 +426,106 @@ def histogram_quantile(snapshot: Mapping[str, Any], q: float) -> float | None:
     return total / count
 
 
+def merge_histograms(parts: "Iterable[Mapping[str, Any]]") -> dict[str, Any]:
+    """Bucket-aligned merge of histogram snapshots: counts and sums add,
+    cumulative buckets add pointwise. Parts whose bucket boundaries
+    disagree raise ``ValueError`` — a silent merge across mismatched
+    bounds would make ``histogram_quantile`` read garbage, and the
+    rollup plane must drop the series loudly instead. The merged ``max``
+    (the quantile clamp) is kept only when every non-empty part carries
+    one: a partial max would understate quantiles, which is worse than
+    no clamp."""
+    merged_bounds: tuple[float, ...] | None = None
+    cums: list[int] = []
+    count = 0
+    total = 0.0
+    maxes: list[float] = []
+    max_known = True
+    for part in parts:
+        if not part:
+            continue
+        buckets = part.get("buckets") or []
+        bounds = tuple(float(b) for b, _ in buckets)
+        if bounds:
+            if merged_bounds is None:
+                merged_bounds = bounds
+                cums = [0] * len(bounds)
+            elif bounds != merged_bounds:
+                raise ValueError(
+                    "mismatched histogram bucket boundaries: "
+                    f"{list(merged_bounds)} vs {list(bounds)}"
+                )
+            for i, (_, cum) in enumerate(buckets):
+                cums[i] += int(cum)
+        n = int(part.get("count", 0) or 0)
+        count += n
+        total += float(part.get("sum", 0.0) or 0.0)
+        if n > 0:
+            raw_max = part.get("max")
+            if isinstance(raw_max, (int, float)) and math.isfinite(raw_max):
+                maxes.append(float(raw_max))
+            else:
+                max_known = False
+    snap: dict[str, Any] = {
+        "count": count,
+        "sum": total,
+        "buckets": [[b, c] for b, c in zip(merged_bounds or (), cums)],
+    }
+    if count and max_known and maxes:
+        snap["max"] = max(maxes)
+    return snap
+
+
+_GAUGE_AGGS = {
+    "sum": sum,
+    "max": max,
+    "min": min,
+    "avg": lambda vals: sum(vals) / len(vals),
+    "last": lambda vals: vals[-1],
+}
+
+
+def merge_snapshots(
+    snapshots: "Iterable[Mapping[str, Any] | None]",
+    gauge_agg: str = "sum",
+) -> dict[str, Any]:
+    """Union-merge registry snapshots from many processes into one
+    (the rollup plane's fold): counters sum per labeled sample key,
+    gauges fold per key with ``gauge_agg`` (sum|max|min|avg|last),
+    histograms merge bucket-aligned via ``merge_histograms`` (which
+    raises on mismatched boundaries), ``ts_ms`` is the newest part's.
+    None/empty parts are skipped so evicted targets merge cleanly."""
+    fold = _GAUGE_AGGS.get(gauge_agg)
+    if fold is None:
+        raise ValueError(
+            f"unknown gauge_agg {gauge_agg!r} "
+            f"(want one of {sorted(_GAUGE_AGGS)})"
+        )
+    counters: dict[str, float] = {}
+    gauge_parts: dict[str, list[float]] = {}
+    hist_parts: dict[str, list[Mapping[str, Any]]] = {}
+    ts = 0
+    for snap in snapshots:
+        if not snap:
+            continue
+        ts = max(ts, int(snap.get("ts_ms", 0) or 0))
+        for key, value in (snap.get("counters") or {}).items():
+            counters[key] = counters.get(key, 0.0) + float(value)
+        for key, value in (snap.get("gauges") or {}).items():
+            gauge_parts.setdefault(key, []).append(float(value))
+        for key, h in (snap.get("histograms") or {}).items():
+            hist_parts.setdefault(key, []).append(h)
+    return {
+        "ts_ms": ts or int(time.time() * 1000),
+        "counters": counters,
+        "gauges": {key: fold(vals) for key, vals in gauge_parts.items()},
+        "histograms": {
+            key: merge_histograms(parts)
+            for key, parts in hist_parts.items()
+        },
+    }
+
+
 def load_snapshot_file(path: str | os.PathLike[str]) -> dict[str, Any] | None:
     """Read a published snapshot; None when absent or (transiently)
     malformed — a missing snapshot must never fail a heartbeat."""
@@ -490,17 +590,21 @@ def render_prometheus(
         name, inline = split_labeled_key(key)
         header(name, "gauge")
         out.append(f"{name}{_labels(labels, inline)} {_fmt(value)}")
-    for name, h in sorted(snapshot.get("histograms", {}).items()):
+    for key, h in sorted(snapshot.get("histograms", {}).items()):
+        # Histogram sample keys may be labeled too (the rollup plane's
+        # scope-labeled merges): split them like counters/gauges so the
+        # inline labels land in the label block, not inside the name.
+        name, inline_labels = parse_labeled_key(key)
         header(name, "histogram")
-        base = dict(labels or {})
+        base = {**inline_labels, **(labels or {})}
         for bound, cum in h.get("buckets", []):
             out.append(
                 f"{name}_bucket{_labels({**base, 'le': _fmt(bound)})} {cum}"
             )
         out.append(f"{name}_bucket{_labels({**base, 'le': '+Inf'})} "
                    f"{h.get('count', 0)}")
-        out.append(f"{name}_sum{_labels(labels)} {_fmt(h.get('sum', 0.0))}")
-        out.append(f"{name}_count{_labels(labels)} {h.get('count', 0)}")
+        out.append(f"{name}_sum{_labels(base)} {_fmt(h.get('sum', 0.0))}")
+        out.append(f"{name}_count{_labels(base)} {h.get('count', 0)}")
     return "\n".join(out) + ("\n" if out else "")
 
 

@@ -1,0 +1,713 @@
+"""Fleet metrics plane: scraping collector + rollup rules + SLO burn
+rates — one scrape for the whole fleet.
+
+Per-job observability is one coordinator HTTP port per job: "what is my
+fleet's goodput right now" is N scrapes plus hand-joining, and every
+per-job series dies with its coordinator. ``FleetRollup`` closes that
+gap for the history server:
+
+* **discovery** — each tick reads the scheduler's state through the one
+  fallback chain every consumer shares (``scheduler.http.read_state``:
+  live ``/api/state``, else the published ``scheduler-state.json``) and
+  derives the target list: the scheduler daemon itself (its JSON
+  ``/api/metrics``; the fleet router runs in-process there and shares
+  the daemon registry, so router gauges ride this scrape) plus one
+  target per non-terminal job via ``<app_dir>/coordinator.http`` (fleet
+  replicas are ordinary jobs, so they are covered too);
+* **scraping** — each target's ``/api/metrics`` JSON on a tick, with
+  per-target failure counts and staleness eviction: a target that
+  stops answering keeps serving its last-good snapshot until
+  ``stale_after_ms``, then its gauges and histograms vanish — the
+  ``tony_task_heartbeat_age_seconds`` discipline (silence becomes
+  visible, then absence) applied at fleet scope. A target the
+  scheduler no longer lists is evicted immediately;
+* **rollup rules** — per-task/per-job series fold into tenant-, fleet-
+  and cluster-scope aggregates: ``*_total`` counters sum restart-safely
+  (per-source deltas clamped at zero, the ``counter_rate`` discipline,
+  so a restarted task can never subtract from a fleet total); gauges
+  fold by name family (``avg`` for ratios/MFU/utilization, ``max`` for
+  ages, ``sum`` otherwise); histograms merge bucket-aligned via
+  ``metrics.merge_snapshots`` so ``histogram_quantile`` stays valid —
+  a bucket-boundary conflict drops the series LOUDLY
+  (``tony_rollup_histogram_merge_conflicts_total``), never
+  misquantiles;
+* **retention** — every folded series lands in the multi-resolution
+  ``tsdb.TimeSeriesStore`` (series key ``<sample-key>|<scope>``, plus
+  ``:p50/:p95/:p99`` quantile series per merged histogram), so the
+  range API answers about jobs that are gone;
+* **SLOs** — declarative objectives over the rolled-up series (fleet
+  goodput ratio, serving p95 TTFT, MFU floor) evaluated with fast+slow
+  window burn rates (burn 1.0 = exactly on target; breach = BOTH
+  windows past ``tony.slo.burn-threshold``, the multi-window guard
+  against flapping). A breach edge emits one ``slo_burn`` lifecycle
+  event and the ``tony_slo_burn_rate`` /
+  ``tony_slo_error_budget_remaining`` gauges track every objective.
+
+Single-writer: ``tick()`` runs on the rollup thread (or is driven
+synchronously in tests); the render/query entry points are thread-safe.
+Everything here is jax-free — this is control plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+from tony_tpu.analysis import sync_sanitizer as _sync
+from tony_tpu.observability import events as events_mod
+from tony_tpu.observability.aggregator import (
+    HEARTBEAT_AGE_GAUGE,
+    HEARTBEAT_COUNTER,
+    _histogram_family,
+    _numeric_family,
+)
+from tony_tpu.observability.metrics import (
+    MetricsRegistry,
+    _labeled_key,
+    histogram_quantile,
+    json_safe,
+    merge_histograms,
+    parse_labeled_key,
+    render_prometheus,
+)
+from tony_tpu.observability.tsdb import TimeSeriesStore
+
+log = logging.getLogger(__name__)
+
+# Scopes a series can roll up to. ``cluster`` is everything including
+# the scheduler daemon's own registry; ``fleet`` is every job source;
+# ``tenant:<t>`` is the per-tenant slice of the fleet.
+SCOPE_CLUSTER = "cluster"
+SCOPE_FLEET = "fleet"
+
+# Rollup self-metrics (docs/DEPLOY.md "Fleet observability").
+ROLLUP_SCRAPES_COUNTER = "tony_rollup_scrapes_total"
+ROLLUP_SCRAPE_FAILURES_COUNTER = "tony_rollup_scrape_failures_total"
+ROLLUP_EVICTIONS_COUNTER = "tony_rollup_evictions_total"
+ROLLUP_MERGE_CONFLICTS_COUNTER = \
+    "tony_rollup_histogram_merge_conflicts_total"
+ROLLUP_TARGETS_GAUGE = "tony_rollup_targets"
+ROLLUP_TICK_MS_GAUGE = "tony_rollup_tick_ms"
+ROLLUP_SERIES_GAUGE = "tony_rollup_series"
+SLO_BURN_RATE_GAUGE = "tony_slo_burn_rate"
+SLO_BUDGET_GAUGE = "tony_slo_error_budget_remaining"
+
+_ACTIVE_JOB_STATES = ("LAUNCHING", "RUNNING", "PREEMPTING")
+
+QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
+def _gauge_rule(name: str) -> str:
+    """Which fold a gauge family gets at rollup (the rule table in
+    DEPLOY.md): averages for intensive quantities (ratios, MFU,
+    utilization — summing them is meaningless), max for ages (the
+    staleness question is "who is WORST"), sum for everything else
+    (depths, slots, tokens/sec, chip-seconds: extensive quantities)."""
+    if name.endswith("_ratio") or "mfu" in name or name.endswith("_util"):
+        return "avg"
+    if "age_seconds" in name or name.endswith("_age_ms"):
+        return "max"
+    return "sum"
+
+
+_GAUGE_FOLDS: dict[str, Callable[[list], float]] = {
+    "avg": lambda vals: sum(vals) / len(vals),
+    "max": max,
+    "sum": sum,
+}
+
+
+def _default_fetch_json(url: str, timeout_s: float) -> Any:
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class Target:
+    """One scrape target the discovery pass produced."""
+
+    __slots__ = ("key", "kind", "tenant", "addr")
+
+    def __init__(self, key: str, kind: str, addr: str,
+                 tenant: str = "") -> None:
+        self.key = key        # "scheduler" or the job id
+        self.kind = kind      # "scheduler" | "job"
+        self.addr = addr      # host:port
+        self.tenant = tenant  # jobs only
+
+    def scopes(self) -> list[str]:
+        if self.kind == "scheduler":
+            return [SCOPE_CLUSTER]
+        scopes = [SCOPE_CLUSTER, SCOPE_FLEET]
+        if self.tenant:
+            scopes.append(f"tenant:{self.tenant}")
+        return scopes
+
+
+class SloObjective:
+    """One declarative objective over a rolled-up TSDB series.
+
+    ``kind="min"``: actual must stay at or above ``target`` (goodput
+    ratio, MFU floor); burn = target / actual. ``kind="max"``: actual
+    must stay at or below ``target`` (p95 TTFT ceiling); burn = actual
+    / target. Either way burn 1.0 = exactly on target, >1 = spending
+    error budget."""
+
+    __slots__ = ("name", "series", "kind", "target")
+
+    def __init__(self, name: str, series: str, kind: str,
+                 target: float) -> None:
+        if kind not in ("min", "max"):
+            raise ValueError(f"objective kind must be min|max, got {kind!r}")
+        self.name = name
+        self.series = series
+        self.kind = kind
+        self.target = float(target)
+
+    def burn(self, actual: float) -> float:
+        if self.kind == "min":
+            return min(self.target / max(actual, 1e-9), 1000.0)
+        return max(actual, 0.0) / max(self.target, 1e-9)
+
+
+def _scope_labels(scope: str) -> dict[str, str]:
+    if scope.startswith("tenant:"):
+        return {"scope": "tenant", "tenant": scope.split(":", 1)[1]}
+    return {"scope": scope}
+
+
+def _relabel(key: str, scope: str) -> str:
+    """A source sample key re-emitted at a rollup scope: the inline
+    labels survive and the scope labels join them."""
+    name, labels = parse_labeled_key(key)
+    return _labeled_key(name, {**labels, **_scope_labels(scope)})
+
+
+class FleetRollup:
+    """The collector + rollup + SLO engine the history server hosts."""
+
+    def __init__(
+        self,
+        scheduler_dir: "str | Path | None",
+        tsdb: "TimeSeriesStore | None" = None,
+        registry: "MetricsRegistry | None" = None,
+        events: "events_mod.EventLog | None" = None,
+        interval_ms: int = 15000,
+        stale_after_ms: int = 120000,
+        scrape_timeout_ms: int = 2000,
+        objectives: "list[SloObjective] | None" = None,
+        fast_window_s: int = 300,
+        slow_window_s: int = 3600,
+        burn_threshold: float = 1.0,
+        budget_period_s: int = 2592000,
+        clock: Callable[[], float] = time.time,
+        fetch_json: Callable[[str, float], Any] = _default_fetch_json,
+    ) -> None:
+        self.scheduler_dir = Path(scheduler_dir) if scheduler_dir else None
+        self.tsdb = tsdb if tsdb is not None else TimeSeriesStore(None)
+        self.registry = registry or MetricsRegistry()
+        self.events = events
+        self.interval_ms = max(int(interval_ms), 100)
+        self.stale_after_ms = max(int(stale_after_ms), 1000)
+        self.scrape_timeout_s = max(int(scrape_timeout_ms), 100) / 1000.0
+        self.objectives = list(objectives or [])
+        self.fast_window_s = max(int(fast_window_s), 1)
+        self.slow_window_s = max(int(slow_window_s), 1)
+        self.burn_threshold = float(burn_threshold)
+        self.budget_period_s = max(int(budget_period_s), 1)
+        self._clock = clock
+        self._fetch_json = fetch_json
+        self._lock = _sync.make_lock("rollup.FleetRollup._lock")
+        # target key -> {"target", "parts": [snapshots], "ok_ms": last
+        # successful scrape (rollup clock), "failures": consecutive}
+        self._cache: dict[str, dict[str, Any]] = {}
+        # (target key, part id, counter sample key) -> last seen value.
+        self._prev: dict[tuple[str, str, str], float] = {}
+        # scope -> counter sample key -> cumulative folded total. These
+        # survive target eviction on purpose: a finished job's work
+        # happened; only its GAUGES stop being true.
+        self._totals: dict[str, dict[str, float]] = {}
+        # The last fold, render-ready ({counters, gauges, histograms}).
+        self._snapshot: dict[str, Any] = {
+            "ts_ms": 0, "counters": {}, "gauges": {}, "histograms": {},
+        }
+        self._target_failures: dict[str, int] = {}
+        self._breached: set[str] = set()
+        self._slo_state: dict[str, dict[str, Any]] = {}
+        self._ticks = 0
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+
+    # -- conf seam ---------------------------------------------------------
+    @classmethod
+    def from_conf(cls, conf, scheduler_dir, tsdb_dir=None,
+                  events=None, clock=time.time) -> "FleetRollup":
+        from tony_tpu.conf import keys
+
+        tsdb = TimeSeriesStore(
+            tsdb_dir,
+            retention_raw_s=conf.get_int(keys.K_ROLLUP_RETENTION_RAW_S,
+                                         3600),
+            retention_1m_s=conf.get_int(keys.K_ROLLUP_RETENTION_1M_S,
+                                        86400),
+            retention_10m_s=conf.get_int(keys.K_ROLLUP_RETENTION_10M_S,
+                                         604800),
+        )
+        objectives = default_objectives(conf)
+        return cls(
+            scheduler_dir,
+            tsdb=tsdb,
+            events=events,
+            interval_ms=conf.get_int(keys.K_ROLLUP_INTERVAL_MS, 15000),
+            stale_after_ms=conf.get_int(keys.K_ROLLUP_STALE_AFTER_MS,
+                                        120000),
+            scrape_timeout_ms=conf.get_int(keys.K_ROLLUP_SCRAPE_TIMEOUT_MS,
+                                           2000),
+            objectives=objectives,
+            fast_window_s=conf.get_int(keys.K_SLO_FAST_WINDOW_S, 300),
+            slow_window_s=conf.get_int(keys.K_SLO_SLOW_WINDOW_S, 3600),
+            burn_threshold=conf.get_float(keys.K_SLO_BURN_THRESHOLD, 1.0),
+            budget_period_s=conf.get_int(keys.K_SLO_BUDGET_PERIOD_S,
+                                         2592000),
+            clock=clock,
+        )
+
+    # -- discovery ---------------------------------------------------------
+    def discover_targets(self) -> list[Target]:
+        """The scheduler daemon + one target per non-terminal job that
+        has advertised its observability port. No scheduler dir (or no
+        state yet) discovers nothing — the rollup degrades to empty, it
+        never raises out of the tick."""
+        if self.scheduler_dir is None:
+            return []
+        from tony_tpu.scheduler.http import read_state
+
+        targets: list[Target] = []
+        addr_file = self.scheduler_dir / "scheduler.addr"
+        try:
+            sched_addr = addr_file.read_text().strip()
+        except OSError:
+            sched_addr = ""
+        if sched_addr:
+            targets.append(Target("scheduler", "scheduler", sched_addr))
+        state, _source = read_state(self.scheduler_dir, addr=sched_addr
+                                    or None)
+        for job in (state or {}).get("jobs") or []:
+            if not isinstance(job, Mapping):
+                continue
+            if str(job.get("state")) not in _ACTIVE_JOB_STATES:
+                continue
+            app_dir = str(job.get("app_dir") or "")
+            if not app_dir:
+                continue
+            try:
+                addr = (Path(app_dir) / "coordinator.http") \
+                    .read_text().strip()
+            except OSError:
+                continue  # not advertising yet (or obs disabled)
+            if addr:
+                targets.append(Target(
+                    str(job.get("job_id")), "job", addr,
+                    tenant=str(job.get("tenant") or "default"),
+                ))
+        return targets
+
+    # -- scraping ----------------------------------------------------------
+    def _scrape(self, target: Target) -> "list[tuple[str, dict]] | None":
+        """One target's ``/api/metrics`` flattened to (part id, registry
+        snapshot) pairs: the scheduler is one part; a job contributes
+        its coordinator registry, every task snapshot, and a synthesized
+        heartbeat part. None = scrape failed."""
+        try:
+            doc = self._fetch_json(f"http://{target.addr}/api/metrics",
+                                   self.scrape_timeout_s)
+        except Exception:
+            return None
+        if not isinstance(doc, Mapping):
+            return None
+        parts: list[tuple[str, dict]] = []
+        if "counters" in doc or "gauges" in doc or "histograms" in doc:
+            parts.append(("self", _normalize(doc)))     # plain registry
+        coord = doc.get("coordinator")
+        if isinstance(coord, Mapping):
+            parts.append(("coordinator", _normalize(coord)))
+        tasks = doc.get("tasks")
+        if isinstance(tasks, Mapping):
+            for task_id, snap in sorted(tasks.items()):
+                if isinstance(snap, Mapping):
+                    parts.append((f"task:{task_id}", _normalize(snap)))
+        heartbeats = _numeric_family(doc.get("heartbeats"))
+        ages = _numeric_family(doc.get("heartbeat_age_s"))
+        if heartbeats or ages:
+            hb: dict[str, Any] = {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+            if heartbeats:
+                hb["counters"][HEARTBEAT_COUNTER] = \
+                    sum(heartbeats.values())
+            if ages:
+                hb["gauges"][HEARTBEAT_AGE_GAUGE] = max(ages.values())
+            parts.append(("heartbeats", hb))
+        return parts
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self, now_ms: "int | None" = None) -> dict[str, Any]:
+        """One collect → fold → record → evaluate pass. Returns the
+        tick's summary (targets, failures, slo states) — the same doc
+        ``summary()`` serves."""
+        t0 = time.monotonic()
+        now = int(self._clock() * 1000) if now_ms is None else int(now_ms)
+        targets = self.discover_targets()
+        scraped: list[tuple[Target, "list[tuple[str, dict]] | None"]] = [
+            (t, self._scrape(t)) for t in targets
+        ]
+        with self._lock:
+            self._fold(now, scraped)
+            snapshot = self._snapshot
+            values = self._tsdb_values(snapshot)
+        # File I/O and cross-lock work outside our lock.
+        self.tsdb.record_many(now, values)
+        self._ticks += 1
+        if self._ticks % 4 == 0:
+            self.tsdb.checkpoint()
+        self._evaluate_slos(now)
+        self._publish_self_metrics(len(targets), time.monotonic() - t0)
+        return self.summary()
+
+    def _fold(self, now: int,
+              scraped: "list[tuple[Target, list | None]]") -> None:
+        """Caller holds the lock. Updates the scrape cache (success,
+        failure, staleness, disappearance) and rebuilds the rollup
+        snapshot from every live source's parts."""
+        discovered = set()
+        for target, parts in scraped:
+            discovered.add(target.key)
+            entry = self._cache.get(target.key)
+            if parts is not None:
+                self._cache[target.key] = {
+                    "target": target, "parts": parts,
+                    "ok_ms": now, "failures": 0,
+                }
+                self.registry.counter(ROLLUP_SCRAPES_COUNTER).inc()
+            else:
+                self._target_failures[target.key] = \
+                    self._target_failures.get(target.key, 0) + 1
+                self.registry.counter(
+                    ROLLUP_SCRAPE_FAILURES_COUNTER,
+                    labels={"kind": target.kind},
+                ).inc()
+                if entry is not None:
+                    entry["failures"] += 1
+        # Eviction: gone-from-scheduler targets drop now; unreachable
+        # ones age out at stale_after_ms (heartbeat-age semantics).
+        for key in list(self._cache):
+            entry = self._cache[key]
+            stale = now - int(entry.get("ok_ms") or 0) > self.stale_after_ms
+            if key not in discovered or stale:
+                del self._cache[key]
+                self.registry.counter(ROLLUP_EVICTIONS_COUNTER).inc()
+                for pk in [p for p in self._prev if p[0] == key]:
+                    del self._prev[pk]
+
+        counters: dict[str, float] = {}
+        gauges_parts: dict[str, list[float]] = {}
+        hist_parts: dict[str, list[Mapping[str, Any]]] = {}
+        for entry in self._cache.values():
+            target: Target = entry["target"]
+            scopes = target.scopes()
+            for part_id, snap in entry["parts"]:
+                for key, value in snap.get("counters", {}).items():
+                    prev = self._prev.get((target.key, part_id, key))
+                    delta = float(value) if prev is None \
+                        else max(float(value) - prev, 0.0)
+                    self._prev[(target.key, part_id, key)] = float(value)
+                    for scope in scopes:
+                        totals = self._totals.setdefault(scope, {})
+                        totals[key] = totals.get(key, 0.0) + delta
+                for key, value in snap.get("gauges", {}).items():
+                    for scope in scopes:
+                        gauges_parts.setdefault(
+                            _relabel(key, scope), []
+                        ).append(float(value))
+                for key, h in snap.get("histograms", {}).items():
+                    for scope in scopes:
+                        hist_parts.setdefault(
+                            _relabel(key, scope), []
+                        ).append(h)
+
+        for scope, totals in self._totals.items():
+            for key, value in totals.items():
+                counters[_relabel(key, scope)] = value
+        gauges = {
+            key: _GAUGE_FOLDS[_gauge_rule(parse_labeled_key(key)[0])](vals)
+            for key, vals in gauges_parts.items()
+        }
+        histograms: dict[str, Any] = {}
+        for key, parts in hist_parts.items():
+            try:
+                histograms[key] = merge_histograms(parts)
+            except ValueError:
+                self.registry.counter(ROLLUP_MERGE_CONFLICTS_COUNTER).inc()
+                log.warning(
+                    "rollup: dropping %s — mismatched histogram bucket "
+                    "boundaries across sources (refusing to misquantile)",
+                    key,
+                )
+        self._snapshot = {
+            "ts_ms": now,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": histograms,
+        }
+
+    def _tsdb_values(self, snapshot: Mapping[str, Any]) -> dict[str, float]:
+        """Caller holds the lock. The series batch one tick records:
+        every folded counter/gauge keyed ``<sample-key>|<scope>`` plus
+        p50/p95/p99 series per merged histogram."""
+        values: dict[str, float] = {}
+
+        def series_key(labeled: str) -> "tuple[str, str] | None":
+            name, labels = parse_labeled_key(labeled)
+            scope = labels.pop("scope", "")
+            if scope == "tenant":
+                scope = f"tenant:{labels.pop('tenant', '')}"
+            if not scope:
+                return None
+            base = _labeled_key(name, labels) if labels else name
+            return base, scope
+
+        for labeled, value in snapshot.get("counters", {}).items():
+            parsed = series_key(labeled)
+            if parsed:
+                values[f"{parsed[0]}|{parsed[1]}"] = value
+        for labeled, value in snapshot.get("gauges", {}).items():
+            parsed = series_key(labeled)
+            if parsed:
+                values[f"{parsed[0]}|{parsed[1]}"] = value
+        for labeled, h in snapshot.get("histograms", {}).items():
+            parsed = series_key(labeled)
+            if not parsed:
+                continue
+            for q, suffix in QUANTILES:
+                quantile = histogram_quantile(h, q)
+                if quantile is not None:
+                    values[f"{parsed[0]}:{suffix}|{parsed[1]}"] = quantile
+        return values
+
+    # -- SLO evaluation ----------------------------------------------------
+    def _evaluate_slos(self, now_ms: int) -> None:
+        for obj in self.objectives:
+            fast = self.tsdb.avg_over(obj.series, self.fast_window_s,
+                                      until_ms=now_ms)
+            slow = self.tsdb.avg_over(obj.series, self.slow_window_s,
+                                      until_ms=now_ms)
+            state: dict[str, Any] = {
+                "series": obj.series, "kind": obj.kind,
+                "target": obj.target, "fast": fast, "slow": slow,
+            }
+            if fast is None or slow is None:
+                # No data in a window: an absent fleet must not read as
+                # either "breached" or "all budget intact" — the gauges
+                # go quiet and the breach latch holds its state.
+                with self._lock:
+                    self._slo_state[obj.name] = state
+                continue
+            burn_fast = obj.burn(fast)
+            burn_slow = obj.burn(slow)
+            # Budget spent ≈ the slow window's overrun extrapolated over
+            # the budget period (an estimate, documented as such).
+            overrun = max(burn_slow - 1.0, 0.0)
+            remaining = max(
+                1.0 - overrun * (self.slow_window_s / self.budget_period_s),
+                0.0,
+            )
+            breached = (burn_fast > self.burn_threshold
+                        and burn_slow > self.burn_threshold)
+            state.update({
+                "burn_fast": round(burn_fast, 4),
+                "burn_slow": round(burn_slow, 4),
+                "budget_remaining": round(remaining, 6),
+                "breached": breached,
+            })
+            self.registry.gauge(
+                SLO_BURN_RATE_GAUGE, labels={"objective": obj.name}
+            ).set(burn_fast)
+            self.registry.gauge(
+                SLO_BUDGET_GAUGE, labels={"objective": obj.name}
+            ).set(remaining)
+            with self._lock:
+                was = obj.name in self._breached
+                if breached and not was:
+                    self._breached.add(obj.name)
+                elif not breached and was:
+                    self._breached.discard(obj.name)
+                self._slo_state[obj.name] = state
+            if breached and not was and self.events is not None:
+                # Edge-triggered, outside the lock (the sink is file
+                # I/O): one event per breach episode.
+                self.events.emit(
+                    events_mod.SLO_BURN,
+                    objective=obj.name,
+                    series=obj.series,
+                    target=obj.target,
+                    actual=round(fast, 6),
+                    burn_fast=round(burn_fast, 4),
+                    burn_slow=round(burn_slow, 4),
+                )
+
+    def _publish_self_metrics(self, n_targets: int, tick_s: float) -> None:
+        self.registry.gauge(ROLLUP_TARGETS_GAUGE).set(n_targets)
+        self.registry.gauge(ROLLUP_TICK_MS_GAUGE).set(
+            round(tick_s * 1000.0, 3)
+        )
+        self.registry.gauge(ROLLUP_SERIES_GAUGE).set(
+            self.tsdb.stats()["series"]
+        )
+
+    # -- read side ---------------------------------------------------------
+    def fleet_snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "ts_ms": self._snapshot["ts_ms"],
+                "counters": dict(self._snapshot["counters"]),
+                "gauges": dict(self._snapshot["gauges"]),
+                "histograms": dict(self._snapshot["histograms"]),
+            }
+
+    def prometheus_text(self) -> str:
+        """The one-scrape fleet view: every rolled-up series (scope- and
+        tenant-labeled) plus the rollup's own health and SLO gauges."""
+        seen: set[str] = set()
+        parts = [
+            render_prometheus(self.fleet_snapshot(), types_seen=seen),
+            render_prometheus(self.registry.snapshot(), types_seen=seen),
+        ]
+        return "".join(p for p in parts if p)
+
+    def query_series(
+        self,
+        name: str,
+        agg: str = "avg",
+        tenant: "str | None" = None,
+        since_s: int = 3600,
+        step_s: int = 60,
+        scope: "str | None" = None,
+    ) -> dict[str, Any]:
+        """The ``/api/query`` range read: ``name`` is a rolled-up sample
+        key (``tony_goodput_ratio``, ``tony_serving_ttft_ms:p95``);
+        ``tenant`` narrows to that tenant's scope, ``scope`` picks
+        cluster/fleet explicitly (default fleet)."""
+        if tenant:
+            resolved = f"tenant:{tenant}"
+        else:
+            resolved = scope or SCOPE_FLEET
+        key = f"{name}|{resolved}"
+        until = self.tsdb.latest_ms()
+        points = self.tsdb.query(
+            key, since_ms=until - max(int(since_s), 1) * 1000,
+            until_ms=until, step_s=step_s, agg=agg,
+        )
+        return {
+            "name": name, "scope": resolved, "agg": agg,
+            "step_s": int(step_s), "points": points,
+        }
+
+    def summary(self) -> dict[str, Any]:
+        """The ``/api/fleet/summary`` document (and ``tick()``'s return
+        value): live targets, per-target failure counts, SLO states,
+        store shape."""
+        with self._lock:
+            targets = [
+                {
+                    "key": key,
+                    "kind": entry["target"].kind,
+                    "tenant": entry["target"].tenant,
+                    "addr": entry["target"].addr,
+                    "age_ms": max(
+                        self._snapshot["ts_ms"]
+                        - int(entry.get("ok_ms") or 0), 0,
+                    ),
+                    "failures": self._target_failures.get(key, 0),
+                }
+                for key, entry in sorted(self._cache.items())
+            ]
+            slo = {name: dict(state)
+                   for name, state in sorted(self._slo_state.items())}
+            breached = sorted(self._breached)
+        return json_safe({
+            "ts_ms": self._snapshot["ts_ms"],
+            "targets": targets,
+            "target_failures": dict(self._target_failures),
+            "slo": slo,
+            "breached": breached,
+            "tsdb": self.tsdb.stats(),
+        })
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_background(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.wait(self.interval_ms / 1000.0):
+                try:
+                    self.tick()
+                except Exception:  # pragma: no cover - defensive
+                    log.warning("rollup tick failed", exc_info=True)
+
+        self._thread = threading.Thread(target=run, name="fleet-rollup",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            self._thread = None
+        self.tsdb.checkpoint()
+
+
+def _normalize(snap: Mapping[str, Any]) -> dict[str, Any]:
+    """Trust-boundary coercion for a scraped registry snapshot — the
+    aggregator's discipline applied to the rollup's own inputs."""
+    return {
+        "counters": _numeric_family(snap.get("counters")),
+        "gauges": _numeric_family(snap.get("gauges")),
+        "histograms": _histogram_family(snap.get("histograms")),
+    }
+
+
+def default_objectives(conf) -> "list[SloObjective]":
+    """The shipped objective set, from ``tony.slo.*``: fleet goodput
+    ratio floor, serving p95 TTFT ceiling, and an MFU floor (0 =
+    disabled, the default — absolute MFU varies too much across
+    hardware to ship a floor)."""
+    from tony_tpu.conf import keys
+
+    objectives: list[SloObjective] = []
+    if not conf.get_bool(keys.K_SLO_ENABLED, True):
+        return objectives
+    goodput_target = conf.get_float(keys.K_SLO_GOODPUT_RATIO_TARGET, 0.9)
+    if goodput_target > 0:
+        objectives.append(SloObjective(
+            "fleet_goodput_ratio", "tony_goodput_ratio|fleet",
+            "min", goodput_target,
+        ))
+    ttft_target = conf.get_float(keys.K_SLO_SERVING_TTFT_P95_MS, 2000.0)
+    if ttft_target > 0:
+        objectives.append(SloObjective(
+            "serving_ttft_p95", "tony_serving_ttft_ms:p95|fleet",
+            "max", ttft_target,
+        ))
+    mfu_floor = conf.get_float(keys.K_SLO_MFU_FLOOR, 0.0)
+    if mfu_floor > 0:
+        objectives.append(SloObjective(
+            "fleet_mfu_floor", "tony_mfu|fleet", "min", mfu_floor,
+        ))
+    return objectives

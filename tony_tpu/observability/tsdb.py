@@ -1,0 +1,379 @@
+"""Dependency-free multi-resolution time-series store — the rollup
+plane's retention layer.
+
+The per-job registries die with their coordinators; the fleet rollup
+(``observability/rollup.py``) records the folded series here so "what
+was my fleet's goodput an hour ago" has an answer after every job in
+that window is gone. Three resolutions, each with its own retention:
+
+    raw    — every recorded point, bounded by ``retention_raw_s``;
+    1m/10m — streaming downsample buckets ``[count, sum, min, max,
+             last]`` per 60 s / 600 s window, bounded by their own
+             retention horizons.
+
+Every ``record_many`` folds the points into all three resolutions on
+the way in (no batch re-downsample pass), so the store's memory is
+bounded by the retention horizons alone, never by uptime.
+
+Persistence (beside the history dir) follows ``scheduler/journal.py``'s
+discipline: appends go to ``tsdb-wal.jsonl`` one line per batch via a
+single ``O_APPEND`` write (worst crash artifact: one torn tail line the
+lenient loader skips), and ``checkpoint()`` snapshots the folded state
+to ``tsdb-chunks.json`` atomically (write-aside + ``os.replace``) then
+truncates the WAL. Restart = load chunks best-effort + replay WAL lines
+past the chunk watermark — a torn or missing file degrades to whatever
+the other half holds, never to a crash.
+
+Single-writer by design: ``record_many``/``checkpoint`` are called from
+the rollup tick thread only (the WAL append and checkpoint write happen
+outside the lock, so a second writer could interleave them); ``query``
+and the other readers are thread-safe from any thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+from collections import deque
+from pathlib import Path
+from typing import Any, Mapping
+
+from tony_tpu.analysis import sync_sanitizer as _sync
+
+log = logging.getLogger(__name__)
+
+CHUNKS_FILE = "tsdb-chunks.json"
+WAL_FILE = "tsdb-wal.jsonl"
+
+# Downsample bucket widths, seconds, finest first. Raw is "resolution 0".
+RESOLUTIONS_S = (60, 600)
+
+AGGS = ("avg", "sum", "min", "max", "last", "count")
+
+# Bucket cell layout (list, not dict: these dominate the on-disk bytes).
+_COUNT, _SUM, _MIN, _MAX, _LAST = range(5)
+
+
+def _fold_cell(cell: "list[float] | None", value: float) -> list[float]:
+    if cell is None:
+        return [1, value, value, value, value]
+    cell[_COUNT] += 1
+    cell[_SUM] += value
+    if value < cell[_MIN]:
+        cell[_MIN] = value
+    if value > cell[_MAX]:
+        cell[_MAX] = value
+    cell[_LAST] = value
+    return cell
+
+
+def _merge_cell(into: "list[float] | None", cell: list[float]) -> list[float]:
+    if into is None:
+        return list(cell)
+    into[_COUNT] += cell[_COUNT]
+    into[_SUM] += cell[_SUM]
+    into[_MIN] = min(into[_MIN], cell[_MIN])
+    into[_MAX] = max(into[_MAX], cell[_MAX])
+    into[_LAST] = cell[_LAST]
+    return into
+
+
+def _cell_agg(cell: list[float], agg: str) -> float:
+    if agg == "avg":
+        return cell[_SUM] / cell[_COUNT] if cell[_COUNT] else 0.0
+    if agg == "sum":
+        return cell[_SUM]
+    if agg == "min":
+        return cell[_MIN]
+    if agg == "max":
+        return cell[_MAX]
+    if agg == "count":
+        return cell[_COUNT]
+    return cell[_LAST]
+
+
+class TimeSeriesStore:
+    """Bounded in-memory store with WAL + chunk-snapshot persistence.
+
+    ``dir_path=None`` runs purely in memory (unit tests, bench)."""
+
+    def __init__(
+        self,
+        dir_path: "str | os.PathLike[str] | None" = None,
+        retention_raw_s: int = 3600,
+        retention_1m_s: int = 86400,
+        retention_10m_s: int = 604800,
+    ) -> None:
+        self.dir = Path(dir_path) if dir_path else None
+        self.retention_s = {
+            0: max(int(retention_raw_s), 1),
+            60: max(int(retention_1m_s), 1),
+            600: max(int(retention_10m_s), 1),
+        }
+        self._lock = _sync.make_lock("tsdb.TimeSeriesStore._lock")
+        # name -> deque of (ts_ms, value), append order == time order.
+        self._raw: dict[str, deque] = {}
+        # res_s -> name -> {bucket_start_s: [count, sum, min, max, last]}
+        self._buckets: dict[int, dict[str, dict[int, list[float]]]] = {
+            res: {} for res in RESOLUTIONS_S
+        }
+        self._latest_ms = 0
+        self._last_trim_minute = -1
+        if self.dir is not None:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            self._load()
+
+    # -- write path --------------------------------------------------------
+    def record_many(self, ts_ms: int, values: Mapping[str, float]) -> int:
+        """Record one batch of (series -> value) points stamped ``ts_ms``.
+        WAL-first (write-ahead), then the in-memory fold. Non-finite and
+        non-numeric values are dropped. Returns points recorded."""
+        ts_ms = int(ts_ms)
+        clean: dict[str, float] = {}
+        for name, value in values.items():
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            if math.isfinite(v):
+                clean[str(name)] = v
+        if not clean:
+            return 0
+        if self.dir is not None:
+            line = (json.dumps(
+                {"ts_ms": ts_ms, "values": clean}, sort_keys=True
+            ) + "\n").encode()
+            try:
+                fd = os.open(str(self.dir / WAL_FILE),
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    os.write(fd, line)
+                finally:
+                    os.close(fd)
+            except OSError:
+                log.warning("tsdb: WAL append failed", exc_info=True)
+        with self._lock:
+            self._fold(ts_ms, clean)
+            self._maybe_trim()
+        return len(clean)
+
+    def _fold(self, ts_ms: int, values: Mapping[str, float]) -> None:
+        """In-memory fold of one batch; caller holds the lock."""
+        self._latest_ms = max(self._latest_ms, ts_ms)
+        ts_s = ts_ms // 1000
+        for name, value in values.items():
+            self._raw.setdefault(name, deque()).append((ts_ms, value))
+            for res in RESOLUTIONS_S:
+                per_series = self._buckets[res].setdefault(name, {})
+                start = (ts_s // res) * res
+                per_series[start] = _fold_cell(per_series.get(start), value)
+
+    def _maybe_trim(self) -> None:
+        """Retention enforcement, at most once per minute of series time
+        (the bucket-key scan is O(total buckets)); raw deques trim from
+        the left every call (cheap). Caller holds the lock."""
+        horizon_ms = self._latest_ms - self.retention_s[0] * 1000
+        for dq in self._raw.values():
+            while dq and dq[0][0] < horizon_ms:
+                dq.popleft()
+        minute = self._latest_ms // 60000
+        if minute == self._last_trim_minute:
+            return
+        self._last_trim_minute = minute
+        latest_s = self._latest_ms // 1000
+        for res in RESOLUTIONS_S:
+            cutoff = latest_s - self.retention_s[res]
+            for per_series in self._buckets[res].values():
+                for start in [s for s in per_series if s + res <= cutoff]:
+                    del per_series[start]
+        for name in [n for n, dq in self._raw.items()
+                     if not dq and not any(self._buckets[res].get(name)
+                                           for res in RESOLUTIONS_S)]:
+            self._raw.pop(name, None)
+            for res in RESOLUTIONS_S:
+                self._buckets[res].pop(name, None)
+
+    # -- persistence -------------------------------------------------------
+    def checkpoint(self) -> None:
+        """Snapshot the folded state to ``tsdb-chunks.json`` (write-aside
+        + atomic replace) and truncate the WAL it supersedes. A reader
+        restarting mid-checkpoint sees either the old chunks + full WAL
+        or the new chunks + empty WAL — both replay to the same state."""
+        if self.dir is None:
+            return
+        with self._lock:
+            doc = {
+                "v": 1,
+                "watermark_ms": self._latest_ms,
+                "raw": {name: [[ts, v] for ts, v in dq]
+                        for name, dq in self._raw.items()},
+                "buckets": {
+                    str(res): {
+                        name: {str(start): list(cell)
+                               for start, cell in per_series.items()}
+                        for name, per_series in self._buckets[res].items()
+                    }
+                    for res in RESOLUTIONS_S
+                },
+            }
+        tmp = self.dir / (CHUNKS_FILE + ".tmp")
+        try:
+            tmp.write_text(json.dumps(doc, sort_keys=True))
+            os.replace(tmp, self.dir / CHUNKS_FILE)
+            (self.dir / WAL_FILE).write_text("")
+        except OSError:
+            log.warning("tsdb: checkpoint failed", exc_info=True)
+
+    def _load(self) -> None:
+        """Lenient restore: chunks best-effort, then WAL lines with
+        ``ts_ms`` past the chunk watermark replayed through the fold.
+        Malformed halves degrade, never crash (journal-style load)."""
+        try:
+            doc = json.loads((self.dir / CHUNKS_FILE).read_text())
+        except (OSError, ValueError):
+            doc = None
+        try:
+            wal_text = (self.dir / WAL_FILE).read_text(errors="replace")
+        except OSError:
+            wal_text = ""
+        with self._lock:
+            watermark = 0
+            if isinstance(doc, dict):
+                watermark = int(doc.get("watermark_ms") or 0)
+                self._latest_ms = watermark
+                for name, points in (doc.get("raw") or {}).items():
+                    if isinstance(points, list):
+                        self._raw[str(name)] = deque(
+                            (int(ts), float(v)) for ts, v in points
+                        )
+                for res in RESOLUTIONS_S:
+                    chunk = (doc.get("buckets") or {}).get(str(res)) or {}
+                    for name, per_series in chunk.items():
+                        if not isinstance(per_series, dict):
+                            continue
+                        self._buckets[res][str(name)] = {
+                            int(start): [float(x) for x in cell]
+                            for start, cell in per_series.items()
+                            if isinstance(cell, list) and len(cell) == 5
+                        }
+            for line in wal_text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or \
+                        not isinstance(rec.get("values"), dict):
+                    continue
+                ts_ms = int(rec.get("ts_ms") or 0)
+                if ts_ms <= watermark:
+                    continue  # already folded into the chunks snapshot
+                clean = {
+                    str(n): float(v) for n, v in rec["values"].items()
+                    if isinstance(v, (int, float)) and math.isfinite(v)
+                }
+                if clean:
+                    self._fold(ts_ms, clean)
+
+    # -- read path ---------------------------------------------------------
+    def names(self) -> list[str]:
+        with self._lock:
+            out = set(self._raw)
+            for res in RESOLUTIONS_S:
+                out.update(self._buckets[res])
+            return sorted(out)
+
+    def latest_ms(self) -> int:
+        with self._lock:
+            return self._latest_ms
+
+    def query(
+        self,
+        name: str,
+        since_ms: "int | None" = None,
+        until_ms: "int | None" = None,
+        step_s: int = 60,
+        agg: str = "avg",
+    ) -> list[list[float]]:
+        """Range read: ``[[bucket_start_ms, value], ...]`` ascending,
+        one row per ``step_s`` bucket that holds data. The resolution is
+        the finest whose retention still covers ``since_ms`` and whose
+        bucket width fits the step (raw for sub-minute steps over the
+        raw window, else 1m, else 10m)."""
+        if agg not in AGGS:
+            raise ValueError(f"unknown agg {agg!r} (want one of {AGGS})")
+        step_s = max(int(step_s), 1)
+        with self._lock:
+            until = self._latest_ms if until_ms is None else int(until_ms)
+            since = until - 3600 * 1000 if since_ms is None else int(since_ms)
+            res = self._pick_resolution(since, step_s)
+            cells: dict[int, list[float]] = {}
+            if res == 0:
+                for ts, v in self._raw.get(name, ()):
+                    if since <= ts <= until:
+                        start = (ts // 1000 // step_s) * step_s
+                        cells[start] = _fold_cell(cells.get(start), v)
+            else:
+                for start, cell in self._buckets[res].get(name, {}).items():
+                    if since <= start * 1000 <= until:
+                        out_start = (start // step_s) * step_s
+                        cells[out_start] = _merge_cell(
+                            cells.get(out_start), cell
+                        )
+            return [[start * 1000, _cell_agg(cells[start], agg)]
+                    for start in sorted(cells)]
+
+    def _pick_resolution(self, since_ms: int, step_s: int) -> int:
+        """Caller holds the lock. Finest resolution that can serve the
+        range: a step below a resolution's width cannot use it, and a
+        ``since`` past a resolution's retention horizon must coarsen."""
+        age_s = max((self._latest_ms - since_ms) // 1000, 0)
+        candidates = [0] + [r for r in RESOLUTIONS_S if r <= step_s]
+        for res in candidates:
+            if age_s <= self.retention_s[res]:
+                return res
+        return RESOLUTIONS_S[-1]
+
+    def avg_over(self, name: str, window_s: int,
+                 until_ms: "int | None" = None) -> "float | None":
+        """Time-weighted-enough mean of a series over a trailing window
+        (the SLO evaluator's primitive): the average of the window's
+        per-step averages; None when the window holds no data."""
+        until = self.latest_ms() if until_ms is None else int(until_ms)
+        window_s = max(int(window_s), 1)
+        step = 60 if window_s >= 600 else max(window_s // 10, 1)
+        rows = self.query(name, since_ms=until - window_s * 1000,
+                          until_ms=until, step_s=step, agg="avg")
+        if not rows:
+            return None
+        return sum(v for _, v in rows) / len(rows)
+
+    def stats(self) -> dict[str, Any]:
+        """Store-shape readout for bench/diagnostics."""
+        with self._lock:
+            raw_points = sum(len(dq) for dq in self._raw.values())
+            bucket_cells = sum(
+                len(per_series)
+                for res in RESOLUTIONS_S
+                for per_series in self._buckets[res].values()
+            )
+            names = set(self._raw)
+            for res in RESOLUTIONS_S:
+                names.update(self._buckets[res])
+        disk = 0
+        if self.dir is not None:
+            for fname in (CHUNKS_FILE, WAL_FILE):
+                try:
+                    disk += (self.dir / fname).stat().st_size
+                except OSError:
+                    pass
+        return {
+            "series": len(names),
+            "raw_points": raw_points,
+            "bucket_cells": bucket_cells,
+            "disk_bytes": disk,
+        }

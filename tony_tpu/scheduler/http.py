@@ -25,6 +25,8 @@ Routes::
     GET  /api/fleets   -> {"fleets": {name: status}}
     GET  /api/fleet/<name> -> one fleet status
     GET  /metrics      -> Prometheus text
+    GET  /api/metrics  -> the daemon registry's JSON snapshot (the
+                          fleet rollup collector's scrape shape)
     GET  /healthz      -> {"ok": true, ...}
 """
 
@@ -184,6 +186,11 @@ class SchedulerHttpServer:
                             200, d.registry.to_prometheus().encode(),
                             content_type="text/plain; version=0.0.4",
                         )
+                    elif self.path == "/api/metrics":
+                        # The fleet collector's scrape shape: the plain
+                        # registry snapshot (counters/gauges/histograms),
+                        # not Prometheus text — rollup folds JSON.
+                        self._reply(200, d.registry.snapshot())
                     elif self.path == "/api/state":
                         self._reply(200, d.state_json())
                     elif self.path == "/api/jobs":

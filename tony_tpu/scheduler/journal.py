@@ -81,9 +81,21 @@ class SchedulerJournal:
         self._lock = _sync.make_lock("journal.SchedulerJournal._lock")
         self._seq = 0
         self._since_rotate = 0
-        for rec in self.load(self.path):
-            self._seq = max(self._seq, int(rec.get("seq", 0)))
-            self._since_rotate += 1
+        self._oldest_ts_ms: int | None = None
+        records = self.load(self.path)
+        with self._lock:
+            for rec in records:
+                self._seq = max(self._seq, int(rec.get("seq", 0)))
+                self._since_rotate += 1
+                self._note_ts(rec)
+
+    def _note_ts(self, rec: Mapping[str, Any]) -> None:
+        """Track the oldest live record's timestamp (age-based rotation).
+        Caller holds the lock (or is single-threaded __init__)."""
+        ts = rec.get("ts_ms")
+        if isinstance(ts, int) and ts > 0:
+            if self._oldest_ts_ms is None or ts < self._oldest_ts_ms:
+                self._oldest_ts_ms = ts
 
     @property
     def last_seq(self) -> int:
@@ -94,6 +106,37 @@ class SchedulerJournal:
     def records_since_rotate(self) -> int:
         with self._lock:
             return self._since_rotate
+
+    def size_bytes(self) -> int:
+        """Current on-disk journal size (0 when the file does not exist
+        yet). Stat only — cheap enough for every publish."""
+        try:
+            return os.stat(self.path).st_size
+        except OSError:
+            return 0
+
+    def oldest_age_ms(self, now_ms: int) -> int:
+        """Age of the oldest live record, ms (0 when empty)."""
+        with self._lock:
+            if self._oldest_ts_ms is None:
+                return 0
+            return max(int(now_ms) - self._oldest_ts_ms, 0)
+
+    def needs_rotation(self, now_ms: int, max_records: int = 0,
+                       max_bytes: int = 0, max_age_ms: int = 0) -> bool:
+        """Automatic compaction policy: rotate when the live journal
+        exceeds ANY enabled bound — record count, on-disk bytes, or
+        oldest-record age (0 disables that dimension). Count alone is
+        not enough: a quiet fleet with fat records (or a long-lived one
+        with few transitions) can grow an unbounded recovery replay
+        while staying under the record cap."""
+        if max_records > 0 and self.records_since_rotate > max_records:
+            return True
+        if max_bytes > 0 and self.size_bytes() > max_bytes:
+            return True
+        if max_age_ms > 0 and self.oldest_age_ms(now_ms) > max_age_ms:
+            return True
+        return False
 
     def append(self, kind: str, ts_ms: int, **fields: Any) -> int:
         """Journal one transition BEFORE acting on it. Returns the
@@ -111,6 +154,7 @@ class SchedulerJournal:
             finally:
                 os.close(fd)
             self._since_rotate += 1
+            self._note_ts(rec)
             return self._seq
 
     def resync(self) -> int:
@@ -120,8 +164,10 @@ class SchedulerJournal:
         it. Returns the new last seq."""
         with self._lock:
             records = self.load(self.path)  # tony: noqa[TONY-T002] — takeover-only path; the read must exclude appends so the continued seq cannot collide
+            self._oldest_ts_ms = None
             for rec in records:
                 self._seq = max(self._seq, int(rec["seq"]))
+                self._note_ts(rec)
             self._since_rotate = len(records)
             return self._seq
 
@@ -140,6 +186,9 @@ class SchedulerJournal:
             ))
             tmp.replace(self.path)
             self._since_rotate = len(kept)
+            self._oldest_ts_ms = None
+            for r in kept:
+                self._note_ts(r)
             return len(kept)
 
     @staticmethod

@@ -429,6 +429,14 @@ class SchedulerDaemon:
         self._journal_max = self.conf.get_int(
             keys.K_SCHED_HA_JOURNAL_MAX, 4096
         )
+        # Size/age companions to the record-count threshold (0 = that
+        # dimension disabled): the journal rotates when ANY bound trips.
+        self._journal_max_bytes = self.conf.get_int(
+            keys.K_SCHED_JOURNAL_MAX_BYTES, 16777216
+        )
+        self._journal_max_age_ms = self.conf.get_int(
+            keys.K_SCHED_JOURNAL_MAX_AGE_MS, 86400000
+        )
         # Attempt ids whose goodput already folded into the tenant
         # accounts — the exactly-once guard across restarts.
         self._folded: set[str] = set()
@@ -1845,7 +1853,12 @@ class SchedulerDaemon:
         except OSError:
             log.warning("could not publish scheduler state", exc_info=True)
             return
-        if self.journal.records_since_rotate > self._journal_max:
+        if self.journal.needs_rotation(
+            int(state.get("ts_ms") or time.time() * 1000),
+            max_records=self._journal_max,
+            max_bytes=self._journal_max_bytes,
+            max_age_ms=self._journal_max_age_ms,
+        ):
             try:
                 self.journal.rotate(int(state.get("journal_seq", 0)))
             except OSError:

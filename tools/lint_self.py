@@ -95,6 +95,7 @@ def check_metric_names() -> list[str]:
     docs/DEPLOY.md."""
     from tony_tpu.analysis.metrics_lint import (
         check_declared_names,
+        check_label_cardinality,
         check_metric_names as check,
         check_observability_docs,
         parse_metric_trees,
@@ -102,7 +103,7 @@ def check_metric_names() -> list[str]:
 
     roots = [REPO / "tony_tpu", REPO / "examples", REPO / "tools",
              REPO / "bench.py"]
-    trees = parse_metric_trees(roots)  # one walk + parse for both rules
+    trees = parse_metric_trees(roots)  # one walk + parse for all rules
     findings = (
         check(roots, trees=trees)
         + check_declared_names(
@@ -111,6 +112,9 @@ def check_metric_names() -> list[str]:
         # TONY-M002 extension: step-anatomy phase label values and
         # health detector names must have DEPLOY.md rows too.
         + check_observability_docs(REPO / "docs" / "DEPLOY.md")
+        # TONY-M003: no label value fed from a per-occurrence id —
+        # unbounded label cardinality is a slow-motion registry leak.
+        + check_label_cardinality(roots, trees=trees)
     )
     return [f.render() for f in findings]
 
